@@ -1,0 +1,8 @@
+"""CPU ground-truth BLS12-381 cryptography (fields, curves, pairing, BLS).
+
+The correctness oracle for the JAX/TPU kernels in `lodestar_tpu.ops`, and
+the latency-critical CPU fallback verifier (the analog of the reference's
+`BlsSingleThreadVerifier`, packages/beacon-node/src/chain/bls/singleThread.ts).
+"""
+
+from . import bls, curves, fields, hash_to_curve, pairing  # noqa: F401
