@@ -98,8 +98,12 @@ def aggregate_signatures(sigs: Sequence[Affine]) -> Affine:
 def fast_aggregate_verify(
     pks: Sequence[Affine], msg: bytes, sig: Affine, dst: bytes = DST_G2
 ) -> bool:
-    """n pubkeys, one message, one aggregate signature (sync-committee shape)."""
-    if not pks:
+    """n pubkeys, one message, one aggregate signature (sync-committee shape).
+
+    KeyValidate (IETF BLS / blst): an infinity pubkey in the set fails the
+    whole verification — it must not be silently skipped.
+    """
+    if not pks or any(pk is None for pk in pks):
         return False
     return verify(aggregate_pubkeys(pks), msg, sig, dst)
 
@@ -168,6 +172,5 @@ def verify_bytes(pk48: bytes, msg: bytes, sig96: bytes) -> bool:
         sig = g2_decompress(sig96)
     except ValueError:
         return False
-    if pk is None or not g1_subgroup_check(pk):
-        return False
+    # verify() performs KeyValidate (None / on-curve / subgroup) itself.
     return verify(pk, msg, sig)

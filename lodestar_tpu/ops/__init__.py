@@ -1,0 +1,19 @@
+"""JAX/TPU kernels for BLS12-381 — the device-side compute path.
+
+Layering (each module only depends downward):
+
+    limbs.py    multi-limb uint32 bignum primitives (vector ops, no modulus)
+    fp.py       GF(p) in Montgomery form over the limb layer
+    tower.py    Fp2 / Fp6 / Fp12 extension towers (batched: one fused
+                Montgomery multiply per tower op)
+    curve.py    G1/G2 jacobian point arithmetic + scalar multiplication
+    pairing.py  optimal ate Miller loop + final exponentiation
+    bls_kernels.py  batched signature verification (random linear combination)
+
+Design: every op is shape-polymorphic over leading batch dims and contains
+no data-dependent Python control flow, so the whole verification pipeline
+jits into a single XLA program and shards over a `jax.sharding.Mesh` by
+splitting the signature-set batch axis (the TPU-native analog of the
+reference's `BlsMultiThreadWorkerPool` spreading jobs over CPU workers —
+reference: packages/beacon-node/src/chain/bls/multithread/index.ts:106).
+"""

@@ -1,0 +1,154 @@
+"""Multi-limb big-integer primitives for JAX on TPU.
+
+A 384-bit integer is represented as 24 little-endian limbs of 16 bits each,
+stored in a uint32 array of shape ``[..., 24]``.  16-bit limbs are chosen so
+that a limb product ``a_i * b_j`` is exact in uint32 (max (2^16-1)^2 < 2^32)
+and a full schoolbook column (48 half-products) still fits uint32
+(< 2^21.6) — i.e. everything maps onto the TPU VPU's native 32-bit integer
+lanes with no wide-multiply emulation.
+
+All functions are shape-polymorphic over leading batch dimensions and use
+only static (Python-time) loops over the limb index, so they trace into
+small fixed XLA graphs and vectorize over the batch.
+
+No modulus lives at this layer; see ``fp.py`` for GF(p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+N_LIMBS = 24  # 24 * 16 = 384 bits >= 381-bit field elements
+DTYPE = jnp.uint32
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (numpy; used for constants and test plumbing)
+# ---------------------------------------------------------------------------
+
+
+def to_limbs(x: int, n: int = N_LIMBS) -> np.ndarray:
+    """Python int -> little-endian uint32 limb array (host side)."""
+    assert 0 <= x < 1 << (LIMB_BITS * n), "value does not fit"
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)], dtype=np.uint32
+    )
+
+
+def from_limbs(arr) -> int:
+    """Limb array (last axis) -> Python int (host side)."""
+    a = np.asarray(arr, dtype=np.uint64)
+    assert a.ndim == 1, "from_limbs expects a single element"
+    out = 0
+    for i in range(a.shape[0] - 1, -1, -1):
+        out = (out << LIMB_BITS) | int(a[i])
+    return out
+
+
+def batch_to_limbs(xs, n: int = N_LIMBS) -> np.ndarray:
+    """List of ints -> uint32[len(xs), n]."""
+    return np.stack([to_limbs(x, n) for x in xs])
+
+
+def batch_from_limbs(arr) -> list:
+    """Limb array [..., n] -> flat list of Python ints (host side)."""
+    a = np.asarray(arr)
+    return [from_limbs(row) for row in a.reshape(-1, a.shape[-1])]
+
+
+# ---------------------------------------------------------------------------
+# Carry / borrow chains
+# ---------------------------------------------------------------------------
+
+
+def carry_prop(cols):
+    """Fold carries in a column vector (values < 2^31) into canonical limbs.
+
+    The final carry out of the top column is dropped — callers must ensure it
+    is zero (true for all uses here by construction).
+    """
+    out = []
+    carry = jnp.zeros(cols.shape[:-1], DTYPE)
+    for i in range(cols.shape[-1]):
+        t = cols[..., i] + carry
+        out.append(t & LIMB_MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def add_nocarryout(a, b):
+    """a + b where the sum fits the limb count.  Canonical inputs/output."""
+    return carry_prop(a + b)
+
+
+def sub_with_borrow(a, b):
+    """(a - b mod 2^(16n), borrow_out) — borrow_out is 1 where a < b."""
+    out = []
+    borrow = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), DTYPE)
+    for i in range(a.shape[-1]):
+        t = a[..., i] + jnp.uint32(1 << LIMB_BITS) - b[..., i] - borrow
+        out.append(t & LIMB_MASK)
+        borrow = jnp.uint32(1) - (t >> LIMB_BITS)
+    return jnp.stack(out, axis=-1), borrow
+
+
+def geq(a, b):
+    """Boolean mask: a >= b (canonical limbs)."""
+    _, borrow = sub_with_borrow(a, b)
+    return borrow == 0
+
+
+def cond_sub(a, m):
+    """a - m where a >= m, else a.  The standard modular-reduce step."""
+    d, borrow = sub_with_borrow(a, m)
+    return jnp.where((borrow == 0)[..., None], d, a)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Multiplication
+# ---------------------------------------------------------------------------
+
+
+def mul_full(a, b):
+    """Full product of two canonical n-limb numbers -> canonical 2n limbs.
+
+    Schoolbook with hi/lo half-product split; the i-loop is a static Python
+    unroll (24 iterations) of pure vector ops.
+    """
+    n = a.shape[-1]
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros((*batch, 2 * n), DTYPE)
+    for i in range(n):
+        p = a[..., i : i + 1] * b  # exact in uint32
+        acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
+        acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
+    return carry_prop(acc)
+
+
+def mul_low(a, b):
+    """Low half product: (a * b) mod 2^(16n) -> canonical n limbs."""
+    n = a.shape[-1]
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros((*batch, n), DTYPE)
+    for i in range(n):
+        p = a[..., i : i + 1] * b[..., : n - i]
+        acc = acc.at[..., i:].add(p & LIMB_MASK)
+        if i + 1 < n:
+            acc = acc.at[..., i + 1 :].add((p >> LIMB_BITS)[..., : n - i - 1])
+    return carry_prop(acc)
+
+
+# NOTE: no generic small-constant multiply lives here on purpose: k*a for a
+# near 2^381 overflows the 24-limb window, so modular small multiples are
+# built from reduced addition chains in fp.mul_small instead.

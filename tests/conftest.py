@@ -1,12 +1,26 @@
-"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+"""Test configuration: run the suite on a virtual 8-device CPU platform so
 multi-chip sharding paths compile and execute without TPU hardware (the
-driver separately dry-runs `__graft_entry__.dryrun_multichip`)."""
+driver separately dry-runs `__graft_entry__.dryrun_multichip`).
+
+The axon sitecustomize imports jax and registers the TPU backend before
+conftest runs, so env-var edits to `JAX_PLATFORMS` are too late; instead
+select the platform via `jax.config` (backend *clients* are created lazily,
+so this still takes effect).  `XLA_FLAGS` is amended before the CPU client
+exists for the same reason.
+
+Set LODESTAR_TPU_TEST_PLATFORM=tpu to intentionally run tests on the real
+chip instead.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import order is the point here)
+
+if os.environ.get("LODESTAR_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")

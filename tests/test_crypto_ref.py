@@ -209,6 +209,9 @@ class TestBls:
         agg_sig = bls.aggregate_signatures([bls.sign(sk, msg) for sk in sks])
         assert bls.fast_aggregate_verify(pks, msg, agg_sig)
         assert not bls.fast_aggregate_verify(pks[:3], msg, agg_sig)
+        # KeyValidate: an infinity pubkey in the set must fail, not be skipped
+        assert not bls.fast_aggregate_verify(pks + [None], msg, agg_sig)
+        assert not bls.fast_aggregate_verify([], msg, agg_sig)
 
     def test_verify_multiple_signatures(self):
         sets = []

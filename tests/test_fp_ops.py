@@ -1,0 +1,112 @@
+"""JAX Fp layer vs the pure-Python ground truth (`crypto.fields`).
+
+All device work is funneled through a handful of jitted composite functions
+so the suite pays a few compiles instead of per-op eager dispatch (the
+library is designed to run under an outer jit in production anyway).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.ops import fp, limbs as L
+
+rng = random.Random(0xB15)
+
+
+def rand_fp(n):
+    return [rng.randrange(GT.P) for _ in range(n)]
+
+
+RINV = pow(fp.R_INT, -1, GT.P)
+
+
+def enc(xs):
+    return jnp.asarray(np.stack([fp.const(x) for x in xs]))
+
+
+def dec(arr):
+    return [v * RINV % GT.P for v in L.batch_from_limbs(arr)]
+
+
+N = 16
+
+
+@jax.jit
+def _ring_suite(a, b):
+    return (
+        fp.mont_mul(a, b),
+        fp.add(a, b),
+        fp.sub(a, b),
+        fp.neg(a),
+        fp.sqr(a),
+        fp.is_zero(a),
+        fp.mul_small(a, 2),
+        fp.mul_small(a, 3),
+        fp.mul_small(a, 12),
+        fp.sgn(a),
+    )
+
+
+@jax.jit
+def _exp_suite(a, sq):
+    cand, ok = fp.sqrt(sq)
+    return fp.pow_static(a, 5), fp.inv(a), cand, ok
+
+
+def test_limb_roundtrip():
+    for x in rand_fp(8) + [0, 1, GT.P - 1]:
+        assert L.from_limbs(L.to_limbs(x)) == x
+
+
+def test_mul_full_low():
+    xs, ys = rand_fp(N), rand_fp(N)
+    a = jnp.asarray(L.batch_to_limbs(xs))
+    b = jnp.asarray(L.batch_to_limbs(ys))
+    full, low = jax.jit(lambda a, b: (L.mul_full(a, b), L.mul_low(a, b)))(a, b)
+    assert L.batch_from_limbs(full) == [x * y for x, y in zip(xs, ys)]
+    assert L.batch_from_limbs(low) == [x * y % (1 << 384) for x, y in zip(xs, ys)]
+
+
+def test_ring_ops():
+    xs = rand_fp(N - 4) + [0, 1, GT.P - 1, GT.P - 2]
+    ys = rand_fp(N - 4) + [GT.P - 1, 0, GT.P - 1, 1]
+    a, b = enc(xs), enc(ys)
+    mul, add_, sub_, neg_, sq, isz, m2, m3, m12, sg = _ring_suite(a, b)
+    assert dec(mul) == [x * y % GT.P for x, y in zip(xs, ys)]
+    assert dec(add_) == [(x + y) % GT.P for x, y in zip(xs, ys)]
+    assert dec(sub_) == [(x - y) % GT.P for x, y in zip(xs, ys)]
+    assert dec(neg_) == [(-x) % GT.P for x in xs]
+    assert dec(sq) == [x * x % GT.P for x in xs]
+    assert list(np.asarray(isz)) == [x == 0 for x in xs]
+    assert dec(m2) == [2 * x % GT.P for x in xs]
+    assert dec(m3) == [3 * x % GT.P for x in xs]
+    assert dec(m12) == [12 * x % GT.P for x in xs]
+    assert [int(v) for v in np.asarray(sg)] == [GT.fp_sgn(x) if x else 0 for x in xs]
+
+
+def test_exp_ops():
+    xs = rand_fp(4)
+    sq = [x * x % GT.P for x in xs]
+    p5, invs, cand, ok = _exp_suite(enc(xs), enc(sq))
+    assert dec(p5) == [pow(x, 5, GT.P) for x in xs]
+    assert dec(invs) == [GT.fp_inv(x) for x in xs]
+    assert all(np.asarray(ok))
+    for got, want in zip(dec(cand), sq):
+        assert got * got % GT.P == want
+    # non-residues: for p = 3 mod 4, -x^2 is never a QR (x != 0)
+    nonres = [(GT.P - x * x) % GT.P for x in xs]
+    _, _, _, ok2 = _exp_suite(enc(xs), enc(nonres))
+    assert not any(np.asarray(ok2))
+
+
+def test_to_from_mont():
+    xs = rand_fp(N)
+    plain = jnp.asarray(L.batch_to_limbs(xs))
+    back = jax.jit(lambda a: fp.from_mont(fp.to_mont(a)))(plain)
+    assert L.batch_from_limbs(back) == xs
