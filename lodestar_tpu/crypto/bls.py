@@ -100,11 +100,15 @@ def fast_aggregate_verify(
 ) -> bool:
     """n pubkeys, one message, one aggregate signature (sync-committee shape).
 
-    KeyValidate (IETF BLS / blst): an infinity pubkey in the set fails the
-    whole verification — it must not be silently skipped.
+    KeyValidate (IETF BLS / blst) applies per pubkey: infinity, off-curve,
+    or out-of-subgroup members fail the whole verification even when their
+    torsion components would cancel in the aggregate.
     """
     if not pks or any(pk is None for pk in pks):
         return False
+    for pk in pks:
+        if not (is_on_curve(FP_OPS, pk) and g1_subgroup_check(pk)):
+            return False
     return verify(aggregate_pubkeys(pks), msg, sig, dst)
 
 

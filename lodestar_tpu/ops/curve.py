@@ -1,0 +1,363 @@
+"""Branchless jacobian point arithmetic for G1 (Fp) and G2 (Fp2) in JAX.
+
+Points are pytree triples ``(X, Y, Z)`` of field elements; infinity is
+encoded as ``Z == 0`` so every formula is data-parallel (no Python branches
+on values — all exceptional cases resolve through `select`).  One generic
+implementation is shared by both groups via a tiny field-ops record, the
+same structure as the ground truth (`crypto.curves.FieldOps`).
+
+This layer provides what the reference gets from blst point ops:
+  - scalar multiplication (the `r_i * pk_i` / `r_i * sig_i` randomization of
+    batch verification — reference: chain/bls/maybeBatch.ts:16-27),
+  - batched point aggregation (`PublicKey.aggregate` for aggregate-type
+    signature sets — reference: chain/bls/utils.ts:5-16),
+  - subgroup membership checks (blst KeyValidate / sig group check).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+from jax import tree_util
+
+from ..crypto import fields as GT
+from . import fp, fp2
+from . import limbs as L
+
+# ---------------------------------------------------------------------------
+# Field-ops records
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FieldOps:
+    name: str
+    add: Callable
+    sub: Callable
+    mul: Callable
+    sqr: Callable
+    neg: Callable
+    inv: Callable
+    eq: Callable
+    is_zero: Callable
+    select: Callable
+    mul_small: Callable
+    const: Callable            # host: ground-truth value -> device constant
+    decode: Callable           # host: device element -> ground-truth value
+    broadcast_to: Callable
+    zero_c: Any                # host-side constants (numpy)
+    one_c: Any
+    b_c: Any                   # curve b coefficient
+
+
+def _fp_broadcast(a, batch):
+    return jnp.broadcast_to(a, (*batch, L.N_LIMBS))
+
+
+FP_OPS = FieldOps(
+    name="fp",
+    add=fp.add, sub=fp.sub, mul=fp.mont_mul, sqr=fp.sqr, neg=fp.neg,
+    inv=fp.inv, eq=fp.eq, is_zero=fp.is_zero, select=fp.select,
+    mul_small=fp.mul_small, const=fp.const, decode=fp.decode,
+    broadcast_to=_fp_broadcast,
+    zero_c=fp.ZERO, one_c=fp.MONT_ONE, b_c=fp.const(4),
+)
+
+FP2_OPS = FieldOps(
+    name="fp2",
+    add=fp2.add, sub=fp2.sub, mul=fp2.mul, sqr=fp2.sqr, neg=fp2.neg,
+    inv=fp2.inv, eq=fp2.eq, is_zero=fp2.is_zero, select=fp2.select,
+    mul_small=fp2.mul_small, const=fp2.const, decode=fp2.decode,
+    broadcast_to=fp2.broadcast_to,
+    zero_c=fp2.ZERO, one_c=fp2.ONE, b_c=fp2.const(GT.fp2_mul_fp(GT.XI, 4)),
+)
+
+
+# ---------------------------------------------------------------------------
+# Host-side point encode/decode (ground-truth affine <-> device jacobian)
+# ---------------------------------------------------------------------------
+
+
+def point_const(fo: FieldOps, pt):
+    """Ground-truth affine point (or None) -> host-side jacobian constant."""
+    if pt is None:
+        return (fo.one_c, fo.one_c, fo.zero_c)
+    return (fo.const(pt[0]), fo.const(pt[1]), fo.one_c)
+
+
+def batch_points(fo: FieldOps, pts):
+    """List of ground-truth affine points -> batched device jacobian point."""
+    consts = [point_const(fo, p) for p in pts]
+    return tree_util.tree_map(lambda *xs: jnp.asarray(np.stack(xs)), *consts)
+
+
+def decode_point(fo: FieldOps, pt):
+    """Device jacobian point (single element) -> ground-truth affine/None."""
+    X, Y, Z = tree_util.tree_map(np.asarray, pt)
+    z = fo.decode(Z)
+    if _gt_is_zero(z):
+        return None
+    x, y = fo.decode(X), fo.decode(Y)
+    zi = _gt_inv(z)
+    zi2 = _gt_mul(zi, zi)
+    return (_gt_mul(x, zi2), _gt_mul(y, _gt_mul(zi2, zi)))
+
+
+def decode_points(fo: FieldOps, pt):
+    """Device jacobian point with one leading batch axis -> list of affine."""
+    n = tree_util.tree_leaves(pt)[0].shape[0]
+    return [
+        decode_point(fo, tree_util.tree_map(lambda a: a[i], pt))
+        for i in range(n)
+    ]
+
+
+def _gt_is_zero(v):
+    return v == 0 if isinstance(v, int) else GT.fp2_is_zero(v)
+
+
+def _gt_inv(v):
+    return GT.fp_inv(v) if isinstance(v, int) else GT.fp2_inv(v)
+
+
+def _gt_mul(a, b):
+    return a * b % GT.P if isinstance(a, int) else GT.fp2_mul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Core jacobian formulas (branchless)
+# ---------------------------------------------------------------------------
+
+
+def infinity(fo: FieldOps, batch=()):
+    one = fo.broadcast_to(jnp.asarray(fo.one_c) if fo.name == "fp" else tuple(map(jnp.asarray, fo.one_c)), batch)
+    zero = fo.broadcast_to(jnp.asarray(fo.zero_c) if fo.name == "fp" else tuple(map(jnp.asarray, fo.zero_c)), batch)
+    return (one, one, zero)
+
+
+def is_infinity(fo: FieldOps, p):
+    return fo.is_zero(p[2])
+
+
+def jac_dbl(fo: FieldOps, p):
+    """2P.  Valid for all inputs incl. infinity (Z=0 propagates)."""
+    X, Y, Z = p
+    A = fo.sqr(X)
+    B = fo.sqr(Y)
+    C = fo.sqr(B)
+    # D = 2*((X+B)^2 - A - C) = 4*X*B
+    D = fo.mul_small(fo.sub(fo.sub(fo.sqr(fo.add(X, B)), A), C), 2)
+    E = fo.mul_small(A, 3)
+    F = fo.sqr(E)
+    X3 = fo.sub(F, fo.mul_small(D, 2))
+    Y3 = fo.sub(fo.mul(E, fo.sub(D, X3)), fo.mul_small(C, 8))
+    Z3 = fo.mul_small(fo.mul(Y, Z), 2)
+    return (X3, Y3, Z3)
+
+
+def jac_add(fo: FieldOps, p, q):
+    """P + Q, branchless over all exceptional cases."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = fo.sqr(Z1)
+    Z2Z2 = fo.sqr(Z2)
+    U1 = fo.mul(X1, Z2Z2)
+    U2 = fo.mul(X2, Z1Z1)
+    S1 = fo.mul(fo.mul(Y1, Z2), Z2Z2)
+    S2 = fo.mul(fo.mul(Y2, Z1), Z1Z1)
+    H = fo.sub(U2, U1)
+    Rr = fo.sub(S2, S1)
+    # generic chord addition
+    I = fo.sqr(fo.mul_small(H, 2))
+    J = fo.mul(H, I)
+    Rr2 = fo.mul_small(Rr, 2)
+    V = fo.mul(U1, I)
+    X3 = fo.sub(fo.sub(fo.sqr(Rr2), J), fo.mul_small(V, 2))
+    Y3 = fo.sub(
+        fo.mul(Rr2, fo.sub(V, X3)), fo.mul_small(fo.mul(S1, J), 2)
+    )
+    Z3 = fo.mul_small(fo.mul(fo.mul(Z1, Z2), H), 2)
+    generic = (X3, Y3, Z3)
+
+    p_inf = fo.is_zero(Z1)
+    q_inf = fo.is_zero(Z2)
+    same_x = fo.is_zero(H)
+    same_y = fo.is_zero(Rr)
+    # exceptional resolutions, innermost first:
+    #   same x, same y  -> doubling
+    #   same x, diff y  -> infinity
+    dbl = jac_dbl(fo, p)
+    inf = tuple(
+        fo.broadcast_to(c, _batch_of(fo, Z1))
+        for c in _const_tuple(fo)
+    )
+    out = _sel3(fo, same_x & same_y, dbl, _sel3(fo, same_x, inf, generic))
+    out = _sel3(fo, q_inf, p, out)
+    out = _sel3(fo, p_inf, q, out)
+    return out
+
+
+def _const_tuple(fo: FieldOps):
+    if fo.name == "fp":
+        return (jnp.asarray(fo.one_c), jnp.asarray(fo.one_c), jnp.asarray(fo.zero_c))
+    one = tuple(map(jnp.asarray, fo.one_c))
+    zero = tuple(map(jnp.asarray, fo.zero_c))
+    return (one, one, zero)
+
+
+def _batch_of(fo: FieldOps, z):
+    leaf = z if fo.name == "fp" else z[0]
+    return leaf.shape[:-1]
+
+
+def _sel3(fo: FieldOps, cond, a, b):
+    return tuple(fo.select(cond, x, y) for x, y in zip(a, b))
+
+
+def jac_neg(fo: FieldOps, p):
+    return (p[0], fo.neg(p[1]), p[2])
+
+
+def jac_eq(fo: FieldOps, p, q):
+    """Equality of jacobian points (cross-multiplied, infinity-aware)."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = fo.sqr(Z1)
+    Z2Z2 = fo.sqr(Z2)
+    ex = fo.eq(fo.mul(X1, Z2Z2), fo.mul(X2, Z1Z1))
+    ey = fo.eq(
+        fo.mul(Y1, fo.mul(Z2, Z2Z2)), fo.mul(Y2, fo.mul(Z1, Z1Z1))
+    )
+    p_inf = fo.is_zero(Z1)
+    q_inf = fo.is_zero(Z2)
+    return jnp.where(p_inf | q_inf, p_inf & q_inf, ex & ey)
+
+
+def to_affine(fo: FieldOps, p):
+    """((x, y), inf_mask).  x = y = 0 where inf_mask is set."""
+    X, Y, Z = p
+    inf = fo.is_zero(Z)
+    zi = fo.inv(Z)  # inv(0) = 0 in our field layers
+    zi2 = fo.sqr(zi)
+    return (fo.mul(X, zi2), fo.mul(Y, fo.mul(zi2, zi))), inf
+
+
+def is_on_curve(fo: FieldOps, p):
+    """y^2 = x^3 + b in jacobian form: Y^2 = X^3 + b*Z^6 (infinity passes)."""
+    X, Y, Z = p
+    z2 = fo.sqr(Z)
+    z6 = fo.mul(fo.sqr(z2), z2)
+    b = _broadcast_const(fo, fo.b_c, _batch_of(fo, Z))
+    rhs = fo.add(fo.mul(fo.sqr(X), X), fo.mul(b, z6))
+    return fo.eq(fo.sqr(Y), rhs) | fo.is_zero(Z)
+
+
+def _broadcast_const(fo: FieldOps, c, batch):
+    if fo.name == "fp":
+        return fo.broadcast_to(jnp.asarray(c), batch)
+    return fo.broadcast_to(tuple(map(jnp.asarray, c)), batch)
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+def scalar_mul_static(fo: FieldOps, p, k: int):
+    """k * P for a static Python scalar (shared by the whole batch).
+
+    Left-to-right double-and-add as a `fori_loop` over the bit table, so the
+    graph holds one loop body regardless of scalar size (255-bit subgroup
+    scalars included).
+    """
+    if k < 0:
+        return scalar_mul_static(fo, jac_neg(fo, p), -k)
+    batch = _batch_of(fo, p[2])
+    if k == 0:
+        return infinity(fo, batch)
+    bits = jnp.asarray(
+        np.array([int(c) for c in bin(k)[2:]], dtype=np.uint32)
+    )
+
+    def body(i, acc):
+        acc = jac_dbl(fo, acc)
+        added = jac_add(fo, acc, p)
+        return _sel3(fo, bits[i] == 1, added, acc)
+
+    return lax.fori_loop(0, bits.shape[0], body, infinity(fo, batch))
+
+
+def scalar_mul_bits(fo: FieldOps, p, bits):
+    """Per-element dynamic scalars: ``bits`` is uint32[nbits, *batch],
+    MSB-first, one bit-plane per step (bit-major so the loop index is the
+    leading axis — a cheap dynamic slice).
+    """
+    nbits = bits.shape[0]
+    batch = _batch_of(fo, p[2])
+
+    def body(i, acc):
+        acc = jac_dbl(fo, acc)
+        added = jac_add(fo, acc, p)
+        return _sel3(fo, bits[i] == 1, added, acc)
+
+    return lax.fori_loop(0, nbits, body, infinity(fo, batch))
+
+
+def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
+    """Host: list/array of ints -> uint32[nbits, n] MSB-first bit planes."""
+    out = np.zeros((nbits, len(scalars)), dtype=np.uint32)
+    for j, s in enumerate(scalars):
+        for i in range(nbits):
+            out[nbits - 1 - i, j] = (int(s) >> i) & 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched aggregation (sum over a leading axis)
+# ---------------------------------------------------------------------------
+
+
+def sum_points(fo: FieldOps, p, valid=None):
+    """Sum points along the leading batch axis by halving tree reduction.
+
+    `valid` (bool[n, ...]) masks entries; masked slots contribute infinity.
+    log2(n) rounds of pairwise jac_add — each round is fully data-parallel,
+    which is the TPU replacement for blst's sequential `PublicKey.aggregate`
+    loop (reference: chain/bls/utils.ts:5-16).
+    """
+    if valid is not None:
+        inf = infinity(fo, _batch_of(fo, p[2]))
+        p = _sel3(fo, valid, p, inf)
+    n = tree_util.tree_leaves(p)[0].shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        lo = tree_util.tree_map(lambda a: a[:half], p)
+        hi = tree_util.tree_map(lambda a: a[half:], p)
+        if n % 2 == 1:  # pad the odd tail with infinity
+            rest = _batch_of(fo, hi[2])[1:]
+            pad = infinity(fo, (1, *rest))
+            hi = tree_util.tree_map(
+                lambda h, z: jnp.concatenate([h, z], axis=0), hi, pad
+            )
+        p = jac_add(fo, lo, hi)
+        n = half
+    return tree_util.tree_map(lambda a: a[0], p)
+
+
+# ---------------------------------------------------------------------------
+# Subgroup checks
+# ---------------------------------------------------------------------------
+
+
+def in_subgroup(fo: FieldOps, p):
+    """r*P == O — the direct order check (blst KeyValidate equivalent).
+
+    Correct for any on-curve point; the endomorphism-accelerated versions
+    (GLV for G1, psi for G2) are a later optimization on top of this oracle.
+    """
+    return is_infinity(fo, scalar_mul_static(fo, p, GT.R))

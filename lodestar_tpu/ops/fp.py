@@ -1,6 +1,6 @@
 """GF(p) for BLS12-381 in Montgomery form, on the JAX limb layer.
 
-Elements are uint32[..., 24] canonical limb arrays holding a*R mod p with
+Elements are uint32[..., N_LIMBS] canonical limb arrays holding a*R mod p with
 R = 2^384 (Montgomery form).  The multiply is the classic three-product
 REDC — full product, low product with -p^-1, full product with p — which
 costs 3 schoolbook multiplies of pure uint32 vector ops and therefore
@@ -58,8 +58,8 @@ def mont_mul(a, b):
     t = L.mul_full(a, b)
     m = L.mul_low(t[..., : L.N_LIMBS], jnp.asarray(NPRIME_LIMBS))
     u = L.mul_full(m, jnp.asarray(P_LIMBS))
-    # t + u == 0 mod 2^384 by construction; carry_prop runs over all 48
-    # columns so the low half's final carry lands in limb 24, and the high
+    # t + u == 0 mod 2^384 by construction; carry_prop runs over all 2n
+    # columns so the low half's final carry lands in limb n, and the high
     # half is then the REDC result (< 2p, one conditional subtract).
     s = L.carry_prop(t + u)
     return L.cond_sub(s[..., L.N_LIMBS :], jnp.asarray(P_LIMBS))
@@ -155,7 +155,7 @@ def sqrt(a):
 def sgn(a):
     """1 where a > p - a (matches ZCash compressed-y ordering), else 0."""
     # In Montgomery form comparisons are meaningless; decode via REDC first.
-    plain = mont_mul(a, jnp.asarray(ONE_LIMBS))
+    plain = from_mont(a)
     doubled = L.add_nocarryout(plain, plain)
     return jnp.where(L.geq(doubled, jnp.asarray(P_LIMBS)) & ~L.is_zero(plain), 1, 0).astype(jnp.uint32)
 
@@ -163,6 +163,12 @@ def sgn(a):
 # ---------------------------------------------------------------------------
 # Boundary conversions (device side)
 # ---------------------------------------------------------------------------
+
+
+def broadcast_to_limbs(batch, c=None):
+    """Broadcast a host limb constant (default: Montgomery 1) to batch dims."""
+    arr = jnp.asarray(MONT_ONE if c is None else c)
+    return jnp.broadcast_to(arr, (*batch, L.N_LIMBS))
 
 
 def to_mont(a_plain):

@@ -1,0 +1,312 @@
+"""Fp6 = Fp2[v]/(v^3 - xi) and Fp12 = Fp6[w]/(w^2 - v) in JAX.
+
+Elements are nested pytrees mirroring the ground truth (`crypto.fields`):
+
+    Fp6  : (Fp2, Fp2, Fp2)
+    Fp12 : (Fp6, Fp6)
+
+with Fp2 = (c0, c1) Montgomery limb arrays.  Includes the pairing-specific
+machinery on top of the generic tower:
+
+  - Frobenius maps (precomputed gamma constants, Montgomery form),
+  - sparse multiplication by Miller-loop line values (shape c0=(a,0,0),
+    c1=(0,b,c) under the D-type untwist used by `crypto.pairing.untwist`),
+  - cyclotomic conjugation-inverse (valid after the easy final-exp part).
+
+This is the Fp12 arithmetic that blst runs in assembly inside its pairing
+(reference: the `@chainsafe/blst` dependency, consumed by
+packages/beacon-node/src/chain/bls/multithread/worker.ts:52-87).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto import fields as GT
+from . import fp, fp2
+
+Fp6 = tuple
+Fp12 = tuple
+
+
+# ---------------------------------------------------------------------------
+# Host-side constants / conversions
+# ---------------------------------------------------------------------------
+
+
+def const6(x) -> tuple:
+    return tuple(fp2.const(c) for c in x)
+
+
+def const12(x) -> tuple:
+    return (const6(x[0]), const6(x[1]))
+
+
+def decode6(a) -> tuple:
+    return tuple(fp2.decode(c) for c in a)
+
+
+def decode12(a) -> tuple:
+    return (decode6(a[0]), decode6(a[1]))
+
+
+def stack_consts12(xs) -> tuple:
+    """List of ground-truth Fp12 values -> batched device constant."""
+    import jax
+
+    consts = [const12(x) for x in xs]
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.asarray(np.stack(leaves)), *consts
+    )
+
+
+SIX_ZERO = const6(GT.FP6_ZERO)
+SIX_ONE = const6(GT.FP6_ONE)
+TWELVE_ONE = const12(GT.FP12_ONE)
+
+
+def one12(batch=()) -> Fp12:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda c: jnp.broadcast_to(jnp.asarray(c), (*batch, c.shape[-1])),
+        TWELVE_ONE,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fp6
+# ---------------------------------------------------------------------------
+
+
+def add6(a, b):
+    return tuple(fp2.add(x, y) for x, y in zip(a, b))
+
+
+def sub6(a, b):
+    return tuple(fp2.sub(x, y) for x, y in zip(a, b))
+
+
+def neg6(a):
+    return tuple(fp2.neg(x) for x in a)
+
+
+def mul6(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2.mul(a0, b0)
+    t1 = fp2.mul(a1, b1)
+    t2 = fp2.mul(a2, b2)
+    c0 = fp2.add(
+        t0,
+        fp2.mul_xi(
+            fp2.sub(
+                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b1, b2)), t1), t2
+            )
+        ),
+    )
+    c1 = fp2.add(
+        fp2.sub(
+            fp2.sub(fp2.mul(fp2.add(a0, a1), fp2.add(b0, b1)), t0), t1
+        ),
+        fp2.mul_xi(t2),
+    )
+    c2 = fp2.add(
+        fp2.sub(
+            fp2.sub(fp2.mul(fp2.add(a0, a2), fp2.add(b0, b2)), t0), t2
+        ),
+        t1,
+    )
+    return (c0, c1, c2)
+
+
+def sqr6(a):
+    return mul6(a, a)
+
+
+def mul6_by_v(a):
+    """(a0 + a1 v + a2 v^2) * v = xi*a2 + a0 v + a1 v^2."""
+    return (fp2.mul_xi(a[2]), a[0], a[1])
+
+
+def mul6_fp2(a, k):
+    return tuple(fp2.mul(x, k) for x in a)
+
+
+def inv6(a):
+    a0, a1, a2 = a
+    c0 = fp2.sub(fp2.sqr(a0), fp2.mul_xi(fp2.mul(a1, a2)))
+    c1 = fp2.sub(fp2.mul_xi(fp2.sqr(a2)), fp2.mul(a0, a1))
+    c2 = fp2.sub(fp2.sqr(a1), fp2.mul(a0, a2))
+    t = fp2.add(
+        fp2.mul_xi(fp2.add(fp2.mul(a2, c1), fp2.mul(a1, c2))),
+        fp2.mul(a0, c0),
+    )
+    tinv = fp2.inv(t)
+    return (fp2.mul(c0, tinv), fp2.mul(c1, tinv), fp2.mul(c2, tinv))
+
+
+def eq6(a, b):
+    out = fp2.eq(a[0], b[0])
+    for x, y in zip(a[1:], b[1:]):
+        out = out & fp2.eq(x, y)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+
+def mul12(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = mul6(a0, b0)
+    t1 = mul6(a1, b1)
+    c0 = add6(t0, mul6_by_v(t1))
+    c1 = sub6(sub6(mul6(add6(a0, a1), add6(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def sqr12(a):
+    """Complex squaring: 2 Fp6 muls instead of mul12's 3."""
+    a0, a1 = a
+    t = mul6(a0, a1)
+    c0 = sub6(
+        sub6(mul6(add6(a0, a1), add6(a0, mul6_by_v(a1))), t), mul6_by_v(t)
+    )
+    c1 = add6(t, t)
+    return (c0, c1)
+
+
+def conj12(a):
+    """x -> x^(p^6): negate the w part."""
+    return (a[0], neg6(a[1]))
+
+
+def inv12(a):
+    a0, a1 = a
+    t = sub6(sqr6(a0), mul6_by_v(sqr6(a1)))
+    tinv = inv6(t)
+    return (mul6(a0, tinv), neg6(mul6(a1, tinv)))
+
+
+def eq12(a, b):
+    return eq6(a[0], b[0]) & eq6(a[1], b[1])
+
+
+def is_one12(a):
+    import jax
+
+    one = jax.tree_util.tree_map(
+        lambda leaf, c: jnp.broadcast_to(jnp.asarray(c), leaf.shape),
+        a,
+        TWELVE_ONE,
+    )
+    return eq12(a, one)
+
+
+def select12(cond, x, y):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l, r: jnp.where(cond[..., None], l, r), x, y
+    )
+
+
+# ---------------------------------------------------------------------------
+# Frobenius (precomputed gammas, Montgomery form)
+# ---------------------------------------------------------------------------
+
+# gamma[k] = xi^(k*(p-1)/6), k = 0..5 — same table as the ground truth.
+_GAMMA1_C = [fp2.const(g) for g in GT._GAMMA]
+# Second-power table: gamma2[k] = gamma1[k] * conj-twisted — derived on the
+# ground truth side to stay bit-exact: x^(p^2) coefficient for slot k.
+_GAMMA2_C = [
+    fp2.const(GT.fp2_mul(GT.fp2_conj(g), g)) for g in GT._GAMMA
+]
+
+
+def _frob_fp6(a, j: int, gammas):
+    out = []
+    for i in range(3):
+        k = 2 * i + j
+        out.append(fp2.mul(fp2.conj(a[i]), _as_dev(gammas[k])))
+    return tuple(out)
+
+
+def _frob2_fp6(a, j: int):
+    # p^2-Frobenius: conjugation applied twice = identity on Fp2; only the
+    # gamma2 scaling remains.
+    out = []
+    for i in range(3):
+        k = 2 * i + j
+        out.append(fp2.mul(a[i], _as_dev(_GAMMA2_C[k])))
+    return tuple(out)
+
+
+def _as_dev(c):
+    return tuple(map(jnp.asarray, c))
+
+
+def frobenius12(a, power: int = 1):
+    """x -> x^(p^power) for power in {1, 2, 3}."""
+    if power == 1:
+        return (_frob_fp6(a[0], 0, _GAMMA1_C), _frob_fp6(a[1], 1, _GAMMA1_C))
+    if power == 2:
+        return (_frob2_fp6(a[0], 0), _frob2_fp6(a[1], 1))
+    if power == 3:
+        return frobenius12(frobenius12(a, 2), 1)
+    raise ValueError("unsupported Frobenius power")
+
+
+# ---------------------------------------------------------------------------
+# Sparse multiplication by a Miller line value
+# ---------------------------------------------------------------------------
+
+
+def mul12_by_line(f, l00, l11, l12):
+    """f * L where L = (c0=(l00,0,0), c1=(0,l11,l12)) — the sparse shape
+    produced by the D-type untwist line evaluation (see ops/pairing.py).
+
+    Costs 13 Fp2 muls vs mul12's 18: c0-part is an Fp6 scale by l00; the
+    c1-part is a sparse Fp6 mul by (0, l11, l12) done by hand.
+    """
+    f0, f1 = f
+    b = (l11, l12)
+
+    def sparse6(a):
+        # a * (0 + b0 v + b1 v^2), a = (a0, a1, a2)
+        a0, a1, a2 = a
+        t1 = fp2.mul(a1, b[0])
+        t2 = fp2.mul(a2, b[1])
+        c0 = fp2.mul_xi(
+            fp2.sub(
+                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b[0], b[1])), t1),
+                t2,
+            )
+        )
+        c1 = fp2.add(fp2.mul(a0, b[0]), fp2.mul_xi(t2))
+        c2 = fp2.add(fp2.mul(a0, b[1]), t1)
+        return (c0, c1, c2)
+
+    t0 = mul6_fp2(f0, l00)           # a0 * c0
+    t1 = sparse6(f1)                  # a1 * c1(sparse)
+    c0 = add6(t0, mul6_by_v(t1))
+    # (a0 + a1) * (c0 + c1) - t0 - t1, with (c0 + c1) = (l00, l11, l12)
+    s = add6(f0, f1)
+    cs = (l00, l11, l12)
+    c1 = sub6(sub6(mul6(s, cs), t0), t1)
+    return (c0, c1)
+
+
+# ---------------------------------------------------------------------------
+# Cyclotomic helpers (valid after the easy part of the final exponentiation)
+# ---------------------------------------------------------------------------
+
+
+def cyclo_inv(a):
+    """In the cyclotomic subgroup x^(p^6+1)=... the inverse is conjugation."""
+    return conj12(a)

@@ -1,0 +1,130 @@
+"""GF(p^2) = Fp[u]/(u^2+1) on the JAX Montgomery-Fp layer.
+
+Elements are pytree pairs ``(c0, c1)`` of Fp limb arrays (uint32[..., 24],
+Montgomery form), so every op broadcasts over arbitrary leading batch
+dimensions and composes under jit/vmap.  Karatsuba multiply (3 Fp products)
+mirrors the ground truth in ``crypto.fields.fp2_mul``.
+
+Reference role: Fp2 is the coordinate field of G2 (signatures) and the
+bottom of the Fp12 tower the pairing lives in — the arithmetic blst runs in
+hand-written assembly inside `verifyMultipleSignatures` (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:52-87).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto import fields as GT
+from . import fp
+
+Fp2 = tuple  # (c0, c1)
+
+
+# ---------------------------------------------------------------------------
+# Host-side constants / conversions
+# ---------------------------------------------------------------------------
+
+
+def const(x) -> tuple:
+    """(int, int) ground-truth element -> Montgomery limb constant pair."""
+    return (fp.const(x[0]), fp.const(x[1]))
+
+
+def decode(a) -> tuple:
+    """Montgomery pair -> (int, int) ground-truth element (host side)."""
+    return (fp.decode(a[0]), fp.decode(a[1]))
+
+
+def stack_consts(xs) -> tuple:
+    """List of (int, int) -> batched Fp2 constant (c0[n,24], c1[n,24])."""
+    return (
+        np.stack([fp.const(x[0]) for x in xs]),
+        np.stack([fp.const(x[1]) for x in xs]),
+    )
+
+
+ZERO = const(GT.FP2_ZERO)
+ONE = const(GT.FP2_ONE)
+
+
+# ---------------------------------------------------------------------------
+# Ring ops
+# ---------------------------------------------------------------------------
+
+
+def add(a: Fp2, b: Fp2) -> Fp2:
+    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+
+
+def sub(a: Fp2, b: Fp2) -> Fp2:
+    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+
+
+def neg(a: Fp2) -> Fp2:
+    return (fp.neg(a[0]), fp.neg(a[1]))
+
+
+def mul(a: Fp2, b: Fp2) -> Fp2:
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp.mont_mul(a0, b0)
+    t1 = fp.mont_mul(a1, b1)
+    # Karatsuba cross term: (a0+a1)(b0+b1) - t0 - t1
+    t2 = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
+    return (fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1))
+
+
+def sqr(a: Fp2) -> Fp2:
+    a0, a1 = a
+    # (a0+a1)(a0-a1), 2*a0*a1
+    c0 = fp.mont_mul(fp.add(a0, a1), fp.sub(a0, a1))
+    c1 = fp.mont_mul(a0, a1)
+    return (c0, fp.add(c1, c1))
+
+
+def mul_fp(a: Fp2, k) -> Fp2:
+    """Multiply by an Fp element (Montgomery limb array)."""
+    return (fp.mont_mul(a[0], k), fp.mont_mul(a[1], k))
+
+
+def mul_small(a: Fp2, k: int) -> Fp2:
+    return (fp.mul_small(a[0], k), fp.mul_small(a[1], k))
+
+
+def conj(a: Fp2) -> Fp2:
+    """Frobenius x -> x^p on Fp2 (conjugation)."""
+    return (a[0], fp.neg(a[1]))
+
+
+def mul_xi(a: Fp2) -> Fp2:
+    """Multiply by xi = u + 1: (c0 - c1) + (c0 + c1) u."""
+    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+
+
+def inv(a: Fp2) -> Fp2:
+    """1/a via the norm map; returns 0 for input 0 (callers gate)."""
+    a0, a1 = a
+    n = fp.add(fp.sqr(a0), fp.sqr(a1))
+    ninv = fp.inv(n)
+    return (fp.mont_mul(a0, ninv), fp.neg(fp.mont_mul(a1, ninv)))
+
+
+def is_zero(a: Fp2):
+    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+
+
+def eq(a: Fp2, b: Fp2):
+    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+
+
+def select(cond, x: Fp2, y: Fp2) -> Fp2:
+    """Batch-shaped boolean select over both components."""
+    return (fp.select(cond, x[0], y[0]), fp.select(cond, x[1], y[1]))
+
+
+def broadcast_to(a: Fp2, batch) -> Fp2:
+    shape = (*batch, fp.L.N_LIMBS)
+    return (jnp.broadcast_to(a[0], shape), jnp.broadcast_to(a[1], shape))

@@ -1,15 +1,18 @@
 """Multi-limb big-integer primitives for JAX on TPU.
 
-A 384-bit integer is represented as 24 little-endian limbs of 16 bits each,
-stored in a uint32 array of shape ``[..., 24]``.  16-bit limbs are chosen so
-that a limb product ``a_i * b_j`` is exact in uint32 (max (2^16-1)^2 < 2^32)
-and a full schoolbook column (48 half-products) still fits uint32
-(< 2^21.6) — i.e. everything maps onto the TPU VPU's native 32-bit integer
-lanes with no wide-multiply emulation.
+A 384-bit integer is represented as 32 little-endian limbs of 12 bits each,
+stored in a uint32 array of shape ``[..., 32]``.  12-bit limbs are chosen so
+the FULL schoolbook product folds into a single integer contraction: a limb
+product is < 2^24 and a 32-term column sum is < 2^29, both exact in uint32 —
+so ``a * b`` is one einsum of ``a`` against the Toeplitz matrix of ``b``
+(products and anti-diagonal sums in the same contraction), with a single
+carry-propagation afterwards.  That keeps the traced graph per multiply at
+~10 ops instead of hundreds, and maps onto TPU vector/matrix units instead
+of long scalar chains.  (A future Pallas path can split limbs to 8 bits and
+run the same contraction on the MXU's int8 pipeline.)
 
-All functions are shape-polymorphic over leading batch dimensions and use
-only static (Python-time) loops over the limb index, so they trace into
-small fixed XLA graphs and vectorize over the batch.
+Carry/borrow chains are `lax.scan`s over the limb axis — sequential by
+nature, O(1) graph size, fully vectorized over the batch.
 
 No modulus lives at this layer; see ``fp.py`` for GF(p).
 """
@@ -19,11 +22,18 @@ from __future__ import annotations
 import numpy as np
 
 import jax.numpy as jnp
+from jax import lax
 
-LIMB_BITS = 16
+LIMB_BITS = 12
 LIMB_MASK = (1 << LIMB_BITS) - 1
-N_LIMBS = 24  # 24 * 16 = 384 bits >= 381-bit field elements
+N_LIMBS = 32  # 32 * 12 = 384 bits >= 381-bit field elements
 DTYPE = jnp.uint32
+
+# Static Toeplitz gather index: TOEP_IDX[j, k] selects b_padded[k - j] for
+# the column sum full[k] = sum_j a_j * b_{k-j}; out-of-range differences
+# point into the zero padding at index >= N_LIMBS.
+_D = np.arange(2 * N_LIMBS)[None, :] - np.arange(N_LIMBS)[:, None]
+TOEP_IDX = np.where((_D >= 0) & (_D < N_LIMBS), _D, N_LIMBS).astype(np.int32)
 
 # ---------------------------------------------------------------------------
 # Host-side conversions (numpy; used for constants and test plumbing)
@@ -67,16 +77,19 @@ def batch_from_limbs(arr) -> list:
 def carry_prop(cols):
     """Fold carries in a column vector (values < 2^31) into canonical limbs.
 
-    The final carry out of the top column is dropped — callers must ensure it
-    is zero (true for all uses here by construction).
+    The final carry out of the top column is dropped — callers must ensure
+    it is zero (true for all uses here by construction).
     """
-    out = []
-    carry = jnp.zeros(cols.shape[:-1], DTYPE)
-    for i in range(cols.shape[-1]):
-        t = cols[..., i] + carry
-        out.append(t & LIMB_MASK)
-        carry = t >> LIMB_BITS
-    return jnp.stack(out, axis=-1)
+    def step(carry, col):
+        t = col + carry
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    _, out = lax.scan(
+        step,
+        jnp.zeros(cols.shape[:-1], DTYPE),
+        jnp.moveaxis(cols, -1, 0),
+    )
+    return jnp.moveaxis(out, 0, -1)
 
 
 def add_nocarryout(a, b):
@@ -85,14 +98,20 @@ def add_nocarryout(a, b):
 
 
 def sub_with_borrow(a, b):
-    """(a - b mod 2^(16n), borrow_out) — borrow_out is 1 where a < b."""
-    out = []
-    borrow = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), DTYPE)
-    for i in range(a.shape[-1]):
-        t = a[..., i] + jnp.uint32(1 << LIMB_BITS) - b[..., i] - borrow
-        out.append(t & LIMB_MASK)
-        borrow = jnp.uint32(1) - (t >> LIMB_BITS)
-    return jnp.stack(out, axis=-1), borrow
+    """(a - b mod 2^(12n), borrow_out) — borrow_out is 1 where a < b."""
+    a, b = jnp.broadcast_arrays(a, b)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        t = ai + jnp.uint32(1 << LIMB_BITS) - bi - borrow
+        return jnp.uint32(1) - (t >> LIMB_BITS), t & LIMB_MASK
+
+    borrow, out = lax.scan(
+        step,
+        jnp.zeros(a.shape[:-1], DTYPE),
+        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)),
+    )
+    return jnp.moveaxis(out, 0, -1), borrow
 
 
 def geq(a, b):
@@ -123,32 +142,28 @@ def eq(a, b):
 def mul_full(a, b):
     """Full product of two canonical n-limb numbers -> canonical 2n limbs.
 
-    Schoolbook with hi/lo half-product split; the i-loop is a static Python
-    unroll (24 iterations) of pure vector ops.
+    One integer contraction: full[k] = sum_j a_j * b_{k-j} via the static
+    Toeplitz gather of b (zero-padded), then a single carry propagation.
+    Exact in uint32 by the 12-bit limb bound.
     """
     n = a.shape[-1]
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    acc = jnp.zeros((*batch, 2 * n), DTYPE)
-    for i in range(n):
-        p = a[..., i : i + 1] * b  # exact in uint32
-        acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
-        acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
-    return carry_prop(acc)
+    bpad = jnp.concatenate(
+        [b, jnp.zeros((*b.shape[:-1], n), DTYPE)], axis=-1
+    )
+    bmat = bpad[..., TOEP_IDX]  # [..., n, 2n]
+    cols = jnp.einsum("...j,...jk->...k", a, bmat)
+    return carry_prop(cols)
 
 
 def mul_low(a, b):
-    """Low half product: (a * b) mod 2^(16n) -> canonical n limbs."""
+    """Low half product: (a * b) mod 2^(12n) -> canonical n limbs.
+
+    Same contraction as mul_full but sliced to the low n columns (half the
+    multiply work and carry length — this is REDC's middle multiply)."""
     n = a.shape[-1]
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    acc = jnp.zeros((*batch, n), DTYPE)
-    for i in range(n):
-        p = a[..., i : i + 1] * b[..., : n - i]
-        acc = acc.at[..., i:].add(p & LIMB_MASK)
-        if i + 1 < n:
-            acc = acc.at[..., i + 1 :].add((p >> LIMB_BITS)[..., : n - i - 1])
-    return carry_prop(acc)
-
-
-# NOTE: no generic small-constant multiply lives here on purpose: k*a for a
-# near 2^381 overflows the 24-limb window, so modular small multiples are
-# built from reduced addition chains in fp.mul_small instead.
+    bpad = jnp.concatenate(
+        [b, jnp.zeros((*b.shape[:-1], n), DTYPE)], axis=-1
+    )
+    bmat = bpad[..., TOEP_IDX[:, :n]]  # [..., n, n]
+    cols = jnp.einsum("...j,...jk->...k", a, bmat)
+    return carry_prop(cols)

@@ -24,3 +24,9 @@ import jax  # noqa: E402  (import order is the point here)
 
 if os.environ.get("LODESTAR_TPU_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the pairing kernels are compile-heavy, and
+# the cache makes repeat test runs start in seconds instead of minutes.
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

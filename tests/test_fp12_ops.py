@@ -1,0 +1,93 @@
+"""JAX Fp6/Fp12 tower vs the pure-Python ground truth."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.ops import fp12
+
+rng = random.Random(0x12F)
+
+N = 4
+
+
+def rand_fp2():
+    return (rng.randrange(GT.P), rng.randrange(GT.P))
+
+
+def rand_fp12(n):
+    return [
+        (
+            (rand_fp2(), rand_fp2(), rand_fp2()),
+            (rand_fp2(), rand_fp2(), rand_fp2()),
+        )
+        for _ in range(n)
+    ]
+
+
+def dec(a):
+    leaves = jax.tree_util.tree_leaves(a)
+    n = leaves[0].shape[0]
+    return [
+        fp12.decode12(
+            jax.tree_util.tree_map(lambda leaf: np.asarray(leaf)[i], a)
+        )
+        for i in range(n)
+    ]
+
+
+@jax.jit
+def _suite(a, b):
+    return (
+        fp12.mul12(a, b),
+        fp12.sqr12(a),
+        fp12.conj12(a),
+        fp12.inv12(a),
+        fp12.frobenius12(a, 1),
+        fp12.frobenius12(a, 2),
+        fp12.frobenius12(a, 3),
+        fp12.eq12(a, b),
+        fp12.eq12(a, a),
+        fp12.is_one12(a),
+    )
+
+
+def test_fp12_ops():
+    xs = rand_fp12(N - 1) + [GT.FP12_ONE]
+    ys = rand_fp12(N)
+    a, b = fp12.stack_consts12(xs), fp12.stack_consts12(ys)
+    mul, sqr, conj, inv, fr1, fr2, fr3, eqab, eqaa, isone = _suite(a, b)
+    assert dec(mul) == [GT.fp12_mul(x, y) for x, y in zip(xs, ys)]
+    assert dec(sqr) == [GT.fp12_mul(x, x) for x in xs]
+    assert dec(conj) == [GT.fp12_conj(x) for x in xs]
+    assert dec(inv) == [GT.fp12_inv(x) for x in xs]
+    assert dec(fr1) == [GT.fp12_frobenius(x, 1) for x in xs]
+    assert dec(fr2) == [GT.fp12_frobenius(x, 2) for x in xs]
+    assert dec(fr3) == [GT.fp12_frobenius(x, 3) for x in xs]
+    assert not any(np.asarray(eqab))
+    assert all(np.asarray(eqaa))
+    assert list(np.asarray(isone)) == [False] * (N - 1) + [True]
+
+
+def test_sparse_line_mul():
+    xs = rand_fp12(N)
+    # sparse line values: c0 = (a, 0, 0), c1 = (0, b, c)
+    lines = [(rand_fp2(), rand_fp2(), rand_fp2()) for _ in range(N)]
+    a = fp12.stack_consts12(xs)
+
+    def to_full(l):
+        l00, l11, l12 = l
+        return ((l00, GT.FP2_ZERO, GT.FP2_ZERO), (GT.FP2_ZERO, l11, l12))
+
+    import lodestar_tpu.ops.fp2 as fp2m
+
+    l00 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[0] for l in lines]))
+    l11 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[1] for l in lines]))
+    l12 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[2] for l in lines]))
+    got = jax.jit(fp12.mul12_by_line)(a, l00, l11, l12)
+    want = [GT.fp12_mul(x, to_full(l)) for x, l in zip(xs, lines)]
+    assert dec(got) == want

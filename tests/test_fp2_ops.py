@@ -1,0 +1,74 @@
+"""JAX Fp2 layer vs the pure-Python ground truth (`crypto.fields`)."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.ops import fp2
+
+rng = random.Random(0xF92)
+
+N = 8
+
+
+def rand_fp2(n):
+    return [(rng.randrange(GT.P), rng.randrange(GT.P)) for _ in range(n)]
+
+
+def enc(xs):
+    c0, c1 = fp2.stack_consts(xs)
+    return (jnp.asarray(c0), jnp.asarray(c1))
+
+
+def dec(a):
+    c0, c1 = np.asarray(a[0]), np.asarray(a[1])
+    return [
+        fp2.decode((c0[i], c1[i])) for i in range(c0.shape[0])
+    ]
+
+
+@jax.jit
+def _suite(a, b):
+    k = tuple(map(jnp.asarray, fp2.const((7, 0))))  # an Fp scalar, as Fp2 c0
+    return (
+        fp2.mul(a, b),
+        fp2.sqr(a),
+        fp2.add(a, b),
+        fp2.sub(a, b),
+        fp2.neg(a),
+        fp2.conj(a),
+        fp2.mul_xi(a),
+        fp2.mul_small(a, 3),
+        fp2.mul_fp(a, k[0]),
+        fp2.inv(a),
+        fp2.is_zero(a),
+        fp2.eq(a, b),
+        fp2.eq(a, a),
+    )
+
+
+def test_fp2_ops():
+    xs = rand_fp2(N - 2) + [GT.FP2_ZERO, GT.FP2_ONE]
+    ys = rand_fp2(N - 2) + [(5, 9), GT.FP2_ONE]
+    a, b = enc(xs), enc(ys)
+    mul, sqr, add, sub, neg, conj, xi, m3, mfp, inv, isz, eqab, eqaa = _suite(a, b)
+    assert dec(mul) == [GT.fp2_mul(x, y) for x, y in zip(xs, ys)]
+    assert dec(sqr) == [GT.fp2_sqr(x) for x in xs]
+    assert dec(add) == [GT.fp2_add(x, y) for x, y in zip(xs, ys)]
+    assert dec(sub) == [GT.fp2_sub(x, y) for x, y in zip(xs, ys)]
+    assert dec(neg) == [GT.fp2_neg(x) for x in xs]
+    assert dec(conj) == [GT.fp2_conj(x) for x in xs]
+    assert dec(xi) == [GT.fp2_mul_xi(x) for x in xs]
+    assert dec(m3) == [GT.fp2_mul_fp(x, 3) for x in xs]
+    assert dec(mfp) == [GT.fp2_mul_fp(x, 7) for x in xs]
+    want_inv = [
+        GT.fp2_inv(x) if not GT.fp2_is_zero(x) else GT.FP2_ZERO for x in xs
+    ]
+    assert dec(inv) == want_inv
+    assert list(np.asarray(isz)) == [GT.fp2_is_zero(x) for x in xs]
+    assert list(np.asarray(eqab)) == [GT.fp2_eq(x, y) for x, y in zip(xs, ys)]
+    assert all(np.asarray(eqaa))

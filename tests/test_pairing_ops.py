@@ -1,0 +1,107 @@
+"""JAX Miller loop / final exponentiation vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.crypto import pairing as GTP
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.ops import fp, fp2, fp12
+from lodestar_tpu.ops import pairing as KP
+
+rng = random.Random(0xA7E)
+
+
+def enc_g1_affine(pts):
+    xs = jnp.asarray(np.stack([fp.const(p[0]) for p in pts]))
+    ys = jnp.asarray(np.stack([fp.const(p[1]) for p in pts]))
+    return (xs, ys)
+
+
+def enc_g2_affine(pts):
+    xc = fp2.stack_consts([p[0] for p in pts])
+    yc = fp2.stack_consts([p[1] for p in pts])
+    return (
+        tuple(jnp.asarray(v) for v in xc),
+        tuple(jnp.asarray(v) for v in yc),
+    )
+
+
+def dec12(a):
+    leaves = jax.tree_util.tree_leaves(a)
+    n = leaves[0].shape[0]
+    return [
+        fp12.decode12(jax.tree_util.tree_map(lambda l: np.asarray(l)[i], a))
+        for i in range(n)
+    ]
+
+
+def rand_pairs(n):
+    out = []
+    for _ in range(n):
+        p = C.scalar_mul(C.FP_OPS, C.G1_GEN, rng.randrange(1, GT.R))
+        q = C.scalar_mul(C.FP2_OPS, C.G2_GEN, rng.randrange(1, GT.R))
+        out.append((p, q))
+    return out
+
+
+def test_miller_loop_matches_oracle():
+    pairs = rand_pairs(2) + [(C.G1_GEN, C.G2_GEN)]
+    ps = enc_g1_affine([p for p, _ in pairs])
+    qs = enc_g2_affine([q for _, q in pairs])
+    got = dec12(jax.jit(KP.miller_loop)(ps, qs))
+    want = [GTP.miller_loop(p, q) for p, q in pairs]
+    assert got == want
+
+
+def test_final_exponentiation_is_cubed_oracle():
+    pairs = rand_pairs(2)
+    ps = enc_g1_affine([p for p, _ in pairs])
+    qs = enc_g2_affine([q for _, q in pairs])
+    got = dec12(
+        jax.jit(lambda p, q: KP.final_exponentiation(KP.miller_loop(p, q)))(
+            ps, qs
+        )
+    )
+    for (p, q), g in zip(pairs, got):
+        e = GTP.pairing(p, q)
+        assert g == GT.fp12_pow(e, 3)
+
+
+def test_pairing_product_bilinearity():
+    # e(aP, Q) * e(-P, aQ) == 1
+    a = rng.randrange(2, GT.R)
+    p = C.scalar_mul(C.FP_OPS, C.G1_GEN, rng.randrange(1, GT.R))
+    q = C.scalar_mul(C.FP2_OPS, C.G2_GEN, rng.randrange(1, GT.R))
+    ap = C.scalar_mul(C.FP_OPS, p, a)
+    aq = C.scalar_mul(C.FP2_OPS, q, a)
+    ps = enc_g1_affine([ap, C.affine_neg(C.FP_OPS, p)])
+    qs = enc_g2_affine([q, aq])
+    ok = jax.jit(KP.pairing_product_is_one)(ps, qs)
+    assert bool(ok)
+    # and the same with a mismatched scalar fails
+    ps_bad = enc_g1_affine([ap, C.affine_neg(C.FP_OPS, p)])
+    qs_bad = enc_g2_affine([q, C.scalar_mul(C.FP2_OPS, q, a + 1)])
+    assert not bool(jax.jit(KP.pairing_product_is_one)(ps_bad, qs_bad))
+
+
+def test_bls_verify_relation_on_device():
+    # Full BLS verification relation: e(-G1, sig) * e(pk, H(m)) == 1.
+    sk = GTB.keygen(b"pairing-test")
+    pk = GTB.sk_to_pk(sk)
+    msg = b"attestation signing root"
+    sig = GTB.sign(sk, msg)
+    hm = hash_to_g2(msg)
+    ps = enc_g1_affine([GTB.NEG_G1_GEN, pk])
+    qs = enc_g2_affine([sig, hm])
+    assert bool(jax.jit(KP.pairing_product_is_one)(ps, qs))
+    # wrong message fails
+    hm_bad = hash_to_g2(b"different root")
+    qs_bad = enc_g2_affine([sig, hm_bad])
+    assert not bool(jax.jit(KP.pairing_product_is_one)(ps, qs_bad))
