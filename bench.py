@@ -1,0 +1,118 @@
+"""Headline benchmark: BLS signature-sets verified per second on one chip.
+
+Measures the flagship kernel end-to-end — host randomizer generation,
+host->device transfer, the jitted random-linear-combination batch
+verification (`verify_batch`), and the verdict sync back to host — the same
+work the reference's BlsMultiThreadWorkerPool performs per job (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106).
+
+Baseline: the reference's CPU thread-pool ceiling, ~32 workers x ~1.1k
+sigs/s x <=2 batching gain = 3-7e4 sig-sets/s (SURVEY.md section 6;
+packages/beacon-node/src/metrics/metrics/lodestar.ts:427).  We take the
+midpoint 5.0e4 sets/s as the baseline denominator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.ops import bls_kernels as BK
+from lodestar_tpu.ops import fp, fp2
+
+BASELINE_SETS_PER_S = 5.0e4
+
+# Batch size per device call: the TPU analog of the reference's 128-set job
+# cap (chain/bls/multithread/index.ts:39), raised because one chip replaces
+# the whole worker pool.  Overridable for experiments.
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+DISTINCT = 32  # distinct (pk, msg, sig) triples tiled to BATCH
+REPEATS = int(os.environ.get("BENCH_REPEATS", "8"))
+
+
+def _tile(a, reps):
+    return jnp.tile(a, (reps,) + (1,) * (a.ndim - 1))
+
+
+def _tile_tree(tree, reps):
+    return jax.tree_util.tree_map(lambda a: _tile(a, reps), tree)
+
+
+def build_inputs():
+    pks, hms, sigs = [], [], []
+    for i in range(DISTINCT):
+        sk = GTB.keygen(b"bench-%d" % i)
+        msg = b"bench signing root %d" % (i % 4)
+        pks.append(GTB.sk_to_pk(sk))
+        hms.append(hash_to_g2(msg))
+        sigs.append(GTB.sign(sk, msg))
+    pk_aff = (
+        jnp.asarray(np.stack([fp.const(p[0]) for p in pks])),
+        jnp.asarray(np.stack([fp.const(p[1]) for p in pks])),
+    )
+
+    def enc2(pts):
+        return (
+            jnp.asarray(fp2.stack_consts([p[0] for p in pts])),
+            jnp.asarray(fp2.stack_consts([p[1] for p in pts])),
+        )
+
+    reps = BATCH // DISTINCT
+    return (
+        _tile_tree(pk_aff, reps),
+        _tile_tree(enc2(hms), reps),
+        _tile_tree(enc2(sigs), reps),
+    )
+
+
+def main():
+    pk_aff, msg_aff, sig_aff = build_inputs()
+    valid = jnp.ones((BATCH,), bool)
+    fn = jax.jit(BK.verify_batch)
+    rng = np.random.default_rng(0xBE7C)
+
+    # Warm-up / compile.
+    rand = jnp.asarray(BK.make_rand_bits(BATCH, rng))
+    ok, _ = fn(pk_aff, msg_aff, sig_aff, rand, valid)
+    assert bool(ok), "bench inputs failed verification"
+
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        rand = jnp.asarray(BK.make_rand_bits(BATCH, rng))
+        ok, sig_ok = fn(pk_aff, msg_aff, sig_aff, rand, valid)
+    ok.block_until_ready()
+    assert bool(ok)
+    dt = time.perf_counter() - t0
+
+    sets_per_s = BATCH * REPEATS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_signature_sets_verified_per_s",
+                "value": round(sets_per_s, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

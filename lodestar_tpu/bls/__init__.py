@@ -1,0 +1,16 @@
+"""The IBlsVerifier plugin boundary, TPU-native.
+
+This package reproduces the semantics of the reference's `chain/bls`
+subsystem (reference: packages/beacon-node/src/chain/bls/interface.ts:20-51,
+multithread/index.ts, maybeBatch.ts) with the worker-thread pool replaced by
+batched JAX kernels on a device:
+
+  signature_set  — the ISignatureSet model (single | aggregate)
+  pubkey_table   — device-resident validator pubkey table (Index2Pubkey)
+  verifier       — TpuBlsVerifier: buckets, batch->retry, backpressure
+  metrics        — lodestar_bls_thread_pool_* compatible counters
+"""
+
+from .signature_set import SignatureSet, SignatureSetType  # noqa: F401
+from .pubkey_table import PubkeyTable  # noqa: F401
+from .verifier import TpuBlsVerifier, VerifyOptions  # noqa: F401

@@ -1,0 +1,56 @@
+"""The signature-set model — mirror of the reference's ISignatureSet.
+
+Reference: packages/state-transition/src/util/signatureSets.ts:5-22 defines
+
+    SignatureSetType = single | aggregate
+    ISignatureSet   = { type, pubkey | pubkeys, signingRoot, signature }
+
+Here a set carries validator *indices* into the device-resident pubkey
+table instead of deserialized pubkey objects (the reference parses blst
+PublicKey objects once into Index2PubkeyCache — reference:
+packages/state-transition/src/cache/pubkeyCache.ts:29-47; on TPU the
+table itself lives in HBM and only indices cross the boundary).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+Affine = Optional[Tuple]  # ground-truth affine point or None (infinity)
+
+
+class SignatureSetType(enum.Enum):
+    single = "single"
+    aggregate = "aggregate"
+
+
+@dataclass(frozen=True)
+class SignatureSet:
+    """One verifiable (pubkey(s), message, signature) statement.
+
+    type=single:    one validator index, one signing root.
+    type=aggregate: several validator indices whose keys are point-added on
+                    device before the pairing (sync committees, aggregates).
+
+    `signature` is the decompressed affine G2 point; `message` is the
+    hashed-to-curve affine G2 point of the signing root.  Decompression
+    and hashing happen at ingest (see verifier.prepare_sets) so the hot
+    loop works on fixed-shape arrays only.
+    """
+
+    type: SignatureSetType
+    indices: Tuple[int, ...]
+    message: Tuple  # affine G2 (ground-truth ints) — hash_to_g2(signing_root)
+    signature: Affine  # affine G2 or None (invalid/infinity -> always False)
+
+    @staticmethod
+    def single(index: int, message, signature) -> "SignatureSet":
+        return SignatureSet(SignatureSetType.single, (index,), message, signature)
+
+    @staticmethod
+    def aggregate(indices: Sequence[int], message, signature) -> "SignatureSet":
+        return SignatureSet(
+            SignatureSetType.aggregate, tuple(indices), message, signature
+        )

@@ -83,15 +83,13 @@ _G2_GEN_C = (fp2.const(GTC.G2_GEN[0]), fp2.const(GTC.G2_GEN[1]))
 
 
 def g2_psi(q):
-    """psi on jacobian twist coordinates: conj each coord, scale X and Y."""
+    """psi on jacobian twist coordinates: conj each coord, scale X and Y.
+
+    The two constant multiplies run as one stacked Fp2 multiply."""
     X, Y, Z = q
-    cx = tuple(map(jnp.asarray, _CX_C))
-    cy = tuple(map(jnp.asarray, _CY_C))
-    return (
-        fp2.mul(fp2.conj(X), cx),
-        fp2.mul(fp2.conj(Y), cy),
-        fp2.conj(Z),
-    )
+    c = jnp.stack([jnp.asarray(_CX_C), jnp.asarray(_CY_C)])
+    m = fp2.mul_stacked(jnp.stack([fp2.conj(X), fp2.conj(Y)], axis=-3), c)
+    return (m[..., 0, :, :], m[..., 1, :, :], fp2.conj(Z))
 
 
 def g2_subgroup_check_fast(q):
@@ -128,12 +126,10 @@ def _select_aff_g2(cond, a, b):
     return (fp2.select(cond, a[0], b[0]), fp2.select(cond, a[1], b[1]))
 
 
-def _bcast_aff(c, batch, field):
-    if field == "fp":
-        return tuple(jnp.broadcast_to(jnp.asarray(v), (*batch, v.shape[-1])) for v in c)
+def _bcast_aff(c, batch):
+    """Broadcast a host-side affine constant (x, y) over batch dims."""
     return tuple(
-        tuple(jnp.broadcast_to(jnp.asarray(l), (*batch, l.shape[-1])) for l in comp)
-        for comp in c
+        jnp.broadcast_to(jnp.asarray(v), (*batch, *v.shape)) for v in c
     )
 
 
@@ -160,13 +156,13 @@ def verify_batch(pk_aff, msg_aff, sig_aff, rand_bits, valid):
     n = valid.shape[0]
     batch = (n,)
     # Replace padded slots with generators so every lane stays on-curve.
-    g1gen = _bcast_aff(_G1_GEN_C, batch, "fp")
-    g2gen = _bcast_aff(_G2_GEN_C, batch, "fp2")
+    g1gen = _bcast_aff(_G1_GEN_C, batch)
+    g2gen = _bcast_aff(_G2_GEN_C, batch)
     pk_aff = _select_aff_g1(valid, pk_aff, g1gen)
     msg_aff = _select_aff_g2(valid, msg_aff, g2gen)
     sig_aff = _select_aff_g2(valid, sig_aff, g2gen)
 
-    one_fp2 = fp2.broadcast_to(tuple(map(jnp.asarray, fp2.ONE)), batch)
+    one_fp2 = fp2.broadcast_to(fp2.ONE, batch)
     pk_jac = (pk_aff[0], pk_aff[1], fp.broadcast_to_limbs(batch))
     sig_jac = (sig_aff[0], sig_aff[1], one_fp2)
 
@@ -188,7 +184,7 @@ def verify_batch(pk_aff, msg_aff, sig_aff, rand_bits, valid):
     # r_i odd and pk in G1 \ {O}  =>  r*pk never infinity; same for sig.
 
     # Miller loops: N set pairs + 1 aggregate pair, in one batch of N+1.
-    neg_g1 = _bcast_aff(_NEG_G1_C, (1,), "fp")
+    neg_g1 = _bcast_aff(_NEG_G1_C, (1,))
     ps = tuple(
         jnp.concatenate([a, b], axis=0) for a, b in zip(rpk_aff, neg_g1)
     )
@@ -214,17 +210,17 @@ def verify_each(pk_aff, msg_aff, sig_aff, valid):
     """
     n = valid.shape[0]
     batch = (n,)
-    g1gen = _bcast_aff(_G1_GEN_C, batch, "fp")
-    g2gen = _bcast_aff(_G2_GEN_C, batch, "fp2")
+    g1gen = _bcast_aff(_G1_GEN_C, batch)
+    g2gen = _bcast_aff(_G2_GEN_C, batch)
     pk_aff = _select_aff_g1(valid, pk_aff, g1gen)
     msg_aff = _select_aff_g2(valid, msg_aff, g2gen)
     sig_aff = _select_aff_g2(valid, sig_aff, g2gen)
 
-    one_fp2 = fp2.broadcast_to(tuple(map(jnp.asarray, fp2.ONE)), batch)
+    one_fp2 = fp2.broadcast_to(fp2.ONE, batch)
     sig_jac = (sig_aff[0], sig_aff[1], one_fp2)
     sig_ok = g2_subgroup_check_fast(sig_jac)
 
-    neg_g1 = _bcast_aff(_NEG_G1_C, batch, "fp")
+    neg_g1 = _bcast_aff(_NEG_G1_C, batch)
     f1 = KP.miller_loop(pk_aff, msg_aff)
     f2 = KP.miller_loop(neg_g1, sig_aff)
     f = fp12.mul12(f1, f2)

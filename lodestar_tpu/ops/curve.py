@@ -37,6 +37,7 @@ from . import limbs as L
 @dataclass(frozen=True)
 class FieldOps:
     name: str
+    tail: int                  # trailing element axes (fp: 1, fp2: 2)
     add: Callable
     sub: Callable
     mul: Callable
@@ -60,7 +61,7 @@ def _fp_broadcast(a, batch):
 
 
 FP_OPS = FieldOps(
-    name="fp",
+    name="fp", tail=1,
     add=fp.add, sub=fp.sub, mul=fp.mont_mul, sqr=fp.sqr, neg=fp.neg,
     inv=fp.inv, eq=fp.eq, is_zero=fp.is_zero, select=fp.select,
     mul_small=fp.mul_small, const=fp.const, decode=fp.decode,
@@ -69,7 +70,7 @@ FP_OPS = FieldOps(
 )
 
 FP2_OPS = FieldOps(
-    name="fp2",
+    name="fp2", tail=2,
     add=fp2.add, sub=fp2.sub, mul=fp2.mul, sqr=fp2.sqr, neg=fp2.neg,
     inv=fp2.inv, eq=fp2.eq, is_zero=fp2.is_zero, select=fp2.select,
     mul_small=fp2.mul_small, const=fp2.const, decode=fp2.decode,
@@ -135,8 +136,8 @@ def _gt_mul(a, b):
 
 
 def infinity(fo: FieldOps, batch=()):
-    one = fo.broadcast_to(jnp.asarray(fo.one_c) if fo.name == "fp" else tuple(map(jnp.asarray, fo.one_c)), batch)
-    zero = fo.broadcast_to(jnp.asarray(fo.zero_c) if fo.name == "fp" else tuple(map(jnp.asarray, fo.zero_c)), batch)
+    one = fo.broadcast_to(jnp.asarray(fo.one_c), batch)
+    zero = fo.broadcast_to(jnp.asarray(fo.zero_c), batch)
     return (one, one, zero)
 
 
@@ -144,19 +145,34 @@ def is_infinity(fo: FieldOps, p):
     return fo.is_zero(p[2])
 
 
+def _mulN(fo: FieldOps, pairs):
+    """Run several independent field products as ONE stacked multiply.
+
+    Every jacobian formula below groups its products into rounds of
+    _mulN so the traced graph holds a handful of stacked Montgomery
+    multiplies instead of one per product — the same packing discipline
+    as the Fp12 tower (see ops/fp2.py docstring).
+    """
+    ax = -(fo.tail + 1)
+    A = jnp.stack([a for a, _ in pairs], axis=ax)
+    B = jnp.stack([b for _, b in pairs], axis=ax)
+    m = fo.mul(A, B)
+    return [jnp.take(m, i, axis=ax) for i in range(len(pairs))]
+
+
 def jac_dbl(fo: FieldOps, p):
     """2P.  Valid for all inputs incl. infinity (Z=0 propagates)."""
     X, Y, Z = p
-    A = fo.sqr(X)
-    B = fo.sqr(Y)
-    C = fo.sqr(B)
-    # D = 2*((X+B)^2 - A - C) = 4*X*B
-    D = fo.mul_small(fo.sub(fo.sub(fo.sqr(fo.add(X, B)), A), C), 2)
+    A, B, YZ = _mulN(fo, [(X, X), (Y, Y), (Y, Z)])
     E = fo.mul_small(A, 3)
-    F = fo.sqr(E)
+    XB = fo.add(X, B)
+    C, S, F = _mulN(fo, [(B, B), (XB, XB), (E, E)])
+    # D = 2*((X+B)^2 - A - C) = 4*X*B
+    D = fo.mul_small(fo.sub(fo.sub(S, A), C), 2)
     X3 = fo.sub(F, fo.mul_small(D, 2))
-    Y3 = fo.sub(fo.mul(E, fo.sub(D, X3)), fo.mul_small(C, 8))
-    Z3 = fo.mul_small(fo.mul(Y, Z), 2)
+    (T1,) = _mulN(fo, [(E, fo.sub(D, X3))])
+    Y3 = fo.sub(T1, fo.mul_small(C, 8))
+    Z3 = fo.mul_small(YZ, 2)
     return (X3, Y3, Z3)
 
 
@@ -164,24 +180,24 @@ def jac_add(fo: FieldOps, p, q):
     """P + Q, branchless over all exceptional cases."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    Z1Z1 = fo.sqr(Z1)
-    Z2Z2 = fo.sqr(Z2)
-    U1 = fo.mul(X1, Z2Z2)
-    U2 = fo.mul(X2, Z1Z1)
-    S1 = fo.mul(fo.mul(Y1, Z2), Z2Z2)
-    S2 = fo.mul(fo.mul(Y2, Z1), Z1Z1)
+    Z1Z1, Z2Z2, Y1Z2, Y2Z1 = _mulN(
+        fo, [(Z1, Z1), (Z2, Z2), (Y1, Z2), (Y2, Z1)]
+    )
+    U1, U2, S1, S2 = _mulN(
+        fo, [(X1, Z2Z2), (X2, Z1Z1), (Y1Z2, Z2Z2), (Y2Z1, Z1Z1)]
+    )
     H = fo.sub(U2, U1)
     Rr = fo.sub(S2, S1)
-    # generic chord addition
-    I = fo.sqr(fo.mul_small(H, 2))
-    J = fo.mul(H, I)
+    H2 = fo.mul_small(H, 2)
     Rr2 = fo.mul_small(Rr, 2)
-    V = fo.mul(U1, I)
-    X3 = fo.sub(fo.sub(fo.sqr(Rr2), J), fo.mul_small(V, 2))
-    Y3 = fo.sub(
-        fo.mul(Rr2, fo.sub(V, X3)), fo.mul_small(fo.mul(S1, J), 2)
+    (I,) = _mulN(fo, [(H2, H2)])
+    J, V, Z1Z2, RR = _mulN(
+        fo, [(H, I), (U1, I), (Z1, Z2), (Rr2, Rr2)]
     )
-    Z3 = fo.mul_small(fo.mul(fo.mul(Z1, Z2), H), 2)
+    X3 = fo.sub(fo.sub(RR, J), fo.mul_small(V, 2))
+    T1, T2, ZH = _mulN(fo, [(Rr2, fo.sub(V, X3)), (S1, J), (Z1Z2, H)])
+    Y3 = fo.sub(T1, fo.mul_small(T2, 2))
+    Z3 = fo.mul_small(ZH, 2)
     generic = (X3, Y3, Z3)
 
     p_inf = fo.is_zero(Z1)
@@ -203,16 +219,11 @@ def jac_add(fo: FieldOps, p, q):
 
 
 def _const_tuple(fo: FieldOps):
-    if fo.name == "fp":
-        return (jnp.asarray(fo.one_c), jnp.asarray(fo.one_c), jnp.asarray(fo.zero_c))
-    one = tuple(map(jnp.asarray, fo.one_c))
-    zero = tuple(map(jnp.asarray, fo.zero_c))
-    return (one, one, zero)
+    return (jnp.asarray(fo.one_c), jnp.asarray(fo.one_c), jnp.asarray(fo.zero_c))
 
 
 def _batch_of(fo: FieldOps, z):
-    leaf = z if fo.name == "fp" else z[0]
-    return leaf.shape[:-1]
+    return z.shape[: z.ndim - fo.tail]
 
 
 def _sel3(fo: FieldOps, cond, a, b):
@@ -227,12 +238,14 @@ def jac_eq(fo: FieldOps, p, q):
     """Equality of jacobian points (cross-multiplied, infinity-aware)."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    Z1Z1 = fo.sqr(Z1)
-    Z2Z2 = fo.sqr(Z2)
-    ex = fo.eq(fo.mul(X1, Z2Z2), fo.mul(X2, Z1Z1))
-    ey = fo.eq(
-        fo.mul(Y1, fo.mul(Z2, Z2Z2)), fo.mul(Y2, fo.mul(Z1, Z1Z1))
+    Z1Z1, Z2Z2, Y1Z2, Y2Z1 = _mulN(
+        fo, [(Z1, Z1), (Z2, Z2), (Y1, Z2), (Y2, Z1)]
     )
+    ax1, ax2, ay1, ay2 = _mulN(
+        fo, [(X1, Z2Z2), (X2, Z1Z1), (Y1Z2, Z2Z2), (Y2Z1, Z1Z1)]
+    )
+    ex = fo.eq(ax1, ax2)
+    ey = fo.eq(ay1, ay2)
     p_inf = fo.is_zero(Z1)
     q_inf = fo.is_zero(Z2)
     return jnp.where(p_inf | q_inf, p_inf & q_inf, ex & ey)
@@ -243,24 +256,26 @@ def to_affine(fo: FieldOps, p):
     X, Y, Z = p
     inf = fo.is_zero(Z)
     zi = fo.inv(Z)  # inv(0) = 0 in our field layers
-    zi2 = fo.sqr(zi)
-    return (fo.mul(X, zi2), fo.mul(Y, fo.mul(zi2, zi))), inf
+    (zi2,) = _mulN(fo, [(zi, zi)])
+    (zi3,) = _mulN(fo, [(zi2, zi)])
+    x, y = _mulN(fo, [(X, zi2), (Y, zi3)])
+    return (x, y), inf
 
 
 def is_on_curve(fo: FieldOps, p):
     """y^2 = x^3 + b in jacobian form: Y^2 = X^3 + b*Z^6 (infinity passes)."""
     X, Y, Z = p
-    z2 = fo.sqr(Z)
-    z6 = fo.mul(fo.sqr(z2), z2)
+    X2, Y2, Z2 = _mulN(fo, [(X, X), (Y, Y), (Z, Z)])
+    X3, Z4 = _mulN(fo, [(X2, X), (Z2, Z2)])
+    (Z6,) = _mulN(fo, [(Z4, Z2)])
     b = _broadcast_const(fo, fo.b_c, _batch_of(fo, Z))
-    rhs = fo.add(fo.mul(fo.sqr(X), X), fo.mul(b, z6))
-    return fo.eq(fo.sqr(Y), rhs) | fo.is_zero(Z)
+    (bZ6,) = _mulN(fo, [(b, Z6)])
+    rhs = fo.add(X3, bZ6)
+    return fo.eq(Y2, rhs) | fo.is_zero(Z)
 
 
 def _broadcast_const(fo: FieldOps, c, batch):
-    if fo.name == "fp":
-        return fo.broadcast_to(jnp.asarray(c), batch)
-    return fo.broadcast_to(tuple(map(jnp.asarray, c)), batch)
+    return fo.broadcast_to(jnp.asarray(c), batch)
 
 
 # ---------------------------------------------------------------------------
@@ -323,30 +338,39 @@ def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
 
 
 def sum_points(fo: FieldOps, p, valid=None):
-    """Sum points along the leading batch axis by halving tree reduction.
+    """Sum points along the leading batch axis, hypercube reduction.
 
     `valid` (bool[n, ...]) masks entries; masked slots contribute infinity.
-    log2(n) rounds of pairwise jac_add — each round is fully data-parallel,
-    which is the TPU replacement for blst's sequential `PublicKey.aggregate`
-    loop (reference: chain/bls/utils.ts:5-16).
+    ceil(log2(n)) rounds of x_i += x_{i+2^r} at FULL width inside one
+    fori_loop — a single compiled jac_add body regardless of n, the TPU
+    replacement for blst's sequential `PublicKey.aggregate` loop
+    (reference: chain/bls/utils.ts:5-16).
     """
     if valid is not None:
         inf = infinity(fo, _batch_of(fo, p[2]))
         p = _sel3(fo, valid, p, inf)
     n = tree_util.tree_leaves(p)[0].shape[0]
-    while n > 1:
-        half = (n + 1) // 2
-        lo = tree_util.tree_map(lambda a: a[:half], p)
-        hi = tree_util.tree_map(lambda a: a[half:], p)
-        if n % 2 == 1:  # pad the odd tail with infinity
-            rest = _batch_of(fo, hi[2])[1:]
-            pad = infinity(fo, (1, *rest))
-            hi = tree_util.tree_map(
-                lambda h, z: jnp.concatenate([h, z], axis=0), hi, pad
-            )
-        p = jac_add(fo, lo, hi)
-        n = half
-    return tree_util.tree_map(lambda a: a[0], p)
+    if n == 1:
+        return tree_util.tree_map(lambda a: a[0], p)
+    rounds = (n - 1).bit_length()
+    inf1 = infinity(fo, _batch_of(fo, p[2]))
+
+    def body(r, acc):
+        d = jnp.int32(1) << r
+        idx = jnp.arange(n, dtype=jnp.int32) + d
+        in_range = idx < n
+        idx = jnp.where(in_range, idx, 0)
+        partner = tuple(jnp.take(c, idx, axis=0) for c in acc)
+        partner = _sel3(
+            fo,
+            in_range.reshape((n,) + (1,) * (len(_batch_of(fo, acc[2])) - 1)),
+            partner,
+            inf1,
+        )
+        return jac_add(fo, acc, partner)
+
+    out = lax.fori_loop(0, rounds, body, p)
+    return tree_util.tree_map(lambda a: a[0], out)
 
 
 # ---------------------------------------------------------------------------

@@ -54,14 +54,20 @@ def decode(a) -> int:
 
 
 def mont_mul(a, b):
-    """REDC(a*b): Montgomery product, canonical output < p."""
-    t = L.mul_full(a, b)
-    m = L.mul_low(t[..., : L.N_LIMBS], jnp.asarray(NPRIME_LIMBS))
-    u = L.mul_full(m, jnp.asarray(P_LIMBS))
-    # t + u == 0 mod 2^384 by construction; carry_prop runs over all 2n
-    # columns so the low half's final carry lands in limb n, and the high
-    # half is then the REDC result (< 2p, one conditional subtract).
-    s = L.carry_prop(t + u)
+    """REDC(a*b): Montgomery product, canonical output < p.
+
+    The two inner propagations are cheap 3-pass shrinks (redundant limbs
+    <= 2^12): only the residue of m mod R matters for REDC's divisibility,
+    and the value of t is preserved, so one exact carry propagation at the
+    end suffices.  t + u == 0 mod 2^384 by construction; the full
+    carry_prop pushes the low half's carry into limb n, and the high half
+    is the REDC result (< 2p because t < p^2 and u < R*p*(1+2^-12); one
+    conditional subtract makes it canonical).
+    """
+    t = L.shrink(L.mul_full_cols(a, b))
+    m = L.shrink(L.mul_low_cols(t[..., : L.N_LIMBS], jnp.asarray(NPRIME_LIMBS)))
+    u_cols = L.mul_full_cols(m, jnp.asarray(P_LIMBS))
+    s = L.carry_prop(t + u_cols)
     return L.cond_sub(s[..., L.N_LIMBS :], jnp.asarray(P_LIMBS))
 
 

@@ -1,16 +1,20 @@
-"""Fp6 = Fp2[v]/(v^3 - xi) and Fp12 = Fp6[w]/(w^2 - v) in JAX.
+"""Fp6 = Fp2[v]/(v^3 - xi) and Fp12 = Fp6[w]/(w^2 - v) in JAX — packed.
 
-Elements are nested pytrees mirroring the ground truth (`crypto.fields`):
+Layout (all Montgomery uint32):
 
-    Fp6  : (Fp2, Fp2, Fp2)
-    Fp12 : (Fp6, Fp6)
+    Fp6  : [..., 3, 2, 32]      (v-coefficient axis, then Fp2 layout)
+    Fp12 : [..., 2, 3, 2, 32]   (w-coefficient axis, then Fp6 layout)
 
-with Fp2 = (c0, c1) Montgomery limb arrays.  Includes the pairing-specific
-machinery on top of the generic tower:
+Every tower multiply gathers ALL of its independent Fp products into one
+stacked `fp2.mul_stacked` call (a `mul12` runs its 54 Montgomery products
+as a single [..., 54, 32]-shaped mont_mul), so the traced graph per tower
+op is a handful of fused tensor ops — the design that keeps XLA compile
+times in seconds and feeds the TPU wide arrays.
 
-  - Frobenius maps (precomputed gamma constants, Montgomery form),
-  - sparse multiplication by Miller-loop line values (shape c0=(a,0,0),
-    c1=(0,b,c) under the D-type untwist used by `crypto.pairing.untwist`),
+Includes the pairing-specific machinery:
+  - Frobenius maps (precomputed gamma tables, Montgomery form),
+  - sparse multiplication by Miller-loop line values (shape c0=(l00,0,0),
+    c1=(0,l11,l12) under the D-type untwist used by `crypto.pairing.untwist`),
   - cyclotomic conjugation-inverse (valid after the easy final-exp part).
 
 This is the Fp12 arithmetic that blst runs in assembly inside its pairing
@@ -22,44 +26,38 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..crypto import fields as GT
 from . import fp, fp2
-
-Fp6 = tuple
-Fp12 = tuple
-
 
 # ---------------------------------------------------------------------------
 # Host-side constants / conversions
 # ---------------------------------------------------------------------------
 
 
-def const6(x) -> tuple:
-    return tuple(fp2.const(c) for c in x)
+def const6(x) -> np.ndarray:
+    return np.stack([fp2.const(c) for c in x])
 
 
-def const12(x) -> tuple:
-    return (const6(x[0]), const6(x[1]))
+def const12(x) -> np.ndarray:
+    return np.stack([const6(x[0]), const6(x[1])])
 
 
 def decode6(a) -> tuple:
-    return tuple(fp2.decode(c) for c in a)
+    a = np.asarray(a)
+    return tuple(fp2.decode(a[i]) for i in range(3))
 
 
 def decode12(a) -> tuple:
+    a = np.asarray(a)
     return (decode6(a[0]), decode6(a[1]))
 
 
-def stack_consts12(xs) -> tuple:
+def stack_consts12(xs) -> jnp.ndarray:
     """List of ground-truth Fp12 values -> batched device constant."""
-    import jax
-
-    consts = [const12(x) for x in xs]
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.asarray(np.stack(leaves)), *consts
-    )
+    return jnp.asarray(np.stack([const12(x) for x in xs]))
 
 
 SIX_ZERO = const6(GT.FP6_ZERO)
@@ -67,59 +65,57 @@ SIX_ONE = const6(GT.FP6_ONE)
 TWELVE_ONE = const12(GT.FP12_ONE)
 
 
-def one12(batch=()) -> Fp12:
-    import jax
-
-    return jax.tree_util.tree_map(
-        lambda c: jnp.broadcast_to(jnp.asarray(c), (*batch, c.shape[-1])),
-        TWELVE_ONE,
-    )
+def one12(batch=()):
+    return jnp.broadcast_to(jnp.asarray(TWELVE_ONE), (*batch, 2, 3, 2, fp.L.N_LIMBS))
 
 
 # ---------------------------------------------------------------------------
-# Fp6
+# Fp6 (coefficient axis = -3 of the Fp2-packed layout, i.e. axis -4 overall)
 # ---------------------------------------------------------------------------
+
+_V_AXIS = -4  # the 3-long v-coefficient axis of an Fp6 array
 
 
 def add6(a, b):
-    return tuple(fp2.add(x, y) for x, y in zip(a, b))
+    return fp.add(a, b)
 
 
 def sub6(a, b):
-    return tuple(fp2.sub(x, y) for x, y in zip(a, b))
+    return fp.sub(a, b)
 
 
 def neg6(a):
-    return tuple(fp2.neg(x) for x in a)
+    return fp.neg(a)
+
+
+def _vc(a, i):
+    """i-th v-coefficient (an Fp2 array) of an Fp6 array."""
+    return a[..., i, :, :]
+
+
+def _vstack(cs):
+    return jnp.stack(cs, axis=-3)
 
 
 def mul6(a, b):
-    a0, a1, a2 = a
-    b0, b1, b2 = b
-    t0 = fp2.mul(a0, b0)
-    t1 = fp2.mul(a1, b1)
-    t2 = fp2.mul(a2, b2)
-    c0 = fp2.add(
-        t0,
-        fp2.mul_xi(
-            fp2.sub(
-                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b1, b2)), t1), t2
-            )
-        ),
-    )
-    c1 = fp2.add(
-        fp2.sub(
-            fp2.sub(fp2.mul(fp2.add(a0, a1), fp2.add(b0, b1)), t0), t1
-        ),
-        fp2.mul_xi(t2),
-    )
-    c2 = fp2.add(
-        fp2.sub(
-            fp2.sub(fp2.mul(fp2.add(a0, a2), fp2.add(b0, b2)), t0), t2
-        ),
-        t1,
-    )
-    return (c0, c1, c2)
+    """Karatsuba-style 6-product Fp6 multiply; one stacked Fp2 multiply.
+
+    Stacks over arbitrary leading dims (mul12 runs 3 of these in one call).
+    """
+    # products: a0b0, a1b1, a2b2, (a1+a2)(b1+b2), (a0+a1)(b0+b1), (a0+a2)(b0+b2)
+    idx_hi = np.array([1, 0, 0])
+    idx_lo = np.array([2, 1, 2])
+    asum = fp.add(a[..., idx_hi, :, :], a[..., idx_lo, :, :])
+    bsum = fp.add(b[..., idx_hi, :, :], b[..., idx_lo, :, :])
+    A = jnp.concatenate([a, asum], axis=-3)  # [..., 6, 2, 32]
+    B = jnp.concatenate([b, bsum], axis=-3)
+    m = fp2.mul_stacked(A, B)
+    m0, m1, m2 = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
+    m12, m01, m02 = m[..., 3, :, :], m[..., 4, :, :], m[..., 5, :, :]
+    c0 = fp2.add(m0, fp2.mul_xi(fp2.sub(fp2.sub(m12, m1), m2)))
+    c1 = fp2.add(fp2.sub(fp2.sub(m01, m0), m1), fp2.mul_xi(m2))
+    c2 = fp2.add(fp2.sub(fp2.sub(m02, m0), m2), m1)
+    return _vstack([c0, c1, c2])
 
 
 def sqr6(a):
@@ -128,135 +124,145 @@ def sqr6(a):
 
 def mul6_by_v(a):
     """(a0 + a1 v + a2 v^2) * v = xi*a2 + a0 v + a1 v^2."""
-    return (fp2.mul_xi(a[2]), a[0], a[1])
+    return _vstack([fp2.mul_xi(_vc(a, 2)), _vc(a, 0), _vc(a, 1)])
 
 
 def mul6_fp2(a, k):
-    return tuple(fp2.mul(x, k) for x in a)
+    """Fp6 * Fp2 scalar: one stacked Fp2 multiply (k broadcasts over v)."""
+    return fp2.mul_stacked(a, k[..., None, :, :])
 
 
 def inv6(a):
-    a0, a1, a2 = a
-    c0 = fp2.sub(fp2.sqr(a0), fp2.mul_xi(fp2.mul(a1, a2)))
-    c1 = fp2.sub(fp2.mul_xi(fp2.sqr(a2)), fp2.mul(a0, a1))
-    c2 = fp2.sub(fp2.sqr(a1), fp2.mul(a0, a2))
+    a0, a1, a2 = _vc(a, 0), _vc(a, 1), _vc(a, 2)
+    # round 1: a0^2, a1^2, a2^2, a1*a2, a0*a1, a0*a2 — one stacked multiply
+    A = jnp.stack([a0, a1, a2, a1, a0, a0], axis=-3)
+    B = jnp.stack([a0, a1, a2, a2, a1, a2], axis=-3)
+    m = fp2.mul_stacked(A, B)
+    s0, s1, s2 = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
+    p12, p01, p02 = m[..., 3, :, :], m[..., 4, :, :], m[..., 5, :, :]
+    c0 = fp2.sub(s0, fp2.mul_xi(p12))
+    c1 = fp2.sub(fp2.mul_xi(s2), p01)
+    c2 = fp2.sub(s1, p02)
+    # round 2: a2*c1, a1*c2, a0*c0
+    A2 = jnp.stack([a2, a1, a0], axis=-3)
+    C2 = jnp.stack([c1, c2, c0], axis=-3)
+    r = fp2.mul_stacked(A2, C2)
     t = fp2.add(
-        fp2.mul_xi(fp2.add(fp2.mul(a2, c1), fp2.mul(a1, c2))),
-        fp2.mul(a0, c0),
+        fp2.mul_xi(fp2.add(r[..., 0, :, :], r[..., 1, :, :])), r[..., 2, :, :]
     )
     tinv = fp2.inv(t)
-    return (fp2.mul(c0, tinv), fp2.mul(c1, tinv), fp2.mul(c2, tinv))
+    return fp2.mul_stacked(_vstack([c0, c1, c2]), tinv[..., None, :, :])
 
 
 def eq6(a, b):
-    out = fp2.eq(a[0], b[0])
-    for x, y in zip(a[1:], b[1:]):
-        out = out & fp2.eq(x, y)
-    return out
+    return jnp.all(a == b, axis=(-1, -2, -3))
 
 
 # ---------------------------------------------------------------------------
-# Fp12
+# Fp12 (w-coefficient axis = -5 overall)
 # ---------------------------------------------------------------------------
+
+
+def _wc(a, i):
+    return a[..., i, :, :, :]
+
+
+def _wstack(cs):
+    return jnp.stack(cs, axis=-4)
 
 
 def mul12(a, b):
-    a0, a1 = a
-    b0, b1 = b
-    t0 = mul6(a0, b0)
-    t1 = mul6(a1, b1)
+    a0, a1 = _wc(a, 0), _wc(a, 1)
+    b0, b1 = _wc(b, 0), _wc(b, 1)
+    # three Fp6 products in one stacked mul6 (=> one mont_mul of 54 products)
+    A = jnp.stack([a0, a1, add6(a0, a1)], axis=-4)
+    B = jnp.stack([b0, b1, add6(b0, b1)], axis=-4)
+    t = mul6(A, B)
+    t0, t1, t2 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
     c0 = add6(t0, mul6_by_v(t1))
-    c1 = sub6(sub6(mul6(add6(a0, a1), add6(b0, b1)), t0), t1)
-    return (c0, c1)
+    c1 = sub6(sub6(t2, t0), t1)
+    return _wstack([c0, c1])
 
 
 def sqr12(a):
-    """Complex squaring: 2 Fp6 muls instead of mul12's 3."""
-    a0, a1 = a
-    t = mul6(a0, a1)
-    c0 = sub6(
-        sub6(mul6(add6(a0, a1), add6(a0, mul6_by_v(a1))), t), mul6_by_v(t)
-    )
-    c1 = add6(t, t)
-    return (c0, c1)
+    """Complex squaring: 2 Fp6 products (vs mul12's 3), one stacked call."""
+    a0, a1 = _wc(a, 0), _wc(a, 1)
+    A = jnp.stack([a0, add6(a0, a1)], axis=-4)
+    B = jnp.stack([a1, add6(a0, mul6_by_v(a1))], axis=-4)
+    t = mul6(A, B)
+    t01 = t[..., 0, :, :, :]           # a0*a1
+    tm = t[..., 1, :, :, :]            # (a0+a1)(a0+v a1)
+    c0 = sub6(sub6(tm, t01), mul6_by_v(t01))
+    c1 = add6(t01, t01)
+    return _wstack([c0, c1])
 
 
 def conj12(a):
     """x -> x^(p^6): negate the w part."""
-    return (a[0], neg6(a[1]))
+    return _wstack([_wc(a, 0), neg6(_wc(a, 1))])
 
 
 def inv12(a):
-    a0, a1 = a
-    t = sub6(sqr6(a0), mul6_by_v(sqr6(a1)))
+    a0, a1 = _wc(a, 0), _wc(a, 1)
+    s = mul6(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
+    t = sub6(s[..., 0, :, :, :], mul6_by_v(s[..., 1, :, :, :]))
     tinv = inv6(t)
-    return (mul6(a0, tinv), neg6(mul6(a1, tinv)))
+    r = mul6(
+        jnp.stack([a0, a1], axis=-4), jnp.stack([tinv, tinv], axis=-4)
+    )
+    return _wstack([r[..., 0, :, :, :], neg6(r[..., 1, :, :, :])])
 
 
 def eq12(a, b):
-    return eq6(a[0], b[0]) & eq6(a[1], b[1])
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
 
 
 def is_one12(a):
-    import jax
-
-    one = jax.tree_util.tree_map(
-        lambda leaf, c: jnp.broadcast_to(jnp.asarray(c), leaf.shape),
-        a,
-        TWELVE_ONE,
-    )
-    return eq12(a, one)
+    return eq12(a, jnp.broadcast_to(jnp.asarray(TWELVE_ONE), a.shape))
 
 
 def select12(cond, x, y):
-    import jax
-
-    return jax.tree_util.tree_map(
-        lambda l, r: jnp.where(cond[..., None], l, r), x, y
-    )
+    return jnp.where(cond[..., None, None, None, None], x, y)
 
 
 # ---------------------------------------------------------------------------
-# Frobenius (precomputed gammas, Montgomery form)
+# Frobenius (precomputed gamma tables, Montgomery form)
 # ---------------------------------------------------------------------------
 
-# gamma[k] = xi^(k*(p-1)/6), k = 0..5 — same table as the ground truth.
-_GAMMA1_C = [fp2.const(g) for g in GT._GAMMA]
-# Second-power table: gamma2[k] = gamma1[k] * conj-twisted — derived on the
-# ground truth side to stay bit-exact: x^(p^2) coefficient for slot k.
-_GAMMA2_C = [
-    fp2.const(GT.fp2_mul(GT.fp2_conj(g), g)) for g in GT._GAMMA
-]
-
-
-def _frob_fp6(a, j: int, gammas):
-    out = []
-    for i in range(3):
-        k = 2 * i + j
-        out.append(fp2.mul(fp2.conj(a[i]), _as_dev(gammas[k])))
-    return tuple(out)
-
-
-def _frob2_fp6(a, j: int):
-    # p^2-Frobenius: conjugation applied twice = identity on Fp2; only the
-    # gamma2 scaling remains.
-    out = []
-    for i in range(3):
-        k = 2 * i + j
-        out.append(fp2.mul(a[i], _as_dev(_GAMMA2_C[k])))
-    return tuple(out)
-
-
-def _as_dev(c):
-    return tuple(map(jnp.asarray, c))
+# gamma1[k] = xi^(k*(p-1)/6), k = 0..5; coefficient (j, i) of the packed
+# layout (j = w-power, i = v-power) uses k = 2i + j.
+_G1_TABLE = np.stack(
+    [
+        np.stack([fp2.const(GT._GAMMA[2 * i + j]) for i in range(3)])
+        for j in range(2)
+    ]
+)  # [2, 3, 2, 32]
+_G2_TABLE = np.stack(
+    [
+        np.stack(
+            [
+                fp2.const(
+                    GT.fp2_mul(
+                        GT.fp2_conj(GT._GAMMA[2 * i + j]), GT._GAMMA[2 * i + j]
+                    )
+                )
+                for i in range(3)
+            ]
+        )
+        for j in range(2)
+    ]
+)
 
 
 def frobenius12(a, power: int = 1):
-    """x -> x^(p^power) for power in {1, 2, 3}."""
+    """x -> x^(p^power) for power in {1, 2, 3} — one stacked Fp2 multiply."""
     if power == 1:
-        return (_frob_fp6(a[0], 0, _GAMMA1_C), _frob_fp6(a[1], 1, _GAMMA1_C))
+        ac = jnp.stack(
+            [a[..., 0, :], fp.neg(a[..., 1, :])], axis=-2
+        )  # conj every Fp2 coefficient
+        return fp2.mul_stacked(ac, jnp.asarray(_G1_TABLE))
     if power == 2:
-        return (_frob2_fp6(a[0], 0), _frob2_fp6(a[1], 1))
+        return fp2.mul_stacked(a, jnp.asarray(_G2_TABLE))
     if power == 3:
         return frobenius12(frobenius12(a, 2), 1)
     raise ValueError("unsupported Frobenius power")
@@ -271,35 +277,41 @@ def mul12_by_line(f, l00, l11, l12):
     """f * L where L = (c0=(l00,0,0), c1=(0,l11,l12)) — the sparse shape
     produced by the D-type untwist line evaluation (see ops/pairing.py).
 
-    Costs 13 Fp2 muls vs mul12's 18: c0-part is an Fp6 scale by l00; the
-    c1-part is a sparse Fp6 mul by (0, l11, l12) done by hand.
+    14 Fp2 products total, grouped into two stacked multiplies: 8 sparse
+    products + one mul6 for the Karatsuba cross term.
     """
-    f0, f1 = f
-    b = (l11, l12)
+    f0, f1 = _wc(f, 0), _wc(f, 1)
+    f0_0, f0_1, f0_2 = _vc(f0, 0), _vc(f0, 1), _vc(f0, 2)
+    f1_0, f1_1, f1_2 = _vc(f1, 0), _vc(f1, 1), _vc(f1, 2)
 
-    def sparse6(a):
-        # a * (0 + b0 v + b1 v^2), a = (a0, a1, a2)
-        a0, a1, a2 = a
-        t1 = fp2.mul(a1, b[0])
-        t2 = fp2.mul(a2, b[1])
-        c0 = fp2.mul_xi(
-            fp2.sub(
-                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b[0], b[1])), t1),
-                t2,
-            )
-        )
-        c1 = fp2.add(fp2.mul(a0, b[0]), fp2.mul_xi(t2))
-        c2 = fp2.add(fp2.mul(a0, b[1]), t1)
-        return (c0, c1, c2)
-
-    t0 = mul6_fp2(f0, l00)           # a0 * c0
-    t1 = sparse6(f1)                  # a1 * c1(sparse)
+    # 8 independent Fp2 products in one stacked call:
+    #  0..2: f0 * l00 (t0 = f0 scaled)        3: f1_1*l11  4: f1_2*l12
+    #  5: (f1_1+f1_2)(l11+l12)                6: f1_0*l11  7: f1_0*l12
+    A = jnp.stack(
+        [f0_0, f0_1, f0_2, f1_1, f1_2, fp2.add(f1_1, f1_2), f1_0, f1_0],
+        axis=-3,
+    )
+    B = jnp.stack(
+        [l00, l00, l00, l11, l12, fp2.add(l11, l12), l11, l12], axis=-3
+    )
+    m = fp2.mul_stacked(A, B)
+    t0 = m[..., 0:3, :, :]  # f0 * l00 as an Fp6
+    p11, p22 = m[..., 3, :, :], m[..., 4, :, :]
+    pmm, p01, p02 = m[..., 5, :, :], m[..., 6, :, :], m[..., 7, :, :]
+    # t1 = f1 * (0, l11, l12)
+    t1 = _vstack(
+        [
+            fp2.mul_xi(fp2.sub(fp2.sub(pmm, p11), p22)),
+            fp2.add(p01, fp2.mul_xi(p22)),
+            fp2.add(p02, p11),
+        ]
+    )
     c0 = add6(t0, mul6_by_v(t1))
-    # (a0 + a1) * (c0 + c1) - t0 - t1, with (c0 + c1) = (l00, l11, l12)
+    # (f0 + f1) * (l00, l11, l12) - t0 - t1
     s = add6(f0, f1)
-    cs = (l00, l11, l12)
+    cs = _vstack([l00, l11, l12])
     c1 = sub6(sub6(mul6(s, cs), t0), t1)
-    return (c0, c1)
+    return _wstack([c0, c1])
 
 
 # ---------------------------------------------------------------------------
@@ -308,5 +320,5 @@ def mul12_by_line(f, l00, l11, l12):
 
 
 def cyclo_inv(a):
-    """In the cyclotomic subgroup x^(p^6+1)=... the inverse is conjugation."""
+    """In the cyclotomic subgroup the inverse is conjugation."""
     return conj12(a)

@@ -1,9 +1,15 @@
-"""GF(p^2) = Fp[u]/(u^2+1) on the JAX Montgomery-Fp layer.
+"""GF(p^2) = Fp[u]/(u^2+1) on the JAX Montgomery-Fp layer — packed layout.
 
-Elements are pytree pairs ``(c0, c1)`` of Fp limb arrays (uint32[..., 24],
-Montgomery form), so every op broadcasts over arbitrary leading batch
-dimensions and composes under jit/vmap.  Karatsuba multiply (3 Fp products)
-mirrors the ground truth in ``crypto.fields.fp2_mul``.
+An Fp2 element is ONE uint32 array ``[..., 2, 32]`` (component axis, then
+limb axis; Montgomery form).  All ops broadcast over arbitrary leading batch
+dimensions, and — critically — the component axis is part of the *batch*
+from the Fp layer's point of view, so an Fp2 multiply costs a single
+stacked `mont_mul` call in the traced graph no matter how many Fp2
+multiplies the caller stacks on top.  (The first version used `(c0, c1)`
+tuple pytrees, which inlined every Fp product separately; one `fp12.mul12`
+then traced 54 independent Montgomery-multiply graphs and XLA compile time
+exploded.  Packing the tower into array axes is what makes the pairing
+compile in seconds and lets the TPU see wide fused tensors.)
 
 Reference role: Fp2 is the coordinate field of G2 (signatures) and the
 bottom of the Fp12 tower the pairing lives in — the arithmetic blst runs in
@@ -20,30 +26,25 @@ import jax.numpy as jnp
 from ..crypto import fields as GT
 from . import fp
 
-Fp2 = tuple  # (c0, c1)
-
-
 # ---------------------------------------------------------------------------
 # Host-side constants / conversions
 # ---------------------------------------------------------------------------
 
 
-def const(x) -> tuple:
-    """(int, int) ground-truth element -> Montgomery limb constant pair."""
-    return (fp.const(x[0]), fp.const(x[1]))
+def const(x) -> np.ndarray:
+    """(int, int) ground-truth element -> Montgomery constant [2, 32]."""
+    return np.stack([fp.const(x[0]), fp.const(x[1])])
 
 
 def decode(a) -> tuple:
-    """Montgomery pair -> (int, int) ground-truth element (host side)."""
+    """Montgomery [2, 32] array -> (int, int) ground-truth element."""
+    a = np.asarray(a)
     return (fp.decode(a[0]), fp.decode(a[1]))
 
 
-def stack_consts(xs) -> tuple:
-    """List of (int, int) -> batched Fp2 constant (c0[n,24], c1[n,24])."""
-    return (
-        np.stack([fp.const(x[0]) for x in xs]),
-        np.stack([fp.const(x[1]) for x in xs]),
-    )
+def stack_consts(xs) -> np.ndarray:
+    """List of (int, int) -> batched Fp2 constant [n, 2, 32]."""
+    return np.stack([const(x) for x in xs])
 
 
 ZERO = const(GT.FP2_ZERO)
@@ -55,76 +56,93 @@ ONE = const(GT.FP2_ONE)
 # ---------------------------------------------------------------------------
 
 
-def add(a: Fp2, b: Fp2) -> Fp2:
-    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+def add(a, b):
+    return fp.add(a, b)
 
 
-def sub(a: Fp2, b: Fp2) -> Fp2:
-    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+def sub(a, b):
+    return fp.sub(a, b)
 
 
-def neg(a: Fp2) -> Fp2:
-    return (fp.neg(a[0]), fp.neg(a[1]))
+def neg(a):
+    return fp.neg(a)
 
 
-def mul(a: Fp2, b: Fp2) -> Fp2:
-    a0, a1 = a
-    b0, b1 = b
-    t0 = fp.mont_mul(a0, b0)
-    t1 = fp.mont_mul(a1, b1)
-    # Karatsuba cross term: (a0+a1)(b0+b1) - t0 - t1
-    t2 = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
-    return (fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1))
+def _split(a):
+    return a[..., 0, :], a[..., 1, :]
 
 
-def sqr(a: Fp2) -> Fp2:
-    a0, a1 = a
-    # (a0+a1)(a0-a1), 2*a0*a1
-    c0 = fp.mont_mul(fp.add(a0, a1), fp.sub(a0, a1))
-    c1 = fp.mont_mul(a0, a1)
-    return (c0, fp.add(c1, c1))
+def mul_stacked(a, b):
+    """Karatsuba product where callers may stack any number of Fp2 pairs in
+    the leading batch dims; the three Fp products run as ONE mont_mul."""
+    a0, a1 = _split(a)
+    b0, b1 = _split(b)
+    A = jnp.stack([a0, a1, fp.add(a0, a1)], axis=-2)
+    B = jnp.stack([b0, b1, fp.add(b0, b1)], axis=-2)
+    t = fp.mont_mul(A, B)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    c0 = fp.sub(t0, t1)
+    c1 = fp.sub(t2, fp.add(t0, t1))
+    return jnp.stack([c0, c1], axis=-2)
 
 
-def mul_fp(a: Fp2, k) -> Fp2:
-    """Multiply by an Fp element (Montgomery limb array)."""
-    return (fp.mont_mul(a[0], k), fp.mont_mul(a[1], k))
+mul = mul_stacked
 
 
-def mul_small(a: Fp2, k: int) -> Fp2:
-    return (fp.mul_small(a[0], k), fp.mul_small(a[1], k))
+def sqr(a):
+    """(a0+a1)(a0-a1), 2*a0*a1 — two Fp products as one mont_mul."""
+    a0, a1 = _split(a)
+    A = jnp.stack([fp.add(a0, a1), a0], axis=-2)
+    B = jnp.stack([fp.sub(a0, a1), a1], axis=-2)
+    t = fp.mont_mul(A, B)
+    c0 = t[..., 0, :]
+    c1 = t[..., 1, :]
+    return jnp.stack([c0, fp.add(c1, c1)], axis=-2)
 
 
-def conj(a: Fp2) -> Fp2:
+def mul_fp(a, k):
+    """Multiply by an Fp element k ([..., 32]): one broadcast mont_mul."""
+    return fp.mont_mul(a, k[..., None, :])
+
+
+def mul_small(a, k: int):
+    return fp.mul_small(a, k)
+
+
+def conj(a):
     """Frobenius x -> x^p on Fp2 (conjugation)."""
-    return (a[0], fp.neg(a[1]))
+    a0, a1 = _split(a)
+    return jnp.stack([a0, fp.neg(a1)], axis=-2)
 
 
-def mul_xi(a: Fp2) -> Fp2:
+def mul_xi(a):
     """Multiply by xi = u + 1: (c0 - c1) + (c0 + c1) u."""
-    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+    a0, a1 = _split(a)
+    return jnp.stack([fp.sub(a0, a1), fp.add(a0, a1)], axis=-2)
 
 
-def inv(a: Fp2) -> Fp2:
+def inv(a):
     """1/a via the norm map; returns 0 for input 0 (callers gate)."""
-    a0, a1 = a
-    n = fp.add(fp.sqr(a0), fp.sqr(a1))
+    a0, a1 = _split(a)
+    sq = fp.mont_mul(a, a)  # a0^2, a1^2 in one call
+    n = fp.add(sq[..., 0, :], sq[..., 1, :])
     ninv = fp.inv(n)
-    return (fp.mont_mul(a0, ninv), fp.neg(fp.mont_mul(a1, ninv)))
+    t = fp.mont_mul(a, ninv[..., None, :])
+    return jnp.stack([t[..., 0, :], fp.neg(t[..., 1, :])], axis=-2)
 
 
-def is_zero(a: Fp2):
-    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+def is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
 
 
-def eq(a: Fp2, b: Fp2):
-    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+def eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
 
 
-def select(cond, x: Fp2, y: Fp2) -> Fp2:
-    """Batch-shaped boolean select over both components."""
-    return (fp.select(cond, x[0], y[0]), fp.select(cond, x[1], y[1]))
+def select(cond, x, y):
+    return jnp.where(cond[..., None, None], x, y)
 
 
-def broadcast_to(a: Fp2, batch) -> Fp2:
-    shape = (*batch, fp.L.N_LIMBS)
-    return (jnp.broadcast_to(a[0], shape), jnp.broadcast_to(a[1], shape))
+def broadcast_to(a, batch):
+    a = jnp.asarray(a)
+    return jnp.broadcast_to(a, (*batch, 2, fp.L.N_LIMBS))

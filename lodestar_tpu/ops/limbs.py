@@ -11,8 +11,13 @@ carry-propagation afterwards.  That keeps the traced graph per multiply at
 of long scalar chains.  (A future Pallas path can split limbs to 8 bits and
 run the same contraction on the MXU's int8 pipeline.)
 
-Carry/borrow chains are `lax.scan`s over the limb axis — sequential by
-nature, O(1) graph size, fully vectorized over the batch.
+Carry/borrow chains are fully vectorized: three shift-add passes fold the
+multi-bit column carries down until every limb is <= 2^12 (carries become
+binary), then a Kogge-Stone carry-lookahead resolves the remaining ripple
+in log2(n) steps.  No `lax.scan` anywhere — the whole field layer is
+shift/mask/add vector ops, which XLA compiles and schedules well on both
+TPU and CPU (a sequential scan per multiply was the dominant compile-time
+and runtime cost of the first version).
 
 No modulus lives at this layer; see ``fp.py`` for GF(p).
 """
@@ -74,44 +79,87 @@ def batch_from_limbs(arr) -> list:
 # ---------------------------------------------------------------------------
 
 
+def _shift_up(c):
+    """Move each value one limb up (carry flow): out[k] = c[k-1], out[0]=0.
+
+    The value carried out of the top limb is dropped — callers must ensure
+    it is zero (true for all uses here by construction).
+    """
+    return jnp.concatenate(
+        [jnp.zeros((*c.shape[:-1], 1), c.dtype), c[..., :-1]], axis=-1
+    )
+
+
+def _shift_up_dyn(c, d):
+    """_shift_up by a *traced* distance d (for the lookahead fori_loop)."""
+    n = c.shape[-1]
+    pad = jnp.concatenate([jnp.zeros_like(c), c], axis=-1)
+    start = [jnp.int32(0)] * (c.ndim - 1) + [jnp.int32(n) - d]
+    return lax.dynamic_slice(pad, start, c.shape)
+
+
+def _lookahead(g, p):
+    """Kogge-Stone composition: per-limb carry/borrow OUT of each position.
+
+    g = generate, p = propagate (binary uint32).  log2(n) rounds as a
+    fori_loop whose body compiles once (the shift distance is a loop
+    value), keeping the traced graph small.
+    """
+    n = g.shape[-1]
+    rounds = max(1, (n - 1).bit_length())
+
+    def body(i, gp):
+        g, p = gp
+        d = jnp.int32(1) << i
+        g = g | (p & _shift_up_dyn(g, d))
+        p = p & _shift_up_dyn(p, d)
+        return (g, p)
+
+    g, _ = lax.fori_loop(0, rounds, body, (g, p))
+    return g
+
+
 def carry_prop(cols):
     """Fold carries in a column vector (values < 2^31) into canonical limbs.
 
-    The final carry out of the top column is dropped — callers must ensure
-    it is zero (true for all uses here by construction).
+    Three vectorized shift-add passes shrink the carries: after pass 1
+    limbs are < 2^12 + 2^19, after pass 2 < 2^12 + 2^8, after pass 3
+    <= 2^12 — so the residual carry is binary.  A Kogge-Stone lookahead
+    (generate g = limb == 2^12, propagate p = limb == 2^12 - 1) then
+    resolves the remaining ripple in log2(n) rounds.  Entirely
+    shift/mask/add — no sequential scan; repeated rounds run as fori_loops
+    so each body is traced and compiled once.
     """
-    def step(carry, col):
-        t = col + carry
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    _, out = lax.scan(
-        step,
-        jnp.zeros(cols.shape[:-1], DTYPE),
-        jnp.moveaxis(cols, -1, 0),
+    t = lax.fori_loop(
+        0, 3, lambda _, t: (t & LIMB_MASK) + _shift_up(t >> LIMB_BITS), cols
     )
-    return jnp.moveaxis(out, 0, -1)
+    # t[i] <= 2^12: binary carry-lookahead.
+    g = _lookahead(t >> LIMB_BITS, (t == LIMB_MASK).astype(DTYPE))
+    return (t + _shift_up(g)) & LIMB_MASK
 
 
 def add_nocarryout(a, b):
-    """a + b where the sum fits the limb count.  Canonical inputs/output."""
-    return carry_prop(a + b)
+    """a + b where the sum fits the limb count.  Canonical inputs/output.
+
+    Sums of two canonical numbers have binary carries already, so this
+    skips the multi-bit passes and goes straight to the lookahead.
+    """
+    t = a + b
+    g = _lookahead(t >> LIMB_BITS, (t == LIMB_MASK).astype(DTYPE))
+    return (t + _shift_up(g)) & LIMB_MASK
 
 
 def sub_with_borrow(a, b):
-    """(a - b mod 2^(12n), borrow_out) — borrow_out is 1 where a < b."""
+    """(a - b mod 2^(12n), borrow_out) — borrow_out is 1 where a < b.
+
+    Canonical inputs.  Borrow is binary from the start: one lookahead
+    (generate a_i < b_i, propagate a_i == b_i).
+    """
     a, b = jnp.broadcast_arrays(a, b)
-
-    def step(borrow, ab):
-        ai, bi = ab
-        t = ai + jnp.uint32(1 << LIMB_BITS) - bi - borrow
-        return jnp.uint32(1) - (t >> LIMB_BITS), t & LIMB_MASK
-
-    borrow, out = lax.scan(
-        step,
-        jnp.zeros(a.shape[:-1], DTYPE),
-        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)),
-    )
-    return jnp.moveaxis(out, 0, -1), borrow
+    g = _lookahead((a < b).astype(DTYPE), (a == b).astype(DTYPE))
+    borrow_in = _shift_up(g)
+    out = (a + jnp.uint32(1 << LIMB_BITS) - b - borrow_in) & LIMB_MASK
+    return out, g[..., -1]
 
 
 def geq(a, b):
@@ -146,13 +194,7 @@ def mul_full(a, b):
     Toeplitz gather of b (zero-padded), then a single carry propagation.
     Exact in uint32 by the 12-bit limb bound.
     """
-    n = a.shape[-1]
-    bpad = jnp.concatenate(
-        [b, jnp.zeros((*b.shape[:-1], n), DTYPE)], axis=-1
-    )
-    bmat = bpad[..., TOEP_IDX]  # [..., n, 2n]
-    cols = jnp.einsum("...j,...jk->...k", a, bmat)
-    return carry_prop(cols)
+    return carry_prop(mul_full_cols(a, b))
 
 
 def mul_low(a, b):
@@ -160,10 +202,36 @@ def mul_low(a, b):
 
     Same contraction as mul_full but sliced to the low n columns (half the
     multiply work and carry length — this is REDC's middle multiply)."""
+    return carry_prop(mul_low_cols(a, b))
+
+def shrink(cols):
+    """Three shift-add passes: columns < 2^31 -> redundant limbs <= 2^12.
+
+    Value-preserving but NOT canonical (a limb may be exactly 2^12).  Cheap
+    replacement for carry_prop at points where only the represented value
+    matters (mid-REDC) — exactness of subsequent 12-bit-limb products is
+    retained since 4096^2 * 32 < 2^31.
+    """
+    return lax.fori_loop(
+        0, 3, lambda _, t: (t & LIMB_MASK) + _shift_up(t >> LIMB_BITS), cols
+    )
+
+
+def mul_full_cols(a, b):
+    """Raw column products (no carry): [..., 2n] with columns < 2^29."""
+    n = a.shape[-1]
+    bpad = jnp.concatenate(
+        [b, jnp.zeros((*b.shape[:-1], n), DTYPE)], axis=-1
+    )
+    bmat = bpad[..., TOEP_IDX]  # [..., n, 2n]
+    return jnp.einsum("...j,...jk->...k", a, bmat)
+
+
+def mul_low_cols(a, b):
+    """Low-half column products: [..., n], columns < 2^29."""
     n = a.shape[-1]
     bpad = jnp.concatenate(
         [b, jnp.zeros((*b.shape[:-1], n), DTYPE)], axis=-1
     )
     bmat = bpad[..., TOEP_IDX[:, :n]]  # [..., n, n]
-    cols = jnp.einsum("...j,...jk->...k", a, bmat)
-    return carry_prop(cols)
+    return jnp.einsum("...j,...jk->...k", a, bmat)

@@ -13,6 +13,10 @@ Structure (all batched over leading dims, all branchless on values):
     Z3*xi for addition) — legal because any Fp6-subfield factor is killed
     by the easy part of the final exponentiation.
 
+  - Every step groups its independent Fp2 products into stacked
+    `fp2.mul_stacked`/`fp2.sqr` calls (see ops/fp2.py): a doubling step is
+    ~5 fused multiplies in the traced graph, not ~30 inlined ones.
+
   - The Miller loop is a `fori_loop` over the static bit table of |x| with
     a `lax.cond` for the (rare: 5) addition steps, so the traced graph is a
     single loop body.
@@ -32,7 +36,6 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -45,6 +48,11 @@ _ATE_BITS = np.array([int(c) for c in GTP.ATE_BITS], dtype=np.uint32)
 _Z_ABS = -GT.X_PARAM  # positive 64-bit loop parameter
 
 
+def _s(xs):
+    """Stack Fp2 elements along a new product axis (-3)."""
+    return jnp.stack(xs, axis=-3)
+
+
 # ---------------------------------------------------------------------------
 # Miller-loop steps (G2 jacobian over Fp2, line evaluated at embedded P)
 # ---------------------------------------------------------------------------
@@ -54,23 +62,35 @@ def dbl_step(t, xp, yp):
     """T <- 2T and the tangent line at T evaluated at P = (xp, yp) in Fp.
 
     Line scale factor: 2*Y*Z^3 * xi (an Fp2 element — final-exp-invariant).
-    Returns (T', (l00, l11, l12)).
+    Returns (T', (l00, l11, l12)).  5 stacked multiplies total.
     """
     X, Y, Z = t
-    A = fp2.sqr(X)
-    B = fp2.sqr(Y)
-    C = fp2.sqr(B)
-    D = fp2.mul_small(fp2.sub(fp2.sub(fp2.sqr(fp2.add(X, B)), A), C), 2)
+    s1 = fp2.sqr(_s([X, Y, Z]))
+    A, B, Z2 = s1[..., 0, :, :], s1[..., 1, :, :], s1[..., 2, :, :]
     E = fp2.mul_small(A, 3)
-    F = fp2.sqr(E)
+    s2 = fp2.sqr(_s([B, E, fp2.add(X, B)]))
+    C, F, S = s2[..., 0, :, :], s2[..., 1, :, :], s2[..., 2, :, :]
+    D = fp2.mul_small(fp2.sub(fp2.sub(S, A), C), 2)
     X3 = fp2.sub(F, fp2.mul_small(D, 2))
-    Y3 = fp2.sub(fp2.mul(E, fp2.sub(D, X3)), fp2.mul_small(C, 8))
-    Z3 = fp2.mul_small(fp2.mul(Y, Z), 2)
-    Z2 = fp2.sqr(Z)
+    m = fp2.mul_stacked(
+        _s([Y, E, E, E]), _s([Z, fp2.sub(D, X3), X, Z2])
+    )
+    YZ, T1, EX, EZ2 = (
+        m[..., 0, :, :],
+        m[..., 1, :, :],
+        m[..., 2, :, :],
+        m[..., 3, :, :],
+    )
+    Y3 = fp2.sub(T1, fp2.mul_small(C, 8))
+    Z3 = fp2.mul_small(YZ, 2)
+    Z3Z2 = fp2.mul_stacked(Z3, Z2)
     # l00 = xi * Z3 * Z^2 * yp ; l11 = E*X - 2B ; l12 = -E * Z^2 * xp
-    l00 = fp2.mul_xi(fp2.mul_fp(fp2.mul(Z3, Z2), yp))
-    l11 = fp2.sub(fp2.mul(E, X), fp2.mul_small(B, 2))
-    l12 = fp2.neg(fp2.mul_fp(fp2.mul(E, Z2), xp))
+    pf = fp.mont_mul(
+        _s([Z3Z2, EZ2]), jnp.stack([yp, xp], axis=-2)[..., None, :]
+    )
+    l00 = fp2.mul_xi(pf[..., 0, :, :])
+    l11 = fp2.sub(EX, fp2.mul_small(B, 2))
+    l12 = fp2.neg(pf[..., 1, :, :])
     return (X3, Y3, Z3), (l00, l11, l12)
 
 
@@ -82,19 +102,26 @@ def add_step(t, q, xp, yp):
     X1, Y1, Z1 = t
     xq, yq = q
     Z1Z1 = fp2.sqr(Z1)
-    U2 = fp2.mul(xq, Z1Z1)
-    S2 = fp2.mul(yq, fp2.mul(Z1, Z1Z1))
+    m1 = fp2.mul_stacked(_s([xq, Z1]), _s([Z1Z1, Z1Z1]))
+    U2, Z1c = m1[..., 0, :, :], m1[..., 1, :, :]
+    S2 = fp2.mul_stacked(yq, Z1c)
     H = fp2.sub(U2, X1)
     r = fp2.sub(S2, Y1)
-    H2 = fp2.sqr(H)
-    H3 = fp2.mul(H, H2)
-    V = fp2.mul(X1, H2)
-    X3 = fp2.sub(fp2.sub(fp2.sqr(r), H3), fp2.mul_small(V, 2))
-    Y3 = fp2.sub(fp2.mul(r, fp2.sub(V, X3)), fp2.mul(Y1, H3))
-    Z3 = fp2.mul(Z1, H)
-    l00 = fp2.mul_xi(fp2.mul_fp(Z3, yp))
-    l11 = fp2.sub(fp2.mul(r, xq), fp2.mul(yq, Z3))
-    l12 = fp2.neg(fp2.mul_fp(r, xp))
+    s2 = fp2.sqr(_s([H, r]))
+    H2, R2 = s2[..., 0, :, :], s2[..., 1, :, :]
+    m2 = fp2.mul_stacked(_s([H, X1, Z1]), _s([H2, H2, H]))
+    H3, V, Z3 = m2[..., 0, :, :], m2[..., 1, :, :], m2[..., 2, :, :]
+    X3 = fp2.sub(fp2.sub(R2, H3), fp2.mul_small(V, 2))
+    m3 = fp2.mul_stacked(
+        _s([r, Y1, r, yq]), _s([fp2.sub(V, X3), H3, xq, Z3])
+    )
+    Y3 = fp2.sub(m3[..., 0, :, :], m3[..., 1, :, :])
+    l11 = fp2.sub(m3[..., 2, :, :], m3[..., 3, :, :])
+    pf = fp.mont_mul(
+        _s([Z3, r]), jnp.stack([yp, xp], axis=-2)[..., None, :]
+    )
+    l00 = fp2.mul_xi(pf[..., 0, :, :])
+    l12 = fp2.neg(pf[..., 1, :, :])
     return (X3, Y3, Z3), (l00, l11, l12)
 
 
@@ -107,14 +134,14 @@ def miller_loop(p_aff, q_aff):
     """f_{|x|,Q}(P) conjugated for the negative BLS parameter.
 
     `p_aff = (xp, yp)` — affine G1 coordinates (Fp limb arrays).
-    `q_aff = (xq, yq)` — affine G2 coordinates on the twist (Fp2 pairs).
+    `q_aff = (xq, yq)` — affine G2 coordinates on the twist (packed Fp2).
     Inputs must be valid non-infinity points (padding is resolved by the
     callers in ops/bls_kernels.py before reaching the loop).
     """
     xp, yp = p_aff
     batch = xp.shape[:-1]
     bits = jnp.asarray(_ATE_BITS)
-    t0 = (q_aff[0], q_aff[1], fp2.broadcast_to(tuple(map(jnp.asarray, fp2.ONE)), batch))
+    t0 = (q_aff[0], q_aff[1], fp2.broadcast_to(fp2.ONE, batch))
     f0 = fp12.one12(batch)
 
     def body(i, carry):
@@ -136,21 +163,28 @@ def miller_loop(p_aff, q_aff):
 
 
 def product12(fs):
-    """Product along the leading axis by halving tree reduction."""
-    n = jax.tree_util.tree_leaves(fs)[0].shape[0]
-    while n > 1:
-        half = (n + 1) // 2
-        lo = jax.tree_util.tree_map(lambda a: a[:half], fs)
-        hi = jax.tree_util.tree_map(lambda a: a[half:], fs)
-        if n % 2 == 1:
-            rest = jax.tree_util.tree_leaves(hi)[0].shape[:-1][1:]
-            pad = fp12.one12((1, *rest))
-            hi = jax.tree_util.tree_map(
-                lambda h, z: jnp.concatenate([h, z], axis=0), hi, pad
-            )
-        fs = fp12.mul12(lo, hi)
-        n = half
-    return jax.tree_util.tree_map(lambda a: a[0], fs)
+    """Product along the leading axis — hypercube reduction.
+
+    ceil(log2(n)) rounds of f_i *= f_{i+2^r} at full width inside one
+    fori_loop: a single compiled mul12 body regardless of n.
+    """
+    n = fs.shape[0]
+    if n == 1:
+        return fs[0]
+    rounds = (n - 1).bit_length()
+    ones = fp12.one12(fs.shape[:-4])
+
+    def body(r, acc):
+        d = jnp.int32(1) << r
+        idx = jnp.arange(n, dtype=jnp.int32) + d
+        in_range = idx < n
+        partner = jnp.take(acc, jnp.where(in_range, idx, 0), axis=0)
+        partner = fp12.select12(
+            in_range.reshape((n,) + (1,) * (acc.ndim - 5)), partner, ones
+        )
+        return fp12.mul12(acc, partner)
+
+    return lax.fori_loop(0, rounds, body, fs)[0]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,6 @@
+"""Runtime utilities: queues, backpressure, metrics.
+
+Mirrors the reference's `packages/beacon-node/src/util/` + `src/metrics/`
+roles (JobItemQueue, gossip queues, prom metrics) in the shapes this
+framework needs.
+"""

@@ -1,0 +1,175 @@
+"""Minimal Prometheus-style metrics registry.
+
+Reproduces the reference's `lodestar_bls_thread_pool_*` metric family
+(reference: packages/beacon-node/src/metrics/metrics/lodestar.ts:357-430 —
+queueLength, jobWaitTime, timePerSigSet, batchRetries, batchSigsSuccess,
+latencyToWorker/FromWorker, per-worker jobsWorkerTime) so the shipped
+Grafana dashboard (reference: dashboards/lodestar_bls_thread_pool.json)
+reads identically against the TPU backend.  Text exposition follows the
+Prometheus format; no external dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+            f"{self.name} {self._v}",
+        ]
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._v += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._v -= amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self._v}",
+        ]
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: Sequence[float]):
+        self.name, self.help = name, help_
+        self.buckets = sorted(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cum = 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._n}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self._get(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str, buckets) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets))
+
+    def _get(self, name, factory):
+        if name not in self._metrics:
+            self._metrics[name] = factory()
+        return self._metrics[name]
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+_SECONDS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5]
+
+
+class BlsPoolMetrics:
+    """The lodestar_bls_thread_pool_* family, verbatim names.
+
+    Reference: packages/beacon-node/src/metrics/metrics/lodestar.ts:357-430.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        p = "lodestar_bls_thread_pool_"
+        self.queue_length = r.gauge(p + "queue_length", "Queued verification jobs")
+        self.workers_busy = r.gauge(p + "workers_busy", "Busy device streams")
+        self.job_wait_time = r.histogram(
+            p + "queue_job_wait_time_seconds", "Time a job waits in queue", _SECONDS
+        )
+        self.job_time = r.histogram(
+            p + "job_time_seconds", "Device time per job", _SECONDS
+        )
+        self.time_per_sig_set = r.histogram(
+            p + "time_per_sig_set_seconds",
+            "Device time per signature set",
+            [1e-5, 1e-4, 1e-3, 1e-2],
+        )
+        self.success_jobs = r.counter(
+            p + "success_jobs_signature_sets_count", "Sig sets verified OK"
+        )
+        self.error_jobs = r.counter(p + "error_jobs_count", "Failed jobs")
+        self.batch_retries = r.counter(
+            p + "batch_retries_count", "Batches re-verified set-by-set"
+        )
+        self.batch_sigs_success = r.counter(
+            p + "batch_sigs_success_count", "Sig sets verified in a batch"
+        )
+        self.batchable_sigs = r.counter(
+            p + "batchable_sigs_count", "Sig sets submitted as batchable"
+        )
+        self.invalid_sets = r.counter(
+            p + "invalid_sig_sets_count", "Sig sets that failed verification"
+        )

@@ -1,0 +1,150 @@
+"""Device batch-verification kernels vs the pure-Python ground truth.
+
+Covers the jitted entry points the verifier service calls (the work the
+reference performs in its BLS worker threads, reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106):
+verify_batch (random-linear-combination batch), verify_each (retry path),
+aggregate_pubkeys (device-resident table), g2_subgroup_check_fast.
+"""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.ops import bls_kernels as BK
+from lodestar_tpu.ops import curve as K
+from lodestar_tpu.ops import fp, fp2
+
+rng = random.Random(0xB15)
+nprng = np.random.default_rng(0xB15)
+
+
+def enc_g1_affine(pts):
+    xs = jnp.asarray(np.stack([fp.const(p[0]) for p in pts]))
+    ys = jnp.asarray(np.stack([fp.const(p[1]) for p in pts]))
+    return (xs, ys)
+
+
+def enc_g2_affine(pts):
+    return (
+        jnp.asarray(fp2.stack_consts([p[0] for p in pts])),
+        jnp.asarray(fp2.stack_consts([p[1] for p in pts])),
+    )
+
+
+def make_sets(n, bad=()):
+    """n signature sets [(pk, H(m), sig)]; indices in `bad` get a wrong sig."""
+    pks, hms, sigs = [], [], []
+    for i in range(n):
+        sk = GTB.keygen(b"kernel-test-%d" % i)
+        msg = b"signing root %d" % i
+        sig = GTB.sign(sk, msg)
+        if i in bad:
+            sig = C.scalar_mul(C.FP2_OPS, sig, 2)  # valid point, wrong sig
+        pks.append(GTB.sk_to_pk(sk))
+        hms.append(hash_to_g2(msg))
+        sigs.append(sig)
+    return pks, hms, sigs
+
+
+def run_batch(pks, hms, sigs, valid):
+    n = len(valid)
+    rand_bits = jnp.asarray(BK.make_rand_bits(n, nprng))
+    ok, sig_ok = jax.jit(BK.verify_batch)(
+        enc_g1_affine(pks),
+        enc_g2_affine(hms),
+        enc_g2_affine(sigs),
+        rand_bits,
+        jnp.asarray(valid),
+    )
+    return bool(ok), np.asarray(sig_ok)
+
+
+def test_verify_batch_accepts_valid_sets_with_padding():
+    pks, hms, sigs = make_sets(3)
+    # pad slot 3 with garbage-but-encodable data (the generator itself)
+    pks.append(C.G1_GEN)
+    hms.append(C.G2_GEN)
+    sigs.append(C.G2_GEN)
+    ok, sig_ok = run_batch(pks, hms, sigs, [True, True, True, False])
+    assert ok
+    assert sig_ok.all()
+
+
+def test_verify_batch_rejects_one_bad_sig():
+    pks, hms, sigs = make_sets(4, bad={2})
+    ok, _ = run_batch(pks, hms, sigs, [True] * 4)
+    assert not ok
+
+
+def test_verify_batch_ignores_bad_sig_in_padded_slot():
+    pks, hms, sigs = make_sets(4, bad={2})
+    ok, _ = run_batch(pks, hms, sigs, [True, True, False, True])
+    assert ok
+
+
+def test_verify_each_pinpoints_bad_sets():
+    pks, hms, sigs = make_sets(4, bad={1, 3})
+    ok = jax.jit(BK.verify_each)(
+        enc_g1_affine(pks),
+        enc_g2_affine(hms),
+        enc_g2_affine(sigs),
+        jnp.asarray([True, True, True, False]),
+    )
+    # slot 3 is padding -> forced True even though its sig is bad
+    assert np.asarray(ok).tolist() == [True, False, True, True]
+
+
+def test_verify_batch_rejects_non_subgroup_signature():
+    pks, hms, sigs = make_sets(2)
+    # An on-curve G2 point outside the r-torsion (the cofactor is huge, so
+    # a random curve point is ~never in the subgroup): scan x = (ctr, 1).
+    ctr, h = 0, None
+    while h is None:
+        x = (ctr, 1)
+        rhs = GT.fp2_add(GT.fp2_mul(GT.fp2_mul(x, x), x), C.FP2_OPS.b_coeff)
+        y = GT.fp2_sqrt(rhs)
+        ctr += 1
+        if y is not None and not C.g2_subgroup_check((x, y)):
+            h = (x, y)
+    sigs[1] = h
+    ok, sig_ok = run_batch(pks, hms, sigs, [True, True])
+    assert not ok
+    assert sig_ok.tolist() == [True, False]
+
+
+def test_aggregate_pubkeys_matches_ground_truth():
+    V, N, Kk = 8, 3, 4
+    pks = [GTB.sk_to_pk(GTB.keygen(b"table-%d" % i)) for i in range(V)]
+    table_x = jnp.asarray(np.stack([fp.const(p[0]) for p in pks]))
+    table_y = jnp.asarray(np.stack([fp.const(p[1]) for p in pks]))
+    idx = np.zeros((N, Kk), np.int32)
+    mask = np.zeros((N, Kk), bool)
+    want = []
+    for i in range(N):
+        k = rng.randrange(1, Kk + 1)
+        sel = rng.sample(range(V), k)
+        idx[i, :k] = sel
+        mask[i, :k] = True
+        want.append(GTB.aggregate_pubkeys([pks[j] for j in sel]))
+    agg = jax.jit(BK.aggregate_pubkeys)(
+        table_x, table_y, jnp.asarray(idx), jnp.asarray(mask)
+    )
+    got = K.decode_points(K.FP_OPS, agg)
+    assert got == want
+
+
+def test_g2_subgroup_check_fast_matches_full_check():
+    good = C.scalar_mul(C.FP2_OPS, C.G2_GEN, rng.randrange(1, GT.R))
+    pts = [good, C.G2_GEN]
+    xs, ys = enc_g2_affine(pts)
+    one = fp2.broadcast_to(fp2.ONE, (len(pts),))
+    ok = jax.jit(BK.g2_subgroup_check_fast)((xs, ys, one))
+    assert np.asarray(ok).all()

@@ -85,9 +85,9 @@ def test_sparse_line_mul():
 
     import lodestar_tpu.ops.fp2 as fp2m
 
-    l00 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[0] for l in lines]))
-    l11 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[1] for l in lines]))
-    l12 = tuple(jnp.asarray(v) for v in fp2m.stack_consts([l[2] for l in lines]))
+    l00 = jnp.asarray(fp2m.stack_consts([l[0] for l in lines]))
+    l11 = jnp.asarray(fp2m.stack_consts([l[1] for l in lines]))
+    l12 = jnp.asarray(fp2m.stack_consts([l[2] for l in lines]))
     got = jax.jit(fp12.mul12_by_line)(a, l00, l11, l12)
     want = [GT.fp12_mul(x, to_full(l)) for x, l in zip(xs, lines)]
     assert dec(got) == want

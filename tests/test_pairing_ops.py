@@ -25,11 +25,9 @@ def enc_g1_affine(pts):
 
 
 def enc_g2_affine(pts):
-    xc = fp2.stack_consts([p[0] for p in pts])
-    yc = fp2.stack_consts([p[1] for p in pts])
     return (
-        tuple(jnp.asarray(v) for v in xc),
-        tuple(jnp.asarray(v) for v in yc),
+        jnp.asarray(fp2.stack_consts([p[0] for p in pts])),
+        jnp.asarray(fp2.stack_consts([p[1] for p in pts])),
     )
 
 
@@ -51,13 +49,20 @@ def rand_pairs(n):
     return out
 
 
-def test_miller_loop_matches_oracle():
+def test_miller_loop_matches_oracle_up_to_subfield():
+    # The twisted loop scales each line by an Fp2 factor (killed by the
+    # easy part of the final exponentiation — see ops/pairing.py), so the
+    # raw Miller value equals the affine oracle's up to an Fp2 factor.
     pairs = rand_pairs(2) + [(C.G1_GEN, C.G2_GEN)]
     ps = enc_g1_affine([p for p, _ in pairs])
     qs = enc_g2_affine([q for _, q in pairs])
     got = dec12(jax.jit(KP.miller_loop)(ps, qs))
-    want = [GTP.miller_loop(p, q) for p, q in pairs]
-    assert got == want
+    for (p, q), g in zip(pairs, got):
+        want = GTP.miller_loop(p, q)
+        ratio = GT.fp12_mul(g, GT.fp12_inv(want))
+        c0, c1 = ratio
+        assert c1 == GT.FP6_ZERO and c0[1] == GT.FP2_ZERO and c0[2] == GT.FP2_ZERO
+        assert not GT.fp2_is_zero(c0[0])
 
 
 def test_final_exponentiation_is_cubed_oracle():

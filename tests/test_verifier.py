@@ -1,0 +1,100 @@
+"""TpuBlsVerifier service semantics vs the reference's IBlsVerifier contract.
+
+Covers: single + aggregate sets against the device pubkey table, RLC batch
+accept, batch-failure -> individual retry accounting, per-set verdicts,
+backpressure counter, undecodable-signature handling.
+Reference semantics: packages/beacon-node/src/chain/bls/{interface.ts,
+maybeBatch.ts, multithread/worker.ts:52-96}.
+"""
+
+import numpy as np
+
+from lodestar_tpu.bls import PubkeyTable, SignatureSet, TpuBlsVerifier, VerifyOptions
+from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+
+N_KEYS = 6
+
+
+def make_world():
+    sks = [GTB.keygen(b"verifier-%d" % i) for i in range(N_KEYS)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=N_KEYS)
+    idxs = table.register(pks)
+    assert idxs == list(range(N_KEYS))
+    verifier = TpuBlsVerifier(table, rng=np.random.default_rng(7))
+    return sks, table, verifier
+
+
+def single_set(sks, i, msg: bytes, tamper=False) -> SignatureSet:
+    sig = GTB.sign(sks[i], msg)
+    if tamper:
+        sig = C.scalar_mul(C.FP2_OPS, sig, 2)
+    return SignatureSet.single(i, hash_to_g2(msg), sig)
+
+
+def agg_set(sks, idxs, msg: bytes) -> SignatureSet:
+    sig = GTB.aggregate_signatures([GTB.sign(sks[i], msg) for i in idxs])
+    return SignatureSet.aggregate(idxs, hash_to_g2(msg), sig)
+
+
+def test_batchable_accepts_valid_mixed_sets():
+    sks, _table, verifier = make_world()
+    sets = [
+        single_set(sks, 0, b"root-0"),
+        single_set(sks, 1, b"root-1"),
+        agg_set(sks, [2, 3, 4], b"root-agg"),
+    ]
+    assert verifier.verify_signature_sets(sets, VerifyOptions(batchable=True))
+    m = verifier.metrics
+    assert m.batch_sigs_success.value == 3
+    assert m.batch_retries.value == 0
+    assert m.success_jobs.value == 3
+
+
+def test_batch_failure_retries_individually():
+    sks, _table, verifier = make_world()
+    sets = [
+        single_set(sks, 0, b"root-0"),
+        single_set(sks, 1, b"root-1", tamper=True),
+        single_set(sks, 2, b"root-2"),
+    ]
+    assert not verifier.verify_signature_sets(sets, VerifyOptions(batchable=True))
+    m = verifier.metrics
+    assert m.batch_retries.value == 1
+    assert m.success_jobs.value == 2      # the two honest sets still count
+    assert m.invalid_sets.value == 1
+
+
+def test_individual_verdicts():
+    sks, _table, verifier = make_world()
+    sets = [
+        single_set(sks, 0, b"root-0"),
+        single_set(sks, 1, b"root-1", tamper=True),
+        agg_set(sks, [0, 5], b"root-agg"),
+    ]
+    assert verifier.verify_signature_sets_individually(sets) == [True, False, True]
+
+
+def test_undecodable_signature_fails_fast():
+    sks, _table, verifier = make_world()
+    bad = SignatureSet.single(0, hash_to_g2(b"m"), None)
+    good = single_set(sks, 1, b"root-1")
+    assert not verifier.verify_signature_sets([good, bad], VerifyOptions(batchable=True))
+    assert verifier.verify_signature_sets_individually([good, bad]) == [True, False]
+
+
+def test_non_batchable_small_job():
+    sks, _table, verifier = make_world()
+    assert verifier.verify_signature_sets([single_set(sks, 3, b"solo")])
+    assert not verifier.verify_signature_sets(
+        [single_set(sks, 3, b"solo", tamper=True)]
+    )
+
+
+def test_can_accept_work():
+    _sks, _table, verifier = make_world()
+    assert verifier.can_accept_work()
+    verifier._pending_jobs = 512
+    assert not verifier.can_accept_work()
