@@ -1,0 +1,145 @@
+"""Microbenchmark of Montgomery-multiply variants on the real chip (dev tool).
+
+Times K chained multiplies inside one jit (fori_loop) so per-op dispatch and
+transfer overheads vanish; reports ns per element-multiply for each variant.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import limbs as L
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+K = 64  # chained multiplies per jit call
+
+P_L = jnp.asarray(fp.P_LIMBS)
+NP_L = jnp.asarray(fp.NPRIME_LIMBS)
+
+
+def fold1(t):
+    """One carry-fold pass: limbs <= 4095 + (carry-in)."""
+    return (t & L.LIMB_MASK) + jnp.concatenate(
+        [jnp.zeros((*t.shape[:-1], 1), t.dtype), t[..., :-1] >> L.LIMB_BITS],
+        axis=-1,
+    )
+
+
+def shrink3(t):
+    return fold1(fold1(fold1(t)))
+
+
+def mont_mul_lazy(a, b):
+    """REDC without canonicalization: output limbs <= ~4100, value < ~2p."""
+    t = shrink3(L.mul_full_cols(a, b))
+    m = shrink3(L.mul_low_cols(t[..., :32], NP_L))
+    u = L.mul_full_cols(m, P_L)
+    s = shrink3(t + u)
+    # one extra fold to absorb stragglers
+    return fold1(s)[..., 32:]
+
+
+# --- transposed layout [32, N] via shifted multiply-adds --------------------
+
+
+def mul_cols_T(a, b):
+    """a, b: [32, N] -> [64, N] columns, via 32 shifted multiply-adds."""
+    n = a.shape[-1]
+    zeros = jnp.zeros((32, n), jnp.uint32)
+    acc = jnp.zeros((64, n), jnp.uint32)
+    for j in range(32):
+        prod = a[j][None, :] * b
+        acc = acc + jnp.concatenate(
+            [
+                jnp.zeros((j, n), jnp.uint32),
+                prod,
+                jnp.zeros((32 - j, n), jnp.uint32),
+            ],
+            axis=0,
+        )
+    return acc
+
+
+def fold1_T(t):
+    return (t & L.LIMB_MASK) + jnp.concatenate(
+        [jnp.zeros((1, t.shape[-1]), t.dtype), t[:-1] >> L.LIMB_BITS], axis=0
+    )
+
+
+def shrink3_T(t):
+    return fold1_T(fold1_T(fold1_T(t)))
+
+
+P_T = jnp.asarray(fp.P_LIMBS)[:, None]
+NP_T = jnp.asarray(fp.NPRIME_LIMBS)[:, None]
+
+
+def mul_cols_shared_T(a, w):
+    """a: [32, N], w: [32] shared -> [64, N] via 32 shifted scales."""
+    n = a.shape[-1]
+    acc = jnp.zeros((64, n), jnp.uint32)
+    for j in range(32):
+        prod = w[j] * a
+        acc = acc + jnp.concatenate(
+            [
+                jnp.zeros((j, n), jnp.uint32),
+                prod,
+                jnp.zeros((32 - j, n), jnp.uint32),
+            ],
+            axis=0,
+        )
+    return acc
+
+
+def mont_mul_lazy_T(a, b):
+    t = shrink3_T(mul_cols_T(a, b))
+    m = shrink3_T(mul_cols_shared_T(t[:32], jnp.asarray(fp.NPRIME_LIMBS))[:32])
+    u = mul_cols_shared_T(m, jnp.asarray(fp.P_LIMBS))
+    s = shrink3_T(t + u)
+    return fold1_T(s)[32:]
+
+
+def timeit(name, fn, a, per_el_ops=1):
+    out = fn(a)  # compile
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = fn(a)
+    np.asarray(out[..., :1])  # force with minimal transfer
+    dt = time.perf_counter() - t0
+    per = dt / (K * N) * 1e9
+    print(f"{name:32s} {dt*1e3:9.2f} ms   {per:8.2f} ns/el-mult")
+
+
+def chain(mulfn):
+    def run(a):
+        return lax.fori_loop(0, K, lambda i, x: mulfn(x, x), a)
+
+    return jax.jit(run)
+
+
+def main():
+    print(f"N={N}, K={K} chained, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 1 << 12, size=(N, 32), dtype=np.uint32)
+    a = jnp.asarray(vals)
+    aT = jnp.asarray(vals.T.copy())
+
+    timeit("current mont_mul", chain(fp.mont_mul), a)
+    timeit("lazy einsum", chain(mont_mul_lazy), a)
+    timeit("lazy transposed shift-add", chain(mont_mul_lazy_T), aT)
+
+
+if __name__ == "__main__":
+    main()

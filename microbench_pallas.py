@@ -1,0 +1,138 @@
+"""Pallas mont_mul prototype benchmark (dev tool).
+
+Layout experiment: limbs in sublanes, batch in lanes ([32, N]); schoolbook
+multiply as 32 unrolled shifted multiply-adds; REDC's two shared-operand
+multiplies as constant-scaled shifted adds.  Compares against the XLA lazy
+einsum variant from microbench_mul.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import limbs as L
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+K = 64
+BT = 1024  # batch tile (lanes)
+
+NPRIME = [int(x) for x in fp.NPRIME_LIMBS]
+P_LIMB = [int(x) for x in fp.P_LIMBS]
+MASK = np.uint32((1 << 12) - 1)
+
+
+def _pad_rows(x, lo, hi):
+    return jnp.pad(x, ((lo, hi), (0, 0)))
+
+
+def _mul_cols(a, b):
+    """a, b: [32, B] -> [64, B] column products (values < 2^29)."""
+    acc = jnp.zeros((64, a.shape[1]), jnp.uint32)
+    for j in range(32):
+        acc = acc + _pad_rows(a[j][None, :] * b, j, 32 - j)
+    return acc
+
+
+def _mul_shared(a, w, out_rows):
+    """a: [32, B] times shared constant limbs w -> [out_rows, B] columns."""
+    acc = jnp.zeros((out_rows, a.shape[1]), jnp.uint32)
+    for j in range(32):
+        if w[j] == 0:
+            continue
+        rows = min(32, out_rows - j)
+        acc = acc + _pad_rows(
+            jnp.uint32(w[j]) * a[:rows], j, out_rows - j - rows
+        )
+    return acc
+
+
+def _fold(t):
+    return (t & MASK) + _pad_rows(t[:-1] >> 12, 1, 0)
+
+
+def _mont_kernel(a_ref, b_ref, o_ref):
+    a = a_ref[...]
+    b = b_ref[...]
+    t = _fold(_fold(_fold(_mul_cols(a, b))))
+    m = _fold(_fold(_fold(_mul_shared(t[:32], NPRIME, 32))))
+    u = _mul_shared(m, P_LIMB, 64)
+    s = _fold(_fold(_fold(t + u)))
+    # Residual low-half carry: value(low) is 0 or R exactly; add the bit.
+    k = jnp.any(s[:32] != 0, axis=0, keepdims=True).astype(jnp.uint32)
+    hi = s[32:]
+    o_ref[...] = _fold(hi + _pad_rows(k, 0, 31))
+
+
+@jax.jit
+def mont_mul_pallas(a, b):
+    n = a.shape[1]
+    return pl.pallas_call(
+        _mont_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, n), jnp.uint32),
+        grid=(n // BT,),
+        in_specs=[
+            pl.BlockSpec((32, BT), lambda i: (0, i)),
+            pl.BlockSpec((32, BT), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((32, BT), lambda i: (0, i)),
+    )(a, b)
+
+
+def timeit(name, fn, a):
+    out = fn(a)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = fn(a)
+    np.asarray(out[..., :1])
+    dt = time.perf_counter() - t0
+    per = dt / (K * N) * 1e9
+    print(f"{name:32s} {dt*1e3:9.2f} ms   {per:8.2f} ns/el-mult")
+
+
+def chain(mulfn):
+    def run(a):
+        return lax.fori_loop(0, K, lambda i, x: mulfn(x, x), a)
+
+    return jax.jit(run)
+
+
+def main():
+    print(f"N={N}, K={K} chained, BT={BT}, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    # proper field elements (canonical) for correctness comparison
+    import random
+
+    random.seed(7)
+    vals = [random.randrange(fp.P_INT) for _ in range(N)]
+    aT = jnp.asarray(L.batch_to_limbs(vals).T.copy())
+
+    # correctness: compare one pallas mont_mul against the reference op
+    a_ref = jnp.asarray(L.batch_to_limbs(vals[:BT]))
+    want = np.asarray(fp.mont_mul(a_ref, a_ref))
+    got = np.asarray(mont_mul_pallas(aT[:, :BT], aT[:, :BT])).T
+    # lazy output may exceed canonical: reduce mod p to compare values
+    got_vals = [v % fp.P_INT for v in L.batch_from_limbs(got)]
+    want_vals = L.batch_from_limbs(want)
+    assert got_vals == want_vals, "pallas mont_mul mismatch"
+    print("correctness ok")
+
+    timeit("pallas [32,B] mont_mul", chain(mont_mul_pallas), aT)
+
+
+if __name__ == "__main__":
+    main()

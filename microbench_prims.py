@@ -1,0 +1,150 @@
+"""Isolate pallas primitive costs: uint32 mult, shifts, f32 (dev tool)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+N = 32768
+K = 64
+BT = 1024
+
+
+def timeit(name, fn, a, work):
+    out = fn(a)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = fn(a)
+    np.asarray(out[..., :1])
+    dt = time.perf_counter() - t0
+    per = dt / (K * N) * 1e9
+    print(f"{name:44s} {dt*1e3:9.2f} ms  {per:8.2f} ns/el ({work} vops/el)")
+
+
+def chain(mulfn):
+    return jax.jit(
+        lambda a: lax.fori_loop(0, K, lambda i, x: mulfn(x), a)
+    )
+
+
+def pcall(kernel, dtype=jnp.uint32):
+    def run(a):
+        n = a.shape[1]
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((32, n), dtype),
+            grid=(n // BT,),
+            in_specs=[pl.BlockSpec((32, BT), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((32, BT), lambda i: (0, i)),
+        )(a)
+
+    return run
+
+
+# A: 32 unrolled uint32 multiplies, no shifts
+def k_mul32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(32):
+        acc = acc + a[j][None, :] * a
+    o_ref[...] = acc
+
+
+# B: 32 unrolled uint16-ish adds only
+def k_add32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(32):
+        acc = acc + (a + np.uint32(j))
+    o_ref[...] = acc
+
+
+# C: 32 unrolled padded shifts (no mult)
+def k_shift32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros((64, a.shape[1]), jnp.uint32)
+    for j in range(32):
+        acc = acc + jnp.pad(a, ((j, 32 - j), (0, 0)))
+    o_ref[...] = acc[:32] + acc[32:]
+
+
+# D: f32 multiplies
+def k_mulf32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(32):
+        acc = acc + a[j][None, :] * a
+    o_ref[...] = acc
+
+
+# E: MXU f32 matmul [32,32]@[32,B]
+W = np.random.default_rng(0).integers(0, 63, size=(32, 32)).astype(np.float32)
+
+
+def k_mxu(w_ref, a_ref, o_ref):
+    a = a_ref[...]
+    o_ref[...] = jax.lax.dot_general(
+        w_ref[...],
+        a,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def pcall_mxu():
+    def run(a):
+        n = a.shape[1]
+        return pl.pallas_call(
+            k_mxu,
+            out_shape=jax.ShapeDtypeStruct((32, n), jnp.float32),
+            grid=(n // BT,),
+            in_specs=[
+                pl.BlockSpec((32, 32), lambda i: (0, 0)),
+                pl.BlockSpec((32, BT), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((32, BT), lambda i: (0, i)),
+        )(jnp.asarray(W), a)
+
+    return run
+
+
+# F: single fused elementwise op
+def k_one(a_ref, o_ref):
+    a = a_ref[...]
+    o_ref[...] = a * a + a
+
+
+def main():
+    print(f"N={N}, K={K}, BT={BT}, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    a32 = jnp.asarray(
+        rng.integers(0, 1 << 12, size=(32, N), dtype=np.uint32)
+    )
+    af = a32.astype(jnp.float32)
+
+    timeit("A: 32x uint32 broadcast-mult-add", chain(pcall(k_mul32)), a32, 64)
+    timeit("B: 32x uint32 add", chain(pcall(k_add32)), a32, 64)
+    timeit("C: 32x padded shift-add", chain(pcall(k_shift32)), a32, 64)
+    timeit(
+        "D: 32x f32 broadcast-mult-add",
+        chain(pcall(k_mulf32, jnp.float32)),
+        af,
+        64,
+    )
+    timeit("E: f32 MXU [32,32]@[32,B]", chain(pcall_mxu()), af, 2)
+    timeit("F: one mult+add", chain(pcall(k_one)), a32, 2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Round 2 of pallas primitive probing (dev tool).
+
+Questions:
+  1. Is the ~33 ns/el a fixed per-call floor (test: 10x more ops, 4x N)?
+  2. How slow is sublane-row broadcast really (test: 320 broadcast-adds)?
+  3. Does an MXU replicate-matmul beat per-row broadcasts for the schoolbook?
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+K = 16
+BT = 512
+
+
+def timeit(name, fn, a, n):
+    out = fn(a)
+    np.asarray(out)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(a)
+        np.asarray(out[..., :1])
+    dt = (time.perf_counter() - t0) / reps
+    per = dt / (K * n) * 1e9
+    print(f"{name:44s} {dt*1e3:9.2f} ms  {per:8.2f} ns/el")
+
+
+def chain(fn):
+    return jax.jit(lambda a: lax.fori_loop(0, K, lambda i, x: fn(x), a))
+
+
+def pcall(kernel, rows=32, dtype=jnp.uint32, extra=None):
+    def run(a):
+        n = a.shape[1]
+        ins = [a] if extra is None else [extra, a]
+        in_specs = [pl.BlockSpec((rows, BT), lambda i: (0, i))]
+        if extra is not None:
+            in_specs.insert(
+                0,
+                pl.BlockSpec(extra.shape, lambda i: (0, 0)),
+            )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, n), dtype),
+            grid=(n // BT,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((rows, BT), lambda i: (0, i)),
+        )(*ins)
+
+    return run
+
+
+# 1) 320 adds — floor vs op-bound
+def k_add320(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(320):
+        acc = acc + (a + np.uint32(j & 7))
+    o_ref[...] = acc
+
+
+# 2) 320 elementwise mult-adds (no broadcast)
+def k_mul320(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(320):
+        acc = acc + (a & np.uint32(63)) * (acc | np.uint32(1))
+    o_ref[...] = acc
+
+
+# 3) 32 broadcast-mult-adds via static keepdim slice
+def k_bcast_slice(a_ref, o_ref):
+    a = a_ref[...]
+    acc = jnp.zeros_like(a)
+    for j in range(32):
+        acc = acc + a[j : j + 1] * a
+    o_ref[...] = acc
+
+
+# 4) full schoolbook via MXU replicate: planes of a replicated to [32*32, B]
+REP = np.zeros((1024, 32), np.float32)
+for _j in range(32):
+    REP[_j * 32 : (_j + 1) * 32, _j] = 1.0
+
+
+def k_rep_mxu(rep_ref, a_ref, o_ref):
+    a = a_ref[...]  # [32, B] uint32, 12-bit limbs
+    lo = (a & np.uint32(63)).astype(jnp.int32).astype(jnp.float32)
+    hi = (a >> np.uint32(6)).astype(jnp.int32).astype(jnp.float32)
+    rep = rep_ref[...]
+    bc_lo = jax.lax.dot_general(
+        rep, lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    bc_hi = jax.lax.dot_general(
+        rep, hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    arep = bc_lo.astype(jnp.int32).astype(jnp.uint32) + (bc_hi.astype(jnp.int32).astype(jnp.uint32) << 6)
+    # tile b 32x: [1024, B]
+    btile = jnp.concatenate([a] * 32, axis=0)
+    prod = arep * btile  # [1024, B] (j-major blocks of 32 k-rows)
+    acc = jnp.zeros((64, a.shape[1]), jnp.uint32)
+    for j in range(32):
+        acc = acc + jnp.pad(
+            prod[32 * j : 32 * (j + 1)], ((j, 32 - j), (0, 0))
+        )
+    o_ref[...] = acc[:32] + acc[32:]
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    print(f"N={n}, K={K}, BT={BT}, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    a32 = jnp.asarray(rng.integers(0, 1 << 12, size=(32, n), dtype=np.uint32))
+
+    timeit("1: 320x uint32 add", chain(pcall(k_add320)), a32, n)
+    timeit("2: 320x uint32 mult-add", chain(pcall(k_mul320)), a32, n)
+    timeit("3: 32x bcast-mult (slice)", chain(pcall(k_bcast_slice)), a32, n)
+    timeit(
+        "4: schoolbook via MXU replicate",
+        chain(
+            lambda a: pl.pallas_call(
+                k_rep_mxu,
+                out_shape=jax.ShapeDtypeStruct((32, a.shape[1]), jnp.uint32),
+                grid=(a.shape[1] // BT,),
+                in_specs=[
+                    pl.BlockSpec((1024, 32), lambda i: (0, 0)),
+                    pl.BlockSpec((32, BT), lambda i: (0, i)),
+                ],
+                out_specs=pl.BlockSpec((32, BT), lambda i: (0, i)),
+            )(jnp.asarray(REP), a)
+        ),
+        a32,
+        n,
+    )
+
+
+if __name__ == "__main__":
+    main()

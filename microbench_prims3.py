@@ -1,0 +1,88 @@
+"""Probe pallas grid-step / DMA overhead on this platform (dev tool)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+K = 16
+
+
+def timeit(name, fn, a, n):
+    out = fn(a)
+    np.asarray(out)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(a)
+        np.asarray(out[..., :1])
+    dt = (time.perf_counter() - t0) / reps
+    per = dt / (K * n) * 1e9
+    print(f"{name:46s} {dt*1e3:9.2f} ms  {per:8.2f} ns/el")
+
+
+def chain(fn):
+    return jax.jit(lambda a: lax.fori_loop(0, K, lambda i, x: fn(x), a))
+
+
+def k_copy(a_ref, o_ref):
+    o_ref[...] = a_ref[...] + np.uint32(1)
+
+
+def k_add32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = a
+    for j in range(32):
+        acc = acc + a
+    o_ref[...] = acc
+
+
+def k_bcast32(a_ref, o_ref):
+    a = a_ref[...]
+    acc = a
+    for j in range(32):
+        acc = acc + a[j : j + 1] * a
+    o_ref[...] = acc
+
+
+def pc(kernel, bt):
+    def run(a):
+        n = a.shape[1]
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((32, n), jnp.uint32),
+            grid=(n // bt,),
+            in_specs=[pl.BlockSpec((32, bt), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((32, bt), lambda i: (0, i)),
+        )(a)
+
+    return run
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    print(f"N={n}, K={K}, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    a32 = jnp.asarray(rng.integers(0, 1 << 12, size=(32, n), dtype=np.uint32))
+
+    for bt in (512, 2048, 8192, n):
+        timeit(f"copy bt={bt} (grid={n//bt})", chain(pc(k_copy, bt)), a32, n)
+    for bt in (512, 8192, n):
+        timeit(f"32x add bt={bt}", chain(pc(k_add32, bt)), a32, n)
+    for bt in (512, 8192, n):
+        timeit(f"32x bcast-mult bt={bt}", chain(pc(k_bcast32, bt)), a32, n)
+
+
+if __name__ == "__main__":
+    main()

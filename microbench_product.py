@@ -1,0 +1,222 @@
+"""Pick the bignum-product formulation for the pallas field layer (dev tool).
+
+Computes t_cols[66, B] = schoolbook column products of a[33, B] * b[33, B]
+(12-bit limbs, uint32) under several formulations; validates each against
+numpy; times K-chained kernels well above the ~65 ms tunnel floor.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+NL = 33  # limbs
+NC = 2 * NL  # columns
+K = 128
+BT = 1024
+
+MASK12 = np.uint32(4095)
+
+
+def fold(t):
+    return (t & MASK12) + jnp.pad(t[:-1] >> 12, ((1, 0), (0, 0)))
+
+
+# --- V1: per-row broadcast (baseline) --------------------------------------
+
+
+def prod_bcast(a, b):
+    acc = jnp.zeros((NC, a.shape[1]), jnp.uint32)
+    for j in range(NL):
+        acc = acc + jnp.pad(a[j : j + 1] * b, ((j, NC - j - NL), (0, 0)))
+    return acc
+
+
+# --- V2: jnp.repeat replicate + shifted adds -------------------------------
+
+
+def prod_repeat(a, b):
+    arep = jnp.repeat(a, NL, axis=0)  # rows j*NL..(j+1)*NL-1 = a[j]
+    btile = jnp.concatenate([b] * NL, axis=0)
+    prod = arep * btile
+    acc = jnp.zeros((NC, a.shape[1]), jnp.uint32)
+    for j in range(NL):
+        acc = acc + jnp.pad(
+            prod[NL * j : NL * (j + 1)], ((j, NC - j - NL), (0, 0))
+        )
+    return acc
+
+
+# --- V3: transpose trick (reverse + shift + row-reduce) --------------------
+
+
+def prod_transpose(a, b):
+    br = jnp.concatenate(
+        [b[i : i + 1] for i in range(NL - 1, -1, -1)], axis=0
+    )  # br[k] = b[NL-1-k] (jnp rev unsupported in mosaic)
+    outs = []
+    for s in range(NC - 1):
+        # column l = NL-1+? : product row j: a[j] * br[j - s2]
+        sh = s - (NL - 1)
+        if sh >= 0:
+            bs = jnp.pad(br[: NL - sh], ((sh, 0), (0, 0)))
+        else:
+            bs = jnp.pad(br[-sh:], ((0, -sh), (0, 0)))
+        outs.append(
+            jnp.sum((a * bs).astype(jnp.int32), axis=0, keepdims=True).astype(
+                jnp.uint32
+            )
+        )
+    outs.append(jnp.zeros((1, a.shape[1]), jnp.uint32))
+    return jnp.concatenate(outs, axis=0)
+
+
+# --- V4: replicate via MXU (bf16 6-bit planes) -----------------------------
+
+REP = np.zeros((NL * NL, NL), np.float32)
+for _j in range(NL):
+    REP[_j * NL : (_j + 1) * NL, _j] = 1.0
+
+
+def prod_mxu(rep, a, b):
+    lo = (a & np.uint32(63)).astype(jnp.int32).astype(jnp.float32)
+    hi = (a >> np.uint32(6)).astype(jnp.int32).astype(jnp.float32)
+    bc_lo = jax.lax.dot_general(
+        rep, lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    bc_hi = jax.lax.dot_general(
+        rep, hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    arep = bc_lo.astype(jnp.int32).astype(jnp.uint32) + (
+        bc_hi.astype(jnp.int32).astype(jnp.uint32) << 6
+    )
+    btile = jnp.concatenate([b] * NL, axis=0)
+    prod = arep * btile
+    acc = jnp.zeros((NC, a.shape[1]), jnp.uint32)
+    for j in range(NL):
+        acc = acc + jnp.pad(
+            prod[NL * j : NL * (j + 1)], ((j, NC - j - NL), (0, 0))
+        )
+    return acc
+
+
+def make_chain(prodfn, with_rep=False):
+    def kernel(*refs):
+        if with_rep:
+            rep_ref, a_ref, o_ref = refs
+            rep = rep_ref[...]
+            fn = lambda a, b: prodfn(rep, a, b)
+        else:
+            a_ref, o_ref = refs
+            fn = prodfn
+        a = a_ref[...]
+
+        def body(i, x):
+            t = fn(x, x)
+            # fold down to NL limbs (wraps value; fine for timing) and mask
+            lo, hi = t[:NL], t[NL:]
+            x2 = fold(fold(fold(lo + hi)))[:NL] & MASK12
+            return x2
+
+        o_ref[...] = lax.fori_loop(0, K, body, a)
+
+    def run(a):
+        n = a.shape[1]
+        ins = [a]
+        in_specs = [pl.BlockSpec((NL, BT), lambda i: (0, i))]
+        if with_rep:
+            ins.insert(0, jnp.asarray(REP))
+            in_specs.insert(0, pl.BlockSpec(REP.shape, lambda i: (0, 0)))
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((NL, n), jnp.uint32),
+            grid=(n // BT,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((NL, BT), lambda i: (0, i)),
+        )(*ins)
+
+    return jax.jit(run)
+
+
+def check(prodfn, with_rep=False):
+    """Validate column products against numpy schoolbook."""
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 1 << 12, size=(NL, 256), dtype=np.uint32)
+    b = rng.integers(0, 1 << 12, size=(NL, 256), dtype=np.uint32)
+    want = np.zeros((NC, 256), np.uint64)
+    for j in range(NL):
+        for kk in range(NL):
+            want[j + kk] += a[j].astype(np.uint64) * b[kk]
+    assert want.max() < 1 << 32
+
+    def kernel(*refs):
+        if with_rep:
+            rep_ref, a_ref, b_ref, o_ref = refs
+            o_ref[...] = prodfn(rep_ref[...], a_ref[...], b_ref[...])
+        else:
+            a_ref, b_ref, o_ref = refs
+            o_ref[...] = prodfn(a_ref[...], b_ref[...])
+
+    ins = [jnp.asarray(a), jnp.asarray(b)]
+    in_specs = [
+        pl.BlockSpec((NL, 256), lambda: (0, 0)),
+        pl.BlockSpec((NL, 256), lambda: (0, 0)),
+    ]
+    if with_rep:
+        ins.insert(0, jnp.asarray(REP))
+        in_specs.insert(0, pl.BlockSpec(REP.shape, lambda: (0, 0)))
+    got = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((NC, 256), jnp.uint32),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((NC, 256), lambda: (0, 0)),
+    )(*ins)
+    ok = np.array_equal(np.asarray(got), want.astype(np.uint32))
+    return ok
+
+
+def timeit(name, fn, a, n):
+    out = fn(a)
+    np.asarray(out)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(a)
+        np.asarray(out[..., :1])
+        times.append(time.perf_counter() - t0)
+    dt = min(times) - 0.065  # subtract tunnel floor
+    per = dt / (K * n) * 1e9
+    print(f"{name:34s} {min(times)*1e3:9.2f} ms   ~{per:7.2f} ns/el-product")
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    print(f"N={n}, K={K}, BT={BT}, NL={NL}, device={jax.devices()[0]}")
+    rng = np.random.default_rng(3)
+    a32 = jnp.asarray(rng.integers(0, 1 << 12, size=(NL, n), dtype=np.uint32))
+
+    for name, fn, wr in [
+        ("V1 bcast", prod_bcast, False),
+        ("V2 repeat", prod_repeat, False),
+        ("V3 transpose-reduce", prod_transpose, False),
+        ("V4 replicate-MXU", prod_mxu, True),
+    ]:
+        ok = check(fn, wr)
+        print(f"{name:34s} correctness: {'OK' if ok else 'FAIL'}")
+        if ok:
+            timeit(name, make_chain(fn, wr), a32, n)
+
+
+if __name__ == "__main__":
+    main()

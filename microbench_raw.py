@@ -1,0 +1,75 @@
+"""Raw chip capability check: MXU matmul FLOPs + VPU elementwise (dev tool)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def bench(name, fn, args, flops, reps=5):
+    out = fn(*args)
+    np.asarray(out[..., :1])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(out[..., :1])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    print(
+        f"{name:40s} {dt*1e3:9.2f} ms   {flops/dt/1e12:8.2f} Tops/s"
+        f"   (floor-uncorrected)"
+    )
+
+
+def main():
+    print(f"device={jax.devices()[0]}")
+    M = 4096
+    a = jnp.ones((M, M), jnp.bfloat16)
+    K = 8
+
+    @jax.jit
+    def mm(a):
+        def body(i, x):
+            return jnp.dot(x, x, preferred_element_type=jnp.bfloat16)
+
+        return lax.fori_loop(0, K, body, a)
+
+    bench("bf16 matmul 4096^3 x8", mm, (a,), K * 2 * M**3)
+
+    N = 8 * 1024 * 1024  # 8M elements, 32 MB as uint32
+    b = jnp.ones((8, N // 8), jnp.uint32)
+    KV = 64
+
+    @jax.jit
+    def vchain(x):
+        def body(i, x):
+            return x * x + x
+
+        return lax.fori_loop(0, KV, body, x)
+
+    bench("uint32 mult+add chain x64 (8M el)", vchain, (b,), KV * 2 * N)
+
+    bf = jnp.ones((8, N // 8), jnp.float32)
+
+    @jax.jit
+    def fchain(x):
+        def body(i, x):
+            return x * x + x
+
+        return lax.fori_loop(0, KV, body, x)
+
+    bench("f32 fma chain x64 (8M el)", fchain, (bf,), KV * 2 * N)
+
+
+if __name__ == "__main__":
+    main()
