@@ -1,0 +1,18 @@
+"""TPU pallas kernel engine for BLS12-381 — the round-2 performance core.
+
+Transposed limb layout ([limbs, batch] — batch rides the 128 vector lanes),
+Montgomery arithmetic over R = 2^396 (33 x 12-bit limbs) with enough slack
+that additions never need conditional reduction, and lazy tower reduction
+(REDC once per output coefficient, not once per product).  All hot loops
+live INSIDE pallas kernels: on this platform a pallas_call costs ~100 us
+while an in-kernel vector op costs ~1 ns/element, so the design rule is a
+handful of kernel invocations per verification batch, each containing its
+whole loop (measured in microbench_product.py / microbench_prims3.py).
+
+Replaces the round-1 `ops/` einsum path (kept for cross-checks) as the
+engine under `bls/verifier.py`, standing in for blst's assembly pairing in
+the reference's worker pool (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106).
+"""
+
+from . import layout  # noqa: F401

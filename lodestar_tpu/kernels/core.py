@@ -1,0 +1,242 @@
+"""Value-level limb primitives for the pallas field engine.
+
+Every function here operates on traced jnp VALUES (not refs) shaped
+``[..., nlimbs, B]`` — limbs on axis -2 (sublanes), batch on axis -1
+(lanes) — and is designed to be called INSIDE pallas kernels (they also
+run under plain jit for tests).  Signed int32 limbs; see
+`kernels/layout.py` for the representation and the bound discipline.
+
+The multiply strategy (measured in microbench_product.py): a full
+schoolbook column product is NL unrolled broadcast-row multiply-adds with
+sublane pad-shifts (~1 ns/element inside a kernel); REDC's two
+shared-constant multiplies use inlined python-int scalars (cheaper still:
+scalar * array has no broadcast).  Carries are 1-3 "fold" passes
+(value-preserving, no lookahead); the only exact carry resolution in the
+hot path is REDC's 1-bit residual, a 6-round binary Kogge-Stone.
+
+This replaces blst's x86 Montgomery assembly in the reference's worker
+pool (reference: packages/beacon-node/src/chain/bls/multithread/
+worker.ts:30-106) with TPU vector code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import layout as LY
+
+NL = LY.NL
+NC = LY.NC
+MASK = np.int32(LY.LIMB_MASK)
+SH = np.int32(LY.LIMB_BITS)
+
+
+def _pad2(t, lo, hi):
+    """Pad axis -2 with lo zeros below (low limbs) and hi above."""
+    cfg = [(0, 0)] * (t.ndim - 2) + [(lo, hi), (0, 0)]
+    return jnp.pad(t, cfg)
+
+
+def fold(t):
+    """One carry-fold pass along axis -2; value-preserving for ALL inputs.
+
+    Rows 0..n-2: (t & 4095) + carry from below.  The TOP limb is left
+    unmasked (it absorbs its own high bits plus the incoming carry), so
+    no carry is ever dropped — negative values and near-capacity values
+    fold exactly.  Arithmetic shift makes the split exact for signed
+    limbs: t == (t & 4095) + 4096 * (t >> 12) in two's complement.
+    """
+    car = t >> SH
+    body = (t & MASK)[..., :-1, :] + _pad2(car[..., :-2, :], 1, 0)
+    top = t[..., -1:, :] + car[..., -2:-1, :]
+    return jnp.concatenate([body, top], axis=-2)
+
+
+def fold3(t):
+    return fold(fold(fold(t)))
+
+
+def fold_modR(t):
+    """Masked-top fold: drops carries out of the top limb, i.e. reduces
+    the represented value modulo 2^(12*rows).  Used for REDC's m factor,
+    which only matters mod R."""
+    return (t & MASK) + _pad2((t >> SH)[..., :-1, :], 1, 0)
+
+
+def fold3_modR(t):
+    return fold_modR(fold_modR(fold_modR(t)))
+
+
+def mul_cols(a, b):
+    """Schoolbook column products: [..., NL, B] x [..., NL, B] -> [..., NC, B].
+
+    Inputs: |limbs| <= 5700 (columns stay < 2^30, exact in int32).
+    NL unrolled broadcast-row multiply-adds.
+    """
+    acc = _pad2(a[..., 0:1, :] * b, 0, NC - NL)
+    for j in range(1, NL):
+        acc = acc + _pad2(a[..., j : j + 1, :] * b, j, NC - NL - j)
+    return acc
+
+
+def mul_cols_shared(a, w, nout):
+    """Column products against a shared constant (python ints) -> [..., nout, B].
+
+    Skips zero limbs of w; scalar*array multiplies (no broadcasts).
+    """
+    n_in = a.shape[-2]
+    acc = None
+    for j, wj in enumerate(w):
+        if wj == 0 or j >= nout:
+            continue
+        rows = min(n_in, nout - j)
+        term = _pad2(np.int32(wj) * a[..., :rows, :], j, nout - j - rows)
+        acc = term if acc is None else acc + term
+    if acc is None:
+        shape = (*a.shape[:-2], nout, a.shape[-1])
+        acc = jnp.zeros(shape, jnp.int32)
+    return acc
+
+
+def _kogge_carry_out(c):
+    """Exact carry out of the top limb of c ([..., NL, B], limbs in [-1, 4096],
+    value known to be in {0, R}) -> int32 [..., 1, B] in {0, 1}.
+
+    Binary Kogge-Stone: generate = (limb == 4096), propagate = (== 4095).
+    """
+    g = (c == np.int32(4096)).astype(jnp.int32)
+    p = (c == MASK).astype(jnp.int32)
+    span = 1
+    while span < NL:
+        g_lo = _pad2(g[..., :-span, :], span, 0)
+        p_lo = _pad2(p[..., :-span, :], span, 0)
+        g = g | (p & g_lo)
+        p = p & p_lo
+        span *= 2
+    return g[..., NL - 1 : NL, :]
+
+
+def redc(tcols):
+    """Montgomery reduction: columns [..., NC, B] -> limbs [..., NL, B].
+
+    value_out = value_in / R  (mod p), |value_out| <= |value_in|/R + p.
+    Accepts any folded-or-column input with |entries| < 2^30 and
+    |value| < 2^786.
+    """
+    t = fold3(tcols)
+    m = fold3_modR(mul_cols_shared(t[..., :NL, :], LY.NPRIME_LIMBS, NL))
+    u = mul_cols_shared(m, LY.P_LIMBS, NC)
+    s = fold3(t + u)
+    # Low half's value is exactly 0 or R; add the residual carry bit.
+    k = _kogge_carry_out(s[..., :NL, :])
+    return fold(s[..., NL:, :] + _pad2(k, 0, NL - 1))
+
+
+def mont_mul(a, b):
+    """Plain Montgomery product (lazy output, limbs in [-2, 4103])."""
+    return redc(mul_cols(a, b))
+
+
+def mont_mul_shared(a, w_mont):
+    """Montgomery product with a shared python-int-limb constant."""
+    return redc(mul_cols_shared(a, w_mont, NC))
+
+
+def mont_sqr(a):
+    return redc(mul_cols(a, a))
+
+
+def add(a, b):
+    return fold(a + b)
+
+
+def sub(a, b):
+    return fold(a - b)
+
+
+def neg(a):
+    return -a
+
+
+def add_raw(a, b):
+    """Unfolded sum — callers must respect the <= 8-term chain bound."""
+    return a + b
+
+
+def mul_small(a, k: int):
+    """a * small python int: scalar multiply + fold.
+
+    |k| <= 8 keeps the top limb under the fold's no-carry-out contract
+    (T-bound in kernels/layout.py).
+    """
+    assert -8 <= k <= 8
+    return fold(np.int32(k) * a)
+
+
+def select(mask, a, b):
+    """Lane select: mask is [..., B] boolean (broadcast over limbs)."""
+    return jnp.where(mask[..., None, :], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Exact residue tests (comparisons against canonical constants)
+# ---------------------------------------------------------------------------
+
+# Offset trick for signed canonicalization: adding ONES_VEC (1 per limb,
+# value V1 = (R-1)/4095) makes post-fold limbs nonnegative so a binary
+# Kogge pass yields exact canonical limbs; we compare against shifted
+# constants V1 + {0, p, 2p} instead of {−p, 0, p}.
+_V1 = (LY.R - 1) // LY.LIMB_MASK
+assert _V1 * LY.LIMB_MASK == LY.R - 1  # exact: R-1 = 4095 * V1... checked
+
+
+def _canon_nonneg(t):
+    """Exact canonical limbs of t ([..., NL, B], limbs in [0, 4097]).
+
+    fold until carries are binary, then resolve the 4095/4096 ripple with
+    a binary Kogge-Stone (same g/p classes as _kogge_carry_out).
+    """
+    t = fold(fold(t))  # limbs now in [0, 4096]
+    g = (t == np.int32(4096)).astype(jnp.int32)
+    p = (t == MASK).astype(jnp.int32)
+    span = 1
+    while span < NL:
+        g_lo = _pad2(g[..., :-span, :], span, 0)
+        p_lo = _pad2(p[..., :-span, :], span, 0)
+        g = g | (p & g_lo)
+        p = p & p_lo
+        span *= 2
+    carry_in = _pad2(g[..., :-1, :], 1, 0)
+    return (t + carry_in) & MASK
+
+
+def _eq_const(t, c_limbs):
+    """All-limb equality against a python-int limb list -> bool [..., B]."""
+    c = jnp.asarray(np.asarray(c_limbs, np.int32)[:, None])
+    return jnp.all(t == c, axis=-2)
+
+
+# z value lies in {-p, 0, p} when z == 0 (mod p); shifted by +V1:
+_CAND0 = [int(x) for x in LY.to_limbs(_V1 - LY.P)]
+_CAND1 = [int(x) for x in LY.to_limbs(_V1)]
+_CAND2 = [int(x) for x in LY.to_limbs(_V1 + LY.P)]
+
+
+def is_zero_modp(x):
+    """Exact x == 0 (mod p) for a public-class value -> bool [..., B].
+
+    Montgomery-squeeze x to |z| <= p, shift into nonnegative territory
+    with the all-ones vector, canonicalize exactly, and compare against
+    the three possible canonical patterns of a zero residue.
+    """
+    y = mont_mul_shared(x, [int(v) for v in LY.MONT_R2])  # x * R mod p-ish
+    z = redc(_pad2(y, 0, NL))  # value in (-(p+1), p+1)
+    w = z + jnp.ones((), jnp.int32)  # +1 per limb = +V1 in value
+    t = _canon_nonneg(w)
+    return _eq_const(t, _CAND0) | _eq_const(t, _CAND1) | _eq_const(t, _CAND2)
+
+
+def eq_modp(a, b):
+    return is_zero_modp(a - b)
