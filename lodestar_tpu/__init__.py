@@ -2,13 +2,11 @@
 Lodestar (Ethereum consensus client), centered on batched BLS12-381
 signature-set verification on TPU via JAX.
 
-Layout (mirrors SURVEY.md section 2's component inventory; subpackages land
-incrementally — import errors on a listed name mean it is not built yet):
+Layout (mirrors SURVEY.md section 2's component inventory):
   crypto/    CPU ground-truth BLS12-381 (oracle + fallback verifier)
-  ops/       JAX/TPU kernels: limb arithmetic, field towers, curves, pairing
+  kernels/   the pallas field/pairing engine (transposed signed-limb layout)
+  ops/       JAX einsum-path kernels (correctness cross-check of kernels/)
   bls/       the IBlsVerifier boundary: signature sets, batch semantics, retry
-  parallel/  device mesh sharding (data-parallel sets, sharded pubkey table)
-  models/    verification pipelines (attestation gossip, block import)
   utils/     queues, backpressure, metrics (lodestar_bls_thread_pool_* compat)
 """
 

@@ -4,29 +4,29 @@ The reference deserializes every validator pubkey once into a blst
 PublicKey object held in a JS array (reference:
 packages/state-transition/src/cache/pubkeyCache.ts:29-47; ~30 s for 350k
 keys noted at packages/beacon-node/src/chain/chain.ts:218-220).  Here the
-equivalent is two uint32[V, 32] coordinate planes in HBM (Montgomery form,
-affine), indexable by validator index, so `single` sets ship only
-(index, root, sig) across the host->device boundary and `aggregate` sets
-gather+point-add entirely on device (reference main-thread aggregation:
+equivalent is two int32[33, V] transposed limb planes in HBM (Montgomery
+form, affine, kernels/layout.py), indexable by validator index along the
+lane axis, so `single` sets ship only (index, root, sig) across the
+host->device boundary and `aggregate` sets gather+point-add entirely on
+device (reference main-thread aggregation:
 packages/beacon-node/src/chain/bls/utils.ts:5-16).
 
-1M validators = 2 planes x 1M x 32 x 4 B = 256 MB — fits v5e HBM (16 GB).
-Registration validates each key (on-curve + subgroup, blst KeyValidate
-semantics) through the CPU ground truth; amortized once per validator per
-process lifetime, exactly like the reference's cache build.
+1M validators = 2 planes x 33 x 1M x 4 B = 264 MB — fits v5e HBM (16 GB).
+Capacity grows by doubling; a growth step changes the device shape and
+recompiles the gather (pre-size `capacity` for the expected validator
+count to avoid it).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from ..crypto import curves as C
-from ..ops import fp
+from ..kernels import layout as LY
 
 
 class PubkeyTable:
@@ -35,8 +35,8 @@ class PubkeyTable:
     def __init__(self, capacity: int = 1024):
         self._cap = max(capacity, 1)
         self._n = 0
-        self._host_x = np.zeros((self._cap, fp.L.N_LIMBS), np.uint32)
-        self._host_y = np.zeros((self._cap, fp.L.N_LIMBS), np.uint32)
+        self._host_x = np.zeros((LY.NL, self._cap), np.int32)
+        self._host_y = np.zeros((LY.NL, self._cap), np.int32)
         self._device: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
     def __len__(self) -> int:
@@ -46,7 +46,9 @@ class PubkeyTable:
         """Validate + append ground-truth affine pubkeys; returns indices.
 
         Raises ValueError on an invalid key (infinity, off-curve, or out of
-        subgroup — blst KeyValidate semantics).
+        subgroup — blst KeyValidate semantics).  Every downstream path
+        (device kernels and the CPU fallback) relies on registered keys
+        having passed KeyValidate, so there is no opt-out.
         """
         idxs = []
         for pk in pubkeys:
@@ -58,8 +60,8 @@ class PubkeyTable:
                 raise ValueError("pubkey not in G1 subgroup")
             if self._n == self._cap:
                 self._grow()
-            self._host_x[self._n] = fp.const(pk[0])
-            self._host_y[self._n] = fp.const(pk[1])
+            self._host_x[:, self._n] = LY.to_limbs(pk[0] * LY.R_MOD_P % LY.P)
+            self._host_y[:, self._n] = LY.to_limbs(pk[1] * LY.R_MOD_P % LY.P)
             idxs.append(self._n)
             self._n += 1
         self._device = None  # invalidate mirror
@@ -69,8 +71,8 @@ class PubkeyTable:
         self._cap *= 2
         for name in ("_host_x", "_host_y"):
             old = getattr(self, name)
-            new = np.zeros((self._cap, fp.L.N_LIMBS), np.uint32)
-            new[: self._n] = old[: self._n]
+            new = np.zeros((LY.NL, self._cap), np.int32)
+            new[:, : self._n] = old[:, : self._n]
             setattr(self, name, new)
 
     def device_planes(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -86,9 +88,10 @@ class PubkeyTable:
         return self._device
 
     def host_affine(self, index: int):
-        """Ground-truth affine point for tests/debugging."""
+        """Ground-truth affine point for the CPU fallback paths/tests."""
         assert 0 <= index < self._n
+        rinv = LY.R_INV
         return (
-            fp.decode(self._host_x[index]),
-            fp.decode(self._host_y[index]),
+            LY.from_limbs(self._host_x[:, index]) * rinv % LY.P,
+            LY.from_limbs(self._host_y[:, index]) * rinv % LY.P,
         )

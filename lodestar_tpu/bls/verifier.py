@@ -1,4 +1,5 @@
-"""TpuBlsVerifier — the IBlsVerifier implementation backed by JAX kernels.
+"""TpuBlsVerifier — the IBlsVerifier implementation backed by the pallas
+verification pipeline (kernels/verify.py).
 
 Semantics reproduced from the reference (packages/beacon-node/src/chain/bls):
 
@@ -13,12 +14,14 @@ Semantics reproduced from the reference (packages/beacon-node/src/chain/bls):
   - `can_accept_work()` mirrors the 512-pending-job backpressure bound
     consumed by the gossip NetworkProcessor (multithread/index.ts:143-149,
     processor/index.ts:357-371).
+  - `verify_on_main_thread` verifies synchronously on the host CPU — the
+    latency fast path for block proposer signatures
+    (reference: chain/validation/block.ts:146).
 
 TPU-specific structure: sets are padded into fixed shape buckets
-(N-bucket x K-bucket) so XLA compiles a handful of kernels once; pubkeys
-are gathered from the device-resident table and aggregate sets point-add
-on device; messages/signatures ship as plain limb planes and enter
-Montgomery form on device.
+(N-bucket x K-bucket) so the pallas pipeline compiles once per bucket;
+pubkeys are gathered from the device-resident table and aggregate sets
+point-add on device; randomizers come from the OS CSPRNG.
 """
 
 from __future__ import annotations
@@ -28,28 +31,31 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from ..crypto import curves as C
+from ..kernels import layout as LY
+from ..kernels import verify as KV
 from ..ops import bls_kernels as BK
-from ..ops import curve as K
-from ..ops import fp, fp2
-from ..ops import limbs as L
 from ..utils.metrics import BlsPoolMetrics
 from .pubkey_table import PubkeyTable
 from .signature_set import SignatureSet
 
 MAX_JOB_SETS = 128          # reference: chain/bls/multithread/index.ts:39
 MAX_PENDING_JOBS = 512      # reference: chain/bls/multithread/index.ts:64
-N_BUCKETS = (4, 16, 64, 128, 256, 512)
-K_BUCKETS = (1, 4, 16, 64, 512)
+# N buckets are multiples of the kernel lane tile (kernels/verify.py BT):
+# a smaller job pads to one 128-lane tile, which costs the same wall time
+# as a full tile (vector lanes are parallel hardware).
+N_BUCKETS = (128, 256, 512)
+K_BUCKETS = (1, 4, 16, 64, 512, 2048)
+# Largest aggregate the device path handles (a full 2048-validator mainnet
+# committee); beyond it the set is verified on the CPU ground-truth path.
+MAX_AGG_INDICES = K_BUCKETS[-1]
 
 
 class VerifyOptions:
     def __init__(self, batchable: bool = False, verify_on_main_thread: bool = False):
         self.batchable = batchable
-        # kept for interface parity; the CPU fallback path
         self.verify_on_main_thread = verify_on_main_thread
 
 
@@ -60,59 +66,18 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
 
 
-def _ints_to_plain_limbs(vals: Sequence[int]) -> np.ndarray:
-    """[v0, v1, ...] ints -> uint32[n, 32] plain (non-Montgomery) limbs."""
-    out = np.zeros((len(vals), L.N_LIMBS), np.uint32)
-    for i, v in enumerate(vals):
-        out[i] = L.to_limbs(v)
-    return out
-
-
-def _encode_g2_plain(pts, pad_to: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Affine ground-truth G2 points -> plain-limb planes [pad, 2, 32]."""
-    xs = np.zeros((pad_to, 2, L.N_LIMBS), np.uint32)
-    ys = np.zeros((pad_to, 2, L.N_LIMBS), np.uint32)
-    for i, pt in enumerate(pts):
-        (x0, x1), (y0, y1) = pt
-        xs[i, 0], xs[i, 1] = L.to_limbs(x0), L.to_limbs(x1)
-        ys[i, 0], ys[i, 1] = L.to_limbs(y0), L.to_limbs(y1)
-    return xs, ys
-
-
-def _to_mont2(a):
-    """Plain-limb packed array -> Montgomery form, on device."""
-    return fp.mont_mul(a, jnp.asarray(fp.R2_LIMBS))
-
-
-def _verify_batch_job(table_x, table_y, idx, mask, msg_x, msg_y, sig_x, sig_y,
-                      rand_bits, valid):
-    """Jitted: gather/aggregate pubkeys + RLC batch verification."""
-    agg = BK.aggregate_pubkeys(table_x, table_y, idx, mask)
-    pk_aff, pk_inf = K.to_affine(K.FP_OPS, agg)
-    msg_aff = (_to_mont2(msg_x), _to_mont2(msg_y))
-    sig_aff = (_to_mont2(sig_x), _to_mont2(sig_y))
-    ok, sig_ok = BK.verify_batch(pk_aff, msg_aff, sig_aff, rand_bits, valid)
-    ok = ok & ~jnp.any(pk_inf & valid)
-    return ok, sig_ok
-
-
-def _verify_each_job(table_x, table_y, idx, mask, msg_x, msg_y, sig_x, sig_y,
-                     valid):
-    """Jitted: independent per-set verdicts (the batch-failure retry path)."""
-    agg = BK.aggregate_pubkeys(table_x, table_y, idx, mask)
-    pk_aff, pk_inf = K.to_affine(K.FP_OPS, agg)
-    msg_aff = (_to_mont2(msg_x), _to_mont2(msg_y))
-    sig_aff = (_to_mont2(sig_x), _to_mont2(sig_y))
-    ok = BK.verify_each(pk_aff, msg_aff, sig_aff, valid)
-    return ok & ~(pk_inf & valid)
+def _enc(vals) -> jnp.ndarray:
+    # plain limbs — the device converts to Montgomery form (kernels/verify)
+    return jnp.asarray(LY.encode_plain_batch(vals))
 
 
 class TpuBlsVerifier:
     """The device-backed IBlsVerifier.
 
-    One instance owns the jitted kernels and the pubkey table; concurrency
-    control (job queue depth) models the reference's thread-pool
-    backpressure contract.
+    One instance owns the pubkey table; the jitted pipeline is shared
+    process-wide (jax.jit caches per bucket shape).  Concurrency control
+    (job queue depth) models the reference's thread-pool backpressure
+    contract.
     """
 
     def __init__(
@@ -123,10 +88,9 @@ class TpuBlsVerifier:
     ):
         self.table = table
         self.metrics = metrics or BlsPoolMetrics()
-        self.rng = rng or np.random.default_rng()
+        # None => OS CSPRNG randomizers (production); seeded rng for tests.
+        self.rng = rng
         self._pending_jobs = 0
-        self._batch_fn = jax.jit(_verify_batch_job)
-        self._each_fn = jax.jit(_verify_each_job)
 
     # -- backpressure (reference: multithread/index.ts:143-149) -----------
 
@@ -144,6 +108,12 @@ class TpuBlsVerifier:
         t_start = time.perf_counter()
         self._pending_jobs += 1
         try:
+            if opts.verify_on_main_thread:
+                verdicts = [self._verify_set_cpu(s) for s in sets]
+                good = sum(verdicts)
+                self.metrics.success_jobs.inc(good)
+                self.metrics.invalid_sets.inc(len(sets) - good)
+                return all(verdicts)
             ok = True
             for chunk_start in range(0, len(sets), MAX_JOB_SETS):
                 chunk = sets[chunk_start : chunk_start + MAX_JOB_SETS]
@@ -158,73 +128,130 @@ class TpuBlsVerifier:
     # -- job execution ----------------------------------------------------
 
     def _prepare(self, sets: List[SignatureSet]):
+        """Pad sets into an (N, K) bucket and encode the device planes."""
         n = _bucket(len(sets), N_BUCKETS)
         kmax = _bucket(max(len(s.indices) for s in sets), K_BUCKETS)
         idx = np.zeros((n, kmax), np.int32)
-        mask = np.zeros((n, kmax), bool)
-        valid = np.zeros((n,), bool)
-        sig_pts = []
-        msg_pts = []
+        kmask = np.zeros((n, kmax), np.int32)
+        valid = np.zeros((n,), np.int32)
+        sig_inf = np.zeros((n,), np.int32)
+        msgs, sigs = [], []
+        g2 = C.G2_GEN
         for i, s in enumerate(sets):
             k = len(s.indices)
             idx[i, :k] = s.indices
-            mask[i, :k] = True
-            # a set with an undecodable/infinity signature can never verify;
-            # mark the slot invalid and fail the job up front (blst returns
-            # false for such sets — reference: maybeBatch.ts per-set verify)
-            valid[i] = s.signature is not None
-            sig_pts.append(s.signature if s.signature is not None else C.G2_GEN)
-            msg_pts.append(s.message)
-        always_false = not all(valid[: len(sets)])
-        # pad tail slots with the generator (kept off the verdict by `valid`)
+            kmask[i, :k] = 1
+            valid[i] = 1
+            msgs.append(s.message)
+            if s.signature is None:
+                # undecodable/infinity: the kernel fails the set via sig_inf
+                sig_inf[i] = 1
+                sigs.append(g2)
+            else:
+                sigs.append(s.signature)
         for _ in range(n - len(sets)):
-            sig_pts.append(C.G2_GEN)
-            msg_pts.append(C.G2_GEN)
-        msg_x, msg_y = _encode_g2_plain(msg_pts, n)
-        sig_x, sig_y = _encode_g2_plain(sig_pts, n)
+            msgs.append(g2)
+            sigs.append(g2)
         tx, ty = self.table.device_planes()
         args = (
-            tx, ty, jnp.asarray(idx), jnp.asarray(mask),
-            jnp.asarray(msg_x), jnp.asarray(msg_y),
-            jnp.asarray(sig_x), jnp.asarray(sig_y),
+            tx, ty, jnp.asarray(idx), jnp.asarray(kmask),
+            _enc([m[0][0] for m in msgs]), _enc([m[0][1] for m in msgs]),
+            _enc([m[1][0] for m in msgs]), _enc([m[1][1] for m in msgs]),
+            _enc([s[0][0] for s in sigs]), _enc([s[0][1] for s in sigs]),
+            _enc([s[1][0] for s in sigs]), _enc([s[1][1] for s in sigs]),
+            jnp.asarray(sig_inf),
         )
-        return args, jnp.asarray(valid), always_false, n
+        return args, jnp.asarray(valid), n
+
+    def _verify_set_cpu(self, s: SignatureSet) -> bool:
+        """Ground-truth verification of one set on the host CPU.
+
+        Used for `verify_on_main_thread` (latency fast path) and for
+        aggregates too large for the device buckets.  Pubkeys were
+        KeyValidated at table registration; messages are in-subgroup by
+        construction (hash_to_g2)."""
+        if s.signature is None:
+            return False
+        from ..crypto import bls as CB
+        from ..crypto import pairing as CP
+
+        if not C.is_on_curve(C.FP2_OPS, s.signature):
+            return False
+        if not C.g2_subgroup_check(s.signature):
+            return False
+        agg = C.multi_add(C.FP_OPS, [self.table.host_affine(i) for i in s.indices])
+        if agg is None:  # aggregate pubkey at infinity never verifies
+            return False
+        return CP.multi_pairing_is_one(
+            [(agg, s.message), (CB.NEG_G1_GEN, s.signature)]
+        )
 
     def _verify_job(self, sets: List[SignatureSet], batchable: bool) -> bool:
-        args, valid, always_false, n = self._prepare(sets)
-        if always_false:
-            self.metrics.invalid_sets.inc(len(sets))
-            return False
+        # Aggregates beyond the largest device bucket (> MAX_AGG_INDICES
+        # participants) take the CPU ground-truth path so an oversized —
+        # but legitimate — aggregate still gets a verdict.
+        big = [s for s in sets if len(s.indices) > MAX_AGG_INDICES]
+        if big:
+            sets = [s for s in sets if len(s.indices) <= MAX_AGG_INDICES]
+            verdicts = [self._verify_set_cpu(s) for s in big]
+            good = sum(verdicts)
+            self.metrics.success_jobs.inc(good)
+            self.metrics.invalid_sets.inc(len(big) - good)
+            ok_big = all(verdicts)
+            if not sets:
+                return ok_big
+        else:
+            ok_big = True
+
+        args, valid, n = self._prepare(sets)
+        decodable = np.array([s.signature is not None for s in sets])
+        always_false = not decodable.all()
         if batchable and len(sets) >= 2:  # reference: maybeBatch.ts:16
             self.metrics.batchable_sigs.inc(len(sets))
-            rand = jnp.asarray(BK.make_rand_bits(n, self.rng))
-            ok, _sig_ok = self._batch_fn(*args, rand, valid)
-            if bool(ok):
-                self.metrics.batch_sigs_success.inc(len(sets))
-                self.metrics.success_jobs.inc(len(sets))
-                return True
-            # batch failed: retry each set individually
-            # (reference: multithread/worker.ts:74-96)
+            if not always_false:
+                rand = jnp.asarray(
+                    BK.make_rand_bits(n, self.rng).astype(np.int32)
+                )
+                ok, _sub = KV.verify_batch_device(*args, rand, valid)
+                if bool(ok):
+                    self.metrics.batch_sigs_success.inc(len(sets))
+                    self.metrics.success_jobs.inc(len(sets))
+                    return ok_big
+            # batch failed (or contained an undecodable signature): retry
+            # each set individually so one bad signature cannot poison the
+            # verdict of honest sets (reference: multithread/worker.ts:74-96)
             self.metrics.batch_retries.inc()
-        per_set = np.asarray(self._each_fn(*args, valid))[: len(sets)]
+        per_set = (
+            np.asarray(KV.verify_each_device(*args, valid))[: len(sets)]
+            & decodable
+        )
         good = int(per_set.sum())
         self.metrics.success_jobs.inc(good)
         self.metrics.invalid_sets.inc(len(sets) - good)
-        return bool(per_set.all())
+        return ok_big and bool(per_set.all())
 
     def verify_signature_sets_individually(
         self, sets: Sequence[SignatureSet]
     ) -> List[bool]:
         """Per-set verdicts (used by gossip validators that must tell WHICH
         aggregate in a job failed)."""
-        out: List[bool] = []
-        for chunk_start in range(0, len(sets), MAX_JOB_SETS):
-            chunk = list(sets[chunk_start : chunk_start + MAX_JOB_SETS])
-            args, valid, _always_false, _n = self._prepare(chunk)
-            per_set = np.asarray(self._each_fn(*args, valid))[: len(chunk)]
-            decodable = np.array([s.signature is not None for s in chunk])
-            out.extend((per_set & decodable).tolist())
-        return out
+        verdicts: dict = {}
+        device_sets: List[Tuple[int, SignatureSet]] = []
+        for pos, s in enumerate(sets):
+            if len(s.indices) > MAX_AGG_INDICES:
+                verdicts[pos] = self._verify_set_cpu(s)
+            else:
+                device_sets.append((pos, s))
+        for chunk_start in range(0, len(device_sets), MAX_JOB_SETS):
+            chunk = device_sets[chunk_start : chunk_start + MAX_JOB_SETS]
+            subset = [s for _, s in chunk]
+            args, valid, _n = self._prepare(subset)
+            per_set = np.asarray(KV.verify_each_device(*args, valid))[
+                : len(subset)
+            ]
+            for (pos, s), v in zip(chunk, per_set):
+                verdicts[pos] = bool(v) and s.signature is not None
+        return [verdicts[i] for i in range(len(sets))]
 
     def close(self) -> None:
         pass

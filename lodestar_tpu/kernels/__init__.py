@@ -9,10 +9,10 @@ while an in-kernel vector op costs ~1 ns/element, so the design rule is a
 handful of kernel invocations per verification batch, each containing its
 whole loop (measured in microbench_product.py / microbench_prims3.py).
 
-Replaces the round-1 `ops/` einsum path (kept for cross-checks) as the
-engine under `bls/verifier.py`, standing in for blst's assembly pairing in
-the reference's worker pool (reference:
-packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106).
+The engine standing in for blst's assembly pairing in the reference's
+worker pool (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106); the
+round-1 `ops/` einsum path is kept as a correctness cross-check.
 """
 
 from . import layout  # noqa: F401

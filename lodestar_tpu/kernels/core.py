@@ -180,6 +180,43 @@ def select(mask, a, b):
     return jnp.where(mask[..., None, :], a, b)
 
 
+def const_plane(limbs, like):
+    """Python-int limb list -> [NL, B] constant plane, B from `like`.
+
+    Built from scalar splats (33 fills + concat) instead of a captured
+    device array: pallas kernels may not close over array constants, and
+    XLA folds/CSEs the splats anyway.
+    """
+    b = like.shape[-1]
+    cols = [
+        jnp.full((1, b), int(v), jnp.int32) for v in limbs
+    ]
+    return jnp.concatenate(cols, axis=-2)
+
+
+# 2^384 mod p as limbs — limb 32 is zero (p < 2^381), so wrapping the top
+# limb through this constant leaves a fresh zero top.
+_K384 = [int(v) for v in LY.to_limbs((1 << 384) % LY.P)]
+
+
+def _wrap_top_once(t):
+    c = t[..., -1:, :]
+    body = jnp.concatenate([t[..., :-1, :], jnp.zeros_like(c)], axis=-2)
+    wrapped = body + const_plane(_K384, t) * c
+    return fold(fold(wrapped))
+
+
+def squeeze_top(t):
+    """Wrap the top limb back modulo p: value-preserving mod p, top -> ~0.
+
+    Iterated add-chains (cyclotomic squaring's 3t +- 2x terms) grow the
+    unmasked top limb geometrically; this resets it.  K384 is ~2^381, so
+    each wrap shrinks |top| by ~2^3.5; three passes take |top| <= 2^16
+    down to a handful of bits (|top| <= ~8), restoring the T-bound.
+    """
+    return _wrap_top_once(_wrap_top_once(_wrap_top_once(t)))
+
+
 # ---------------------------------------------------------------------------
 # Exact residue tests (comparisons against canonical constants)
 # ---------------------------------------------------------------------------
@@ -214,8 +251,7 @@ def _canon_nonneg(t):
 
 def _eq_const(t, c_limbs):
     """All-limb equality against a python-int limb list -> bool [..., B]."""
-    c = jnp.asarray(np.asarray(c_limbs, np.int32)[:, None])
-    return jnp.all(t == c, axis=-2)
+    return jnp.all(t == const_plane(c_limbs, t), axis=-2)
 
 
 # z value lies in {-p, 0, p} when z == 0 (mod p); shifted by +V1:

@@ -1,0 +1,169 @@
+"""Fp2 arithmetic for the pallas field engine.
+
+An Fp2 element a0 + a1*u (u^2 = -1) is a tuple (a0, a1) of core-layout
+arrays ``[..., NL, B]`` (see kernels/layout.py).  All functions are
+value-level — callable inside pallas kernels and under plain jit.
+
+Multiplication is Karatsuba with LAZY REDUCTION: 3 limb products but only
+2 Montgomery reductions per multiply (the column-space combinations stay
+inside int32 — bound audit in the function bodies).  This is the first
+tower level of the blst-replacement engine (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import core as C
+from . import layout as LY
+
+# ---------------------------------------------------------------------------
+# Linear ops
+# ---------------------------------------------------------------------------
+
+
+def add2(a, b):
+    return (C.add(a[0], b[0]), C.add(a[1], b[1]))
+
+
+def sub2(a, b):
+    return (C.sub(a[0], b[0]), C.sub(a[1], b[1]))
+
+
+def neg2(a):
+    return (C.neg(a[0]), C.neg(a[1]))
+
+
+def conj2(a):
+    """a0 - a1*u == a^p (the Fp2 Frobenius)."""
+    return (a[0], C.neg(a[1]))
+
+
+def double2(a):
+    return (C.mul_small(a[0], 2), C.mul_small(a[1], 2))
+
+
+def mul2_small(a, k: int):
+    return (C.mul_small(a[0], k), C.mul_small(a[1], k))
+
+
+def mul2_xi(a):
+    """Multiply by the Fp6 non-residue xi = 1 + u:
+    (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u."""
+    return (C.sub(a[0], a[1]), C.add(a[0], a[1]))
+
+
+def select2(mask, a, b):
+    return (C.select(mask, a[0], b[0]), C.select(mask, a[1], b[1]))
+
+
+# ---------------------------------------------------------------------------
+# Multiplicative ops (lazy Karatsuba)
+# ---------------------------------------------------------------------------
+
+
+def mul2(a, b):
+    """Fp2 product: 3 limb products, 2 REDCs.
+
+    Column bounds: public inputs have |limbs| <= 4103, folded 2-term sums
+    <= 4098 (+ small top drift), so each product's columns are
+    <= 33 * 4103^2 < 2^29.1; the worst combination (tm - t00 - t11) is
+    < 3 * 2^29.1 < 2^30.7 — inside int32 and inside fold's range.
+    Values: |tm - t00 - t11| < 3 * 2^782 < 2^786 — inside redc's contract.
+    """
+    a0, a1 = a
+    b0, b1 = b
+    t00 = C.mul_cols(a0, b0)
+    t11 = C.mul_cols(a1, b1)
+    tm = C.mul_cols(C.add(a0, a1), C.add(b0, b1))
+    c0 = C.redc(t00 - t11)
+    c1 = C.redc(tm - t00 - t11)
+    return (c0, c1)
+
+
+def sqr2(a):
+    """Fp2 square via the complex method: 2 limb products, 2 REDCs.
+
+    (a0 + a1 u)^2 = (a0 + a1)(a0 - a1) + 2 a0 a1 u.
+    """
+    a0, a1 = a
+    c0 = C.redc(C.mul_cols(C.add(a0, a1), C.sub(a0, a1)))
+    c1 = C.redc(jnp.int32(2) * C.mul_cols(a0, a1))
+    return (c0, c1)
+
+
+def mul2_fp(a, k):
+    """Fp2 element times a batched Fp element: 2 products, 2 REDCs."""
+    return (C.mont_mul(a[0], k), C.mont_mul(a[1], k))
+
+
+def mul2_const(a, k01):
+    """Fp2 element times a shared host constant ((k0, k1) python-int
+    Montgomery limb lists): schoolbook over scalar-limb multiplies.
+
+    Schoolbook (4 shared products) instead of Karatsuba: the Karatsuba
+    middle-term column combination of a doubled-limb constant would peak at
+    ~2.2e9 — past int32 — while each schoolbook combination stays
+    <= 2 * 33 * 4103 * 4095 < 2^30.1.  Shared products are scalar
+    multiplies, cheaper than broadcast products, so 4 vs 3 is fine.
+    """
+    k0, k1 = k01
+    a0, a1 = a
+    t00 = C.mul_cols_shared(a0, k0, LY.NC)
+    t11 = C.mul_cols_shared(a1, k1, LY.NC)
+    t01 = C.mul_cols_shared(a0, k1, LY.NC)
+    t10 = C.mul_cols_shared(a1, k0, LY.NC)
+    return (C.redc(t00 - t11), C.redc(t01 + t10))
+
+
+def mul2_fp_const(a, k):
+    """Fp2 element times a shared host Fp constant (python-int limbs)."""
+    return (
+        C.redc(C.mul_cols_shared(a[0], k, LY.NC)),
+        C.redc(C.mul_cols_shared(a[1], k, LY.NC)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+
+
+def is_zero2(a):
+    return C.is_zero_modp(a[0]) & C.is_zero_modp(a[1])
+
+
+def eq2(a, b):
+    return C.eq_modp(a[0], b[0]) & C.eq_modp(a[1], b[1])
+
+
+# ---------------------------------------------------------------------------
+# Host-side codecs
+# ---------------------------------------------------------------------------
+
+
+def encode2(vals):
+    """List of (x0, x1) int pairs -> ((NL, B), (NL, B)) Montgomery planes."""
+    import numpy as np
+
+    return (
+        np.ascontiguousarray(LY.encode_batch([v[0] for v in vals])),
+        np.ascontiguousarray(LY.encode_batch([v[1] for v in vals])),
+    )
+
+
+def decode2(a):
+    """Device Fp2 planes -> list of (x0, x1) int pairs."""
+    x0 = LY.decode_batch(a[0])
+    x1 = LY.decode_batch(a[1])
+    return list(zip(x0, x1))
+
+
+def const2(v):
+    """Host (x0, x1) int pair -> python-int Montgomery limb lists for
+    mul2_const."""
+    return (
+        [int(x) for x in LY.const_mont(v[0])],
+        [int(x) for x in LY.const_mont(v[1])],
+    )

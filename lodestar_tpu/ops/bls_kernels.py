@@ -30,6 +30,8 @@ item 3).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -232,7 +234,7 @@ def verify_each(pk_aff, msg_aff, sig_aff, valid):
 def aggregate_pubkeys(table_x, table_y, indices, mask):
     """Aggregate pubkeys per set from a device-resident table.
 
-    table_x/table_y: uint32[V, 24] affine G1 coordinate tables (Montgomery)
+    table_x/table_y: uint32[V, 32] affine G1 coordinate tables (Montgomery)
     indices:         int32[N, K] validator indices per set (0-padded)
     mask:            bool[N, K] — which of the K slots are real
 
@@ -254,9 +256,21 @@ def aggregate_pubkeys(table_x, table_y, indices, mask):
 # ---------------------------------------------------------------------------
 
 
-def make_rand_bits(n: int, rng: np.random.Generator) -> np.ndarray:
-    """Random odd 64-bit scalars as MSB-first bit planes uint32[64, n]."""
-    scalars = rng.integers(0, 1 << 63, size=n, dtype=np.uint64) * 2 + 1
+def make_rand_bits(
+    n: int, rng: "np.random.Generator | None" = None
+) -> np.ndarray:
+    """Random odd 64-bit scalars as MSB-first bit planes uint32[64, n].
+
+    With rng=None (the production default) scalars come from the OS CSPRNG —
+    batch-verification soundness requires unpredictable randomizers, same as
+    blst's RAND_bytes (reference: chain/bls/maybeBatch.ts / blst
+    verifyMultipleSignatures).  A seeded Generator is for tests only.
+    """
+    if rng is None:
+        raw = np.frombuffer(os.urandom(8 * n), dtype=np.uint64)
+        scalars = raw | np.uint64(1)  # odd, full 64-bit range
+    else:
+        scalars = rng.integers(0, 1 << 63, size=n, dtype=np.uint64) * 2 + 1
     out = np.zeros((RAND_BITS, n), dtype=np.uint32)
     for i in range(RAND_BITS):
         out[RAND_BITS - 1 - i] = (scalars >> np.uint64(i)) & np.uint64(1)

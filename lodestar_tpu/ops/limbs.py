@@ -207,10 +207,12 @@ def mul_low(a, b):
 def shrink(cols):
     """Three shift-add passes: columns < 2^31 -> redundant limbs <= 2^12.
 
-    Value-preserving but NOT canonical (a limb may be exactly 2^12).  Cheap
-    replacement for carry_prop at points where only the represented value
-    matters (mid-REDC) — exactness of subsequent 12-bit-limb products is
-    retained since 4096^2 * 32 < 2^31.
+    Value-preserving only modulo R = 2^384: the carry out of the TOP limb
+    is dropped (unlike carry_prop, which keeps it).  Callers must tolerate
+    mod-R semantics — mont_mul does, since REDC's low half is consumed
+    mod R anyway.  NOT canonical (a limb may be exactly 2^12); exactness
+    of subsequent 12-bit-limb products is retained since
+    4096^2 * 32 < 2^31.
     """
     return lax.fori_loop(
         0, 3, lambda _, t: (t & LIMB_MASK) + _shift_up(t >> LIMB_BITS), cols

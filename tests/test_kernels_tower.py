@@ -1,0 +1,239 @@
+"""kernels/ Fp2-Fp6-Fp12 tower vs the crypto/ CPU ground truth.
+
+Runs the value-level tower under plain jit (identical int32 semantics to
+the in-kernel path) and checks exact field results, including the lazy
+public-class limb bounds the pallas kernels rely on.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import fields as GT
+from lodestar_tpu.kernels import core as C
+from lodestar_tpu.kernels import fp2 as F2
+from lodestar_tpu.kernels import layout as LY
+from lodestar_tpu.kernels import tower as TW
+
+pytestmark = pytest.mark.smoke
+
+random.seed(0xF00D)
+P = LY.P
+B = 16
+
+
+def r2():
+    return (random.randrange(P), random.randrange(P))
+
+
+def r6():
+    return (r2(), r2(), r2())
+
+
+def r12():
+    return (r6(), r6())
+
+
+def enc2(vals):
+    a = jnp.asarray(LY.encode_batch([v[0] for v in vals]))
+    b = jnp.asarray(LY.encode_batch([v[1] for v in vals]))
+    return (a, b)
+
+
+def dec2(t):
+    return list(zip(LY.decode_batch(np.asarray(t[0])), LY.decode_batch(np.asarray(t[1]))))
+
+
+def enc6(vals):
+    return tuple(enc2([v[i] for v in vals]) for i in range(3))
+
+
+def dec6(t):
+    parts = [dec2(c) for c in t]
+    return list(zip(*parts))
+
+
+def enc12(vals):
+    return tuple(enc6([v[i] for v in vals]) for i in range(2))
+
+
+def dec12(t):
+    parts = [dec6(c) for c in t]
+    return list(zip(*parts))
+
+
+def assert_bounds(tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        assert a.min() >= -4103 and a.max() <= 4103, (a.min(), a.max())
+
+
+def test_fp2_mul_sqr_xi_conj():
+    xs, ys = [r2() for _ in range(B)], [r2() for _ in range(B)]
+    a, b = enc2(xs), enc2(ys)
+
+    @jax.jit
+    def f(a, b):
+        return (
+            F2.mul2(a, b),
+            F2.sqr2(a),
+            F2.mul2_xi(F2.sub2(a, b)),
+            F2.conj2(F2.add2(a, b)),
+        )
+
+    m, s, x, c = f(a, b)
+    assert dec2(m) == [GT.fp2_mul(u, v) for u, v in zip(xs, ys)]
+    assert dec2(s) == [GT.fp2_sqr(u) for u in xs]
+    assert dec2(x) == [GT.fp2_mul_xi(GT.fp2_sub(u, v)) for u, v in zip(xs, ys)]
+    assert dec2(c) == [GT.fp2_conj(GT.fp2_add(u, v)) for u, v in zip(xs, ys)]
+    assert_bounds((m, s, x, c))
+
+
+def test_fp2_const_and_fp_mul():
+    xs = [r2() for _ in range(B)]
+    k = r2()
+    kfp = random.randrange(P)
+    a = enc2(xs)
+    kc = F2.const2(k)
+    kv = jnp.asarray(LY.encode_batch([kfp] * B))
+
+    @jax.jit
+    def f(a, kv):
+        return F2.mul2_const(a, kc), F2.mul2_fp(a, kv), F2.mul2_fp_const(
+            a, [int(v) for v in LY.const_mont(kfp)]
+        )
+
+    mc, mf, mfc = f(a, kv)
+    assert dec2(mc) == [GT.fp2_mul(u, k) for u in xs]
+    want_fp = [GT.fp2_mul_fp(u, kfp) for u in xs]
+    assert dec2(mf) == want_fp
+    assert dec2(mfc) == want_fp
+
+
+def test_fp6_mul_sqr():
+    xs, ys = [r6() for _ in range(B)], [r6() for _ in range(B)]
+    a, b = enc6(xs), enc6(ys)
+
+    @jax.jit
+    def f(a, b):
+        return TW.mul6(a, b), TW.sqr6(a), TW.mul6_by_v(b)
+
+    m, s, v = f(a, b)
+    assert dec6(m) == [GT.fp6_mul(u, w) for u, w in zip(xs, ys)]
+    assert dec6(s) == [GT.fp6_sqr(u) for u in xs]
+    assert dec6(v) == [GT.fp6_mul_by_v(w) for w in ys]
+    assert_bounds((m, s, v))
+
+
+def test_fp12_mul_sqr_deep_chain():
+    xs, ys = [r12() for _ in range(B)], [r12() for _ in range(B)]
+    a, b = enc12(xs), enc12(ys)
+
+    @jax.jit
+    def f(a, b):
+        m = TW.mul12(a, b)
+        s = TW.sqr12(m)
+        return m, TW.mul12(s, TW.conj12(a))
+
+    m, chain = f(a, b)
+    want_m = [GT.fp12_mul(u, w) for u, w in zip(xs, ys)]
+    assert dec12(m) == want_m
+    want = [
+        GT.fp12_mul(GT.fp12_sqr(wm), GT.fp12_conj(u))
+        for wm, u in zip(want_m, xs)
+    ]
+    assert dec12(chain) == want
+    assert_bounds(chain)
+
+
+def test_fp12_frobenius():
+    xs = [r12() for _ in range(B)]
+    a = enc12(xs)
+
+    @jax.jit
+    def f(a):
+        return TW.frob12(a, 1), TW.frob12(a, 2), TW.frob12(a, 3)
+
+    f1, f2, f3 = f(a)
+    assert dec12(f1) == [GT.fp12_frobenius(u, 1) for u in xs]
+    assert dec12(f2) == [GT.fp12_frobenius(u, 2) for u in xs]
+    assert dec12(f3) == [GT.fp12_frobenius(u, 3) for u in xs]
+
+
+def test_is_one_and_select():
+    xs = [r12() for _ in range(4)]
+    ones = [GT.FP12_ONE] * 2
+    vals = xs[:2] + ones + xs[2:]
+    a = enc12(vals)
+
+    @jax.jit
+    def f(a):
+        mask = jnp.asarray([True, False, True, False, True, False])
+        o = TW.one12(a[0][0][0])
+        return TW.is_one12(a), TW.is_one12(TW.select12(mask, a, o))
+
+    raw, sel = f(a)
+    assert list(np.asarray(raw)) == [False, False, True, True, False, False]
+    # slots where mask False were replaced by one
+    assert list(np.asarray(sel)) == [False, True, True, True, False, True]
+
+
+def _cyclotomic_sample(n):
+    """Random elements of the cyclotomic subgroup: m^(p^6-1)(p^2+1)."""
+    out = []
+    for _ in range(n):
+        f = r12()
+        m = GT.fp12_mul(GT.fp12_conj(f), GT.fp12_inv(f))
+        m = GT.fp12_mul(GT.fp12_frobenius(m, 2), m)
+        out.append(m)
+    return out
+
+
+def test_cyclotomic_square_and_pow_x():
+    cs = _cyclotomic_sample(4)
+    a = enc12(cs)
+
+    @jax.jit
+    def f(a):
+        return TW.cyclo_sqr(a), TW.cyclo_pow_x_neg(a)
+
+    s, px = f(a)
+    assert dec12(s) == [GT.fp12_sqr(u) for u in cs]
+    x = GT.X_PARAM
+    want = [GT.fp12_pow(u, (-x)) for u in cs]
+    want = [GT.fp12_conj(w) for w in want]  # inverse == conj in cyclo group
+    assert dec12(px) == want
+    assert_bounds((s, px))
+
+
+def test_inversion_chain():
+    xs = [r2() for _ in range(B)]
+    x6 = [r6() for _ in range(4)]
+    x12 = [r12() for _ in range(2)]
+    a2, a6, a12 = enc2(xs), enc6(x6), enc12(x12)
+
+    @jax.jit
+    def f(a2, a6, a12):
+        return TW.inv2(a2), TW.inv6(a6), TW.inv12(a12)
+
+    i2, i6, i12 = f(a2, a6, a12)
+    assert dec2(i2) == [GT.fp2_inv(u) for u in xs]
+    assert dec6(i6) == [GT.fp6_inv(u) for u in x6]
+    assert dec12(i12) == [GT.fp12_inv(u) for u in x12]
+
+
+def test_pow_static_fp():
+    xs = [random.randrange(P) for _ in range(B)]
+    a = jnp.asarray(LY.encode_batch(xs))
+    e = 0xDEADBEEF_CAFEBABE_0123456789ABCDEF
+
+    @jax.jit
+    def f(a):
+        return TW.pow_static(a, e, C.mont_sqr, C.mont_mul, None)
+
+    got = LY.decode_batch(np.asarray(f(a)))
+    assert got == [pow(x, e, P) for x in xs]

@@ -1,0 +1,178 @@
+"""End-to-end pallas verification pipeline vs the crypto oracle.
+
+Runs in pallas interpret mode on the CPU test platform (the driver and
+dev runs exercise the same kernels compiled through Mosaic on the chip).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto import bls as GB
+from lodestar_tpu.crypto import curves as GC
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.kernels import layout as LY
+from lodestar_tpu.kernels import verify as KV
+from lodestar_tpu.ops import bls_kernels as BK
+
+pytestmark = pytest.mark.slow
+
+random.seed(0xACE5)
+N = 128  # one kernel lane tile (kernels/verify.py BT)
+
+
+def enc_plane(vals):
+    # msg/sig planes ship as PLAIN limbs (ingest wire split)
+    return jnp.asarray(LY.encode_plain_batch(vals))
+
+
+def world(v=6):
+    sks = [GB.keygen(b"kv-%d" % i) for i in range(v)]
+    pks = [GB.sk_to_pk(sk) for sk in sks]
+    # the table is stored in Montgomery form (registration-time encode)
+    tx = jnp.asarray(LY.encode_batch([p[0] for p in pks]))
+    ty = jnp.asarray(LY.encode_batch([p[1] for p in pks]))
+    return sks, pks, tx, ty
+
+
+def encode_sets(sets, n, kmax):
+    """sets: list of (indices, msg_point, sig_point_or_None)."""
+    idx = np.zeros((n, kmax), np.int32)
+    kmask = np.zeros((n, kmax), np.int32)
+    valid = np.zeros((n,), np.int32)
+    sig_inf = np.zeros((n,), np.int32)
+    msgs, sigs = [], []
+    g2 = GC.G2_GEN
+    for i, (ids, msg, sig) in enumerate(sets):
+        idx[i, : len(ids)] = ids
+        kmask[i, : len(ids)] = 1
+        valid[i] = 1
+        msgs.append(msg)
+        if sig is None:
+            sig_inf[i] = 1
+            sigs.append(g2)
+        else:
+            sigs.append(sig)
+    for _ in range(n - len(sets)):
+        msgs.append(g2)
+        sigs.append(g2)
+    planes = dict(
+        idx=jnp.asarray(idx),
+        kmask=jnp.asarray(kmask),
+        msg_x0=enc_plane([m[0][0] for m in msgs]),
+        msg_x1=enc_plane([m[0][1] for m in msgs]),
+        msg_y0=enc_plane([m[1][0] for m in msgs]),
+        msg_y1=enc_plane([m[1][1] for m in msgs]),
+        sig_x0=enc_plane([s[0][0] for s in sigs]),
+        sig_x1=enc_plane([s[0][1] for s in sigs]),
+        sig_y0=enc_plane([s[1][0] for s in sigs]),
+        sig_y1=enc_plane([s[1][1] for s in sigs]),
+        sig_inf=jnp.asarray(sig_inf),
+        valid=jnp.asarray(valid),
+    )
+    return planes
+
+
+def bits_for(n, seed):
+    return jnp.asarray(
+        BK.make_rand_bits(n, np.random.default_rng(seed)).astype(np.int32)
+    )
+
+
+def run_batch(tx, ty, planes, bits):
+    ok, sub = KV.verify_batch_device(
+        tx, ty, planes["idx"], planes["kmask"],
+        planes["msg_x0"], planes["msg_x1"], planes["msg_y0"], planes["msg_y1"],
+        planes["sig_x0"], planes["sig_x1"], planes["sig_y0"], planes["sig_y1"],
+        planes["sig_inf"], bits, planes["valid"],
+    )
+    return bool(ok), list(np.asarray(sub))
+
+
+def run_each(tx, ty, planes):
+    ok = KV.verify_each_device(
+        tx, ty, planes["idx"], planes["kmask"],
+        planes["msg_x0"], planes["msg_x1"], planes["msg_y0"], planes["msg_y1"],
+        planes["sig_x0"], planes["sig_x1"], planes["sig_y0"], planes["sig_y1"],
+        planes["sig_inf"], planes["valid"],
+    )
+    return list(np.asarray(ok))
+
+
+def test_batch_singles_accept_and_reject():
+    sks, pks, tx, ty = world()
+    msgs = [b"root-%d" % (i % 2) for i in range(3)]
+    sets = [
+        ((i,), hash_to_g2(msgs[i]), GB.sign(sks[i], msgs[i])) for i in range(3)
+    ]
+    planes = encode_sets(sets, N, 1)
+    ok, sub = run_batch(tx, ty, planes, bits_for(N, 1))
+    assert ok and all(sub)
+
+    # tamper one signature (stays in subgroup)
+    bad = list(sets)
+    bad[1] = (bad[1][0], bad[1][1], GC.scalar_mul(GC.FP2_OPS, bad[1][2], 2))
+    planes = encode_sets(bad, N, 1)
+    ok, sub = run_batch(tx, ty, planes, bits_for(N, 2))
+    assert not ok and all(sub)
+    each = run_each(tx, ty, planes)
+    assert each[:3] == [True, False, True] and all(each[3:])
+
+
+def test_batch_aggregate_sets():
+    sks, pks, tx, ty = world()
+    msg = b"agg-root"
+    hm = hash_to_g2(msg)
+    ids = [1, 3, 4]
+    agg_sig = GB.aggregate_signatures([GB.sign(sks[i], msg) for i in ids])
+    single = ((0,), hash_to_g2(b"s"), GB.sign(sks[0], b"s"))
+    sets = [single, (tuple(ids), hm, agg_sig)]
+    planes = encode_sets(sets, N, 4)
+    ok, sub = run_batch(tx, ty, planes, bits_for(N, 3))
+    assert ok and all(sub)
+    assert all(run_each(tx, ty, planes))
+
+    # wrong aggregate membership must fail
+    sets_bad = [single, ((1, 3, 5), hm, agg_sig)]
+    planes = encode_sets(sets_bad, N, 4)
+    ok, _ = run_batch(tx, ty, planes, bits_for(N, 4))
+    assert not ok
+    each = run_each(tx, ty, planes)
+    assert each[:2] == [True, False] and all(each[2:])
+
+
+def test_out_of_subgroup_signature_rejected():
+    from lodestar_tpu.crypto import hash_to_curve as GH
+
+    sks, pks, tx, ty = world()
+    bad_sig = GH.map_to_curve_svdw(
+        GC.FP2_OPS, GH.hash_to_field_fp2(b"oos", 1, b"T")[0]
+    )
+    assert not GC.g2_subgroup_check(bad_sig)
+    sets = [
+        ((0,), hash_to_g2(b"m"), GB.sign(sks[0], b"m")),
+        ((1,), hash_to_g2(b"m2"), bad_sig),
+    ]
+    planes = encode_sets(sets, N, 1)
+    ok, sub = run_batch(tx, ty, planes, bits_for(N, 5))
+    assert not ok
+    assert sub[:2] == [True, False] and all(sub[2:])
+    each = run_each(tx, ty, planes)
+    assert each[:2] == [True, False] and all(each[2:])
+
+
+def test_infinity_signature_rejected():
+    sks, pks, tx, ty = world()
+    sets = [
+        ((0,), hash_to_g2(b"m"), GB.sign(sks[0], b"m")),
+        ((1,), hash_to_g2(b"m2"), None),  # infinity/undecodable
+    ]
+    planes = encode_sets(sets, N, 1)
+    ok, _ = run_batch(tx, ty, planes, bits_for(N, 6))
+    assert not ok
+    each = run_each(tx, ty, planes)
+    assert each[:2] == [True, False] and all(each[2:])

@@ -1,0 +1,149 @@
+"""BlsVerifierService: buffering, backpressure, retry, shutdown semantics.
+
+Uses a stub verifier (host-only) so the service contract is tested
+without device time; the device paths are covered by test_verifier.py.
+Reference: packages/beacon-node/src/chain/bls/multithread/index.ts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu.bls.service import BlsVerifierService
+from lodestar_tpu.bls.signature_set import SignatureSet
+from lodestar_tpu.bls.verifier import VerifyOptions
+from lodestar_tpu.utils.metrics import BlsPoolMetrics
+
+pytestmark = pytest.mark.smoke
+
+
+class StubVerifier:
+    """Scriptable IBlsVerifier: records calls, configurable delay/verdict."""
+
+    def __init__(self, delay=0.0, verdict=True):
+        self.metrics = BlsPoolMetrics()
+        self.delay = delay
+        self.verdict = verdict
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def verify_signature_sets(self, sets, opts=None):
+        with self._lock:
+            self.calls.append((len(sets), opts))
+        if self.delay:
+            time.sleep(self.delay)
+        v = self.verdict
+        return v(sets) if callable(v) else v
+
+    def close(self):
+        pass
+
+
+def fake_set(i):
+    return SignatureSet.single(i, ("m", i), ("s", i))
+
+
+def test_small_batchable_jobs_coalesce():
+    stub = StubVerifier()
+    svc = BlsVerifierService(stub, buffer_wait_ms=30)
+    futs = [
+        svc.verify_signature_sets_async([fake_set(i)], VerifyOptions(batchable=True))
+        for i in range(3)
+    ]
+    assert all(f.result(timeout=5) for f in futs)
+    svc.close()
+    # all three 1-set jobs merged into one 3-set device call
+    merged_calls = [c for c in stub.calls if c[0] == 3]
+    assert len(merged_calls) == 1 and len(stub.calls) == 1
+
+
+def test_buffer_flushes_at_max_sigs_without_waiting():
+    stub = StubVerifier()
+    svc = BlsVerifierService(stub, max_buffered_sigs=4, buffer_wait_ms=10_000)
+    futs = [
+        svc.verify_signature_sets_async([fake_set(i)], VerifyOptions(batchable=True))
+        for i in range(4)
+    ]
+    t0 = time.perf_counter()
+    assert all(f.result(timeout=5) for f in futs)
+    assert time.perf_counter() - t0 < 5  # did not wait for the 10 s window
+    svc.close()
+
+
+def test_non_batchable_jobs_bypass_buffer():
+    stub = StubVerifier()
+    svc = BlsVerifierService(stub, buffer_wait_ms=10_000)
+    fut = svc.verify_signature_sets_async([fake_set(0)], VerifyOptions())
+    assert fut.result(timeout=5)
+    svc.close()
+    assert stub.calls and stub.calls[0][0] == 1
+
+
+def test_merged_batch_failure_gives_per_job_verdicts():
+    # verdict: merged call (3 sets) fails; per-job retries succeed for the
+    # two jobs without the poisoned set
+    def verdict(sets):
+        ids = [s.indices[0] for s in sets]
+        return 666 not in ids
+
+    stub = StubVerifier(verdict=verdict)
+    svc = BlsVerifierService(stub, buffer_wait_ms=20)
+    good1 = svc.verify_signature_sets_async([fake_set(1)], VerifyOptions(batchable=True))
+    bad = svc.verify_signature_sets_async([fake_set(666)], VerifyOptions(batchable=True))
+    good2 = svc.verify_signature_sets_async([fake_set(2)], VerifyOptions(batchable=True))
+    assert good1.result(timeout=5) is True
+    assert bad.result(timeout=5) is False
+    assert good2.result(timeout=5) is True
+    svc.close()
+
+
+def test_backpressure_flips_under_load():
+    stub = StubVerifier(delay=0.05)
+    svc = BlsVerifierService(stub, max_pending_jobs=4, buffer_wait_ms=1)
+    assert svc.can_accept_work()
+    futs = [
+        svc.verify_signature_sets_async([fake_set(i)], VerifyOptions())
+        for i in range(5)
+    ]
+    assert not svc.can_accept_work()          # >= 4 pending
+    assert svc.metrics.queue_length.value >= 4
+    assert all(f.result(timeout=5) for f in futs)
+    deadline = time.time() + 5
+    while not svc.can_accept_work() and time.time() < deadline:
+        time.sleep(0.01)
+    assert svc.can_accept_work()              # drained
+    assert svc.metrics.job_wait_time.count >= 5
+    svc.close()
+
+
+def test_verify_on_main_thread_is_synchronous():
+    calls = []
+
+    class SyncStub(StubVerifier):
+        def verify_signature_sets(self, sets, opts=None):
+            calls.append(threading.current_thread().name)
+            return True
+
+    svc = BlsVerifierService(SyncStub())
+    fut = svc.verify_signature_sets_async(
+        [fake_set(0)], VerifyOptions(verify_on_main_thread=True)
+    )
+    assert fut.done() and fut.result() is True
+    assert calls == [threading.current_thread().name]  # caller thread
+    svc.close()
+
+
+def test_close_rejects_queued_jobs():
+    stub = StubVerifier(delay=0.2)
+    svc = BlsVerifierService(stub, buffer_wait_ms=1)
+    running = svc.verify_signature_sets_async([fake_set(0)], VerifyOptions())
+    time.sleep(0.05)  # let the dispatcher pick up the first job
+    queued = svc.verify_signature_sets_async([fake_set(1)], VerifyOptions())
+    svc.close()
+    assert running.result(timeout=5) is True
+    with pytest.raises(RuntimeError):
+        queued.result(timeout=5)
+    late = svc.verify_signature_sets_async([fake_set(2)], VerifyOptions())
+    with pytest.raises(RuntimeError):
+        late.result(timeout=5)

@@ -98,3 +98,54 @@ def test_can_accept_work():
     assert verifier.can_accept_work()
     verifier._pending_jobs = 512
     assert not verifier.can_accept_work()
+
+
+def test_undecodable_signature_still_retries_decodable_sets():
+    """One undecodable sig must not swallow honest sets' accounting
+    (reference: multithread/worker.ts:74-96 retry semantics)."""
+    sks, _table, verifier = make_world()
+    bad = SignatureSet.single(0, hash_to_g2(b"m"), None)
+    good1 = single_set(sks, 1, b"root-1")
+    good2 = single_set(sks, 2, b"root-2")
+    assert not verifier.verify_signature_sets(
+        [good1, bad, good2], VerifyOptions(batchable=True)
+    )
+    m = verifier.metrics
+    assert m.batch_retries.value == 1      # batch implicitly failed
+    assert m.success_jobs.value == 2       # honest sets credited
+    assert m.invalid_sets.value == 1
+
+
+def test_verify_on_main_thread_cpu_path():
+    """The latency fast path (reference: validation/block.ts:146) verifies
+    synchronously on the host CPU ground truth."""
+    sks, _table, verifier = make_world()
+    opts = VerifyOptions(verify_on_main_thread=True)
+    assert verifier.verify_signature_sets([single_set(sks, 0, b"blk")], opts)
+    assert not verifier.verify_signature_sets(
+        [single_set(sks, 0, b"blk", tamper=True)], opts
+    )
+    assert not verifier.verify_signature_sets(
+        [SignatureSet.single(0, hash_to_g2(b"blk"), None)], opts
+    )
+
+
+def test_oversized_aggregate_falls_back_to_cpu():
+    """An aggregate with more participants than the largest device bucket
+    (> 2048, e.g. a full mainnet committee with duplicates) must still get
+    a verdict instead of raising."""
+    sks, _table, verifier = make_world()
+    reps = 342  # 6 keys x 342 = 2052 > MAX_AGG_INDICES
+    idxs = list(range(N_KEYS)) * reps
+    msg = b"committee-root"
+    sig_each = [GTB.sign(sk, msg) for sk in sks]
+    agg_once = GTB.aggregate_signatures(sig_each)
+    sig = C.scalar_mul(C.FP2_OPS, agg_once, reps)
+    big = SignatureSet.aggregate(idxs, hash_to_g2(msg), sig)
+    small = single_set(sks, 1, b"root-1")
+    assert verifier.verify_signature_sets([big, small], VerifyOptions(batchable=True))
+    # tampered oversized aggregate -> False, and no exception
+    bad = SignatureSet.aggregate(
+        idxs, hash_to_g2(msg), C.scalar_mul(C.FP2_OPS, sig, 2)
+    )
+    assert not verifier.verify_signature_sets([bad], VerifyOptions(batchable=True))
