@@ -3,11 +3,18 @@ Lodestar (Ethereum consensus client), centered on batched BLS12-381
 signature-set verification on TPU via JAX.
 
 Layout (mirrors SURVEY.md section 2's component inventory):
+  params/    spec constants, presets, domains (the @lodestar/params layer)
+  ssz/       SSZ serialization + merkleization (+ native batch hasher)
+  types/     per-fork beacon SSZ types (phase0/altair signature path)
+  config/    chain config: fork schedule, domains, digests
   crypto/    CPU ground-truth BLS12-381 (oracle + fallback verifier)
   kernels/   the pallas field/pairing engine (transposed signed-limb layout)
   ops/       JAX einsum-path kernels (correctness cross-check of kernels/)
   bls/       the IBlsVerifier boundary: signature sets, batch semantics, retry
-  utils/     queues, backpressure, metrics (lodestar_bls_thread_pool_* compat)
+  state_transition/  epoch cache, shuffling, signature-set extractors
+  network/   gossip queues + NetworkProcessor scheduling/backpressure
+  utils/     queues, retry, logger, metrics (+ HTTP exposition server)
+  native/    C++ runtime components (batched SHA-256 merkleizer)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Chain-side components: seen caches, clock, (the BLS boundary lives in
+`lodestar_tpu.bls`).  Reference: packages/beacon-node/src/chain/.
+"""
+
+from .clock import Clock  # noqa: F401
+from .seen_cache import (  # noqa: F401
+    SeenAggregators,
+    SeenAttestationDatas,
+    SeenAttesters,
+)

@@ -1,0 +1,49 @@
+"""Slot clock.
+
+Mirror of the reference's Clock (reference:
+packages/beacon-node/src/util/clock.ts): derives the current slot/epoch
+from genesis time, emits per-slot callbacks.  The replay harness drives
+it manually (set_time) — a live node would tick it from wall time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+from .. import params
+
+
+class Clock:
+    def __init__(self, genesis_time: float = 0.0):
+        self.genesis_time = genesis_time
+        self._now = genesis_time
+        self._slot_listeners: List[Callable[[int], None]] = []
+        self._last_emitted_slot = -1
+
+    def on_slot(self, fn: Callable[[int], None]) -> None:
+        self._slot_listeners.append(fn)
+
+    @property
+    def current_slot(self) -> int:
+        elapsed = max(self._now - self.genesis_time, 0.0)
+        return int(elapsed // params.SECONDS_PER_SLOT)
+
+    @property
+    def current_epoch(self) -> int:
+        return self.current_slot // params.SLOTS_PER_EPOCH
+
+    def slot_start(self, slot: int) -> float:
+        return self.genesis_time + slot * params.SECONDS_PER_SLOT
+
+    def set_time(self, t: float) -> None:
+        """Advance the clock (replay driver); emits slot events."""
+        self._now = t
+        slot = self.current_slot
+        while self._last_emitted_slot < slot:
+            self._last_emitted_slot += 1
+            for fn in self._slot_listeners:
+                fn(self._last_emitted_slot)
+
+    def tick_wall(self) -> None:
+        self.set_time(time.time())

@@ -1,0 +1,96 @@
+// Batched SHA-256 for SSZ merkleization — native equivalent of the
+// reference's @chainsafe/as-sha256 WASM hasher (reference: SURVEY.md §2.3;
+// used by persistent-merkle-tree for hashtree roots).
+//
+// The merkleization workload is millions of independent 64-byte sibling
+// pairs -> 32-byte parents.  A 64-byte message is exactly one data block
+// plus one constant padding block, so the padding block's schedule is
+// baked in and each pair costs two compression calls with zero per-call
+// setup.  One C call hashes a whole tree level (amortizing the Python
+// FFI boundary), which is where this beats per-hash hashlib calls.
+//
+// Build: make -C lodestar_tpu/native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+const uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline void compress(uint32_t state[8], const uint32_t w_in[16]) {
+  uint32_t w[64];
+  std::memcpy(w, w_in, 16 * sizeof(uint32_t));
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// Padding block for a 64-byte message: 0x80, zeros, bit-length 512.
+const uint32_t PAD512[16] = {0x80000000, 0, 0, 0, 0, 0, 0, 0,
+                             0,          0, 0, 0, 0, 0, 0, 512};
+
+inline uint32_t load_be(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline void store_be(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24); p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);  p[3] = uint8_t(v);
+}
+
+}  // namespace
+
+extern "C" {
+
+// in:  n consecutive 64-byte blocks (sibling pairs)
+// out: n consecutive 32-byte digests
+void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t* msg = in + 64 * i;
+    uint32_t w[16];
+    for (int j = 0; j < 16; j++) w[j] = load_be(msg + 4 * j);
+    uint32_t st[8];
+    std::memcpy(st, H0, sizeof(H0));
+    compress(st, w);
+    compress(st, PAD512);
+    uint8_t* dst = out + 32 * i;
+    for (int j = 0; j < 8; j++) store_be(dst + 4 * j, st[j]);
+  }
+}
+
+}  // extern "C"

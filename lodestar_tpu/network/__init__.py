@@ -1,0 +1,15 @@
+"""Network-side scheduling components: gossip queues + processor.
+
+Only the scheduling layer is reproduced here — the libp2p/gossipsub
+transport itself stays off the TPU path (SURVEY.md §2.4 P9).
+"""
+
+from .gossip_queues import (  # noqa: F401
+    GossipQueue,
+    GossipType,
+    create_gossip_queues,
+)
+from .processor import (  # noqa: F401
+    NetworkProcessor,
+    PendingGossipMessage,
+)

@@ -1,0 +1,174 @@
+"""Per-topic gossip queues with overload shedding.
+
+Mirrors the reference's queue discipline (reference:
+packages/beacon-node/src/network/processor/gossipQueues.ts):
+
+  - each topic gets FIFO or LIFO ordering and a max length,
+  - on overflow, drop either a fixed COUNT of items or an escalating
+    RATIO of the queue (attestations: start 1%, +1% per overflow, cap
+    95%, reset once the queue fully drains and stays drained for a full
+    queue-length of processed items),
+  - drops evict from the *stale* end (LIFO drops oldest, FIFO drops
+    newest) so the work kept is the work most likely to still matter.
+
+The queue is plain host code — it feeds fixed-shape device batches but
+never touches the device itself.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+MAX_DROP_RATIO = 0.95
+
+
+class QueueType(enum.Enum):
+    FIFO = "FIFO"
+    LIFO = "LIFO"
+
+
+@dataclass(frozen=True)
+class DropByCount:
+    count: int = 1
+
+
+@dataclass(frozen=True)
+class DropByRatio:
+    start: float = 0.01
+    step: float = 0.01
+
+
+@dataclass(frozen=True)
+class GossipQueueOpts:
+    type: QueueType
+    max_length: int
+    drop: object  # DropByCount | DropByRatio
+
+
+class GossipType(enum.Enum):
+    beacon_block = "beacon_block"
+    beacon_aggregate_and_proof = "beacon_aggregate_and_proof"
+    voluntary_exit = "voluntary_exit"
+    bls_to_execution_change = "bls_to_execution_change"
+    beacon_attestation = "beacon_attestation"
+    proposer_slashing = "proposer_slashing"
+    attester_slashing = "attester_slashing"
+    sync_committee_contribution_and_proof = "sync_committee_contribution_and_proof"
+    sync_committee = "sync_committee"
+    light_client_finality_update = "light_client_finality_update"
+    light_client_optimistic_update = "light_client_optimistic_update"
+
+
+# Queue shapes per topic (reference: gossipQueues.ts gossipQueueOpts; the
+# numbers trace to lighthouse's beacon_processor).
+GOSSIP_QUEUE_OPTS: Dict[GossipType, GossipQueueOpts] = {
+    GossipType.beacon_block: GossipQueueOpts(QueueType.FIFO, 1024, DropByCount(1)),
+    GossipType.beacon_aggregate_and_proof: GossipQueueOpts(
+        QueueType.LIFO, 5120, DropByCount(1)
+    ),
+    GossipType.beacon_attestation: GossipQueueOpts(
+        QueueType.LIFO, 24576, DropByRatio(0.01, 0.01)
+    ),
+    GossipType.voluntary_exit: GossipQueueOpts(QueueType.FIFO, 4096, DropByCount(1)),
+    GossipType.proposer_slashing: GossipQueueOpts(
+        QueueType.FIFO, 4096, DropByCount(1)
+    ),
+    GossipType.attester_slashing: GossipQueueOpts(
+        QueueType.FIFO, 4096, DropByCount(1)
+    ),
+    GossipType.sync_committee_contribution_and_proof: GossipQueueOpts(
+        QueueType.LIFO, 4096, DropByCount(1)
+    ),
+    GossipType.sync_committee: GossipQueueOpts(QueueType.LIFO, 4096, DropByCount(1)),
+    GossipType.light_client_finality_update: GossipQueueOpts(
+        QueueType.FIFO, 1024, DropByCount(1)
+    ),
+    GossipType.light_client_optimistic_update: GossipQueueOpts(
+        QueueType.FIFO, 1024, DropByCount(1)
+    ),
+    GossipType.bls_to_execution_change: GossipQueueOpts(
+        QueueType.FIFO, 16384, DropByCount(1)
+    ),
+}
+
+
+class GossipQueue(Generic[T]):
+    """One topic's queue.  `add` returns the number of items dropped."""
+
+    def __init__(self, opts: GossipQueueOpts):
+        self.opts = opts
+        self._q: Deque[T] = deque()
+        self._drop_ratio = 0.0
+        if isinstance(opts.drop, DropByRatio):
+            if not (0.0 < opts.drop.start <= 1.0):
+                raise ValueError(f"invalid drop ratio start {opts.drop.start}")
+            self._drop_ratio = opts.drop.start
+        # After a ratio-drop, the queue draining to empty is not by itself
+        # evidence of good health (we may have just shed 90% of it); only
+        # reset the ratio after a full max_length of items processed
+        # without another overflow.
+        self._recent_drop = False
+        self._processed_since_drop = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def drop_ratio(self) -> float:
+        return self._drop_ratio
+
+    def clear(self) -> None:
+        self._q.clear()
+
+    def add(self, item: T) -> int:
+        drop = self.opts.drop
+        if isinstance(drop, DropByRatio) and not self._recent_drop and not self._q:
+            self._drop_ratio = drop.start  # node looks healthy: retest start
+        self._q.append(item)
+        if len(self._q) <= self.opts.max_length:
+            return 0
+        if isinstance(drop, DropByCount):
+            return self._drop_by_count(drop.count)
+        self._recent_drop = True
+        dropped = self._drop_by_count(int(len(self._q) * self._drop_ratio))
+        self._drop_ratio = min(MAX_DROP_RATIO, self._drop_ratio + drop.step)
+        return dropped
+
+    def next(self) -> Optional[T]:
+        if not self._q:
+            return None
+        item = self._q.pop() if self.opts.type is QueueType.LIFO else self._q.popleft()
+        if isinstance(self.opts.drop, DropByRatio) and self._recent_drop:
+            self._processed_since_drop += 1
+            if self._processed_since_drop >= self.opts.max_length:
+                self._recent_drop = False
+                self._processed_since_drop = 0
+        return item
+
+    def get_all(self) -> List[T]:
+        return list(self._q)
+
+    def _drop_by_count(self, count: int) -> int:
+        if count <= 0:
+            return 0
+        if count >= len(self._q):
+            n = len(self._q)
+            self._q.clear()
+            return n
+        # LIFO keeps the newest (drop from the left/oldest); FIFO keeps
+        # the oldest (drop from the right/newest).
+        for _ in range(count):
+            if self.opts.type is QueueType.LIFO:
+                self._q.popleft()
+            else:
+                self._q.pop()
+        return count
+
+
+def create_gossip_queues() -> Dict[GossipType, GossipQueue]:
+    return {t: GossipQueue(o) for t, o in GOSSIP_QUEUE_OPTS.items()}

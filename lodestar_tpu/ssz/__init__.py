@@ -1,0 +1,38 @@
+"""SSZ — SimpleSerialize encoding + merkleization.
+
+The equivalent of the reference's `@chainsafe/ssz` + `as-sha256` +
+`persistent-merkle-tree` native/WASM stack (reference: SURVEY.md §2.3;
+packages/types/src/sszTypes.ts consumes it).  Python type objects with a
+numpy/C-batched merkleizer instead of a persistent tree: the framework's
+hot path never mutates states incrementally (the TPU build's state
+surface is the pubkey table + signing roots), so a fast batch
+hash-tree-root over contiguous chunks is the idiomatic shape here.
+
+Type objects expose:
+    serialize(value) -> bytes
+    deserialize(data) -> value
+    hash_tree_root(value) -> bytes32
+"""
+
+from .core import (  # noqa: F401
+    Bitlist,
+    Bitvector,
+    Boolean,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    Bytes4,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+    hash_tree_root,
+    merkleize_chunks,
+)

@@ -1,0 +1,63 @@
+"""Batched SHA-256 for merkleization.
+
+The reference merkleizes through `@chainsafe/as-sha256`, a WASM module
+whose core win is hashing many 64-byte sibling pairs per call
+(digest64 / batchHash4UintArray64s — reference: SURVEY.md §2.3).  The
+equivalent here is `hash_pairs`: one call hashes a whole tree level.
+
+Two backends:
+  - a C++ extension (`lodestar_tpu/native/sha256_batch.cpp`) doing the
+    whole level in native code, loaded via ctypes when built;
+  - a pure-hashlib fallback (OpenSSL C speed per hash, Python loop over
+    pairs) that is always available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+from typing import Optional
+
+_NATIVE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "libsha256_batch.so",
+)
+
+_native: Optional[ctypes.CDLL] = None
+if os.path.exists(_NATIVE_PATH):
+    try:
+        _native = ctypes.CDLL(_NATIVE_PATH)
+        _native.sha256_hash_pairs.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        _native.sha256_hash_pairs.restype = None
+    except OSError:  # pragma: no cover - load failure falls back to hashlib
+        _native = None
+
+
+def native_available() -> bool:
+    return _native is not None
+
+
+def hash_pairs(data: bytes) -> bytes:
+    """Hash consecutive 64-byte blocks: one tree level in one call.
+
+    data: concatenation of n sibling pairs (64 bytes each).
+    Returns the n concatenated 32-byte parent nodes.
+    """
+    n = len(data) // 64
+    assert len(data) == 64 * n
+    if _native is not None and n >= 4:
+        out = ctypes.create_string_buffer(32 * n)
+        _native.sha256_hash_pairs(data, out, n)
+        return out.raw
+    sha = hashlib.sha256
+    return b"".join(sha(data[i * 64 : i * 64 + 64]).digest() for i in range(n))
+
+
+def digest(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()

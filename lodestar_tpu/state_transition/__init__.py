@@ -1,0 +1,34 @@
+"""State-transition layer: epoch caches + signature-set extraction.
+
+The reference's `@lodestar/state-transition` is a 12.6k-LoC beacon state
+machine; the TPU build reproduces the parts on the signature path
+(SURVEY.md §7 scope guard):
+
+  - `util`: epoch/slot math, swap-or-not shuffling (vectorized numpy —
+    whole-registry batch shuffles instead of per-index loops),
+  - `EpochCache`: committee assignments + validator pubkey table (the
+    Index2PubkeyCache analog whose storage IS the device pubkey table),
+  - `signature_sets`: getBlockSignatureSets and the per-object
+    extractors feeding the TPU verifier
+    (reference: state-transition/src/signatureSets/index.ts:26-73).
+"""
+
+from .epoch_cache import EpochCache  # noqa: F401
+from .signature_sets import (  # noqa: F401
+    get_aggregate_and_proof_signature_set,
+    get_attestation_signature_sets,
+    get_attester_slashings_signature_sets,
+    get_block_signature_sets,
+    get_proposer_signature_set,
+    get_proposer_slashings_signature_sets,
+    get_randao_reveal_signature_set,
+    get_sync_committee_signature_set,
+    get_voluntary_exits_signature_sets,
+)
+from .util import (  # noqa: F401
+    compute_committee_count_per_slot,
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    shuffle_list,
+    unshuffle_list,
+)

@@ -1,0 +1,61 @@
+"""HTTP metrics server — Prometheus scrape endpoint.
+
+Mirror of the reference's HttpMetricsServer (reference:
+packages/beacon-node/src/metrics/server/http.ts): GET /metrics returns
+the registry's text exposition; scrape duration is itself observed.
+Stdlib http.server in a daemon thread — no external dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import Registry
+
+
+class HttpMetricsServer:
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self.scrape_time = registry.histogram(
+            "lodestar_metrics_scrape_seconds",
+            "Time to collect the metrics exposition",
+            [0.001, 0.01, 0.1, 1],
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                t0 = time.perf_counter()
+                body = outer.registry.expose().encode()
+                outer.scrape_time.observe(time.perf_counter() - t0)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request lines
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

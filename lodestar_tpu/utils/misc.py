@@ -1,0 +1,90 @@
+"""Small shared utilities: sleep/retry/MapDef/hex.
+
+Mirror of the reference's `@lodestar/utils` surface the framework uses
+(reference: packages/utils/src/{sleep,retry,map,bytes}.ts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+T = TypeVar("T")
+
+
+class ErrorAborted(Exception):
+    pass
+
+
+class AbortSignal:
+    """Cooperative cancellation token (the reference uses DOM
+    AbortSignals; a threading.Event is the host-side equivalent)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def abort(self) -> None:
+        self._event.set()
+
+    @property
+    def aborted(self) -> bool:
+        return self._event.is_set()
+
+    def sleep(self, seconds: float) -> None:
+        """Sleep unless aborted; raises ErrorAborted on abort."""
+        if self._event.wait(timeout=seconds):
+            raise ErrorAborted()
+
+
+def sleep(seconds: float, signal: Optional[AbortSignal] = None) -> None:
+    if signal is None:
+        time.sleep(seconds)
+    else:
+        signal.sleep(seconds)
+
+
+def retry(
+    fn: Callable[[], T],
+    retries: int = 3,
+    retry_delay: float = 0.0,
+    should_retry: Optional[Callable[[Exception], bool]] = None,
+    signal: Optional[AbortSignal] = None,
+) -> T:
+    """Call fn up to `retries` times (reference: utils/src/retry.ts)."""
+    last: Optional[Exception] = None
+    for attempt in range(max(retries, 1)):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - retry boundary
+            last = e
+            if should_retry is not None and not should_retry(e):
+                raise
+            if attempt + 1 < retries and retry_delay:
+                sleep(retry_delay, signal)
+    assert last is not None
+    raise last
+
+
+class MapDef(Dict[K, V]):
+    """dict with a default factory + getOrDefault (reference:
+    utils/src/map.ts MapDef)."""
+
+    def __init__(self, factory: Callable[[], V]):
+        super().__init__()
+        self._factory = factory
+
+    def get_or_default(self, key: K) -> V:
+        if key not in self:
+            self[key] = self._factory()
+        return self[key]
+
+
+def to_hex(data: bytes) -> str:
+    return "0x" + data.hex()
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)

@@ -1,0 +1,218 @@
+"""Gossip queues + NetworkProcessor scheduling semantics.
+
+Reference behaviors mirrored: packages/beacon-node/src/network/processor/
+gossipQueues.ts (drop discipline) and index.ts (priority order, per-tick
+job cap, backpressure gating, unknown-root parking).
+"""
+
+import pytest
+
+from lodestar_tpu.network.gossip_queues import (
+    DropByCount,
+    DropByRatio,
+    GossipQueue,
+    GossipQueueOpts,
+    GossipType,
+    QueueType,
+    create_gossip_queues,
+)
+from lodestar_tpu.network.processor import (
+    EXECUTE_GOSSIP_WORK_ORDER,
+    NetworkProcessor,
+    PendingGossipMessage,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+# ---------------------------------------------------------------------------
+# GossipQueue
+# ---------------------------------------------------------------------------
+
+
+def test_fifo_lifo_order():
+    fifo = GossipQueue(GossipQueueOpts(QueueType.FIFO, 10, DropByCount(1)))
+    lifo = GossipQueue(GossipQueueOpts(QueueType.LIFO, 10, DropByCount(1)))
+    for i in range(3):
+        fifo.add(i)
+        lifo.add(i)
+    assert [fifo.next() for _ in range(3)] == [0, 1, 2]
+    assert [lifo.next() for _ in range(3)] == [2, 1, 0]
+    assert fifo.next() is None and lifo.next() is None
+
+
+def test_drop_by_count_keeps_freshest_for_lifo():
+    q = GossipQueue(GossipQueueOpts(QueueType.LIFO, 3, DropByCount(1)))
+    for i in range(4):
+        dropped = q.add(i)
+    assert dropped == 1
+    assert len(q) == 3
+    # LIFO drops the OLDEST (left end): 0 gone, 3 served first
+    assert q.next() == 3
+    assert q.get_all() == [1, 2]
+
+
+def test_drop_by_count_keeps_oldest_for_fifo():
+    q = GossipQueue(GossipQueueOpts(QueueType.FIFO, 3, DropByCount(1)))
+    for i in range(4):
+        q.add(i)
+    # FIFO drops the NEWEST: 3 was evicted right after being added
+    assert q.get_all() == [0, 1, 2]
+
+
+def _fill_until_drop(q):
+    """Add items until the queue overflows; return the dropped count."""
+    while True:
+        d = q.add(0)
+        if d:
+            return d
+
+
+def test_ratio_drop_escalates_and_caps():
+    q = GossipQueue(GossipQueueOpts(QueueType.LIFO, 100, DropByRatio(0.10, 0.10)))
+    for i in range(101):
+        d1 = q.add(i)
+    assert d1 == 10  # 10% of 101
+    assert q.drop_ratio == pytest.approx(0.20)
+    # fill to overflow again: drop 20% (of the 101 items present at overflow)
+    assert _fill_until_drop(q) == 20
+    # escalation caps at 95%
+    for _ in range(20):
+        _fill_until_drop(q)
+    assert q.drop_ratio <= 0.95
+    assert _fill_until_drop(q) == 95
+
+
+def test_ratio_resets_only_after_sustained_drain():
+    q = GossipQueue(GossipQueueOpts(QueueType.LIFO, 8, DropByRatio(0.25, 0.25)))
+    for i in range(9):
+        q.add(i)  # overflow: drop 2 (25% of 9), escalate
+    assert q.drop_ratio == pytest.approx(0.50)
+    # drain to empty: only 7 items processed (< max_length) so the drop is
+    # still "recent" -> ratio NOT reset on next add
+    while q.next() is not None:
+        pass
+    q.add(0)
+    assert q.drop_ratio == pytest.approx(0.50)
+    # process a full max_length of items without overflow -> reset allowed
+    for _ in range(8):
+        q.add(1)
+        q.next()
+    while q.next() is not None:
+        pass
+    q.add(2)
+    assert q.drop_ratio == pytest.approx(0.25)
+
+
+def test_default_queue_shapes_match_reference():
+    qs = create_gossip_queues()
+    att = qs[GossipType.beacon_attestation]
+    assert att.opts.max_length == 24576 and att.opts.type is QueueType.LIFO
+    assert isinstance(att.opts.drop, DropByRatio)
+    agg = qs[GossipType.beacon_aggregate_and_proof]
+    assert agg.opts.max_length == 5120 and agg.opts.type is QueueType.LIFO
+    blk = qs[GossipType.beacon_block]
+    assert blk.opts.max_length == 1024 and blk.opts.type is QueueType.FIFO
+
+
+# ---------------------------------------------------------------------------
+# NetworkProcessor
+# ---------------------------------------------------------------------------
+
+
+def msg(topic, slot=None, root=None):
+    return PendingGossipMessage(topic, data=None, slot=slot, block_root=root)
+
+
+def test_priority_order_blocks_first():
+    done = []
+    proc = NetworkProcessor(lambda m: done.append(m.topic), [lambda: False])
+    # backpressure ON: only bypass topics (blocks) flow
+    proc.queues[GossipType.beacon_attestation].add(msg(GossipType.beacon_attestation))
+    proc.queues[GossipType.beacon_block].add(msg(GossipType.beacon_block))
+    proc.execute_work()
+    assert done == [GossipType.beacon_block]
+    assert proc.queue_lengths()["beacon_attestation"] == 1
+
+
+def test_aggregates_before_attestations():
+    done = []
+    proc = NetworkProcessor(lambda m: done.append(m.topic), [lambda: True])
+    proc.queues[GossipType.beacon_attestation].add(msg(GossipType.beacon_attestation))
+    proc.queues[GossipType.beacon_aggregate_and_proof].add(
+        msg(GossipType.beacon_aggregate_and_proof)
+    )
+    proc.execute_work()
+    assert done == [
+        GossipType.beacon_aggregate_and_proof,
+        GossipType.beacon_attestation,
+    ]
+
+
+def test_per_tick_job_cap():
+    done = []
+    proc = NetworkProcessor(
+        lambda m: done.append(1), [lambda: True], max_jobs_per_tick=5
+    )
+    for _ in range(20):
+        proc.queues[GossipType.beacon_attestation].add(
+            msg(GossipType.beacon_attestation)
+        )
+    assert proc.execute_work() == 5
+    assert len(done) == 5
+
+
+def test_backpressure_flips_mid_tick():
+    # accept work for the first 3 pulls, then downstream fills up
+    state = {"n": 0}
+
+    def can_accept():
+        return state["n"] < 3
+
+    def worker(m):
+        state["n"] += 1
+
+    proc = NetworkProcessor(worker, [can_accept])
+    for _ in range(10):
+        proc.queues[GossipType.beacon_attestation].add(
+            msg(GossipType.beacon_attestation)
+        )
+    n = proc.execute_work()
+    assert n == 3
+    assert proc.queue_lengths()["beacon_attestation"] == 7
+
+
+def test_unknown_root_parked_and_reprocessed():
+    done = []
+    proc = NetworkProcessor(
+        lambda m: done.append(m),
+        [lambda: True],
+        has_block_root=lambda r: r == "known",
+    )
+    proc.current_slot = 10
+    proc.on_gossip_message(msg(GossipType.beacon_attestation, slot=10, root="abc"))
+    assert done == [] and proc.stats.reprocess_parked == 1
+    proc.on_block_processed(10, "abc")
+    assert len(done) == 1
+
+
+def test_unknown_root_expires_on_slot():
+    proc = NetworkProcessor(
+        lambda m: None, [lambda: True], has_block_root=lambda r: False
+    )
+    proc.current_slot = 10
+    proc.on_gossip_message(msg(GossipType.beacon_attestation, slot=10, root="abc"))
+    proc.on_clock_slot(11)
+    assert proc.stats.reprocess_expired == 1
+
+
+def test_past_slot_dropped():
+    proc = NetworkProcessor(lambda m: None, [lambda: True])
+    proc.current_slot = 100
+    proc.on_gossip_message(msg(GossipType.beacon_attestation, slot=10))
+    assert proc.stats.past_slot == 1
+
+
+def test_work_order_covers_all_queue_topics():
+    topics = {t for t, _ in EXECUTE_GOSSIP_WORK_ORDER}
+    assert topics == set(create_gossip_queues().keys())

@@ -1,0 +1,171 @@
+"""utils layer: JobItemQueue, retry/sleep/MapDef, logger, metrics server.
+
+Reference: packages/beacon-node/src/util/queue/itemQueue.ts,
+packages/utils/src/{retry,map}.ts, packages/logger,
+packages/beacon-node/src/metrics/server/http.ts.
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.utils.logger import Logger
+from lodestar_tpu.utils.metrics import Registry
+from lodestar_tpu.utils.metrics_server import HttpMetricsServer
+from lodestar_tpu.utils.misc import AbortSignal, ErrorAborted, MapDef, retry
+from lodestar_tpu.utils.queue import JobItemQueue, QueueError, QueueType
+
+pytestmark = pytest.mark.smoke
+
+
+# -- JobItemQueue -----------------------------------------------------------
+
+
+def test_queue_processes_in_order():
+    done = []
+    q = JobItemQueue(lambda x: done.append(x) or x * 2)
+    futs = [q.push(i) for i in range(5)]
+    assert [f.result(timeout=5) for f in futs] == [0, 2, 4, 6, 8]
+    assert done == list(range(5))
+    q.stop()
+
+
+def test_fifo_overflow_rejects_newest():
+    gate = threading.Event()
+    q = JobItemQueue(lambda x: gate.wait(5) and x, max_length=2)
+    f0 = q.push(0)  # starts processing (blocked on gate)
+    time.sleep(0.05)
+    q.push(1)
+    q.push(2)
+    f3 = q.push(3)  # over max_length -> rejected
+    with pytest.raises(QueueError) as err:
+        f3.result(timeout=1)
+    assert err.value.reason == "QUEUE_MAX_LENGTH"
+    gate.set()
+    assert f0.result(timeout=5) == 0
+    q.stop()
+
+
+def test_lifo_overflow_evicts_oldest():
+    gate = threading.Event()
+    q = JobItemQueue(
+        lambda x: gate.wait(5) and x, max_length=2, queue_type=QueueType.LIFO
+    )
+    q.push("busy")
+    time.sleep(0.05)
+    f1 = q.push(1)
+    q.push(2)
+    f3 = q.push(3)  # evicts job 1, keeps 2 and 3
+    with pytest.raises(QueueError):
+        f1.result(timeout=1)
+    gate.set()
+    assert f3.result(timeout=5) == 3
+    q.stop()
+
+
+def test_stop_rejects_pending():
+    gate = threading.Event()
+    q = JobItemQueue(lambda x: gate.wait(5) and x, max_length=10)
+    q.push(0)
+    time.sleep(0.05)
+    f1 = q.push(1)
+    gate.set()
+    q.stop()
+    # f1 either completed before stop drained it or was aborted
+    try:
+        f1.result(timeout=1)
+    except QueueError as e:
+        assert e.reason == "QUEUE_ABORTED"
+    assert q.push(9).exception(timeout=1) is not None
+
+
+def test_can_accept_work_threshold():
+    gate = threading.Event()
+    q = JobItemQueue(lambda x: gate.wait(5), max_length=64)
+    assert q.can_accept_work(threshold=2)
+    q.push(0)
+    time.sleep(0.05)
+    q.push(1)
+    q.push(2)
+    assert not q.can_accept_work(threshold=2)
+    gate.set()
+    q.stop()
+
+
+# -- misc -------------------------------------------------------------------
+
+
+def test_retry_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("flaky")
+        return "ok"
+
+    assert retry(flaky, retries=5) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_exhausts():
+    with pytest.raises(ValueError):
+        retry(lambda: (_ for _ in ()).throw(ValueError("always")), retries=2)
+
+
+def test_retry_should_retry_predicate():
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        retry(fail, retries=5, should_retry=lambda e: not isinstance(e, KeyError))
+    assert calls["n"] == 1
+
+
+def test_abort_signal_sleep():
+    sig = AbortSignal()
+    threading.Timer(0.05, sig.abort).start()
+    with pytest.raises(ErrorAborted):
+        sig.sleep(5)
+
+
+def test_mapdef():
+    m = MapDef(list)
+    m.get_or_default("a").append(1)
+    m.get_or_default("a").append(2)
+    assert m["a"] == [1, 2]
+
+
+# -- logger -----------------------------------------------------------------
+
+
+def test_logger_children_and_format(capsys=None):
+    log = Logger(level="debug")
+    child = log.child("chain").child("bls")
+    assert child.module == "chain/bls"
+    line = child._fmt(" info", "verified", {"sets": 128})
+    assert "[chain/bls]" in line and "sets=128" in line
+
+
+# -- metrics server ---------------------------------------------------------
+
+
+def test_metrics_http_server_scrapes():
+    reg = Registry()
+    c = reg.counter("lodestar_test_total", "test counter")
+    c.inc(3)
+    srv = HttpMetricsServer(reg, port=0)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert "lodestar_test_total 3.0" in body
+        assert "# TYPE lodestar_test_total counter" in body
+    finally:
+        srv.close()
