@@ -83,14 +83,14 @@ def main():
     fn = KV.verify_batch_device
 
     # Warm-up / compile.
-    rand = jnp.asarray(BK.make_rand_bits(BATCH).astype(np.int32))
+    rand = jnp.asarray(BK.make_rand_words(BATCH))
     ok, _ = fn(*args, rand, valid)
     assert bool(ok), "bench inputs failed verification"
 
     t0 = time.perf_counter()
     ok_list = []
     for _ in range(REPEATS):
-        rand = jnp.asarray(BK.make_rand_bits(BATCH).astype(np.int32))
+        rand = jnp.asarray(BK.make_rand_words(BATCH))
         ok, _sub = fn(*args, rand, valid)
         ok_list.append(ok)
     for ok in ok_list:

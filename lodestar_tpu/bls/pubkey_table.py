@@ -67,6 +67,42 @@ class PubkeyTable:
         self._device = None  # invalidate mirror
         return idxs
 
+    def register_points_unchecked(
+        self, pubkeys: Sequence, tile_to: Optional[int] = None
+    ) -> List[int]:
+        """Bulk-append affine points KNOWN to satisfy KeyValidate.
+
+        For harnesses and states whose keys were validated elsewhere
+        (e.g. replay synthesis from known secret keys, or a batch device
+        KeyValidate).  With `tile_to`, the given keys are tiled cyclically
+        up to that many rows — the replay trick that makes a full-size
+        1M-row device table from a few distinct keypairs.
+        """
+        n_in = len(pubkeys)
+        if n_in == 0:
+            raise ValueError("register_points_unchecked needs >= 1 pubkey")
+        total = tile_to if tile_to is not None else n_in
+        if total < n_in:
+            raise ValueError(f"tile_to {total} < {n_in} input keys")
+        if self._n != 0:
+            raise ValueError("bulk load only into an empty table")
+        if self._cap < total:
+            self._cap = total
+            self._host_x = np.zeros((LY.NL, self._cap), np.int32)
+            self._host_y = np.zeros((LY.NL, self._cap), np.int32)
+        base_x = np.stack(
+            [LY.to_limbs(pk[0] * LY.R_MOD_P % LY.P) for pk in pubkeys], axis=-1
+        )
+        base_y = np.stack(
+            [LY.to_limbs(pk[1] * LY.R_MOD_P % LY.P) for pk in pubkeys], axis=-1
+        )
+        reps = (total + n_in - 1) // n_in
+        self._host_x[:, :total] = np.tile(base_x, (1, reps))[:, :total]
+        self._host_y[:, :total] = np.tile(base_y, (1, reps))[:, :total]
+        self._n = total
+        self._device = None
+        return list(range(total))
+
     def _grow(self) -> None:
         self._cap *= 2
         for name in ("_host_x", "_host_y"):

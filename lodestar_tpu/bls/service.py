@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from queue import SimpleQueue
 from typing import List, Optional, Sequence
 
 from .signature_set import SignatureSet
@@ -34,6 +35,11 @@ from .verifier import MAX_PENDING_JOBS, TpuBlsVerifier, VerifyOptions
 
 MAX_BUFFERED_SIGS = 32      # reference: multithread/index.ts:49
 MAX_BUFFER_WAIT_MS = 100    # reference: multithread/index.ts:57
+# Device jobs dispatched but not yet resolved.  JAX dispatch is async, so
+# in-flight jobs overlap the ~65 ms host<->device tunnel latency
+# (dev/NOTES.md); the bound keeps retry latency and memory in check and
+# is the backpressure coupling between the resolver and the dispatcher.
+MAX_INFLIGHT_JOBS = 4
 
 
 class _Job:
@@ -53,6 +59,7 @@ class BlsVerifierService:
         max_pending_jobs: int = MAX_PENDING_JOBS,
         max_buffered_sigs: int = MAX_BUFFERED_SIGS,
         buffer_wait_ms: float = MAX_BUFFER_WAIT_MS,
+        max_inflight_jobs: int = MAX_INFLIGHT_JOBS,
     ):
         self.verifier = verifier
         self.metrics = verifier.metrics
@@ -65,10 +72,18 @@ class BlsVerifierService:
         self._buffer_deadline: Optional[float] = None
         self._pending = 0  # queued + buffered + in-flight jobs
         self._closed = False
+        # dispatcher begins device jobs; resolver syncs them in order.
+        # The bounded in-flight queue pipelines dispatch latency.
+        self._inflight: "SimpleQueue" = SimpleQueue()
+        self._inflight_slots = threading.Semaphore(max_inflight_jobs)
         self._thread = threading.Thread(
-            target=self._run, name="bls-verifier-service", daemon=True
+            target=self._run, name="bls-verifier-dispatch", daemon=True
+        )
+        self._resolver = threading.Thread(
+            target=self._resolve_loop, name="bls-verifier-resolve", daemon=True
         )
         self._thread.start()
+        self._resolver.start()
 
     # -- submission -------------------------------------------------------
 
@@ -122,10 +137,12 @@ class BlsVerifierService:
     # -- dispatcher -------------------------------------------------------
 
     def _run(self) -> None:
+        """Dispatcher: pull groups, begin device jobs, hand to resolver."""
         while True:
             with self._lock:
                 while True:
                     if self._closed:
+                        self._inflight.put(None)  # wake + stop resolver
                         return
                     now = time.perf_counter()
                     if self._buffer and (
@@ -141,44 +158,95 @@ class BlsVerifierService:
                         timeout = max(self._buffer_deadline - now, 0.0)
                     self._lock.wait(timeout=timeout)
                 self.metrics.queue_length.set(self._pending)
-            self._process(group)
+            self._dispatch(group)
 
-    def _process(self, group: List[_Job]) -> None:
+    def _dispatch(self, group: List[_Job]) -> None:
         t0 = time.perf_counter()
         for j in group:
             self.metrics.job_wait_time.observe(t0 - j.t_submit)
-        self.metrics.workers_busy.set(1)
         try:
-            if len(group) == 1:
-                job = group[0]
-                res = self.verifier.verify_signature_sets(job.sets, job.opts)
-                job.future.set_result(res)
+            if len(group) == 1 and not group[0].opts.batchable:
+                batchable = False
             else:
-                # merged buffered jobs: one device batch; on failure fall
-                # back to per-job verdicts (reference: worker.ts:74-96)
-                merged = [s for j in group for s in j.sets]
-                ok = self.verifier.verify_signature_sets(
-                    merged, VerifyOptions(batchable=True)
-                )
-                if ok:
-                    for j in group:
-                        j.future.set_result(True)
-                else:
-                    for j in group:
-                        j.future.set_result(
-                            self.verifier.verify_signature_sets(j.sets, j.opts)
-                        )
+                batchable = True
+            merged = [s for j in group for s in j.sets]
+            begin = getattr(self.verifier, "begin_job", None)
+            if begin is None:
+                # verifier without async dispatch (CPU fallback/stubs):
+                # the whole job runs at resolve time
+                handles = (merged, batchable)
+            else:
+                cap = self.verifier.max_job_sets
+                handles = [
+                    begin(merged[i : i + cap], batchable)
+                    for i in range(0, len(merged), cap)
+                ]
         except Exception as e:
             for j in group:
                 if not j.future.done():
                     j.future.set_exception(e)
             self.metrics.error_jobs.inc(len(group))
-        finally:
-            self.metrics.workers_busy.set(0)
             with self._lock:
                 self._pending -= len(group)
                 self.metrics.queue_length.set(self._pending)
                 self._lock.notify_all()
+            return
+        self._inflight_slots.acquire()  # backpressure: bounded in-flight
+        self._inflight.put((group, handles, t0))
+
+    def _resolve_loop(self) -> None:
+        """Resolver: sync begun jobs in dispatch order, settle futures."""
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            group, handles, t0 = item
+            self._inflight_slots.release()
+            self.metrics.workers_busy.set(1)
+            try:
+                if isinstance(handles, tuple):
+                    merged, batchable = handles
+                    ok = self.verifier.verify_signature_sets(
+                        merged, VerifyOptions(batchable=batchable)
+                    )
+                else:
+                    ok = True
+                    for h in handles:
+                        ok &= self.verifier.finish_job(h)
+                if ok:
+                    for j in group:
+                        j.future.set_result(True)
+                elif len(group) == 1:
+                    group[0].future.set_result(False)
+                else:
+                    # a failed merged batch re-verifies per job so one bad
+                    # signature cannot poison other jobs' verdicts
+                    # (reference: worker.ts:74-96); those calls observe
+                    # job_time themselves, so skip the group-level observe
+                    handles = (None, None)
+                    for j in group:
+                        j.future.set_result(
+                            self.verifier.verify_signature_sets(j.sets, j.opts)
+                        )
+            except Exception as e:
+                for j in group:
+                    if not j.future.done():
+                        j.future.set_exception(e)
+                self.metrics.error_jobs.inc(len(group))
+            finally:
+                self.metrics.workers_busy.set(0)
+                # verify_signature_sets observes job_time itself; only the
+                # begin/finish handle path accounts here (no double count)
+                if not isinstance(handles, tuple):
+                    dt = time.perf_counter() - t0
+                    nsets = sum(len(j.sets) for j in group)
+                    self.metrics.job_time.observe(dt)
+                    if nsets:
+                        self.metrics.time_per_sig_set.observe(dt / nsets)
+                with self._lock:
+                    self._pending -= len(group)
+                    self.metrics.queue_length.set(self._pending)
+                    self._lock.notify_all()
 
     # -- shutdown (reference: multithread/index.ts:193-214) ---------------
 
@@ -195,4 +263,5 @@ class BlsVerifierService:
         for j in rejected:
             j.future.set_exception(RuntimeError("verifier closed"))
         self._thread.join(timeout=5)
+        self._resolver.join(timeout=30)  # drains in-flight device jobs
         self.verifier.close()

@@ -38,12 +38,17 @@ class SignatureSet:
     hashed-to-curve affine G2 point of the signing root.  Decompression
     and hashing happen at ingest (see verifier.prepare_sets) so the hot
     loop works on fixed-shape arrays only.
+
+    `external_pubkeys` carries decompressed affine G1 points for signers
+    OUTSIDE the validator registry (BLSToExecutionChange withdrawal
+    keys); such sets verify on the CPU path, which KeyValidates them.
     """
 
     type: SignatureSetType
     indices: Tuple[int, ...]
     message: Tuple  # affine G2 (ground-truth ints) — hash_to_g2(signing_root)
     signature: Affine  # affine G2 or None (invalid/infinity -> always False)
+    external_pubkeys: Optional[Tuple] = None  # affine G1 points
 
     @staticmethod
     def single(index: int, message, signature) -> "SignatureSet":
@@ -53,4 +58,77 @@ class SignatureSet:
     def aggregate(indices: Sequence[int], message, signature) -> "SignatureSet":
         return SignatureSet(
             SignatureSetType.aggregate, tuple(indices), message, signature
+        )
+
+
+@dataclass(frozen=True)
+class WireSignatureSet:
+    """A signature set at the wire level — what actually crosses the
+    host boundary: {validator indices | raw pubkeys, 32B signing root,
+    96B compressed signature} (reference: the serialized job layout in
+    packages/beacon-node/src/chain/bls/multithread/index.ts:177 and
+    types.ts:14-38).
+
+    Hashing the root to G2 and decompressing the signature happen at
+    ingest — batched on device in the production path, or on the host
+    via `decode()` (the CPU-oracle/fallback path).
+
+    `pubkeys` (48B compressed each) is only set for signers outside the
+    validator registry (e.g. BLSToExecutionChange withdrawal keys); such
+    sets verify on the CPU path.
+    """
+
+    type: SignatureSetType
+    indices: Tuple[int, ...]
+    signing_root: bytes  # 32 bytes
+    signature: bytes  # 96 bytes, compressed G2
+    pubkeys: Optional[Tuple[bytes, ...]] = None
+
+    @staticmethod
+    def single(index: int, signing_root: bytes, signature: bytes):
+        return WireSignatureSet(
+            SignatureSetType.single, (index,), bytes(signing_root), bytes(signature)
+        )
+
+    @staticmethod
+    def aggregate(indices: Sequence[int], signing_root: bytes, signature: bytes):
+        return WireSignatureSet(
+            SignatureSetType.aggregate,
+            tuple(indices),
+            bytes(signing_root),
+            bytes(signature),
+        )
+
+    @staticmethod
+    def external(pubkeys: Sequence[bytes], signing_root: bytes, signature: bytes):
+        """A set whose keys are not validator-registry members."""
+        return WireSignatureSet(
+            SignatureSetType.aggregate,
+            (),
+            bytes(signing_root),
+            bytes(signature),
+            tuple(bytes(p) for p in pubkeys),
+        )
+
+    def decode(self) -> SignatureSet:
+        """Host-side ingest: hash-to-curve + signature (and, for external
+        sets, pubkey) decompression.  Undecodable bytes decode to a set
+        that always verifies False (signature=None)."""
+        from ..crypto.curves import g1_decompress, g2_decompress
+        from ..crypto.hash_to_curve import hash_to_g2
+
+        try:
+            sig = g2_decompress(self.signature)
+        except ValueError:
+            sig = None
+        ext = None
+        if self.pubkeys is not None:
+            try:
+                ext = tuple(g1_decompress(p) for p in self.pubkeys)
+                if any(p is None for p in ext):  # infinity pubkey
+                    ext, sig = None, None
+            except ValueError:
+                ext, sig = None, None
+        return SignatureSet(
+            self.type, self.indices, hash_to_g2(self.signing_root), sig, ext
         )

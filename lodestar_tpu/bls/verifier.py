@@ -46,7 +46,7 @@ MAX_PENDING_JOBS = 512      # reference: chain/bls/multithread/index.ts:64
 # N buckets are multiples of the kernel lane tile (kernels/verify.py BT):
 # a smaller job pads to one 128-lane tile, which costs the same wall time
 # as a full tile (vector lanes are parallel hardware).
-N_BUCKETS = (128, 256, 512)
+N_BUCKETS = (128, 256, 512, 1024, 2048)
 K_BUCKETS = (1, 4, 16, 64, 512, 2048)
 # Largest aggregate the device path handles (a full 2048-validator mainnet
 # committee); beyond it the set is verified on the CPU ground-truth path.
@@ -57,6 +57,23 @@ class VerifyOptions:
     def __init__(self, batchable: bool = False, verify_on_main_thread: bool = False):
         self.batchable = batchable
         self.verify_on_main_thread = verify_on_main_thread
+
+
+class _DeviceJob:
+    """An in-flight device job: lazy result handles + host-side context."""
+
+    __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
+                 "batch_ok", "per_set")
+
+    def __init__(self, sets, batchable, ok_big):
+        self.sets = sets
+        self.batchable = batchable
+        self.ok_big = ok_big
+        self.args = None
+        self.valid = None
+        self.decodable = None
+        self.batch_ok = None  # lazy device scalar (RLC batch verdict)
+        self.per_set = None  # lazy device vector (per-set verdicts)
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -85,11 +102,16 @@ class TpuBlsVerifier:
         table: PubkeyTable,
         metrics: Optional[BlsPoolMetrics] = None,
         rng: Optional[np.random.Generator] = None,
+        max_job_sets: int = MAX_JOB_SETS,
     ):
         self.table = table
         self.metrics = metrics or BlsPoolMetrics()
         # None => OS CSPRNG randomizers (production); seeded rng for tests.
         self.rng = rng
+        # Device job size: 128 mirrors the reference's per-worker cap; the
+        # service raises it (512-2048) so each ~65 ms tunnel dispatch
+        # carries more sets (dev/NOTES.md dispatch floor).
+        self.max_job_sets = max_job_sets
         self._pending_jobs = 0
 
     # -- backpressure (reference: multithread/index.ts:143-149) -----------
@@ -114,10 +136,18 @@ class TpuBlsVerifier:
                 self.metrics.success_jobs.inc(good)
                 self.metrics.invalid_sets.inc(len(sets) - good)
                 return all(verdicts)
+            # Dispatch every chunk before syncing any: chunks pipeline on
+            # the device stream instead of paying the tunnel round-trip
+            # serially per chunk.
+            jobs = [
+                self.begin_job(
+                    list(sets[i : i + self.max_job_sets]), opts.batchable
+                )
+                for i in range(0, len(sets), self.max_job_sets)
+            ]
             ok = True
-            for chunk_start in range(0, len(sets), MAX_JOB_SETS):
-                chunk = sets[chunk_start : chunk_start + MAX_JOB_SETS]
-                ok &= self._verify_job(list(chunk), opts.batchable)
+            for job in jobs:
+                ok &= self.finish_job(job)
             return ok
         finally:
             self._pending_jobs -= 1
@@ -179,56 +209,91 @@ class TpuBlsVerifier:
             return False
         if not C.g2_subgroup_check(s.signature):
             return False
-        agg = C.multi_add(C.FP_OPS, [self.table.host_affine(i) for i in s.indices])
+        if s.external_pubkeys is not None:
+            # keys outside the registry were never KeyValidated — do it here
+            for pk in s.external_pubkeys:
+                if (
+                    pk is None
+                    or not C.is_on_curve(C.FP_OPS, pk)
+                    or not C.g1_subgroup_check(pk)
+                ):
+                    return False
+            keys = list(s.external_pubkeys)
+        else:
+            keys = [self.table.host_affine(i) for i in s.indices]
+        agg = C.multi_add(C.FP_OPS, keys)
         if agg is None:  # aggregate pubkey at infinity never verifies
             return False
         return CP.multi_pairing_is_one(
             [(agg, s.message), (CB.NEG_G1_GEN, s.signature)]
         )
 
-    def _verify_job(self, sets: List[SignatureSet], batchable: bool) -> bool:
-        # Aggregates beyond the largest device bucket (> MAX_AGG_INDICES
-        # participants) take the CPU ground-truth path so an oversized —
-        # but legitimate — aggregate still gets a verdict.
-        big = [s for s in sets if len(s.indices) > MAX_AGG_INDICES]
+    def begin_job(self, sets: List[SignatureSet], batchable: bool) -> "_DeviceJob":
+        """Dispatch one job (<= max_job_sets sets) WITHOUT blocking.
+
+        JAX dispatch is asynchronous: several begun jobs queue on the
+        device stream and overlap the ~65 ms host<->device tunnel latency
+        (dev/NOTES.md); `finish_job` syncs verdicts in order.
+        """
+        assert len(sets) <= self.max_job_sets
+        # CPU-path sets: aggregates beyond the largest device bucket
+        # (> MAX_AGG_INDICES participants — an oversized but legitimate
+        # aggregate still gets a verdict) and sets signed by keys outside
+        # the validator registry (external_pubkeys).
+        big = [
+            s
+            for s in sets
+            if len(s.indices) > MAX_AGG_INDICES or s.external_pubkeys is not None
+        ]
         if big:
-            sets = [s for s in sets if len(s.indices) <= MAX_AGG_INDICES]
+            sets = [s for s in sets if s not in big]
             verdicts = [self._verify_set_cpu(s) for s in big]
             good = sum(verdicts)
             self.metrics.success_jobs.inc(good)
             self.metrics.invalid_sets.inc(len(big) - good)
             ok_big = all(verdicts)
-            if not sets:
-                return ok_big
         else:
             ok_big = True
+        job = _DeviceJob(sets, batchable, ok_big)
+        if not sets:
+            return job
 
-        args, valid, n = self._prepare(sets)
-        decodable = np.array([s.signature is not None for s in sets])
-        always_false = not decodable.all()
-        if batchable and len(sets) >= 2:  # reference: maybeBatch.ts:16
+        job.args, job.valid, n = self._prepare(sets)
+        job.decodable = np.array([s.signature is not None for s in sets])
+        if batchable and len(sets) >= 2 and job.decodable.all():
+            # reference: maybeBatch.ts:16 (batch iff >= 2 sets)
             self.metrics.batchable_sigs.inc(len(sets))
-            if not always_false:
-                rand = jnp.asarray(
-                    BK.make_rand_bits(n, self.rng).astype(np.int32)
-                )
-                ok, _sub = KV.verify_batch_device(*args, rand, valid)
-                if bool(ok):
-                    self.metrics.batch_sigs_success.inc(len(sets))
-                    self.metrics.success_jobs.inc(len(sets))
-                    return ok_big
+            rand = jnp.asarray(BK.make_rand_words(n, self.rng))
+            job.batch_ok, _sub = KV.verify_batch_device(*job.args, rand, job.valid)
+        else:
+            if batchable and len(sets) >= 2:
+                # an undecodable signature voids the merged batch: count it
+                # as a batch retry and go straight to per-set verdicts
+                self.metrics.batchable_sigs.inc(len(sets))
+                self.metrics.batch_retries.inc()
+            job.per_set = KV.verify_each_device(*job.args, job.valid)
+        return job
+
+    def finish_job(self, job: "_DeviceJob") -> bool:
+        """Sync a begun job's device results and produce the verdict."""
+        sets = job.sets
+        if not sets:
+            return job.ok_big
+        if job.batch_ok is not None:
+            if bool(job.batch_ok):  # device sync point
+                self.metrics.batch_sigs_success.inc(len(sets))
+                self.metrics.success_jobs.inc(len(sets))
+                return job.ok_big
             # batch failed (or contained an undecodable signature): retry
             # each set individually so one bad signature cannot poison the
             # verdict of honest sets (reference: multithread/worker.ts:74-96)
             self.metrics.batch_retries.inc()
-        per_set = (
-            np.asarray(KV.verify_each_device(*args, valid))[: len(sets)]
-            & decodable
-        )
+            job.per_set = KV.verify_each_device(*job.args, job.valid)
+        per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
         good = int(per_set.sum())
         self.metrics.success_jobs.inc(good)
         self.metrics.invalid_sets.inc(len(sets) - good)
-        return ok_big and bool(per_set.all())
+        return job.ok_big and bool(per_set.all())
 
     def verify_signature_sets_individually(
         self, sets: Sequence[SignatureSet]
@@ -238,7 +303,7 @@ class TpuBlsVerifier:
         verdicts: dict = {}
         device_sets: List[Tuple[int, SignatureSet]] = []
         for pos, s in enumerate(sets):
-            if len(s.indices) > MAX_AGG_INDICES:
+            if len(s.indices) > MAX_AGG_INDICES or s.external_pubkeys is not None:
                 verdicts[pos] = self._verify_set_cpu(s)
             else:
                 device_sets.append((pos, s))

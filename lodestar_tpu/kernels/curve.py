@@ -190,21 +190,25 @@ def scalar_mul_bits_jac(fo: FieldOps, q, q_inf, get_bit, nbits: int):
     assigns Q directly).
     """
 
+    # The accumulator-infinity mask is carried as int32, not bool: an i1
+    # vector as an scf.for loop carry fails Mosaic legalization on real
+    # TPUs ("failed to legalize operation 'scf.for'", layout-inconsistent
+    # vector<8x128xi1> block argument).
     def body(i, st):
         (T, t_inf) = st
         T = jac_dbl(fo, T)
         bit = get_bit(i) != 0
         cand = jac_add_mixed_or_full(fo, T, q)
-        cand = select_pt(fo, t_inf, q, cand)
+        cand = select_pt(fo, t_inf != 0, q, cand)
         T = select_pt(fo, bit, cand, T)
-        t_inf = t_inf & ~bit
+        t_inf = t_inf & (~bit).astype(jnp.int32)
         return (T, t_inf)
 
     t0 = q  # placeholder value; masked by t_inf
-    inf0 = jnp.ones(q_inf.shape, bool)
+    inf0 = jnp.ones(q_inf.shape, jnp.int32)
     T, t_inf = lax.fori_loop(0, nbits, body, (t0, inf0))
     # k*O = O for infinity bases; k = 0 (all-zero bits) stays infinity.
-    return T, t_inf | q_inf
+    return T, (t_inf != 0) | q_inf
 
 
 def jac_add_mixed_or_full(fo: FieldOps, p, q):
@@ -311,28 +315,34 @@ def sum_points_axis0(fo: FieldOps, pts, inf):
     )
 
 
-def sum_points_lanes(fo: FieldOps, pts, inf):
-    """Tree-sum over the LANE (batch, last) axis: [..., B] -> [..., 1]."""
+def sum_points_lanes(fo: FieldOps, pts, inf, roll_fn=jnp.roll):
+    """Butterfly-sum over the LANE (batch, last) axis -> FULL width.
+
+    Uses full-width lane rolls instead of halving lane slices: narrow or
+    offset lane slices produce Mosaic layouts that later sublane pads
+    reject ("result/input offset mismatch on non-concat dimension"), and
+    on a 128-lane VPU a half-width op costs the same as a full-width one
+    anyway.  log2(B) rounds of jac_add_full; EVERY lane ends up holding
+    the total (read any one).  B must be a power of two (the pipeline's
+    lane tile BT = 128 is).  Inside pallas kernels pass
+    roll_fn=pltpu.roll (the supported lane-rotate primitive there).
+    """
     b = inf.shape[-1]
-    while b > 1:
-        half = (b + 1) // 2
-        n = b - half
-        lo_pts = jax.tree_util.tree_map(lambda a: a[..., :n], pts)
-        hi_pts = jax.tree_util.tree_map(lambda a: a[..., half:b], pts)
-        s, s_inf = jac_add_full(
-            fo, lo_pts, inf[..., :n], hi_pts, inf[..., half:b]
+    assert b & (b - 1) == 0, f"lane width {b} must be a power of two"
+    inf_i = inf.astype(jnp.int32)
+    shift = b // 2
+    while shift >= 1:
+        other = jax.tree_util.tree_map(
+            lambda a: roll_fn(a, shift, axis=-1), pts
         )
-        if n == half:  # even width: no unpaired middle element
-            pts, inf = s, s_inf
-        else:
-            pts = jax.tree_util.tree_map(
-                lambda a, b_: jnp.concatenate([a, b_[..., n:half]], axis=-1),
-                s,
-                pts,
-            )
-            inf = jnp.concatenate([s_inf, inf[..., n:half]], axis=-1)
-        b = half
-    return pts, inf
+        # lift 1-D lane masks to 2-D for the rotate (TPU prefers >= 2-D)
+        other_inf = roll_fn(inf_i[None, :], shift, axis=-1)[0]
+        pts, s_inf = jac_add_full(
+            fo, pts, inf_i != 0, other, other_inf != 0
+        )
+        inf_i = s_inf.astype(jnp.int32)
+        shift //= 2
+    return pts, inf_i != 0
 
 
 # ---------------------------------------------------------------------------

@@ -185,26 +185,27 @@ def miller_loop(p_jac, q_aff):
     return TW.conj12(f)
 
 
-def product12_lanes(f, valid):
-    """Product of f's lanes over the batch axis, padding lanes -> one."""
+def product12_lanes(f, valid, roll_fn=jnp.roll):
+    """Product of f's lanes over the batch axis -> FULL width.
+
+    Butterfly over full-width lane rolls (log2(B) mul12 rounds) rather
+    than halving lane slices: narrow/offset lane slices produce Mosaic
+    layouts later sublane pads reject, and half-width ops are not
+    cheaper on the 128-lane VPU.  EVERY lane of the result holds the
+    product; B must be a power of two (the lane tile BT = 128 is).
+    Inside pallas kernels pass roll_fn=pltpu.roll.
+    """
     one = TW.one12(f[0][0][0])
     f = TW.select12(valid, f, one)
     b = valid.shape[-1]
-    while b > 1:
-        half = (b + 1) // 2
-        n = b - half
-        lo = jax.tree_util.tree_map(lambda a: a[..., :n], f)
-        hi = jax.tree_util.tree_map(lambda a: a[..., half:b], f)
-        m = TW.mul12(lo, hi)
-        if n == half:  # even width: no unpaired middle element
-            f = m
-        else:
-            f = jax.tree_util.tree_map(
-                lambda a, b_: jnp.concatenate([a, b_[..., n:half]], axis=-1),
-                m,
-                f,
-            )
-        b = half
+    assert b & (b - 1) == 0, f"lane width {b} must be a power of two"
+    shift = b // 2
+    while shift >= 1:
+        other = jax.tree_util.tree_map(
+            lambda a: roll_fn(a, shift, axis=-1), f
+        )
+        f = TW.mul12(f, other)
+        shift //= 2
     return f
 
 

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..crypto import curves as GC
 from ..crypto import fields as GT
@@ -105,12 +106,27 @@ def _to_mont8(planes, n):
     return _tiled(_k_mont8, planes, [NL] * 8, [NL] * 8, n)
 
 
-def _k_g1_rpk(px, py, pz, inf, bits, ox, oy, oz, oinf):
+def _word_bit(rwords, i):
+    """Per-lane bit (MSB-first index i) of packed (hi, lo) scalar words.
+
+    Traced vector shift instead of a dynamic sublane slice: indexing a
+    [64, B] bit-plane array with pl.ds(i, 1) lowers to layout-mismatched
+    rotate/select chains that crash the Mosaic pass on real TPUs.
+    """
+    w = rwords[...].astype(jnp.uint32)  # [2, B]
+    j = jnp.uint32(RAND_BITS - 1) - i.astype(jnp.uint32)
+    use_hi = j >= jnp.uint32(32)
+    sh = jnp.where(use_hi, j - jnp.uint32(32), j)
+    word = jnp.where(use_hi, w[0], w[1])
+    return ((word >> sh) & jnp.uint32(1)).astype(jnp.int32)
+
+
+def _k_g1_rpk(px, py, pz, inf, rwords, ox, oy, oz, oinf):
     p = (px[...], py[...], pz[...])
     q_inf = inf[...][0] != 0
 
     def gb(i):
-        return bits[pl.ds(i, 1), :][0]
+        return _word_bit(rwords, i)
 
     (X, Y, Z), t_inf = CV.scalar_mul_bits_jac(
         CV.FP_OPS, p, q_inf, gb, RAND_BITS
@@ -119,7 +135,7 @@ def _k_g1_rpk(px, py, pz, inf, bits, ox, oy, oz, oinf):
     oinf[...] = t_inf[None, :].astype(jnp.int32)
 
 
-def _k_g2_rsig_sub(sx0, sx1, sy0, sy1, inf, bits,
+def _k_g2_rsig_sub(sx0, sx1, sy0, sy1, inf, rwords,
                    ox0, ox1, oy0, oy1, oz0, oz1, oinf, osub):
     q_aff = ((sx0[...], sx1[...]), (sy0[...], sy1[...]))
     q_inf = inf[...][0] != 0
@@ -127,7 +143,7 @@ def _k_g2_rsig_sub(sx0, sx1, sy0, sy1, inf, bits,
     q_jac = (q_aff[0], q_aff[1], one2)
 
     def gb(i):
-        return bits[pl.ds(i, 1), :][0]
+        return _word_bit(rwords, i)
 
     (X, Y, Z), t_inf = CV.scalar_mul_bits_jac(
         CV.FP2_OPS, q_jac, q_inf, gb, RAND_BITS
@@ -146,21 +162,32 @@ def _k_sub_only(sx0, sx1, sy0, sy1, inf, osub):
     osub[...] = CV.g2_subgroup_check(q_aff, q_inf)[None, :].astype(jnp.int32)
 
 
+def _kroll(a, shift, axis=-1):
+    """Lane rotate inside kernels — pltpu.roll is the supported primitive
+    (jnp.roll-style lane gathers do not lower reliably in Mosaic)."""
+    return pltpu.roll(a, shift, axis=a.ndim - 1)
+
+
 def _k_sum_g2(x0, x1, y0, y1, z0, z1, inf,
               ax0, ax1, ay0, ay1, az0, az1, ainf):
-    """Grid-accumulated jacobian sum over lanes -> one [NL, 1] point."""
+    """Grid-accumulated jacobian sum over lanes, FULL [NL, BT] width.
+
+    Tiles accumulate lane-wise (elementwise jac_add_full); the last grid
+    step butterfly-reduces across lanes so EVERY lane holds the total.
+    All shapes stay [*, BT]: narrow/one-lane blocks hit unsupported
+    Mosaic layouts (see sum_points_lanes).
+    """
     i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
     pts = ((x0[...], x1[...]), (y0[...], y1[...]), (z0[...], z1[...]))
-    infv = inf[...][0] != 0
-    s, s_inf = CV.sum_points_lanes(CV.FP2_OPS, pts, infv)
-    s_inf = s_inf[..., :1]
+    infv = inf[...][0] != 0  # [BT] lane mask
 
     @pl.when(i == 0)
     def _():
-        (ax0[...], ax1[...]) = s[0]
-        (ay0[...], ay1[...]) = s[1]
-        (az0[...], az1[...]) = s[2]
-        ainf[...] = s_inf[None, :].astype(jnp.int32)
+        (ax0[...], ax1[...]) = pts[0]
+        (ay0[...], ay1[...]) = pts[1]
+        (az0[...], az1[...]) = pts[2]
+        ainf[...] = infv[None, :].astype(jnp.int32)
 
     @pl.when(i > 0)
     def _():
@@ -170,15 +197,32 @@ def _k_sum_g2(x0, x1, y0, y1, z0, z1, inf,
             (az0[...], az1[...]),
         )
         acc_inf = ainf[...][0] != 0
-        t, t_inf = CV.jac_add_full(CV.FP2_OPS, acc, acc_inf, s, s_inf)
+        t, t_inf = CV.jac_add_full(CV.FP2_OPS, acc, acc_inf, pts, infv)
         (ax0[...], ax1[...]) = t[0]
         (ay0[...], ay1[...]) = t[1]
         (az0[...], az1[...]) = t[2]
         ainf[...] = t_inf[None, :].astype(jnp.int32)
 
+    @pl.when(i == last)
+    def _():
+        acc = (
+            (ax0[...], ax1[...]),
+            (ay0[...], ay1[...]),
+            (az0[...], az1[...]),
+        )
+        acc_inf = ainf[...][0] != 0
+        s, s_inf = CV.sum_points_lanes(
+            CV.FP2_OPS, acc, acc_inf, roll_fn=_kroll
+        )
+        (ax0[...], ax1[...]) = s[0]
+        (ay0[...], ay1[...]) = s[1]
+        (az0[...], az1[...]) = s[2]
+        ainf[...] = s_inf[None, :].astype(jnp.int32)
+
 
 def _k_affine_g2(x0, x1, y0, y1, z0, z1, inf, ax0, ax1, ay0, ay1, ainf):
-    """One-lane jacobian -> affine; infinity lanes get the generator."""
+    """Jacobian -> affine at full width (all lanes hold the aggregate);
+    infinity lanes get the generator."""
     pt = ((x0[...], x1[...]), (y0[...], y1[...]), (z0[...], z1[...]))
     (ax, ay), aff_inf = KP.to_affine_g2(pt)
     a_inf = (inf[...][0] != 0) | aff_inf
@@ -231,12 +275,18 @@ def _unflatten_f12(leaves):
 
 
 def _k_prod(valid, *f_refs):
-    """Grid-accumulated product of valid lanes -> one [NL, 1] Fp12."""
+    """Grid-accumulated product of valid lanes, FULL [NL, BT] width.
+
+    Tiles multiply lane-wise; the last grid step butterfly-reduces so
+    every lane holds the product (same layout rationale as _k_sum_g2).
+    """
     i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
     fN = _unflatten_f12([r[...] for r in f_refs[:12]])
     outs = f_refs[12:]
-    v = valid[...][0] != 0
-    tile = KP.product12_lanes(fN, v)
+    v = valid[...][0] != 0  # [BT] lane mask
+    one = TW.one12(fN[0][0][0])
+    tile = TW.select12(v, fN, one)
 
     @pl.when(i == 0)
     def _():
@@ -250,13 +300,24 @@ def _k_prod(valid, *f_refs):
         for ref, leaf in zip(outs, jax.tree_util.tree_leaves(t)):
             ref[...] = leaf
 
+    @pl.when(i == last)
+    def _():
+        acc = _unflatten_f12([r[...] for r in outs])
+        ones = jnp.ones(v.shape, bool)  # [BT]
+        t = KP.product12_lanes(acc, ones, roll_fn=_kroll)
+        for ref, leaf in zip(outs, jax.tree_util.tree_leaves(t)):
+            ref[...] = leaf
+
 
 def _k_final_one(ainf, *f_refs):
-    """prod * aggregate-pair f -> final exp -> is-one (one lane)."""
+    """prod * aggregate-pair f -> final exp -> is-one, full width.
+
+    Every lane carries the same aggregate values; the host reads lane 0.
+    """
     prod = _unflatten_f12([r[...] for r in f_refs[:12]])
     fA = _unflatten_f12([r[...] for r in f_refs[12:24]])
     ok_ref = f_refs[24]
-    a_inf = ainf[...][0] != 0
+    a_inf = ainf[...][0] != 0  # [BT] lane mask
     one = TW.one12(fA[0][0][0])
     fA = TW.select12(~a_inf, fA, one)
     f = TW.mul12(prod, fA)
@@ -315,20 +376,12 @@ def _gather_pk(table_x, table_y, idx, kmask):
     return (ox, oy, oz), (oinf[0] != 0)
 
 
-def _one_lane_call(kernel, ins, in_rows, out_rows):
-    return pl.pallas_call(
-        kernel,
-        out_shape=[_sds((r, 1)) for r in out_rows],
-        interpret=_interpret(),
-    )(*ins)
-
-
 @jax.jit
 def verify_batch_device(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,
     sig_x0, sig_x1, sig_y0, sig_y1,
-    sig_inf, bits, valid,
+    sig_inf, rwords, valid,
 ):
     """Full RLC batch verification of N padded sets on device.
 
@@ -338,7 +391,8 @@ def verify_batch_device(
 
     msg/sig planes arrive as PLAIN limbs (the ingest wire split) and are
     converted to Montgomery form on device; the pubkey table is stored in
-    Montgomery form (converted once at registration).
+    Montgomery form (converted once at registration).  `rwords` is the
+    packed int32[2, N] (hi, lo) randomizer layout of make_rand_words.
     """
     n = valid.shape[0]
     msg_x0, msg_x1, msg_y0, msg_y1, sig_x0, sig_x1, sig_y0, sig_y1 = _to_mont8(
@@ -363,8 +417,8 @@ def verify_batch_device(
     # r_i * pk_i
     rx, ry, rz, _rinf = _tiled(
         _k_g1_rpk,
-        (px, py, pz, zero_row, bits),
-        [NL, NL, NL, 1, RAND_BITS],
+        (px, py, pz, zero_row, rwords),
+        [NL, NL, NL, 1, 2],
         [NL, NL, NL, 1],
         n,
     )
@@ -372,8 +426,8 @@ def verify_batch_device(
     # r_i * sig_i + subgroup checks
     sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, rsinf, sub = _tiled(
         _k_g2_rsig_sub,
-        (sx[0], sx[1], sy[0], sy[1], zero_row, bits),
-        [NL, NL, NL, NL, 1, RAND_BITS],
+        (sx[0], sx[1], sy[0], sy[1], zero_row, rwords),
+        [NL, NL, NL, NL, 1, 2],
         [NL] * 6 + [1, 1],
         n,
     )
@@ -383,11 +437,13 @@ def verify_batch_device(
     jx0, jx1, jy0, jy1, jz0, jz1, jinf = _sum_g2(
         sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, excl, n
     )
-    ax0, ax1, ay0, ay1, ainf = _one_lane_call(
+    # [NL, BT] planes: every lane holds the aggregate point
+    ax0, ax1, ay0, ay1, ainf = _tiled(
         _k_affine_g2,
         (jx0, jx1, jy0, jy1, jz0, jz1, jinf),
         [NL] * 6 + [1],
         [NL] * 4 + [1],
+        BT,
     )
 
     # Miller: N set pairs
@@ -399,27 +455,26 @@ def verify_batch_device(
         n,
     )
 
-    # Miller: the aggregate pair (-G1, A), broadcast over one tile so the
-    # same compiled kernel serves it
+    # Miller: the aggregate pair (-G1, A) — full-width lanes all carry A,
+    # so the same compiled tile kernel serves it
     fA = _tiled(
         _k_miller,
         (
             _bcast(_G1X, BT), _bcast(_NEG_G1Y, BT), _bcast(_ONE, BT),
-            jnp.broadcast_to(ax0, (NL, BT)), jnp.broadcast_to(ax1, (NL, BT)),
-            jnp.broadcast_to(ay0, (NL, BT)), jnp.broadcast_to(ay1, (NL, BT)),
+            ax0, ax1, ay0, ay1,
         ),
         [NL] * 7,
         [NL] * 12,
         BT,
     )
-    fA1 = [t[:, :1] for t in fA]
 
     fprod = _prod(fN, live_i, n)
-    ok2 = _one_lane_call(
+    ok2 = _tiled(
         _k_final_one,
-        (ainf, *fprod, *fA1),
+        (ainf, *fprod, *fA),
         [1] + [NL] * 24,
         [1],
+        BT,
     )[0]
 
     sub_ok = (sub[0] != 0) | ~live
@@ -433,28 +488,28 @@ def verify_batch_device(
 
 
 def _sum_g2(x0, x1, y0, y1, z0, z1, excl, n):
-    """Lane-tiled grid accumulation wrapper for _k_sum_g2."""
+    """Lane-tiled grid accumulation wrapper for _k_sum_g2 (full width)."""
     return pl.pallas_call(
         _k_sum_g2,
-        out_shape=[_sds((NL, 1))] * 6 + [_sds((1, 1))],
+        out_shape=[_sds((NL, BT))] * 6 + [_sds((1, BT))],
         grid=(n // BT,),
         in_specs=[pl.BlockSpec((NL, BT), lambda i: (0, i))] * 6
         + [pl.BlockSpec((1, BT), lambda i: (0, i))],
-        out_specs=[pl.BlockSpec((NL, 1), lambda i: (0, 0))] * 6
-        + [pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 6
+        + [pl.BlockSpec((1, BT), lambda i: (0, 0))],
         interpret=_interpret(),
     )(x0, x1, y0, y1, z0, z1, excl)
 
 
 def _prod(fN, live_i, n):
-    """Lane-tiled grid accumulation wrapper for _k_prod."""
+    """Lane-tiled grid accumulation wrapper for _k_prod (full width)."""
     return pl.pallas_call(
         _k_prod,
-        out_shape=[_sds((NL, 1))] * 12,
+        out_shape=[_sds((NL, BT))] * 12,
         grid=(n // BT,),
         in_specs=[pl.BlockSpec((1, BT), lambda i: (0, i))]
         + [pl.BlockSpec((NL, BT), lambda i: (0, i))] * 12,
-        out_specs=[pl.BlockSpec((NL, 1), lambda i: (0, 0))] * 12,
+        out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 12,
         interpret=_interpret(),
     )(live_i, *fN)
 

@@ -256,10 +256,8 @@ def aggregate_pubkeys(table_x, table_y, indices, mask):
 # ---------------------------------------------------------------------------
 
 
-def make_rand_bits(
-    n: int, rng: "np.random.Generator | None" = None
-) -> np.ndarray:
-    """Random odd 64-bit scalars as MSB-first bit planes uint32[64, n].
+def _rand_scalars(n: int, rng: "np.random.Generator | None") -> np.ndarray:
+    """Odd 64-bit randomizer scalars, uint64[n].
 
     With rng=None (the production default) scalars come from the OS CSPRNG —
     batch-verification soundness requires unpredictable randomizers, same as
@@ -268,10 +266,35 @@ def make_rand_bits(
     """
     if rng is None:
         raw = np.frombuffer(os.urandom(8 * n), dtype=np.uint64)
-        scalars = raw | np.uint64(1)  # odd, full 64-bit range
-    else:
-        scalars = rng.integers(0, 1 << 63, size=n, dtype=np.uint64) * 2 + 1
+        return raw | np.uint64(1)  # odd, full 64-bit range
+    return rng.integers(0, 1 << 63, size=n, dtype=np.uint64) * 2 + 1
+
+
+def make_rand_bits(
+    n: int, rng: "np.random.Generator | None" = None
+) -> np.ndarray:
+    """Random odd 64-bit scalars as MSB-first bit planes uint32[64, n]
+    (the XLA einsum path's layout).  CSPRNG contract: _rand_scalars."""
+    scalars = _rand_scalars(n, rng)
     out = np.zeros((RAND_BITS, n), dtype=np.uint32)
     for i in range(RAND_BITS):
         out[RAND_BITS - 1 - i] = (scalars >> np.uint64(i)) & np.uint64(1)
     return out
+
+
+def make_rand_words(
+    n: int, rng: "np.random.Generator | None" = None
+) -> np.ndarray:
+    """Random odd 64-bit scalars packed as int32[2, n] = (hi, lo) words.
+
+    The packed form the pallas pipeline consumes (kernels/verify.py):
+    per-lane bit i is extracted in-kernel with a traced shift — dynamic
+    sublane indexing of a [64, n] bit-plane array does not lower through
+    Mosaic (layout-mismatched rotate/select chains), packed words do.
+    CSPRNG contract: _rand_scalars.
+    """
+    scalars = _rand_scalars(n, rng)
+    out = np.zeros((2, n), dtype=np.uint32)
+    out[0] = (scalars >> np.uint64(32)).astype(np.uint32)
+    out[1] = (scalars & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return out.view(np.int32)

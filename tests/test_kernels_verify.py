@@ -79,7 +79,7 @@ def encode_sets(sets, n, kmax):
 
 def bits_for(n, seed):
     return jnp.asarray(
-        BK.make_rand_bits(n, np.random.default_rng(seed)).astype(np.int32)
+        BK.make_rand_words(n, np.random.default_rng(seed))
     )
 
 
