@@ -1,12 +1,22 @@
 """Headline benchmark: BLS signature-sets verified per second on one chip.
 
-Measures the pallas verification pipeline end-to-end per job — host CSPRNG
-randomizer generation, host->device transfer of message/signature planes
-and randomizer bits, pubkey-table gather on device, the full
-random-linear-combination batch verification (scalar muls, Miller loops,
-final exponentiation), and the verdict sync back to host — the same work
-the reference's BlsMultiThreadWorkerPool performs per job (reference:
-packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106).
+Measures the WIRE path end-to-end per job — the work the reference's
+BlsMultiThreadWorkerPool performs per job (reference:
+packages/beacon-node/src/chain/bls/multithread/worker.ts:30-106) plus the
+deserialization it pays inside blst:
+
+  host:   96B compressed signature -> flag bits + x-coordinate limb split,
+          wire checks (length/compression/range), randomizer CSPRNG,
+  device: signing-root hash-to-curve (SSWU, batched per distinct root —
+          the per-slot SeenAttestationDatas cadence), signature
+          decompression (Fp2 sqrt), pubkey-table gather, the full
+          random-linear-combination batch verification (scalar muls,
+          Miller loops, final exponentiation), verdict sync.
+
+Fresh signing roots are hashed inside the timed region (one device batch
+per job, modelling the per-slot cadence: mainnet has ~64 distinct
+attestation datas per slot amortized over ~15k single sets — this bench
+is ~4x more conservative at 8 fresh roots per 512-set job).
 
 Baseline: the reference's CPU thread-pool ceiling, ~32 workers x ~1.1k
 sigs/s x <=2 batching gain = 3-7e4 sig-sets/s (SURVEY.md section 6;
@@ -14,6 +24,7 @@ packages/beacon-node/src/metrics/metrics/lodestar.ts:427).  We take the
 midpoint 5.0e4 sets/s as the baseline denominator.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BENCH_MODE=decoded runs the pre-decoded-planes benchmark instead.
 """
 
 from __future__ import annotations
@@ -34,7 +45,11 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+from lodestar_tpu.bls.pubkey_table import PubkeyTable
+from lodestar_tpu.bls.signature_set import WireSignatureSet
+from lodestar_tpu.bls.verifier import TpuBlsVerifier
 from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto import curves as GCC
 from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
 from lodestar_tpu.kernels import layout as LY
 from lodestar_tpu.kernels import verify as KV
@@ -46,11 +61,68 @@ BASELINE_SETS_PER_S = 5.0e4
 # cap (chain/bls/multithread/index.ts:39), raised because one chip replaces
 # the whole worker pool.  Overridable for experiments.
 BATCH = int(os.environ.get("BENCH_BATCH", "512"))
-DISTINCT = 32  # distinct (pk, msg, sig) triples tiled to BATCH
+DISTINCT = 32  # distinct signing keys tiled across the batch
+ROOTS_PER_ITER = 8  # distinct fresh signing roots per job
 REPEATS = int(os.environ.get("BENCH_REPEATS", "16"))
 
 
-def build_inputs():
+def build_wire_world():
+    sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=max(BATCH, DISTINCT))
+    table.register_points_unchecked(pks, tile_to=max(BATCH, DISTINCT))
+    table.device_planes()
+
+    jobs = []
+    for r in range(REPEATS + 1):  # +1 warmup job with its own roots
+        roots = [b"bench root %d %d" % (r, c) for c in range(ROOTS_PER_ITER)]
+        sig_cache = {}
+        sets = []
+        for j in range(BATCH):
+            key = j % DISTINCT
+            root = roots[j % ROOTS_PER_ITER]
+            if (key, root) not in sig_cache:
+                sig_cache[(key, root)] = GCC.g2_compress(GTB.sign(sks[key], root))
+            sets.append(WireSignatureSet.single(j, root, sig_cache[(key, root)]))
+        jobs.append(sets)
+    return table, jobs
+
+
+def main_wire():
+    table, jobs = build_wire_world()
+    verifier = TpuBlsVerifier(table, max_job_sets=BATCH)
+
+    # Warm-up / compile on the throwaway job (its own roots, so the timed
+    # region still pays its own hash-to-curve batches).
+    warm = verifier.begin_job(jobs[0], batchable=True)
+    assert verifier.finish_job(warm), "bench warmup failed verification"
+
+    t0 = time.perf_counter()
+    # hash all fresh signing roots in ONE device batch (the per-slot
+    # cadence: SeenAttestationDatas misses are hashed together)
+    fresh = list(dict.fromkeys(s.signing_root for job in jobs[1:] for s in job))
+    verifier.messages.get_many(fresh)
+    handles = [verifier.begin_job(job, batchable=True) for job in jobs[1:]]
+    ok_all = True
+    for h in handles:
+        ok_all &= verifier.finish_job(h)
+    dt = time.perf_counter() - t0
+    assert ok_all, "bench jobs failed verification"
+
+    sets_per_s = BATCH * REPEATS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_signature_sets_verified_per_s",
+                "value": round(sets_per_s, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
+            }
+        )
+    )
+
+
+def build_decoded_inputs():
     sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
     pks = [GTB.sk_to_pk(sk) for sk in sks]
     msgs = [b"bench signing root %d" % (i % 4) for i in range(DISTINCT)]
@@ -64,7 +136,6 @@ def build_inputs():
     kmask = jnp.ones((BATCH, 1), jnp.int32)
 
     def enc(vals):
-        # plain limbs: Montgomery conversion happens on device (ingest path)
         return jnp.asarray(np.tile(LY.encode_plain_batch(vals), (1, reps)))
 
     planes = (
@@ -78,11 +149,10 @@ def build_inputs():
     return (tx, ty, idx, kmask) + planes + (sig_inf,), valid
 
 
-def main():
-    args, valid = build_inputs()
+def main_decoded():
+    args, valid = build_decoded_inputs()
     fn = KV.verify_batch_device
 
-    # Warm-up / compile.
     rand = jnp.asarray(BK.make_rand_words(BATCH))
     ok, _ = fn(*args, rand, valid)
     assert bool(ok), "bench inputs failed verification"
@@ -102,7 +172,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "bls_signature_sets_verified_per_s",
+                "metric": "bls_signature_sets_verified_per_s_decoded",
                 "value": round(sets_per_s, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
@@ -112,4 +182,6 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("BENCH_MODE", "wire") == "decoded":
+        sys.exit(main_decoded())
+    sys.exit(main_wire())

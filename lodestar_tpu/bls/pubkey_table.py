@@ -67,6 +67,57 @@ class PubkeyTable:
         self._device = None  # invalidate mirror
         return idxs
 
+    def register_compressed(
+        self, keys: Sequence[bytes], device_batch: int = 65536
+    ) -> List[int]:
+        """Bulk-register 48B compressed pubkeys with DEVICE KeyValidate.
+
+        The 1M-validator ingest path: decompression (Fp sqrt) and the
+        [r]P subgroup test run lane-parallel on TPU
+        (kernels/ingest.g1_keyvalidate_device); the reference pays ~30 s
+        of host blst deserialization for 350k keys
+        (packages/beacon-node/src/chain/chain.ts:218-220).  Raises on
+        the first invalid key, naming its position.
+        """
+        from ..kernels import ingest as IG
+        from .ingest import encode_pubkey_planes
+
+        import jax.numpy as jnp
+
+        # two-phase: validate EVERY chunk before committing anything, so a
+        # late invalid key cannot leave partially-registered rows behind a
+        # stale device mirror
+        validated = []
+        for start in range(0, len(keys), device_batch):
+            chunk = list(keys[start : start + device_batch])
+            n = len(chunk)
+            pad = (-n) % 128
+            planes, flags, host_bad = encode_pubkey_planes(
+                chunk + [chunk[-1]] * pad
+            )
+            (mx, my), ok = IG.g1_keyvalidate_device(
+                jnp.asarray(planes), jnp.asarray(flags)
+            )
+            ok = np.asarray(ok)[:n] & ~host_bad[:n]
+            if not ok.all():
+                bad = int(np.argmin(ok))
+                raise ValueError(
+                    f"pubkey {start + bad} failed KeyValidate "
+                    "(malformed, off-curve, infinity, or out of subgroup)"
+                )
+            validated.append((np.asarray(mx)[:, :n], np.asarray(my)[:, :n]))
+        idxs: List[int] = []
+        for mx, my in validated:
+            n = mx.shape[1]
+            while self._n + n > self._cap:
+                self._grow()
+            self._host_x[:, self._n : self._n + n] = mx
+            self._host_y[:, self._n : self._n + n] = my
+            idxs.extend(range(self._n, self._n + n))
+            self._n += n
+        self._device = None
+        return idxs
+
     def register_points_unchecked(
         self, pubkeys: Sequence, tile_to: Optional[int] = None
     ) -> List[int]:

@@ -176,11 +176,23 @@ class BlsVerifierService:
                 # the whole job runs at resolve time
                 handles = (merged, batchable)
             else:
+                # device jobs must be homogeneous (wire vs decoded sets);
+                # a buffer window can legally mix submitters of both kinds
+                from .signature_set import WireSignatureSet
+
                 cap = self.verifier.max_job_sets
-                handles = [
-                    begin(merged[i : i + cap], batchable)
-                    for i in range(0, len(merged), cap)
-                ]
+                runs: List[List] = []
+                for s in merged:
+                    is_wire = isinstance(s, WireSignatureSet)
+                    if (
+                        runs
+                        and isinstance(runs[-1][0], WireSignatureSet) == is_wire
+                        and len(runs[-1]) < cap
+                    ):
+                        runs[-1].append(s)
+                    else:
+                        runs.append([s])
+                handles = [begin(run, batchable) for run in runs]
         except Exception as e:
             for j in group:
                 if not j.future.done():
@@ -218,16 +230,47 @@ class BlsVerifierService:
                         j.future.set_result(True)
                 elif len(group) == 1:
                     group[0].future.set_result(False)
-                else:
-                    # a failed merged batch re-verifies per job so one bad
+                elif isinstance(handles, tuple):
+                    # no-begin_job fallback: re-verify per job so one bad
                     # signature cannot poison other jobs' verdicts
-                    # (reference: worker.ts:74-96); those calls observe
-                    # job_time themselves, so skip the group-level observe
-                    handles = (None, None)
+                    # (reference: worker.ts:74-96)
                     for j in group:
                         j.future.set_result(
                             self.verifier.verify_signature_sets(j.sets, j.opts)
                         )
+                else:
+                    # a failed merged batch: finish_job already produced
+                    # per-set verdicts for failed handles (the device
+                    # retry pass) — slice them back to the submitting
+                    # jobs by position instead of re-verifying
+                    # (reference accounting: worker.ts:74-96)
+                    per_set = []
+                    aligned = True
+                    for h in handles:
+                        if not bool(h.ok_big):
+                            aligned = False  # a CPU-routed set failed in
+                            break  # this handle; positions ambiguous
+                        if getattr(h, "verdicts", None) is not None:
+                            per_set.extend(bool(v) for v in h.verdicts)
+                        else:
+                            per_set.extend([True] * len(h.sets))
+                    total = sum(len(j.sets) for j in group)
+                    if aligned and len(per_set) == total:
+                        pos = 0
+                        for j in group:
+                            nj = len(j.sets)
+                            j.future.set_result(all(per_set[pos : pos + nj]))
+                            pos += nj
+                    else:
+                        # CPU-routed sets (oversized aggregates, external
+                        # keys) broke positional alignment: re-verify per
+                        # job to attribute failures correctly
+                        for j in group:
+                            j.future.set_result(
+                                self.verifier.verify_signature_sets(
+                                    j.sets, j.opts
+                                )
+                            )
             except Exception as e:
                 for j in group:
                     if not j.future.done():

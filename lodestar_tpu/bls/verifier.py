@@ -38,8 +38,9 @@ from ..kernels import layout as LY
 from ..kernels import verify as KV
 from ..ops import bls_kernels as BK
 from ..utils.metrics import BlsPoolMetrics
+from .ingest import MessageCache, encode_wire_planes
 from .pubkey_table import PubkeyTable
-from .signature_set import SignatureSet
+from .signature_set import SignatureSet, WireSignatureSet
 
 MAX_JOB_SETS = 128          # reference: chain/bls/multithread/index.ts:39
 MAX_PENDING_JOBS = 512      # reference: chain/bls/multithread/index.ts:64
@@ -63,17 +64,19 @@ class _DeviceJob:
     """An in-flight device job: lazy result handles + host-side context."""
 
     __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
-                 "batch_ok", "per_set")
+                 "batch_ok", "per_set", "wire", "verdicts")
 
-    def __init__(self, sets, batchable, ok_big):
+    def __init__(self, sets, batchable, ok_big, wire=False):
         self.sets = sets
         self.batchable = batchable
         self.ok_big = ok_big
+        self.wire = wire
         self.args = None
         self.valid = None
         self.decodable = None
         self.batch_ok = None  # lazy device scalar (RLC batch verdict)
         self.per_set = None  # lazy device vector (per-set verdicts)
+        self.verdicts = None  # host per-set bools, set by finish_job retry
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -111,7 +114,10 @@ class TpuBlsVerifier:
         # Device job size: 128 mirrors the reference's per-worker cap; the
         # service raises it (512-2048) so each ~65 ms tunnel dispatch
         # carries more sets (dev/NOTES.md dispatch floor).
-        self.max_job_sets = max_job_sets
+        # clamp to the largest device bucket: begin_job cannot exceed it
+        self.max_job_sets = min(max_job_sets, N_BUCKETS[-1])
+        # signing-root -> hashed G2 message, device-batched (wire path)
+        self.messages = MessageCache()
         self._pending_jobs = 0
 
     # -- backpressure (reference: multithread/index.ts:143-149) -----------
@@ -131,7 +137,12 @@ class TpuBlsVerifier:
         self._pending_jobs += 1
         try:
             if opts.verify_on_main_thread:
-                verdicts = [self._verify_set_cpu(s) for s in sets]
+                verdicts = [
+                    self._verify_set_cpu(
+                        s.decode() if isinstance(s, WireSignatureSet) else s
+                    )
+                    for s in sets
+                ]
                 good = sum(verdicts)
                 self.metrics.success_jobs.inc(good)
                 self.metrics.invalid_sets.inc(len(sets) - good)
@@ -236,43 +247,92 @@ class TpuBlsVerifier:
         (dev/NOTES.md); `finish_job` syncs verdicts in order.
         """
         assert len(sets) <= self.max_job_sets
+        wire = bool(sets) and isinstance(sets[0], WireSignatureSet)
+        assert all(
+            isinstance(s, WireSignatureSet) == wire for s in sets
+        ), "begin_job requires a homogeneous wire/decoded job (service splits)"
         # CPU-path sets: aggregates beyond the largest device bucket
         # (> MAX_AGG_INDICES participants — an oversized but legitimate
         # aggregate still gets a verdict) and sets signed by keys outside
-        # the validator registry (external_pubkeys).
-        big = [
-            s
-            for s in sets
-            if len(s.indices) > MAX_AGG_INDICES or s.external_pubkeys is not None
-        ]
+        # the validator registry.
+        def _cpu_only(s):
+            if len(s.indices) > MAX_AGG_INDICES:
+                return True
+            # getattr: a mixed-type group (service merge) must not crash
+            return (
+                getattr(s, "pubkeys", None) is not None
+                or getattr(s, "external_pubkeys", None) is not None
+            )
+
+        big = [s for s in sets if _cpu_only(s)]
         if big:
-            sets = [s for s in sets if s not in big]
-            verdicts = [self._verify_set_cpu(s) for s in big]
+            sets = [s for s in sets if not _cpu_only(s)]
+            verdicts = [
+                self._verify_set_cpu(s.decode() if wire else s) for s in big
+            ]
             good = sum(verdicts)
             self.metrics.success_jobs.inc(good)
             self.metrics.invalid_sets.inc(len(big) - good)
             ok_big = all(verdicts)
         else:
             ok_big = True
-        job = _DeviceJob(sets, batchable, ok_big)
+        job = _DeviceJob(sets, batchable, ok_big, wire)
         if not sets:
             return job
 
-        job.args, job.valid, n = self._prepare(sets)
-        job.decodable = np.array([s.signature is not None for s in sets])
+        if wire:
+            job.args, job.valid, n, host_bad = self._prepare_wire(sets)
+            job.decodable = ~host_bad[: len(sets)]
+        else:
+            job.args, job.valid, n = self._prepare(sets)
+            job.decodable = np.array([s.signature is not None for s in sets])
         if batchable and len(sets) >= 2 and job.decodable.all():
             # reference: maybeBatch.ts:16 (batch iff >= 2 sets)
             self.metrics.batchable_sigs.inc(len(sets))
             rand = jnp.asarray(BK.make_rand_words(n, self.rng))
-            job.batch_ok, _sub = KV.verify_batch_device(*job.args, rand, job.valid)
+            batch_fn = (
+                KV.verify_batch_device_wire if wire else KV.verify_batch_device
+            )
+            job.batch_ok, _sub = batch_fn(*job.args, rand, job.valid)
         else:
             if batchable and len(sets) >= 2:
                 # an undecodable signature voids the merged batch: count it
                 # as a batch retry and go straight to per-set verdicts
                 self.metrics.batchable_sigs.inc(len(sets))
                 self.metrics.batch_retries.inc()
-            job.per_set = KV.verify_each_device(*job.args, job.valid)
+            job.per_set = self._each_fn(job)(*job.args, job.valid)
         return job
+
+    def _each_fn(self, job):
+        return KV.verify_each_device_wire if job.wire else KV.verify_each_device
+
+    def _prepare_wire(self, sets: List[WireSignatureSet]):
+        """Wire sets -> device planes: hashed messages from the device
+        MessageCache, signatures as compressed-x limbs + flag bits."""
+        n = _bucket(len(sets), N_BUCKETS)
+        kmax = _bucket(max(len(s.indices) for s in sets), K_BUCKETS)
+        idx = np.zeros((n, kmax), np.int32)
+        kmask = np.zeros((n, kmax), np.int32)
+        valid = np.zeros((n,), np.int32)
+        for i, s in enumerate(sets):
+            k = len(s.indices)
+            idx[i, :k] = s.indices
+            kmask[i, :k] = 1
+            valid[i] = 1
+        msgs = self.messages.get_many([s.signing_root for s in sets])
+        g2 = C.G2_GEN
+        msgs = msgs + [g2] * (n - len(sets))
+        sig_x0, sig_x1, flags, host_bad = encode_wire_planes(
+            [s.signature for s in sets], n
+        )
+        tx, ty = self.table.device_planes()
+        args = (
+            tx, ty, jnp.asarray(idx), jnp.asarray(kmask),
+            _enc([m[0][0] for m in msgs]), _enc([m[0][1] for m in msgs]),
+            _enc([m[1][0] for m in msgs]), _enc([m[1][1] for m in msgs]),
+            jnp.asarray(sig_x0), jnp.asarray(sig_x1), jnp.asarray(flags),
+        )
+        return args, jnp.asarray(valid), n, host_bad
 
     def finish_job(self, job: "_DeviceJob") -> bool:
         """Sync a begun job's device results and produce the verdict."""
@@ -288,8 +348,9 @@ class TpuBlsVerifier:
             # each set individually so one bad signature cannot poison the
             # verdict of honest sets (reference: multithread/worker.ts:74-96)
             self.metrics.batch_retries.inc()
-            job.per_set = KV.verify_each_device(*job.args, job.valid)
+            job.per_set = self._each_fn(job)(*job.args, job.valid)
         per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+        job.verdicts = per_set  # callers can slice per-set results
         good = int(per_set.sum())
         self.metrics.success_jobs.inc(good)
         self.metrics.invalid_sets.inc(len(sets) - good)
@@ -302,9 +363,14 @@ class TpuBlsVerifier:
         aggregate in a job failed)."""
         verdicts: dict = {}
         device_sets: List[Tuple[int, SignatureSet]] = []
+        wire_sets: List[Tuple[int, WireSignatureSet]] = []
         for pos, s in enumerate(sets):
-            if len(s.indices) > MAX_AGG_INDICES or s.external_pubkeys is not None:
-                verdicts[pos] = self._verify_set_cpu(s)
+            wire = isinstance(s, WireSignatureSet)
+            ext = s.pubkeys if wire else s.external_pubkeys
+            if len(s.indices) > MAX_AGG_INDICES or ext is not None:
+                verdicts[pos] = self._verify_set_cpu(s.decode() if wire else s)
+            elif wire:
+                wire_sets.append((pos, s))
             else:
                 device_sets.append((pos, s))
         for chunk_start in range(0, len(device_sets), MAX_JOB_SETS):
@@ -316,6 +382,15 @@ class TpuBlsVerifier:
             ]
             for (pos, s), v in zip(chunk, per_set):
                 verdicts[pos] = bool(v) and s.signature is not None
+        for chunk_start in range(0, len(wire_sets), MAX_JOB_SETS):
+            chunk = wire_sets[chunk_start : chunk_start + MAX_JOB_SETS]
+            subset = [s for _, s in chunk]
+            args, valid, _n, host_bad = self._prepare_wire(subset)
+            per_set = np.asarray(KV.verify_each_device_wire(*args, valid))[
+                : len(subset)
+            ]
+            for j, ((pos, s), v) in enumerate(zip(chunk, per_set)):
+                verdicts[pos] = bool(v) and not host_bad[j]
         return [verdicts[i] for i in range(len(sets))]
 
     def close(self) -> None:

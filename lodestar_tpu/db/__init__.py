@@ -1,0 +1,14 @@
+"""DB layer: native ordered KV controller + bucketed repositories.
+
+Mirror of the reference's `@lodestar/db` (reference:
+packages/db/src/controller/level.ts for the controller surface,
+db/src/abstractRepository.ts + schema.ts for bucket-prefixed
+repositories, and packages/beacon-node/src/db/ for BeaconDb): the
+storage engine is the C++ ordered KV store in
+`lodestar_tpu/native/kvstore.cpp` (the LevelDB-dependency analog),
+loaded via ctypes with a pure-Python in-memory fallback.
+"""
+
+from .controller import KvController  # noqa: F401
+from .repository import Bucket, Repository  # noqa: F401
+from .beacon_db import BeaconDb  # noqa: F401

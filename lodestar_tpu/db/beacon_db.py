@@ -1,0 +1,47 @@
+"""BeaconDb — the typed repository bundle.
+
+Reference: packages/beacon-node/src/db/beaconDb.ts (20 repositories over
+@lodestar/db).  The subset here covers the framework's persistence
+needs: blocks (hot + archive), op pools, and backfill ranges — each an
+SSZ-typed repository keyed by root or slot.
+"""
+
+from __future__ import annotations
+
+from .. import types as T
+from .controller import KvController
+from .repository import Bucket, Repository
+
+
+def _slot_key(slot: int) -> bytes:
+    return slot.to_bytes(8, "big")  # big-endian keeps slot order == byte order
+
+
+class BeaconDb:
+    def __init__(self, path=None):
+        self.controller = KvController(path)
+        db = self.controller
+        self.block = Repository(db, Bucket.block, T.SignedBeaconBlockAltair)
+        self.block_archive = Repository(
+            db, Bucket.block_archive, T.SignedBeaconBlockAltair
+        )
+        self.state_archive = Repository(db, Bucket.state_archive)
+        self.proposer_slashing = Repository(
+            db, Bucket.proposer_slashing, T.ProposerSlashing
+        )
+        self.attester_slashing = Repository(
+            db, Bucket.attester_slashing, T.AttesterSlashing
+        )
+        self.voluntary_exit = Repository(
+            db, Bucket.voluntary_exit, T.SignedVoluntaryExit
+        )
+        self.backfilled_ranges = Repository(db, Bucket.backfilled_ranges)
+
+    def put_block(self, root: bytes, signed_block: dict) -> None:
+        self.block.put(root, signed_block)
+
+    def archive_block(self, slot: int, signed_block: dict) -> None:
+        self.block_archive.put(_slot_key(slot), signed_block)
+
+    def close(self) -> None:
+        self.controller.close()

@@ -1,0 +1,105 @@
+"""ForkChoice facade: latest messages + head computation.
+
+Reference: packages/fork-choice/src/forkChoice/forkChoice.ts — tracks
+per-validator latest messages (epoch, block root), queues attestations
+from future slots, converts votes to proto-array score changes on
+update_head, and exposes the IForkChoice surface the chain/processor
+layers consume (hasBlock/getHead/onBlock/onAttestation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .compute_deltas import compute_deltas
+from .proto_array import ProtoArray
+
+
+@dataclass
+class LatestMessage:
+    epoch: int
+    root: str
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        proto_array: ProtoArray,
+        justified_root: str,
+        balances: Optional[np.ndarray] = None,
+    ):
+        self.proto = proto_array
+        self.justified_root = justified_root
+        self.balances = (
+            balances if balances is not None else np.zeros(0, np.int64)
+        )
+        self._latest: Dict[int, LatestMessage] = {}
+        # vote state at the last update_head (for delta computation)
+        self._applied_votes: Dict[int, str] = {}
+        self._applied_balances = np.zeros_like(self.balances)
+
+    # -- block / attestation ingestion ------------------------------------
+
+    def has_block(self, root: str) -> bool:
+        return root in self.proto
+
+    def on_block(
+        self,
+        slot: int,
+        root: str,
+        parent_root: Optional[str],
+        justified_epoch: int = None,
+        finalized_epoch: int = None,
+    ) -> None:
+        self.proto.on_block(
+            slot,
+            root,
+            parent_root,
+            self.proto.justified_epoch if justified_epoch is None else justified_epoch,
+            self.proto.finalized_epoch if finalized_epoch is None else finalized_epoch,
+        )
+
+    def on_attestation(self, validator_index: int, epoch: int, root: str) -> None:
+        """Track the validator's latest message (newest epoch wins)."""
+        cur = self._latest.get(validator_index)
+        if cur is None or epoch > cur.epoch:
+            self._latest[validator_index] = LatestMessage(epoch, root)
+
+    def set_balances(self, balances: np.ndarray) -> None:
+        self.balances = np.asarray(balances, np.int64)
+
+    # -- head (reference: forkChoice.updateHead) ---------------------------
+
+    def update_head(self) -> str:
+        n_val = max(
+            len(self.balances),
+            (max(self._latest) + 1) if self._latest else 0,
+            len(self._applied_balances),
+        )
+        old_votes = np.full(n_val, -1, np.int64)
+        new_votes = np.full(n_val, -1, np.int64)
+        for v, root in self._applied_votes.items():
+            idx = self.proto.indices.get(root)
+            if idx is not None:
+                old_votes[v] = idx
+        for v, msg in self._latest.items():
+            idx = self.proto.indices.get(msg.root)
+            if idx is not None:
+                new_votes[v] = idx
+        old_bal = np.zeros(n_val, np.int64)
+        old_bal[: len(self._applied_balances)] = self._applied_balances
+        new_bal = np.zeros(n_val, np.int64)
+        new_bal[: len(self.balances)] = self.balances
+
+        deltas = compute_deltas(
+            len(self.proto), old_votes, new_votes, old_bal, new_bal
+        )
+        self.proto.apply_score_changes(
+            deltas, self.proto.justified_epoch, self.proto.finalized_epoch
+        )
+        self._applied_votes = {v: m.root for v, m in self._latest.items()}
+        self._applied_balances = new_bal
+        return self.proto.find_head(self.justified_root)

@@ -1,0 +1,177 @@
+"""ProtoArray — the append-only fork-choice DAG.
+
+Reference: packages/fork-choice/src/protoArray/protoArray.ts.  Nodes are
+stored in insertion order (parents before children), so score/weight
+propagation is two linear passes: deltas apply backwards (child -> parent
+accumulation) and best-child/best-descendant links update in the same
+backward sweep; head lookup is O(1) through the cached best-descendant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ProtoNode:
+    slot: int
+    root: str
+    parent: Optional[int]  # index into the array
+    justified_epoch: int
+    finalized_epoch: int
+    weight: int = 0
+    best_child: Optional[int] = None
+    best_descendant: Optional[int] = None
+
+
+class ProtoArrayError(Exception):
+    pass
+
+
+class ProtoArray:
+    def __init__(
+        self,
+        finalized_root: str,
+        finalized_slot: int = 0,
+        justified_epoch: int = 0,
+        finalized_epoch: int = 0,
+    ):
+        self.nodes: List[ProtoNode] = []
+        self.indices: Dict[str, int] = {}
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.on_block(
+            finalized_slot, finalized_root, None, justified_epoch, finalized_epoch
+        )
+
+    def __contains__(self, root: str) -> bool:
+        return root in self.indices
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    # -- insertion (reference: protoArray.ts onBlock) ----------------------
+
+    def on_block(
+        self,
+        slot: int,
+        root: str,
+        parent_root: Optional[str],
+        justified_epoch: int,
+        finalized_epoch: int,
+    ) -> None:
+        if root in self.indices:
+            return
+        parent = None
+        if parent_root is not None:
+            parent = self.indices.get(parent_root)
+            if parent is None:
+                raise ProtoArrayError(f"unknown parent {parent_root}")
+        node = ProtoNode(slot, root, parent, justified_epoch, finalized_epoch)
+        idx = len(self.nodes)
+        self.indices[root] = idx
+        self.nodes.append(node)
+        if parent is not None:
+            self._maybe_update_best_child(parent, idx)
+
+    # -- scoring (reference: protoArray.ts applyScoreChanges) --------------
+
+    def apply_score_changes(
+        self,
+        deltas: List[int],
+        justified_epoch: int,
+        finalized_epoch: int,
+    ) -> None:
+        """Apply per-node weight deltas and refresh all links.
+
+        `deltas` is indexed like `nodes` (computeDeltas output).  One
+        backward sweep both accumulates child deltas into parents and
+        re-evaluates best-child links (children precede their updates).
+        """
+        if len(deltas) != len(self.nodes):
+            raise ProtoArrayError("invalid deltas length")
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            node.weight += deltas[i]
+            if node.weight < 0:
+                raise ProtoArrayError(f"negative weight at {node.root}")
+            if node.parent is not None:
+                deltas[node.parent] += deltas[i]
+                self._maybe_update_best_child(node.parent, i)
+
+    # -- head (reference: protoArray.ts findHead) --------------------------
+
+    def find_head(self, justified_root: str) -> str:
+        idx = self.indices.get(justified_root)
+        if idx is None:
+            raise ProtoArrayError(f"unknown justified root {justified_root}")
+        node = self.nodes[idx]
+        best = node.best_descendant
+        head = self.nodes[best] if best is not None else node
+        if not self._node_is_viable_for_head(head):
+            raise ProtoArrayError("head is not viable")
+        return head.root
+
+    # -- internals ---------------------------------------------------------
+
+    def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """FFG viability filter (reference: nodeIsViableForHead)."""
+        return (
+            node.justified_epoch == self.justified_epoch
+            or self.justified_epoch == 0
+        ) and (
+            node.finalized_epoch == self.finalized_epoch
+            or self.finalized_epoch == 0
+        )
+
+    def _node_leads_to_viable_head(self, node: ProtoNode) -> bool:
+        if node.best_descendant is not None:
+            return self._node_is_viable_for_head(self.nodes[node.best_descendant])
+        return self._node_is_viable_for_head(node)
+
+    def _maybe_update_best_child(self, parent_idx: int, child_idx: int) -> None:
+        """Re-evaluate parent's best child against `child_idx`
+        (reference: maybeUpdateBestChildAndDescendant's three outcomes)."""
+        parent = self.nodes[parent_idx]
+        child = self.nodes[child_idx]
+        child_viable = self._node_leads_to_viable_head(child)
+
+        if parent.best_child == child_idx:
+            if not child_viable:
+                self._change_best_child(parent_idx, None)
+            else:
+                self._change_best_child(parent_idx, child_idx)  # refresh desc
+            return
+        if not child_viable:
+            return
+        if parent.best_child is None:
+            self._change_best_child(parent_idx, child_idx)
+            return
+        best = self.nodes[parent.best_child]
+        best_viable = self._node_leads_to_viable_head(best)
+        if not best_viable:
+            self._change_best_child(parent_idx, child_idx)
+            return
+        # ties break toward the LOWER root-hash order? The reference
+        # breaks ties by preferring the existing best unless strictly
+        # greater weight (with root-order tiebreak on equal weight).
+        if child.weight > best.weight or (
+            child.weight == best.weight and child.root > best.root
+        ):
+            self._change_best_child(parent_idx, child_idx)
+
+    def _change_best_child(self, parent_idx: int, child_idx: Optional[int]):
+        parent = self.nodes[parent_idx]
+        parent.best_child = child_idx
+        if child_idx is None:
+            parent.best_descendant = None
+        else:
+            child = self.nodes[child_idx]
+            parent.best_descendant = (
+                child.best_descendant
+                if child.best_descendant is not None
+                else child_idx
+            )

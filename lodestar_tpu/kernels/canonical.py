@@ -1,0 +1,113 @@
+"""Canonical residue facts for the pallas engine.
+
+Decompression and hash-to-curve need *canonical* facts about field
+values that the lazy Montgomery representation hides:
+
+  - `fp_sgn`: the ZCash compressed-point sort flag (a > p - a), used to
+    pick the signature y-root matching the wire sign bit (the reference
+    consumes this via blst deserialization inside
+    packages/beacon-node/src/chain/bls/multithread/worker.ts:30-50),
+  - `fp_sgn0` / `fp2_sgn0`: RFC 9380 parity signs for SSWU root choice,
+  - `fp2_sgn`: lexicographic G2 y-sort order (imaginary part first).
+
+Representation trick (shared with core.is_zero_modp): Montgomery-squeeze
+x to a plain value z with |z| <= p, then canonicalize z + V1 + k*p for
+k in {-1, 0, 1}, where V1 = (R-1)/4095 is the all-ones limb vector that
+keeps the signed-limb canonicalization nonnegative.  Exactly one k lands
+in [V1, V1 + p); that result is `canonical_plus(x)` = exact limbs of
+(x mod p) + V1.  Comparisons shift their constants by V1 instead of
+subtracting it (V1 is odd, so parity flips once).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import core as C
+from . import layout as LY
+
+_V1 = (LY.R - 1) // LY.LIMB_MASK  # all-ones limb vector, value
+V1_LIMBS = [1] * LY.NL
+P_LIMBS = [int(v) for v in LY.to_limbs(LY.P)]
+V1P_LIMBS = [int(v) for v in LY.to_limbs(_V1 + LY.P)]
+HALF_P_PLUS_LIMBS = [int(v) for v in LY.to_limbs((LY.P - 1) // 2 + _V1)]
+_R2_LIMBS = [int(v) for v in LY.MONT_R2]
+
+
+def _lex_cmp_const(t, c_limbs):
+    """(gt, lt) of exact limb planes t vs a python limb list."""
+    c = C.const_plane(c_limbs, t)
+    gt_l = t > c
+    lt_l = t < c
+    shape = t.shape[:-2] + t.shape[-1:]
+    decided = jnp.zeros(shape, bool)
+    gt = jnp.zeros(shape, bool)
+    lt = jnp.zeros(shape, bool)
+    for i in range(t.shape[-2] - 1, -1, -1):
+        g, l = gt_l[..., i, :], lt_l[..., i, :]
+        gt = jnp.where(~decided & g, True, gt)
+        lt = jnp.where(~decided & l, True, lt)
+        decided = decided | g | l
+    return gt, lt
+
+
+def lex_gt_const(t, c_limbs):
+    return _lex_cmp_const(t, c_limbs)[0]
+
+
+def lex_lt_const(t, c_limbs):
+    return _lex_cmp_const(t, c_limbs)[1]
+
+
+def canonical_plus(x):
+    """Exact limbs of (x mod p) + V1, for x in Montgomery form."""
+    # REDC of the Montgomery value itself converts to plain: x*R/R = x.
+    z = C.redc(C._pad2(x, 0, LY.NL))  # plain value, |z| <= p
+    one = jnp.ones((), jnp.int32)
+    p_plane = C.const_plane(P_LIMBS, z)
+    # candidates for z + V1 + k*p, k in {-1, 0, 1}; all values >= 0
+    tm = C._canon_nonneg(z + one - p_plane)
+    t0 = C._canon_nonneg(z + one)
+    tp = C._canon_nonneg(z + one + p_plane)
+    below = lex_lt_const(t0, V1_LIMBS)  # z < 0 -> need +p
+    above = ~lex_lt_const(t0, V1P_LIMBS)  # z >= p -> need -p
+    out = C.select(below, tp, t0)
+    return C.select(above & ~below, tm, out)
+
+
+def is_zero_plus(v_plus):
+    """v == 0 given canonical_plus limbs (pattern == all ones)."""
+    return jnp.all(v_plus == 1, axis=-2)
+
+
+def fp_sgn(x):
+    """ZCash sort flag: canonical(x) > (p-1)/2 (False for 0)."""
+    return lex_gt_const(canonical_plus(x), HALF_P_PLUS_LIMBS)
+
+
+def _parity_plus(v_plus):
+    """(v mod 2) from canonical_plus limbs: limb0 = v + 1 mod 2 shifted
+    by the odd V1, higher limbs contribute even amounts."""
+    return ((v_plus[..., 0, :] + 1) & 1) != 0
+
+
+def fp_sgn0(x):
+    """RFC 9380 sgn0 for m = 1: canonical(x) mod 2."""
+    return _parity_plus(canonical_plus(x))
+
+
+def fp2_sgn(x01):
+    """Lexicographic Fp2 sign, imaginary part compared first (mirrors
+    crypto/fields.py fp2_sgn / the ZCash G2 compressed sort)."""
+    v1 = canonical_plus(x01[1])
+    v0 = canonical_plus(x01[0])
+    s1 = lex_gt_const(v1, HALF_P_PLUS_LIMBS)
+    s0 = lex_gt_const(v0, HALF_P_PLUS_LIMBS)
+    return jnp.where(~is_zero_plus(v1), s1, s0)
+
+
+def fp2_sgn0(x01):
+    """RFC 9380 sgn0 for m = 2: sign_0 | (zero_0 & sign_1)."""
+    v0 = canonical_plus(x01[0])
+    v1 = canonical_plus(x01[1])
+    return _parity_plus(v0) | (is_zero_plus(v0) & _parity_plus(v1))

@@ -1,0 +1,74 @@
+"""Square roots on the pallas engine (Fp and Fp2), branch-free.
+
+The ingest building block: signature/pubkey decompression solves
+y^2 = g(x) (the reference gets this from blst's uncompress during
+deserialization, packages/beacon-node/src/chain/bls/multithread/
+worker.ts:30-50), and SSWU hashing needs root existence checks.
+
+p == 3 (mod 4), so the Fp candidate root is a^((p+1)/4) (one static
+exponentiation, tower.pow_static).  Fp2 uses the norm ("complex")
+method mirroring the host oracle (crypto/fields.py fp2_sqrt), with all
+branches flattened to selects; validity is decided by ONE final check
+cand^2 == a, which subsumes every intermediate quadratic-residue test.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import core as C
+from . import fp2 as F2
+from . import layout as LY
+from . import tower as TW
+
+_EXP_SQRT = (LY.P + 1) // 4
+_INV2_MONT = [int(v) for v in LY.to_limbs(pow(2, LY.P - 2, LY.P) * LY.R_MOD_P % LY.P)]
+
+
+def fp_sqrt_cand(a):
+    """The candidate root a^((p+1)/4); valid iff cand^2 == a (mod p)."""
+    return TW.pow_static(a, _EXP_SQRT, C.mont_sqr, C.mont_mul, None)
+
+
+def fp_sqrt(a):
+    """(root, ok): ok lanes carry a root of a; !ok lanes are garbage."""
+    cand = fp_sqrt_cand(a)
+    return cand, C.eq_modp(C.mont_sqr(cand), a)
+
+
+def fp2_sqrt(a):
+    """(root, ok) in Fp2 via the norm method, branch-free.
+
+    Mirrors crypto/fields.py fp2_sqrt: d = sqrt(a0^2 + a1^2),
+    x0 = sqrt((a0 +- d)/2), x1 = a1 / (2 x0); the a1 == 0 sub-case
+    (root is real or purely imaginary) is folded in with selects.  The
+    single final check cand^2 == a decides validity for every path.
+    """
+    a0, a1 = a
+    half = lambda v: C.mont_mul_shared(v, _INV2_MONT)
+
+    n = C.add(C.mont_sqr(a0), C.mont_sqr(a1))
+    d = fp_sqrt_cand(n)
+    x0sq_p = half(C.add(a0, d))
+    x0sq_m = half(C.sub(a0, d))
+    r_p = fp_sqrt_cand(x0sq_p)
+    p_ok = C.eq_modp(C.mont_sqr(r_p), x0sq_p)
+    r_m = fp_sqrt_cand(x0sq_m)
+    x0 = C.select(p_ok, r_p, r_m)
+    x1 = C.mont_mul(a1, TW.inv_fp(C.mul_small(x0, 2)))
+
+    # a1 == 0: root is (sqrt(a0), 0) or (0, sqrt(-a0))
+    s_p = fp_sqrt_cand(a0)
+    sp_ok = C.eq_modp(C.mont_sqr(s_p), a0)
+    s_m = fp_sqrt_cand(C.neg(a0))
+    zero = jnp.zeros_like(s_p)
+    real0 = C.select(sp_ok, s_p, zero)
+    imag0 = C.select(sp_ok, zero, s_m)
+
+    a1z = C.is_zero_modp(a1)
+    cand = (
+        C.select(a1z, real0, x0),
+        C.select(a1z, imag0, x1),
+    )
+    ok = F2.eq2(F2.sqr2(cand), a)
+    return cand, ok

@@ -38,6 +38,7 @@ from ..crypto import fields as GT
 from . import core as C
 from . import curve as CV
 from . import fp2 as F2
+from . import ingest as IG
 from . import layout as LY
 from . import pairing as KP
 from . import tower as TW
@@ -398,8 +399,72 @@ def verify_batch_device(
     msg_x0, msg_x1, msg_y0, msg_y1, sig_x0, sig_x1, sig_y0, sig_y1 = _to_mont8(
         (msg_x0, msg_x1, msg_y0, msg_y1, sig_x0, sig_x1, sig_y0, sig_y1), n
     )
+    return _batch_core(
+        table_x, table_y, idx, kmask,
+        (msg_x0, msg_x1, msg_y0, msg_y1),
+        (sig_x0, sig_x1, sig_y0, sig_y1),
+        (sig_inf != 0), rwords, valid,
+    )
+
+
+@jax.jit
+def verify_batch_device_wire(
+    table_x, table_y, idx, kmask,
+    msg_x0, msg_x1, msg_y0, msg_y1,
+    sig_x0, sig_x1, sig_flags,
+    rwords, valid,
+):
+    """Batch verification from WIRE signatures: sig arrives as the
+    compressed x coordinate (plain limbs) + (sign, infinity) flag bits
+    int32[2, N]; decompression (one Fp2 sqrt chain) runs on device.
+    An undecodable signature (x off-curve) fails the batch like an
+    infinity signature -> callers fall back to per-set verdicts.
+    """
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = _tiled(
+        _k_mont4, (msg_x0, msg_x1, msg_y0, msg_y1), [NL] * 4, [NL] * 4, n
+    )
+    (sx0, sx1, sy0, sy1), dec_ok = _decompress_sig(sig_x0, sig_x1, sig_flags, n)
+    sig_bad = (sig_flags[1] != 0) | ~dec_ok
+    return _batch_core(
+        table_x, table_y, idx, kmask,
+        (msg_x0, msg_x1, msg_y0, msg_y1),
+        (sx0, sx1, sy0, sy1),
+        sig_bad, rwords, valid,
+    )
+
+
+def _decompress_sig(sig_x0, sig_x1, sig_flags, n):
+    out = _tiled(
+        IG._k_g2_decompress,
+        (sig_x0, sig_x1, sig_flags),
+        [NL, NL, 2],
+        [NL] * 4 + [1],
+        n,
+    )
+    return out[:4], out[4][0] != 0
+
+
+def _k_mont4(a0, a1, a2, a3, *outs):
+    """Plain-limb planes -> Montgomery form, 4 at a time."""
+    for ref, r in zip(outs, (a0, a1, a2, a3)):
+        ref[...] = C.redc(C.mul_cols_shared(r[...], _R2_LIMBS, LY.NC))
+
+
+def _batch_core(
+    table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid
+):
+    """Shared batch pipeline from Montgomery planes onward.
+
+    msgM/sigM: affine G2 planes in Montgomery form; sig_bad: bool[N]
+    lanes whose signature cannot participate (infinity or undecodable) —
+    they fail the batch and are excluded from the aggregate.
+    """
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = msgM
+    sig_x0, sig_x1, sig_y0, sig_y1 = sigM
     (pk, pk_inf) = _gather_pk(table_x, table_y, idx, kmask)
-    live = (valid != 0) & ~pk_inf & ~(sig_inf != 0)
+    live = (valid != 0) & ~pk_inf & ~sig_bad
 
     # Substitute generators for dead lanes so every lane stays on-curve.
     g1x, g1y, one = _bcast(_G1X, n), _bcast(_G1Y, n), _bcast(_ONE, n)
@@ -482,7 +547,7 @@ def verify_batch_device(
         (ok2[0, 0] != 0)
         & jnp.all(sub_ok)
         & ~jnp.any(pk_inf & (valid != 0))
-        & ~jnp.any((sig_inf != 0) & (valid != 0))
+        & ~jnp.any(sig_bad & (valid != 0))
     )
     return batch_ok, sub_ok
 
@@ -530,8 +595,42 @@ def verify_each_device(
     msg_x0, msg_x1, msg_y0, msg_y1, sig_x0, sig_x1, sig_y0, sig_y1 = _to_mont8(
         (msg_x0, msg_x1, msg_y0, msg_y1, sig_x0, sig_x1, sig_y0, sig_y1), n
     )
+    return _each_core(
+        table_x, table_y, idx, kmask,
+        (msg_x0, msg_x1, msg_y0, msg_y1),
+        (sig_x0, sig_x1, sig_y0, sig_y1),
+        (sig_inf != 0), valid,
+    )
+
+
+@jax.jit
+def verify_each_device_wire(
+    table_x, table_y, idx, kmask,
+    msg_x0, msg_x1, msg_y0, msg_y1,
+    sig_x0, sig_x1, sig_flags,
+    valid,
+):
+    """Per-set verdicts from WIRE signatures (see verify_batch_device_wire)."""
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = _tiled(
+        _k_mont4, (msg_x0, msg_x1, msg_y0, msg_y1), [NL] * 4, [NL] * 4, n
+    )
+    (sx0, sx1, sy0, sy1), dec_ok = _decompress_sig(sig_x0, sig_x1, sig_flags, n)
+    sig_bad = (sig_flags[1] != 0) | ~dec_ok
+    return _each_core(
+        table_x, table_y, idx, kmask,
+        (msg_x0, msg_x1, msg_y0, msg_y1),
+        (sx0, sx1, sy0, sy1),
+        sig_bad, valid,
+    )
+
+
+def _each_core(table_x, table_y, idx, kmask, msgM, sigM, sig_bad, valid):
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = msgM
+    sig_x0, sig_x1, sig_y0, sig_y1 = sigM
     (pk, pk_inf) = _gather_pk(table_x, table_y, idx, kmask)
-    live = (valid != 0) & ~pk_inf & ~(sig_inf != 0)
+    live = (valid != 0) & ~pk_inf & ~sig_bad
 
     g1x, g1y, one = _bcast(_G1X, n), _bcast(_G1Y, n), _bcast(_ONE, n)
     px = C.select(live, pk[0], g1x)

@@ -1,0 +1,103 @@
+"""Proto-array fork choice: weights, head selection, reorgs, viability.
+
+Reference behaviors: packages/fork-choice/src/protoArray/protoArray.ts
+(best-child/descendant maintenance), computeDeltas.ts (vote movement),
+forkChoice/forkChoice.ts (latest messages, updateHead).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.fork_choice import (
+    ForkChoice,
+    ProtoArray,
+    compute_deltas,
+)
+from lodestar_tpu.fork_choice.proto_array import ProtoArrayError
+
+pytestmark = pytest.mark.smoke
+
+
+def make_chain():
+    """genesis -> a -> (b, c); b and c compete."""
+    pa = ProtoArray("genesis")
+    pa.on_block(1, "a", "genesis", 0, 0)
+    pa.on_block(2, "b", "a", 0, 0)
+    pa.on_block(2, "c", "a", 0, 0)
+    return pa
+
+
+def test_head_follows_weight():
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([10, 10, 10], np.int64))
+    fc.on_attestation(0, 1, "b")
+    fc.on_attestation(1, 1, "c")
+    fc.on_attestation(2, 1, "c")
+    assert fc.update_head() == "c"
+    # votes move: two validators switch to b at a later epoch
+    fc.on_attestation(1, 2, "b")
+    fc.on_attestation(2, 2, "b")
+    assert fc.update_head() == "b"
+
+
+def test_stale_message_ignored():
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([1, 1], np.int64))
+    fc.on_attestation(0, 5, "c")
+    fc.on_attestation(0, 3, "b")  # older epoch: ignored
+    assert fc.update_head() == "c"
+
+
+def test_deep_chain_head_descends():
+    pa = ProtoArray("genesis")
+    for i in range(1, 20):
+        pa.on_block(i, f"n{i}", "genesis" if i == 1 else f"n{i-1}", 0, 0)
+    fc = ForkChoice(pa, "genesis", np.array([5], np.int64))
+    fc.on_attestation(0, 1, "n19")
+    assert fc.update_head() == "n19"
+    # head from a mid root also reaches the tip
+    assert pa.find_head("n7") == "n19"
+
+
+def test_balance_changes_move_weight():
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([10, 1], np.int64))
+    fc.on_attestation(0, 1, "b")
+    fc.on_attestation(1, 1, "c")
+    assert fc.update_head() == "b"
+    fc.set_balances(np.array([1, 10], np.int64))
+    assert fc.update_head() == "c"
+
+
+def test_unknown_parent_rejected():
+    pa = ProtoArray("genesis")
+    with pytest.raises(ProtoArrayError):
+        pa.on_block(1, "x", "nope", 0, 0)
+
+
+def test_viability_filters_wrong_justification():
+    pa = ProtoArray("genesis")
+    pa.on_block(1, "a", "genesis", 0, 0)
+    pa.on_block(2, "good", "a", 1, 0)
+    pa.on_block(2, "bad", "a", 0, 0)
+    # move to justified epoch 1: only "good" is viable
+    pa.apply_score_changes([0, 0, 0, 100], 1, 0)  # all weight on "bad"
+    assert pa.find_head("a") == "good"
+
+
+def test_compute_deltas_scatter():
+    old = np.array([0, 1, -1], np.int64)
+    new = np.array([1, 1, 2], np.int64)
+    ob = np.array([5, 5, 5], np.int64)
+    nb = np.array([5, 7, 5], np.int64)
+    d = compute_deltas(3, old, new, ob, nb)
+    assert d == [-5, 5 - 5 + 7, 5]
+
+
+def test_weights_accumulate_to_ancestors():
+    pa = make_chain()
+    pa.apply_score_changes([0, 0, 3, 7], 0, 0)
+    # a's weight includes both children; genesis includes everything
+    assert pa.nodes[pa.indices["a"]].weight == 10
+    assert pa.nodes[pa.indices["genesis"]].weight == 10
+    assert pa.find_head("genesis") == "c"

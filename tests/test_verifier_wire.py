@@ -1,0 +1,119 @@
+"""Wire-path verification: bytes in -> verdicts out, vs the CPU oracle.
+
+Drives TpuBlsVerifier with WireSignatureSets (32B signing roots + 96B
+compressed signatures): device hash-to-curve via MessageCache, device
+signature decompression inside the pipeline (reference equivalent: blst
+deserialize+hash inside the worker, multithread/worker.ts:30-106).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls.ingest import parse_signature_bytes
+from lodestar_tpu.bls.pubkey_table import PubkeyTable
+from lodestar_tpu.bls.signature_set import WireSignatureSet
+from lodestar_tpu.bls.verifier import TpuBlsVerifier, VerifyOptions
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+
+pytestmark = pytest.mark.slow
+
+N_KEYS = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    sks = [B.keygen(b"wire-%d" % i) for i in range(N_KEYS)]
+    pks = [B.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=N_KEYS)
+    table.register(pks)
+    verifier = TpuBlsVerifier(table, rng=np.random.default_rng(5))
+    return sks, table, verifier
+
+
+def wire_set(sks, i, root):
+    sig = C.g2_compress(B.sign(sks[i % N_KEYS], root))
+    return WireSignatureSet.single(i % N_KEYS, root, sig)
+
+
+def test_parse_signature_bytes_checks():
+    good = C.g2_compress(B.sign(B.keygen(b"x"), b"m"))
+    x0, x1, sign, inf, ok = parse_signature_bytes(good)
+    assert ok and not inf
+    assert parse_signature_bytes(good[:-1])[4] is False  # truncated
+    assert parse_signature_bytes(bytes([good[0] & 0x7F]) + good[1:])[4] is False
+    inf_enc = bytes([0xC0]) + b"\x00" * 95
+    assert parse_signature_bytes(inf_enc) == (0, 0, 0, 1, True)
+    bad_inf = bytes([0xC0]) + b"\x01" + b"\x00" * 94
+    assert parse_signature_bytes(bad_inf)[4] is False
+    too_big = bytes([0x9F]) + b"\xff" * 95  # x >= p
+    assert parse_signature_bytes(too_big)[4] is False
+
+
+def test_wire_batch_accepts_valid(world):
+    sks, _t, verifier = world
+    roots = [b"wire root %d" % (i % 3) for i in range(16)]
+    roots = [r.ljust(32, b"\x00") for r in roots]
+    sets = [wire_set(sks, i, roots[i]) for i in range(16)]
+    assert verifier.verify_signature_sets(sets, VerifyOptions(batchable=True))
+    assert verifier.metrics.batch_sigs_success.value >= 16
+
+
+def test_wire_batch_rejects_bad_and_retries(world):
+    sks, _t, verifier = world
+    roots = [(b"wr2 %d" % i).ljust(32, b"\x00") for i in range(8)]
+    sets = [wire_set(sks, i, roots[i]) for i in range(8)]
+    # wrong message for set 3
+    bad = WireSignatureSet.single(
+        3 % N_KEYS, roots[4], sets[3].signature
+    )
+    mixed = sets[:3] + [bad] + sets[4:]
+    before = verifier.metrics.batch_retries.value
+    assert not verifier.verify_signature_sets(mixed, VerifyOptions(batchable=True))
+    assert verifier.metrics.batch_retries.value == before + 1
+    verdicts = verifier.verify_signature_sets_individually(mixed)
+    assert verdicts == [True] * 3 + [False] + [True] * 4
+
+
+def test_wire_undecodable_and_infinity(world):
+    sks, _t, verifier = world
+    roots = [(b"wr3 %d" % i).ljust(32, b"\x00") for i in range(4)]
+    sets = [wire_set(sks, i, roots[i]) for i in range(4)]
+    corrupted = bytearray(sets[1].signature)
+    corrupted[7] ^= 0x01  # off-curve x (almost surely)
+    mixed = [
+        sets[0],
+        WireSignatureSet.single(1 % N_KEYS, roots[1], bytes(corrupted)),
+        WireSignatureSet.single(2 % N_KEYS, roots[2], bytes([0xC0]) + b"\x00" * 95),
+        sets[3],
+    ]
+    verdicts = verifier.verify_signature_sets_individually(mixed)
+    assert verdicts[0] is True and verdicts[3] is True
+    assert verdicts[1] is False and verdicts[2] is False
+    assert not verifier.verify_signature_sets(mixed, VerifyOptions(batchable=True))
+
+
+def test_wire_aggregate_sets(world):
+    sks, _t, verifier = world
+    root = b"wire agg root".ljust(32, b"\x00")
+    members = [0, 2, 5]
+    agg = B.aggregate_signatures([B.sign(sks[i], root) for i in members])
+    ws = WireSignatureSet.aggregate(members, root, C.g2_compress(agg))
+    other = wire_set(sks, 1, b"other".ljust(32, b"\x00"))
+    assert verifier.verify_signature_sets([ws, other], VerifyOptions(batchable=True))
+    # wrong membership fails
+    ws_bad = WireSignatureSet.aggregate([0, 2, 6], root, C.g2_compress(agg))
+    assert verifier.verify_signature_sets_individually([ws_bad]) == [False]
+
+
+def test_message_cache_device_matches_host(world):
+    _sks, _t, verifier = world
+    from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+
+    roots = [(b"mc %d" % i).ljust(32, b"\x00") for i in range(5)]
+    got = verifier.messages.get_many(roots)
+    for r, g in zip(roots, got):
+        assert g == hash_to_g2(r)
+    h0 = verifier.messages.hits
+    verifier.messages.get_many(roots)
+    assert verifier.messages.hits == h0 + 5
