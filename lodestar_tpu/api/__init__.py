@@ -1,0 +1,14 @@
+"""Beacon REST API: route definitions + HTTP server + typed client.
+
+Mirror of the reference's `@lodestar/api` + beacon-node api/impl
+(reference: packages/api/src/beacon/routes/, api/src/beacon/client/,
+packages/beacon-node/src/api/): route definitions shared by client and
+server, a stdlib-HTTP server binding them to chain components, and a
+fetch-style client.  The surface implemented is the subset the
+framework's own components consume plus the lodestar-namespace
+introspection (gossip-queue dumps) used by the replay tooling.
+"""
+
+from .routes import ROUTES, Route  # noqa: F401
+from .server import BeaconApiServer  # noqa: F401
+from .client import ApiClient  # noqa: F401

@@ -1,0 +1,84 @@
+"""ApiClient — the fetch-style typed client.
+
+Reference: packages/api/src/beacon/client/ (getClient over fetch with
+fallback base URLs).  Methods mirror the route set; multiple base URLs
+are tried in order (the reference's fallback behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ApiClient:
+    def __init__(self, base_urls: Sequence[str], timeout: float = 10.0):
+        self.base_urls: List[str] = list(base_urls)
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body=None):
+        last: Optional[Exception] = None
+        for base in self.base_urls:
+            url = base.rstrip("/") + path
+            data = None if body is None else json.dumps(body).encode()
+            req = urllib.request.Request(url, data=data, method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    raw = resp.read()
+                    return json.loads(raw) if raw else None
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:  # server-side failure: try the next base
+                    last = ApiError(e.code, e.read().decode(errors="replace"))
+                    continue
+                raise ApiError(e.code, e.read().decode(errors="replace"))
+            except urllib.error.URLError as e:  # try the next base URL
+                last = e
+        if isinstance(last, ApiError):
+            raise last
+        raise ApiError(0, f"all base urls failed: {last}")
+
+    # -- node --------------------------------------------------------------
+
+    def get_health(self):
+        return self._request("GET", "/eth/v1/node/health")
+
+    def get_version(self) -> str:
+        return self._request("GET", "/eth/v1/node/version")["data"]["version"]
+
+    def get_syncing(self) -> dict:
+        return self._request("GET", "/eth/v1/node/syncing")["data"]
+
+    # -- beacon ------------------------------------------------------------
+
+    def get_genesis(self) -> dict:
+        return self._request("GET", "/eth/v1/beacon/genesis")["data"]
+
+    def submit_pool_attestations(self, attestations: list):
+        return self._request(
+            "POST", "/eth/v1/beacon/pool/attestations", attestations
+        )
+
+    # -- config ------------------------------------------------------------
+
+    def get_spec(self) -> dict:
+        return self._request("GET", "/eth/v1/config/spec")["data"]
+
+    # -- lodestar introspection --------------------------------------------
+
+    def dump_gossip_queue(self, gossip_type: str) -> dict:
+        return self._request(
+            "GET", f"/eth/v1/lodestar/gossip-queue-items/{gossip_type}"
+        )["data"]
+
+    def get_bls_metrics(self) -> dict:
+        return self._request("GET", "/eth/v1/lodestar/bls-metrics")["data"]

@@ -1,0 +1,65 @@
+"""Route definitions shared by client and server.
+
+Reference: packages/api/src/beacon/routes/{beacon,node,config,debug,
+lodestar}.ts — each route is (method, path template, handler name).
+Responses follow the eth2 API envelope {"data": ...} (the reference's
+returnTypes); the lodestar namespace mirrors the reference's custom
+introspection endpoints (api/impl/lodestar/index.ts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Route:
+    method: str
+    path: str  # template with {param} segments
+    handler: str  # name on the handler object
+
+
+ROUTES: Tuple[Route, ...] = (
+    # node namespace (reference: routes/node.ts)
+    Route("GET", "/eth/v1/node/health", "get_health"),
+    Route("GET", "/eth/v1/node/version", "get_version"),
+    Route("GET", "/eth/v1/node/syncing", "get_syncing"),
+    # beacon namespace (reference: routes/beacon/*.ts)
+    Route("GET", "/eth/v1/beacon/genesis", "get_genesis"),
+    Route("GET", "/eth/v1/beacon/headers/{block_id}", "get_block_header"),
+    Route("GET", "/eth/v2/beacon/blocks/{block_id}", "get_block"),
+    Route("POST", "/eth/v1/beacon/pool/attestations", "submit_attestations"),
+    # config namespace (reference: routes/config.ts)
+    Route("GET", "/eth/v1/config/spec", "get_spec"),
+    # validator namespace (reference: routes/validator.ts)
+    Route("GET", "/eth/v1/validator/duties/proposer/{epoch}", "get_proposer_duties"),
+    Route(
+        "POST", "/eth/v1/validator/duties/attester/{epoch}", "get_attester_duties"
+    ),
+    # lodestar namespace (reference: api/impl/lodestar/index.ts)
+    Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),
+    Route("GET", "/eth/v1/lodestar/bls-metrics", "get_bls_metrics"),
+)
+
+
+def match(method: str, path: str):
+    """Resolve (method, concrete path) -> (route, params dict) or None."""
+    parts = path.rstrip("/").split("/")
+    for route in ROUTES:
+        if route.method != method:
+            continue
+        tparts = route.path.split("/")
+        if len(tparts) != len(parts):
+            continue
+        params = {}
+        ok = True
+        for t, p in zip(tparts, parts):
+            if t.startswith("{") and t.endswith("}"):
+                params[t[1:-1]] = p
+            elif t != p:
+                ok = False
+                break
+        if ok:
+            return route, params
+    return None

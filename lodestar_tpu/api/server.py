@@ -1,0 +1,161 @@
+"""BeaconApiServer — the REST server binding routes to chain components.
+
+Reference: packages/beacon-node/src/api/rest/index.ts (fastify server) +
+api/impl/ (handlers reading chain/network/sync state).  Handlers are
+methods on an injected object; anything absent returns 501 so partial
+deployments (e.g. the replay harness exposing only lodestar introspection)
+still serve.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .routes import match
+
+
+class DefaultHandlers:
+    """Minimal handler set over injected components (any may be None)."""
+
+    def __init__(
+        self,
+        version: str = "lodestar-tpu/0.3.0",
+        genesis_time: int = 0,
+        genesis_validators_root: bytes = b"\x00" * 32,
+        processor=None,
+        bls_metrics=None,
+        spec: Optional[dict] = None,
+    ):
+        self.version = version
+        self.genesis_time = genesis_time
+        self.genesis_validators_root = genesis_validators_root
+        self.processor = processor
+        self.bls_metrics = bls_metrics
+        self.spec = spec or {}
+
+    def get_health(self, params, body):
+        return 200, None  # healthy; 206 while syncing in a full node
+
+    def get_version(self, params, body):
+        return 200, {"data": {"version": self.version}}
+
+    def get_syncing(self, params, body):
+        return 200, {
+            "data": {
+                "head_slot": "0",
+                "sync_distance": "0",
+                "is_syncing": False,
+                "is_optimistic": False,
+            }
+        }
+
+    def get_genesis(self, params, body):
+        return 200, {
+            "data": {
+                "genesis_time": str(self.genesis_time),
+                "genesis_validators_root": "0x"
+                + self.genesis_validators_root.hex(),
+                "genesis_fork_version": "0x00000000",
+            }
+        }
+
+    def get_spec(self, params, body):
+        return 200, {"data": {k: str(v) for k, v in self.spec.items()}}
+
+    def dump_gossip_queue(self, params, body):
+        if self.processor is None:
+            return 501, {"message": "no network processor attached"}
+        from ..network.gossip_queues import GossipType
+
+        try:
+            gt = GossipType(params["gossip_type"])
+        except ValueError:
+            return 400, {"message": f"unknown gossip type {params['gossip_type']}"}
+        q = self.processor.queues[gt]
+        return 200, {
+            "data": {
+                "length": len(q),
+                "drop_ratio": q.drop_ratio,
+            }
+        }
+
+    def get_bls_metrics(self, params, body):
+        if self.bls_metrics is None:
+            return 501, {"message": "no bls metrics attached"}
+        m = self.bls_metrics
+        return 200, {
+            "data": {
+                "queue_length": m.queue_length.value,
+                "success_jobs": m.success_jobs.value,
+                "batch_retries": m.batch_retries.value,
+                "invalid_sets": m.invalid_sets.value,
+            }
+        }
+
+
+class BeaconApiServer:
+    def __init__(self, handlers, host: str = "127.0.0.1", port: int = 0):
+        outer_handlers = handlers
+
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self, method):
+                m = match(method, self.path.split("?")[0])
+                if m is None:
+                    self._send(404, {"message": "route not found"})
+                    return
+                route, params = m
+                fn = getattr(outer_handlers, route.handler, None)
+                if fn is None:
+                    self._send(501, {"message": f"{route.handler} not implemented"})
+                    return
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except ValueError:
+                        self._send(400, {"message": "invalid JSON body"})
+                        return
+                try:
+                    status, payload = fn(params, body)
+                except Exception as e:  # noqa: BLE001 - handler boundary
+                    self._send(500, {"message": str(e)})
+                    return
+                self._send(status, payload)
+
+            def _send(self, status, payload):
+                data = b"" if payload is None else json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._respond("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._respond("POST")
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def listen(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="beacon-api", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -55,9 +55,13 @@ class MessageCache:
 
     def get_many(self, roots: Sequence[bytes]) -> List[Tuple]:
         with self._lock:
+            resolved = {}
             missing = []
             for r in roots:
                 if r in self._cache:
+                    # snapshot hits NOW: inserting a large miss set below
+                    # may evict them before the final answer is built
+                    resolved[r] = self._cache[r]
                     self._cache.move_to_end(r)
                     self.hits += 1
                 elif r not in missing:
@@ -71,13 +75,8 @@ class MessageCache:
                 for r, pt in fetched.items():
                     self._store(r)
                     self._cache[r] = pt
-                # answer from fetched first: a miss set larger than
-                # max_entries may already have evicted early entries
-                return [
-                    fetched[r] if r in fetched else self._cache[r]
-                    for r in roots
-                ]
-            return [self._cache[r] for r in roots]
+                resolved.update(fetched)
+            return [resolved[r] for r in roots]
 
     def _store(self, root: bytes) -> None:
         while len(self._cache) >= self.max_entries:

@@ -68,7 +68,13 @@ class KvController:
         self.path = path
         self._mem = None
         self._h = None
-        if path is not None and _lib is not None:
+        if path is not None:
+            if _lib is None:
+                raise OSError(
+                    "durable path given but libkvstore.so is not built — "
+                    "run `make -C lodestar_tpu/native` (or pass path=None "
+                    "for an explicitly in-memory store)"
+                )
             self._h = _lib.kv_open(path.encode())
             if not self._h:
                 raise OSError(f"kv_open failed for {path}")

@@ -120,24 +120,37 @@ uint64_t kv_count(void* h) { return ((Store*)h)->data.size(); }
 
 int kv_flush(void* h) { return fflush(((Store*)h)->log) == 0 ? 0 : -1; }
 
-// Rewrite the log as a compact snapshot of live records.
+// Rewrite the log as a compact snapshot of live records.  Every write
+// is checked BEFORE the snapshot replaces the WAL: a short write (disk
+// full, I/O error) must never destroy committed data.
 int kv_compact(void* h) {
   Store* s = (Store*)h;
   std::string tmp = s->path + ".compact";
   FILE* f = fopen(tmp.c_str(), "wb");
   if (!f) return -1;
+  bool ok = true;
   for (const auto& [k, v] : s->data) {
     uint8_t op = 1;
     uint32_t klen = (uint32_t)k.size(), vlen = (uint32_t)v.size();
-    fwrite(&op, 1, 1, f);
-    fwrite(&klen, 4, 1, f);
-    fwrite(&vlen, 4, 1, f);
-    if (klen) fwrite(k.data(), 1, klen, f);
-    if (vlen) fwrite(v.data(), 1, vlen, f);
+    ok = ok && fwrite(&op, 1, 1, f) == 1;
+    ok = ok && fwrite(&klen, 4, 1, f) == 1;
+    ok = ok && fwrite(&vlen, 4, 1, f) == 1;
+    if (klen) ok = ok && fwrite(k.data(), 1, klen, f) == klen;
+    if (vlen) ok = ok && fwrite(v.data(), 1, vlen, f) == vlen;
+    if (!ok) break;
   }
-  fclose(f);
+  ok = (fclose(f) == 0) && ok;
+  if (!ok) {
+    remove(tmp.c_str());
+    return -1;  // WAL untouched; store remains fully usable
+  }
   fclose(s->log);
-  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -1;
+  s->log = nullptr;
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    remove(tmp.c_str());
+    s->log = fopen(s->path.c_str(), "ab");  // reopen the original WAL
+    return -1;
+  }
   s->log = fopen(s->path.c_str(), "ab");
   s->log_records = s->data.size();
   return s->log ? 0 : -1;

@@ -1,0 +1,167 @@
+"""BeaconNode — the composition root.
+
+Mirror of the reference's BeaconNode.init wiring order (reference:
+packages/beacon-node/src/node/nodejs.ts:134-307): metrics, db, chain
+components (clock, fork choice, seen caches, the BLS verifier service),
+the network processor, and the REST API server — composed over the TPU
+verifier stack instead of worker threads.
+
+The node's gossip entry (`on_gossip_attestation`) is the framework-level
+end-to-end slice: bytes -> queues -> seen caches -> wire sets -> device
+verification -> fork choice, mirroring SURVEY.md §3.2's hot loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from . import params
+from .api.server import BeaconApiServer, DefaultHandlers
+from .bls.service import BlsVerifierService
+from .bls.signature_set import WireSignatureSet
+from .bls.verifier import TpuBlsVerifier, VerifyOptions
+from .chain.clock import Clock
+from .chain.seen_cache import SeenAttestationDatas, SeenAttesters
+from .config.chain_config import ChainConfig
+from .db.beacon_db import BeaconDb
+from .fork_choice import ForkChoice, ProtoArray
+from .network.gossip_queues import GossipType
+from .network.processor import NetworkProcessor, PendingGossipMessage
+from .utils.logger import get_logger
+from .utils.metrics import BlsPoolMetrics, Registry
+
+
+@dataclass
+class NodeOptions:
+    db_path: Optional[str] = None
+    api_port: int = 0
+    serve_api: bool = True
+    verifier: Optional[object] = None  # injected IBlsVerifier (tests/CPU)
+
+
+class BeaconNode:
+    """Wires the framework; start() brings subsystems up in the
+    reference's order, close() tears them down in reverse."""
+
+    def __init__(
+        self,
+        config: ChainConfig,
+        pubkey_table,
+        genesis_root: str = "genesis",
+        opts: Optional[NodeOptions] = None,
+    ):
+        opts = opts or NodeOptions()
+        self.config = config
+        self.log = get_logger("node")
+        self.registry = Registry()
+        self.metrics = BlsPoolMetrics(self.registry)
+
+        self.db = BeaconDb(opts.db_path)
+        self.clock = Clock(genesis_time=config.genesis_time)
+        self.fork_choice = ForkChoice(ProtoArray(genesis_root), genesis_root)
+
+        verifier = opts.verifier or TpuBlsVerifier(
+            pubkey_table, metrics=self.metrics
+        )
+        self.bls = BlsVerifierService(verifier)
+
+        self.seen_attesters = SeenAttesters()
+        self.seen_data = SeenAttestationDatas()
+        self.processor = NetworkProcessor(
+            self._validate_gossip_message,
+            [self.bls.can_accept_work],
+            has_block_root=self.fork_choice.has_block,
+        )
+        self.clock.on_slot(self.processor.on_clock_slot)
+
+        self.api: Optional[BeaconApiServer] = None
+        if opts.serve_api:
+            self.api = BeaconApiServer(
+                DefaultHandlers(
+                    genesis_time=config.genesis_time,
+                    genesis_validators_root=config.genesis_validators_root,
+                    processor=self.processor,
+                    bls_metrics=self.metrics,
+                    spec={"SECONDS_PER_SLOT": params.SECONDS_PER_SLOT},
+                ),
+                port=opts.api_port,
+            )
+        self._futures = []
+        self._pending_attesters = set()
+
+    def start(self) -> None:
+        if self.api:
+            self.api.listen()
+            self.log.info("rest api listening", port=self.api.port)
+
+    # -- gossip ingress (reference hot loop, SURVEY.md §3.2) ---------------
+
+    def on_gossip_attestation(
+        self,
+        validator_index: int,
+        slot: int,
+        data_key: bytes,
+        signing_root: bytes,
+        signature: bytes,
+        block_root: Optional[str] = None,
+    ) -> None:
+        """Enqueue one attestation's validation (async verdict)."""
+        self.processor.on_gossip_message(
+            PendingGossipMessage(
+                GossipType.beacon_attestation,
+                (validator_index, slot, data_key, signing_root, signature),
+                slot=slot,
+                block_root=block_root,
+                seen_at=time.time(),
+            )
+        )
+
+    def _validate_gossip_message(self, msg: PendingGossipMessage) -> None:
+        validator_index, slot, data_key, signing_root, signature = msg.data
+        epoch = slot // params.SLOTS_PER_EPOCH
+        # dedup against ACCEPTED attesters and in-flight verifications; a
+        # validator is only marked seen once their signature verifies, so
+        # a garbage attestation cannot suppress the real one
+        # (reference race guard: validation/attestation.ts:267-278)
+        if self.seen_attesters.is_known(epoch, validator_index) or (
+            (epoch, validator_index) in self._pending_attesters
+        ):
+            return
+        # derived-value reuse per attestation data (the reference's
+        # SeenAttestationDatas): later messages with the same data key
+        # reuse the first message's signing root
+        root = self.seen_data.get(slot, data_key)
+        if root is None:
+            root = signing_root
+            self.seen_data.put(slot, data_key, root)
+        ws = WireSignatureSet.single(validator_index, root, signature)
+        fut = self.bls.verify_signature_sets_async(
+            [ws], VerifyOptions(batchable=True)
+        )
+        self._pending_attesters.add((epoch, validator_index))
+        self._futures.append((validator_index, epoch, fut))
+
+    def drain_verdicts(self, timeout: float = 60.0) -> int:
+        """Resolve outstanding verifications; count accepted.
+
+        Accepted attesters become seen (dedup for the rest of the
+        epoch); rejected ones are released so a later valid attestation
+        from the same validator still gets through.
+        """
+        accepted = 0
+        for idx, epoch, fut in self._futures:
+            ok = fut.result(timeout=timeout)
+            self._pending_attesters.discard((epoch, idx))
+            if ok:
+                self.seen_attesters.add(epoch, idx)
+                accepted += 1
+        self._futures = []
+        return accepted
+
+    def close(self) -> None:
+        if self.api:
+            self.api.close()
+        self.bls.close()
+        self.db.close()

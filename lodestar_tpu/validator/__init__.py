@@ -1,0 +1,12 @@
+"""Validator client: duties, signing, slashing protection.
+
+Mirror of the reference's `@lodestar/validator` (reference:
+packages/validator/src/): a ValidatorStore that signs duties under
+slashing-protection checks (services/validatorStore.ts +
+slashingProtection/), and an attestation duty service that polls
+duties and produces/signs/submits attestations through the REST client
+(services/attestation.ts, services/attestationDuties.ts).
+"""
+
+from .store import SlashingProtection, SlashingError, ValidatorStore  # noqa: F401
+from .attestation_service import AttestationService  # noqa: F401

@@ -1,0 +1,76 @@
+"""AttestationService — per-slot attestation duty execution.
+
+Reference: packages/validator/src/services/attestation.ts (produce at
+slot/3, sign, submit) + services/attestationDuties.ts (per-epoch duty
+polling).  The api dependency is injected (any object with the
+duty/produce/submit methods), so tests and the replay harness can drive
+it without a live beacon node.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.logger import get_logger
+from .store import SlashingError, ValidatorStore
+
+
+class AttestationService:
+    def __init__(self, store: ValidatorStore, api, logger=None):
+        self.store = store
+        self.api = api
+        self.log = logger or get_logger("validator/attestation")
+        # epoch -> list of duty dicts {validator_index, committee_index, slot}
+        self._duties: Dict[int, List[dict]] = {}
+        self.submitted = 0
+        self.skipped_slashable = 0
+
+    # -- duties (reference: attestationDuties.ts pollBeaconAttesters) ------
+
+    def poll_duties(self, epoch: int) -> None:
+        indices = sorted(self.store.sks)
+        duties = self.api.get_attester_duties(epoch, indices)
+        self._duties[epoch] = duties
+        for old in [e for e in self._duties if e < epoch - 1]:
+            del self._duties[old]
+
+    def duties_at_slot(self, epoch: int, slot: int) -> List[dict]:
+        return [d for d in self._duties.get(epoch, []) if d["slot"] == slot]
+
+    # -- execution (reference: attestation.ts runAttestationTasks) ---------
+
+    def run_attestation_tasks(self, epoch: int, slot: int) -> int:
+        """Produce, sign, and submit for every duty at `slot`."""
+        duties = self.duties_at_slot(epoch, slot)
+        if not duties:
+            return 0
+        produced: Dict[int, dict] = {}
+        submitted = []
+        for duty in duties:
+            ci = duty["committee_index"]
+            if ci not in produced:
+                # one AttestationData per committee (reference reuses the
+                # produced data across that committee's duties)
+                produced[ci] = self.api.produce_attestation_data(ci, slot)
+            data = produced[ci]
+            try:
+                sig = self.store.sign_attestation(duty["validator_index"], data)
+            except SlashingError as e:
+                self.skipped_slashable += 1
+                self.log.warn(
+                    "refusing slashable attestation",
+                    validator=duty["validator_index"],
+                    reason=str(e),
+                )
+                continue
+            submitted.append(
+                {
+                    "aggregation_bits": duty.get("aggregation_bits", [True]),
+                    "data": data,
+                    "signature": "0x" + sig.hex(),
+                }
+            )
+        if submitted:
+            self.api.submit_pool_attestations(submitted)
+            self.submitted += len(submitted)
+        return len(submitted)

@@ -1,0 +1,137 @@
+"""ValidatorStore — keys, signing, and slashing protection.
+
+Reference: packages/validator/src/services/validatorStore.ts (signing
+entry points) and validator/src/slashingProtection/ (EIP-3076-style
+min/max tracking: no double votes, no surround votes, monotonic block
+slots).  The interchange subset kept here is the attester/proposer
+protection invariants; signing uses the framework's CPU BLS oracle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .. import params
+from .. import types as T
+from ..config.chain_config import ChainConfig
+from ..crypto import bls as B
+from ..crypto import curves as C
+
+
+class SlashingError(Exception):
+    pass
+
+
+@dataclass
+class _AttRecord:
+    source: int
+    target: int
+
+
+class SlashingProtection:
+    """Per-pubkey attestation/block slashing guards.
+
+    Invariants enforced (reference: slashingProtection/attestation/ and
+    /block/): target strictly increases, source never decreases
+    (prevents double + surround votes under the min/max simplification),
+    proposal slots strictly increase.
+    """
+
+    def __init__(self):
+        self._atts: Dict[bytes, _AttRecord] = {}
+        self._blocks: Dict[bytes, int] = {}
+
+    def check_attestation(self, pubkey: bytes, source: int, target: int) -> None:
+        if source > target:
+            raise SlashingError("source epoch after target epoch")
+        rec = self._atts.get(pubkey)
+        if rec is not None:
+            if target <= rec.target:
+                raise SlashingError(
+                    f"double vote: target {target} <= signed {rec.target}"
+                )
+            if source < rec.source:
+                raise SlashingError(
+                    f"surround vote: source {source} < signed {rec.source}"
+                )
+        self._atts[pubkey] = _AttRecord(source, target)
+
+    def check_block(self, pubkey: bytes, slot: int) -> None:
+        prev = self._blocks.get(pubkey)
+        if prev is not None and slot <= prev:
+            raise SlashingError(f"double proposal: slot {slot} <= {prev}")
+        self._blocks[pubkey] = slot
+
+    # EIP-3076 interchange (reference: slashingProtection/interchange/)
+    def export_interchange(self) -> dict:
+        return {
+            "metadata": {"interchange_format_version": "5"},
+            "data": [
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_attestations": [
+                        {
+                            "source_epoch": str(rec.source),
+                            "target_epoch": str(rec.target),
+                        }
+                    ],
+                    "signed_blocks": (
+                        [{"slot": str(self._blocks[pk])}]
+                        if pk in self._blocks
+                        else []
+                    ),
+                }
+                for pk, rec in self._atts.items()
+            ],
+        }
+
+    def import_interchange(self, data: dict) -> None:
+        for entry in data.get("data", []):
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            for att in entry.get("signed_attestations", []):
+                rec = self._atts.get(pk)
+                src, tgt = int(att["source_epoch"]), int(att["target_epoch"])
+                if rec is None or tgt > rec.target:
+                    self._atts[pk] = _AttRecord(
+                        max(src, rec.source if rec else 0), tgt
+                    )
+            for blk in entry.get("signed_blocks", []):
+                slot = int(blk["slot"])
+                if slot > self._blocks.get(pk, -1):
+                    self._blocks[pk] = slot
+
+
+class ValidatorStore:
+    """Signing duties for a set of local keypairs."""
+
+    def __init__(self, config: ChainConfig, secret_keys: Dict[int, int]):
+        self.config = config
+        self.sks = dict(secret_keys)  # validator index -> sk
+        self.pubkeys = {
+            i: C.g1_compress(B.sk_to_pk(sk)) for i, sk in self.sks.items()
+        }
+        self.slashing = SlashingProtection()
+
+    def sign_attestation(self, validator_index: int, data: dict) -> bytes:
+        pk = self.pubkeys[validator_index]
+        self.slashing.check_attestation(
+            pk, data["source"]["epoch"], data["target"]["epoch"]
+        )
+        slot = data["target"]["epoch"] * params.SLOTS_PER_EPOCH
+        root = self.config.compute_signing_root(
+            T.AttestationData.hash_tree_root(data),
+            self.config.get_domain(slot, params.DOMAIN_BEACON_ATTESTER, slot),
+        )
+        return C.g2_compress(B.sign(self.sks[validator_index], root))
+
+    def sign_block(self, validator_index: int, block: dict) -> bytes:
+        pk = self.pubkeys[validator_index]
+        self.slashing.check_block(pk, block["slot"])
+        root = self.config.compute_signing_root(
+            T.BeaconBlockAltair.hash_tree_root(block),
+            self.config.get_domain(
+                block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
+            ),
+        )
+        return C.g2_compress(B.sign(self.sks[validator_index], root))

@@ -66,7 +66,8 @@ CACHE = "/tmp/lodestar_tpu_replay_cache.pkl"
 
 def build_world(n_validators: int, distinct_keys: int, slots: int):
     """Keys, table, and per-(key, root) signatures; disk-cached."""
-    key = (n_validators, distinct_keys, slots)
+    # v2: wire format (compressed signature bytes, padded roots)
+    key = ("wire-v2", n_validators, distinct_keys, slots)
     if os.path.exists(CACHE):
         with open(CACHE, "rb") as f:
             cached = pickle.load(f)

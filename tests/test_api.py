@@ -1,0 +1,83 @@
+"""Beacon REST API: server + client round trips.
+
+Reference: packages/api (routes/client) + beacon-node/src/api/rest.
+"""
+
+import pytest
+
+from lodestar_tpu.api import ApiClient, BeaconApiServer
+from lodestar_tpu.api.client import ApiError
+from lodestar_tpu.api.routes import match
+from lodestar_tpu.api.server import DefaultHandlers
+from lodestar_tpu.network.gossip_queues import GossipType
+from lodestar_tpu.network.processor import NetworkProcessor, PendingGossipMessage
+from lodestar_tpu.utils.metrics import BlsPoolMetrics
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture
+def server():
+    proc = NetworkProcessor(lambda m: None, [lambda: False])
+    proc.queues[GossipType.beacon_attestation].add(
+        PendingGossipMessage(GossipType.beacon_attestation, None)
+    )
+    metrics = BlsPoolMetrics()
+    metrics.success_jobs.inc(7)
+    handlers = DefaultHandlers(
+        genesis_time=1606824023,
+        genesis_validators_root=b"\x4b" * 32,
+        processor=proc,
+        bls_metrics=metrics,
+        spec={"SECONDS_PER_SLOT": 12},
+    )
+    srv = BeaconApiServer(handlers)
+    srv.listen()
+    yield srv
+    srv.close()
+
+
+def client(srv):
+    return ApiClient([f"http://127.0.0.1:{srv.port}"])
+
+
+def test_route_matching():
+    r, p = match("GET", "/eth/v2/beacon/blocks/head")
+    assert r.handler == "get_block" and p == {"block_id": "head"}
+    assert match("GET", "/eth/v1/nope") is None
+    assert match("POST", "/eth/v1/node/health") is None  # wrong method
+
+
+def test_node_and_beacon_routes(server):
+    c = client(server)
+    assert c.get_version().startswith("lodestar-tpu")
+    assert c.get_syncing()["is_syncing"] is False
+    g = c.get_genesis()
+    assert g["genesis_time"] == "1606824023"
+    assert g["genesis_validators_root"] == "0x" + "4b" * 32
+    assert c.get_spec()["SECONDS_PER_SLOT"] == "12"
+
+
+def test_lodestar_introspection(server):
+    c = client(server)
+    q = c.dump_gossip_queue("beacon_attestation")
+    assert q["length"] == 1
+    m = c.get_bls_metrics()
+    assert m["success_jobs"] == 7.0
+
+
+def test_unknown_gossip_type_and_unimplemented(server):
+    c = client(server)
+    with pytest.raises(ApiError) as err:
+        c.dump_gossip_queue("not_a_topic")
+    assert err.value.status == 400
+    with pytest.raises(ApiError) as err:
+        c._request("GET", "/eth/v2/beacon/blocks/head")
+    assert err.value.status == 501  # handler not implemented in defaults
+
+
+def test_client_falls_back_across_base_urls(server):
+    c = ApiClient(
+        ["http://127.0.0.1:1", f"http://127.0.0.1:{server.port}"], timeout=2
+    )
+    assert c.get_version().startswith("lodestar-tpu")

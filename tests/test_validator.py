@@ -1,0 +1,136 @@
+"""Validator client: slashing protection, signing, duty execution.
+
+Reference: packages/validator/src/services/{validatorStore,attestation,
+attestationDuties}.ts and slashingProtection/.
+"""
+
+import pytest
+
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto import pairing as P
+from lodestar_tpu.validator import (
+    AttestationService,
+    SlashingError,
+    SlashingProtection,
+    ValidatorStore,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def att_data(slot=32, index=0, source=0, target=1):
+    return {
+        "slot": slot,
+        "index": index,
+        "beacon_block_root": b"\x01" * 32,
+        "source": {"epoch": source, "root": bytes(32)},
+        "target": {"epoch": target, "root": b"\x02" * 32},
+    }
+
+
+def make_store(n=2):
+    sks = {i: B.keygen(b"val-%d" % i) for i in range(n)}
+    return ValidatorStore(MAINNET_CHAIN_CONFIG, sks)
+
+
+# -- slashing protection ----------------------------------------------------
+
+
+def test_double_vote_rejected():
+    sp = SlashingProtection()
+    sp.check_attestation(b"k", 0, 5)
+    with pytest.raises(SlashingError):
+        sp.check_attestation(b"k", 1, 5)  # same target
+    with pytest.raises(SlashingError):
+        sp.check_attestation(b"k", 0, 4)  # older target
+
+
+def test_surround_vote_rejected():
+    sp = SlashingProtection()
+    sp.check_attestation(b"k", 3, 5)
+    with pytest.raises(SlashingError):
+        sp.check_attestation(b"k", 2, 6)  # surrounds (3,5)
+
+
+def test_block_double_proposal_rejected():
+    sp = SlashingProtection()
+    sp.check_block(b"k", 10)
+    with pytest.raises(SlashingError):
+        sp.check_block(b"k", 10)
+    sp.check_block(b"k", 11)
+
+
+def test_interchange_round_trip():
+    sp = SlashingProtection()
+    sp.check_attestation(b"\x01" * 48, 2, 7)
+    sp.check_block(b"\x01" * 48, 99)
+    data = sp.export_interchange()
+    sp2 = SlashingProtection()
+    sp2.import_interchange(data)
+    with pytest.raises(SlashingError):
+        sp2.check_attestation(b"\x01" * 48, 2, 7)  # already signed
+    with pytest.raises(SlashingError):
+        sp2.check_block(b"\x01" * 48, 99)
+
+
+# -- store signing ----------------------------------------------------------
+
+
+def test_sign_attestation_verifies_and_protects():
+    store = make_store(1)
+    data = att_data()
+    sig_bytes = store.sign_attestation(0, data)
+    # the signature verifies under the same domain/root
+    from lodestar_tpu import params, types as T
+
+    root = MAINNET_CHAIN_CONFIG.compute_signing_root(
+        T.AttestationData.hash_tree_root(data),
+        MAINNET_CHAIN_CONFIG.get_domain(32, params.DOMAIN_BEACON_ATTESTER, 32),
+    )
+    from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+
+    pk = B.sk_to_pk(store.sks[0])
+    sig = C.g2_decompress(sig_bytes)
+    assert P.multi_pairing_is_one(
+        [(pk, hash_to_g2(root)), (B.NEG_G1_GEN, sig)]
+    )
+    # re-signing the same target is slashable
+    with pytest.raises(SlashingError):
+        store.sign_attestation(0, data)
+
+
+# -- attestation service ----------------------------------------------------
+
+
+class StubApi:
+    def __init__(self):
+        self.duty_calls = []
+        self.submitted = []
+
+    def get_attester_duties(self, epoch, indices):
+        self.duty_calls.append((epoch, tuple(indices)))
+        return [
+            {"validator_index": i, "committee_index": i % 2, "slot": 32}
+            for i in indices
+        ]
+
+    def produce_attestation_data(self, committee_index, slot):
+        return att_data(slot=slot, index=committee_index)
+
+    def submit_pool_attestations(self, atts):
+        self.submitted.extend(atts)
+
+
+def test_attestation_duty_flow():
+    store = make_store(4)
+    api = StubApi()
+    svc = AttestationService(store, api)
+    svc.poll_duties(1)
+    assert api.duty_calls == [(1, (0, 1, 2, 3))]
+    n = svc.run_attestation_tasks(1, 32)
+    assert n == 4 and len(api.submitted) == 4
+    # repeated slot: every duty is now slashable -> nothing submitted
+    n2 = svc.run_attestation_tasks(1, 32)
+    assert n2 == 0 and svc.skipped_slashable == 4
