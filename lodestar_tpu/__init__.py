@@ -12,9 +12,16 @@ Layout (mirrors SURVEY.md section 2's component inventory):
   ops/       JAX einsum-path kernels (correctness cross-check of kernels/)
   bls/       the IBlsVerifier boundary: signature sets, batch semantics, retry
   state_transition/  epoch cache, shuffling, signature-set extractors
+  fork_choice/  proto-array LMD-GHOST + compute_deltas
+  chain/     seen caches, clock, block import pipeline
   network/   gossip queues + NetworkProcessor scheduling/backpressure
+  db/        bucketed repositories over the native ordered KV store
+  api/       beacon REST routes + server + client
+  validator/ duties, signing, slashing protection
+  light_client/  sync-committee header tracking
+  node.py    BeaconNode composition root
   utils/     queues, retry, logger, metrics (+ HTTP exposition server)
-  native/    C++ runtime components (batched SHA-256 merkleizer)
+  native/    C++ runtime components (SHA-256 merkleizer, KV store)
 """
 
 __version__ = "0.1.0"

@@ -2,6 +2,7 @@
 `lodestar_tpu.bls`).  Reference: packages/beacon-node/src/chain/.
 """
 
+from .block_processor import BlockError, BlockProcessor  # noqa: F401
 from .clock import Clock  # noqa: F401
 from .seen_cache import (  # noqa: F401
     SeenAggregators,

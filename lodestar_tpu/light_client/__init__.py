@@ -1,0 +1,11 @@
+"""Light client: sync-committee-based header tracking.
+
+Mirror of the reference's `@lodestar/light-client` (reference:
+packages/light-client/src/index.ts + validation.ts): bootstrap from a
+trusted header + current sync committee, then advance optimistic and
+finalized headers by verifying LightClientUpdates — sync-committee
+BLS aggregate signatures over attested headers with a 2/3 participation
+threshold, next-committee rotation at period boundaries.
+"""
+
+from .lightclient import Lightclient, LightClientUpdate, ValidationError  # noqa: F401

@@ -29,6 +29,7 @@ struct Store {
   size_t log_records = 0;
 
   bool append(uint8_t op, const std::string& k, const std::string& v) {
+    if (!log) return false;  // compaction reopen failed: fail closed
     uint32_t klen = (uint32_t)k.size(), vlen = (uint32_t)v.size();
     if (fwrite(&op, 1, 1, log) != 1) return false;
     if (fwrite(&klen, 4, 1, log) != 1) return false;
@@ -118,7 +119,10 @@ int64_t kv_get(void* h, const uint8_t* k, uint32_t klen, uint8_t* out,
 
 uint64_t kv_count(void* h) { return ((Store*)h)->data.size(); }
 
-int kv_flush(void* h) { return fflush(((Store*)h)->log) == 0 ? 0 : -1; }
+int kv_flush(void* h) {
+  Store* s = (Store*)h;
+  return (s->log && fflush(s->log) == 0) ? 0 : -1;
+}
 
 // Rewrite the log as a compact snapshot of live records.  Every write
 // is checked BEFORE the snapshot replaces the WAL: a short write (disk

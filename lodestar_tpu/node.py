@@ -129,14 +129,14 @@ class BeaconNode:
             (epoch, validator_index) in self._pending_attesters
         ):
             return
-        # derived-value reuse per attestation data (the reference's
-        # SeenAttestationDatas): later messages with the same data key
-        # reuse the first message's signing root
-        root = self.seen_data.get(slot, data_key)
-        if root is None:
-            root = signing_root
-            self.seen_data.put(slot, data_key, root)
-        ws = WireSignatureSet.single(validator_index, root, signature)
+        # NOTE: the caller-supplied signing_root is used as-is — a
+        # SeenAttestationDatas substitution here would let the FIRST
+        # sender poison the root for every later honest attester.  The
+        # reference caches values DERIVED from the attestation data
+        # itself (committee indices, root computed from the data); that
+        # derivation lives with the extractors, and hash-to-curve reuse
+        # already happens in the verifier's MessageCache keyed by root.
+        ws = WireSignatureSet.single(validator_index, signing_root, signature)
         fut = self.bls.verify_signature_sets_async(
             [ws], VerifyOptions(batchable=True)
         )

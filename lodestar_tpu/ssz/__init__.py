@@ -34,5 +34,6 @@ from .core import (  # noqa: F401
     uint128,
     uint256,
     hash_tree_root,
+    is_valid_merkle_branch,
     merkleize_chunks,
 )

@@ -409,3 +409,20 @@ def _elems_root(elem: SszType, value, limit: Optional[int]) -> bytes:
 
 def hash_tree_root(sztype: SszType, value) -> bytes:
     return sztype.hash_tree_root(value)
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch: Sequence[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    """Spec is_valid_merkle_branch — proves `leaf` sits at generalized
+    index (2**depth + index) under `root` (used by the light client to
+    bind next_sync_committee / finalized_header to the attested state)."""
+    if len(branch) != depth:
+        return False
+    node = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            node = digest(branch[i] + node)
+        else:
+            node = digest(node + branch[i])
+    return node == root

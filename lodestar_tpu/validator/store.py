@@ -65,25 +65,33 @@ class SlashingProtection:
 
     # EIP-3076 interchange (reference: slashingProtection/interchange/)
     def export_interchange(self) -> dict:
-        return {
-            "metadata": {"interchange_format_version": "5"},
-            "data": [
+        pubkeys = set(self._atts) | set(self._blocks)
+        data = []
+        for pk in sorted(pubkeys):
+            rec = self._atts.get(pk)
+            data.append(
                 {
                     "pubkey": "0x" + pk.hex(),
-                    "signed_attestations": [
-                        {
-                            "source_epoch": str(rec.source),
-                            "target_epoch": str(rec.target),
-                        }
-                    ],
+                    "signed_attestations": (
+                        [
+                            {
+                                "source_epoch": str(rec.source),
+                                "target_epoch": str(rec.target),
+                            }
+                        ]
+                        if rec is not None
+                        else []
+                    ),
                     "signed_blocks": (
                         [{"slot": str(self._blocks[pk])}]
                         if pk in self._blocks
                         else []
                     ),
                 }
-                for pk, rec in self._atts.items()
-            ],
+            )
+        return {
+            "metadata": {"interchange_format_version": "5"},
+            "data": data,
         }
 
     def import_interchange(self, data: dict) -> None:

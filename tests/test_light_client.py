@@ -1,0 +1,184 @@
+"""Light client: update validation + header advancement.
+
+Reference: packages/light-client/src/{index,validation}.ts.  Uses the
+minimal preset's 32-member sync committee via monkeypatched size? No —
+builds a small committee directly (size is whatever the bits carry, the
+client checks bits length against the committee it holds).
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.light_client import (
+    Lightclient,
+    LightClientUpdate,
+    ValidationError,
+)
+from lodestar_tpu.light_client.lightclient import sync_period
+from lodestar_tpu.types import BeaconBlockHeader
+
+pytestmark = pytest.mark.smoke
+
+N = 8  # small committee for test speed
+
+
+def header(slot, tag=0):
+    return {
+        "slot": slot,
+        "proposer_index": 0,
+        "parent_root": bytes([tag]) * 32,
+        "state_root": bytes(32),
+        "body_root": bytes(32),
+    }
+
+
+@pytest.fixture
+def world():
+    sks = [B.keygen(b"lc-%d" % i) for i in range(N)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    lc = Lightclient(MAINNET_CHAIN_CONFIG, header(0), pks)
+    return sks, pks, lc
+
+
+def signed_update(sks, attested, signature_slot, bits=None, **kw):
+    bits = bits if bits is not None else [True] * N
+    root = MAINNET_CHAIN_CONFIG.compute_signing_root(
+        BeaconBlockHeader.hash_tree_root(attested),
+        MAINNET_CHAIN_CONFIG.get_domain(
+            signature_slot,
+            params.DOMAIN_SYNC_COMMITTEE,
+            max(signature_slot, 1) - 1,
+        ),
+    )
+    sig = B.aggregate_signatures(
+        [B.sign(sk, root) for sk, b in zip(sks, bits) if b]
+    )
+    return LightClientUpdate(
+        attested_header=attested,
+        sync_committee_bits=bits,
+        sync_committee_signature=C.g2_compress(sig),
+        signature_slot=signature_slot,
+        **kw,
+    )
+
+
+def test_valid_update_advances_optimistic(world):
+    sks, _pks, lc = world
+    up = signed_update(sks, header(5, 1), 6)
+    lc.process_update(up)
+    assert lc.optimistic_header["slot"] == 5
+    assert lc.finalized_header["slot"] == 0
+
+
+def test_finalized_header_advances(world):
+    sks, _pks, lc = world
+    up = signed_update(sks, header(9, 2), 10, finalized_header=header(3, 3))
+    lc.process_update(up)
+    assert lc.finalized_header["slot"] == 3
+
+
+def test_insufficient_participation_rejected(world):
+    sks, _pks, lc = world
+    bits = [True] * (N // 2) + [False] * (N - N // 2)  # 50% < 2/3
+    up = signed_update(sks, header(5, 1), 6, bits=bits)
+    with pytest.raises(ValidationError):
+        lc.process_update(up)
+
+
+def test_wrong_signature_rejected(world):
+    sks, _pks, lc = world
+    up = signed_update(sks, header(5, 1), 6)
+    up.attested_header = header(5, 9)  # signature no longer matches
+    with pytest.raises(ValidationError):
+        lc.process_update(up)
+    assert lc.optimistic_header["slot"] == 0
+
+
+def test_partial_participation_verifies(world):
+    sks, _pks, lc = world
+    bits = [True] * 6 + [False] * 2  # 75% >= 2/3
+    up = signed_update(sks, header(7, 1), 8, bits=bits)
+    lc.process_update(up)
+    assert lc.optimistic_header["slot"] == 7
+
+
+def committee_proof(next_pks):
+    """Build (SyncCommittee value, branch, state_root) with a real
+    merkle binding (arbitrary sibling nodes; root derived from them)."""
+    import hashlib
+
+    from lodestar_tpu.light_client.lightclient import (
+        NEXT_SYNC_COMMITTEE_DEPTH,
+        NEXT_SYNC_COMMITTEE_INDEX,
+    )
+    from lodestar_tpu.types import SyncCommittee
+
+    # SyncCommittee.pubkeys is a fixed 512-vector: tile the test keys
+    full = (next_pks * (params.SYNC_COMMITTEE_SIZE // len(next_pks) + 1))[
+        : params.SYNC_COMMITTEE_SIZE
+    ]
+    value = {"pubkeys": full, "aggregate_pubkey": next_pks[0]}
+    leaf = SyncCommittee.hash_tree_root(value)
+    branch = [bytes([i + 1]) * 32 for i in range(NEXT_SYNC_COMMITTEE_DEPTH)]
+    node = leaf
+    for i in range(NEXT_SYNC_COMMITTEE_DEPTH):
+        if (NEXT_SYNC_COMMITTEE_INDEX >> i) & 1:
+            node = hashlib.sha256(branch[i] + node).digest()
+        else:
+            node = hashlib.sha256(node + branch[i]).digest()
+    return value, branch, node
+
+
+def test_next_committee_rotation_requires_proof(world):
+    sks, pks, lc = world
+    next_sks = [B.keygen(b"lc-next-%d" % i) for i in range(N)]
+    next_pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in next_sks]
+    value, branch, state_root = committee_proof(next_pks)
+    attested = header(5, 1)
+    attested["state_root"] = state_root
+    # without a branch: rejected
+    up = signed_update(sks, attested, 6, next_sync_committee=value)
+    with pytest.raises(ValidationError):
+        lc.process_update(up)
+    # tampered committee: rejected
+    bad_value = dict(value, aggregate_pubkey=next_pks[1 % len(next_pks)])
+    up_bad = signed_update(
+        sks, attested, 6,
+        next_sync_committee=bad_value,
+        next_sync_committee_branch=branch,
+    )
+    with pytest.raises(ValidationError):
+        lc.process_update(up_bad)
+    # correct proof: installed
+    up_ok = signed_update(
+        sks, attested, 6,
+        next_sync_committee=value,
+        next_sync_committee_branch=branch,
+    )
+    lc.process_update(up_ok)
+    assert sync_period(5) + 1 in lc.committees
+    # the rotated committee's keys are the tiled test keys
+    period_slots = params.SLOTS_PER_EPOCH * params.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    late_slot = period_slots + 2
+    tiled_sks = (next_sks * (params.SYNC_COMMITTEE_SIZE // N + 1))[
+        : params.SYNC_COMMITTEE_SIZE
+    ]
+    root2 = MAINNET_CHAIN_CONFIG.compute_signing_root(
+        BeaconBlockHeader.hash_tree_root(header(late_slot, 4)),
+        MAINNET_CHAIN_CONFIG.get_domain(
+            late_slot + 1, params.DOMAIN_SYNC_COMMITTEE, late_slot
+        ),
+    )
+    bits = [True] * params.SYNC_COMMITTEE_SIZE
+    sig = B.aggregate_signatures([B.sign(sk, root2) for sk in tiled_sks])
+    up2 = LightClientUpdate(
+        attested_header=header(late_slot, 4),
+        sync_committee_bits=bits,
+        sync_committee_signature=C.g2_compress(sig),
+        signature_slot=late_slot + 1,
+    )
+    lc.process_update(up2)
+    assert lc.optimistic_header["slot"] == late_slot
