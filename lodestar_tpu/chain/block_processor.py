@@ -50,7 +50,6 @@ class BlockProcessor:
         self.skip_proposer_signature = skip_proposer_signature
         self.log = get_logger("chain/blocks")
         self.imported = 0
-        self._imported_slots = set()
         self._queue = JobItemQueue(self._process_blocks, max_length=max_queue)
 
     def can_accept_work(self) -> bool:
@@ -69,26 +68,35 @@ class BlockProcessor:
         # Each block's root is published to the state view BEFORE the
         # next block's extraction, so an in-segment sync aggregate over
         # its parent resolves the correct root.
+        # remember what each published slot held before this segment, so
+        # ANY failure restores the exact prior state (including a prior
+        # imported root that a failing fork block temporarily shadowed)
+        _MISSING = object()
+        prior = {}
+        imported_here = set()
         futures = []
         extracted = []
         segment_roots = []
-        for signed in signed_blocks:
-            sets = get_block_signature_sets(
-                self.state,
-                signed,
-                skip_proposer_signature=self.skip_proposer_signature,
-            )
-            extracted.append(sets)
-            block = signed["message"]
-            root = BeaconBlockAltair.hash_tree_root(block)
-            segment_roots.append(root)
-            self.state.block_roots[block["slot"]] = root
-            futures.append(
-                self.bls.verify_signature_sets_async(sets)
-                if hasattr(self.bls, "verify_signature_sets_async")
-                else None
-            )
         try:
+            for signed in signed_blocks:
+                sets = get_block_signature_sets(
+                    self.state,
+                    signed,
+                    skip_proposer_signature=self.skip_proposer_signature,
+                )
+                extracted.append(sets)
+                block = signed["message"]
+                root = BeaconBlockAltair.hash_tree_root(block)
+                segment_roots.append(root)
+                slot = block["slot"]
+                if slot not in prior:
+                    prior[slot] = self.state.block_roots.get(slot, _MISSING)
+                self.state.block_roots[slot] = root
+                futures.append(
+                    self.bls.verify_signature_sets_async(sets)
+                    if hasattr(self.bls, "verify_signature_sets_async")
+                    else None
+                )
             roots = []
             for signed, root, sets, fut in zip(
                 signed_blocks, segment_roots, extracted, futures
@@ -104,16 +112,17 @@ class BlockProcessor:
                         f"slot {signed['message']['slot']}",
                     )
                 roots.append(self._import_block(signed, root))
+                imported_here.add(signed["message"]["slot"])
             return roots
-        except BlockError:
-            # roll back published roots of blocks that did not import
-            for signed, root in zip(signed_blocks, segment_roots):
-                slot = signed["message"]["slot"]
-                if (
-                    slot not in self._imported_slots
-                    and self.state.block_roots.get(slot) == root
-                ):
+        except BaseException:
+            # restore every published slot this segment did not import
+            for slot, prev in prior.items():
+                if slot in imported_here:
+                    continue
+                if prev is _MISSING:
                     self.state.block_roots.pop(slot, None)
+                else:
+                    self.state.block_roots[slot] = prev
             raise
 
     def _sanity_checks(self, signed_blocks: List[dict]) -> None:
@@ -134,7 +143,6 @@ class BlockProcessor:
             )
         if self.db is not None:
             self.db.put_block(root, signed)
-        self._imported_slots.add(block["slot"])
         self.imported += 1
         return root
 

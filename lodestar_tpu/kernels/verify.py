@@ -31,7 +31,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from ..crypto import curves as GC
 from ..crypto import fields as GT
@@ -163,23 +162,16 @@ def _k_sub_only(sx0, sx1, sy0, sy1, inf, osub):
     osub[...] = CV.g2_subgroup_check(q_aff, q_inf)[None, :].astype(jnp.int32)
 
 
-def _kroll(a, shift, axis=-1):
-    """Lane rotate inside kernels — pltpu.roll is the supported primitive
-    (jnp.roll-style lane gathers do not lower reliably in Mosaic)."""
-    return pltpu.roll(a, shift, axis=a.ndim - 1)
-
-
 def _k_sum_g2(x0, x1, y0, y1, z0, z1, inf,
               ax0, ax1, ay0, ay1, az0, az1, ainf):
     """Grid-accumulated jacobian sum over lanes, FULL [NL, BT] width.
 
-    Tiles accumulate lane-wise (elementwise jac_add_full); the last grid
-    step butterfly-reduces across lanes so EVERY lane holds the total.
-    All shapes stay [*, BT]: narrow/one-lane blocks hit unsupported
-    Mosaic layouts (see sum_points_lanes).
+    Tiles accumulate lane-wise (elementwise jac_add_full) to 128 partial
+    sums; the cross-lane butterfly runs OUTSIDE this kernel in plain XLA
+    (sum_points_lanes under the enclosing jit) — it is pure jnp code, and
+    keeping it out of Mosaic keeps the kernel compile small.
     """
     i = pl.program_id(0)
-    last = pl.num_programs(0) - 1
     pts = ((x0[...], x1[...]), (y0[...], y1[...]), (z0[...], z1[...]))
     infv = inf[...][0] != 0  # [BT] lane mask
 
@@ -203,22 +195,6 @@ def _k_sum_g2(x0, x1, y0, y1, z0, z1, inf,
         (ay0[...], ay1[...]) = t[1]
         (az0[...], az1[...]) = t[2]
         ainf[...] = t_inf[None, :].astype(jnp.int32)
-
-    @pl.when(i == last)
-    def _():
-        acc = (
-            (ax0[...], ax1[...]),
-            (ay0[...], ay1[...]),
-            (az0[...], az1[...]),
-        )
-        acc_inf = ainf[...][0] != 0
-        s, s_inf = CV.sum_points_lanes(
-            CV.FP2_OPS, acc, acc_inf, roll_fn=_kroll
-        )
-        (ax0[...], ax1[...]) = s[0]
-        (ay0[...], ay1[...]) = s[1]
-        (az0[...], az1[...]) = s[2]
-        ainf[...] = s_inf[None, :].astype(jnp.int32)
 
 
 def _k_affine_g2(x0, x1, y0, y1, z0, z1, inf, ax0, ax1, ay0, ay1, ainf):
@@ -278,11 +254,11 @@ def _unflatten_f12(leaves):
 def _k_prod(valid, *f_refs):
     """Grid-accumulated product of valid lanes, FULL [NL, BT] width.
 
-    Tiles multiply lane-wise; the last grid step butterfly-reduces so
-    every lane holds the product (same layout rationale as _k_sum_g2).
+    Tiles multiply lane-wise to 128 partial products; the cross-lane
+    butterfly runs outside in plain XLA (product12_lanes under the
+    enclosing jit — same rationale as _k_sum_g2).
     """
     i = pl.program_id(0)
-    last = pl.num_programs(0) - 1
     fN = _unflatten_f12([r[...] for r in f_refs[:12]])
     outs = f_refs[12:]
     v = valid[...][0] != 0  # [BT] lane mask
@@ -298,14 +274,6 @@ def _k_prod(valid, *f_refs):
     def _():
         acc = _unflatten_f12([r[...] for r in outs])
         t = TW.mul12(acc, tile)
-        for ref, leaf in zip(outs, jax.tree_util.tree_leaves(t)):
-            ref[...] = leaf
-
-    @pl.when(i == last)
-    def _():
-        acc = _unflatten_f12([r[...] for r in outs])
-        ones = jnp.ones(v.shape, bool)  # [BT]
-        t = KP.product12_lanes(acc, ones, roll_fn=_kroll)
         for ref, leaf in zip(outs, jax.tree_util.tree_leaves(t)):
             ref[...] = leaf
 
@@ -499,9 +467,19 @@ def _batch_core(
 
     # aggregate signature point: dead lanes excluded from the sum
     excl = (~live)[None, :].astype(jnp.int32) | rsinf
-    jx0, jx1, jy0, jy1, jz0, jz1, jinf = _sum_g2(
+    px0, px1, py0, py1, pz0, pz1, pinf = _sum_g2(
         sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, excl, n
     )
+    # cross-lane butterfly in plain XLA: 128 partials -> total in every lane
+    (jX, jY, jZ), j_inf = CV.sum_points_lanes(
+        CV.FP2_OPS,
+        ((px0, px1), (py0, py1), (pz0, pz1)),
+        pinf[0] != 0,
+    )
+    jx0, jx1 = jX
+    jy0, jy1 = jY
+    jz0, jz1 = jZ
+    jinf = j_inf[None, :].astype(jnp.int32)
     # [NL, BT] planes: every lane holds the aggregate point
     ax0, ax1, ay0, ay1, ainf = _tiled(
         _k_affine_g2,
@@ -533,7 +511,11 @@ def _batch_core(
         BT,
     )
 
-    fprod = _prod(fN, live_i, n)
+    fpartial = _prod(fN, live_i, n)
+    ones = jnp.ones((BT,), bool)
+    fprod = jax.tree_util.tree_leaves(
+        KP.product12_lanes(_unflatten_f12(fpartial), ones)
+    )
     ok2 = _tiled(
         _k_final_one,
         (ainf, *fprod, *fA),

@@ -31,6 +31,9 @@ class ValidationError(Exception):
 # gindex 55 = 2**5 + 23 (spec NEXT_SYNC_COMMITTEE_INDEX)
 NEXT_SYNC_COMMITTEE_DEPTH = 5
 NEXT_SYNC_COMMITTEE_INDEX = 23
+# Generalized index of finalized_checkpoint.root: gindex 105 = 2**6 + 41
+FINALIZED_ROOT_DEPTH = 6
+FINALIZED_ROOT_INDEX = 41
 
 
 @dataclass
@@ -48,6 +51,7 @@ class LightClientUpdate:
     sync_committee_signature: bytes  # 96B compressed
     signature_slot: int
     finalized_header: Optional[dict] = None
+    finality_branch: Optional[List[bytes]] = None
     next_sync_committee: Optional[dict] = None  # SyncCommittee value
     next_sync_committee_branch: Optional[List[bytes]] = None
 
@@ -135,6 +139,20 @@ class Lightclient:
                 update.attested_header["state_root"],
             ):
                 raise ValidationError("invalid next sync committee proof")
+        if update.finalized_header is not None:
+            # finality must be merkle-bound to the signed attested state
+            # root too (reference: validation.ts finality_branch check)
+            if update.finality_branch is None:
+                raise ValidationError("finalized header without branch")
+            leaf = BeaconBlockHeader.hash_tree_root(update.finalized_header)
+            if not is_valid_merkle_branch(
+                leaf,
+                update.finality_branch,
+                FINALIZED_ROOT_DEPTH,
+                FINALIZED_ROOT_INDEX,
+                update.attested_header["state_root"],
+            ):
+                raise ValidationError("invalid finality proof")
         if update.attested_header["slot"] > self.optimistic_header["slot"]:
             self.optimistic_header = dict(update.attested_header)
         if (

@@ -23,7 +23,7 @@ from .bls.service import BlsVerifierService
 from .bls.signature_set import WireSignatureSet
 from .bls.verifier import TpuBlsVerifier, VerifyOptions
 from .chain.clock import Clock
-from .chain.seen_cache import SeenAttestationDatas, SeenAttesters
+from .chain.seen_cache import SeenAttesters
 from .config.chain_config import ChainConfig
 from .db.beacon_db import BeaconDb
 from .fork_choice import ForkChoice, ProtoArray
@@ -68,7 +68,6 @@ class BeaconNode:
         self.bls = BlsVerifierService(verifier)
 
         self.seen_attesters = SeenAttesters()
-        self.seen_data = SeenAttestationDatas()
         self.processor = NetworkProcessor(
             self._validate_gossip_message,
             [self.bls.can_accept_work],

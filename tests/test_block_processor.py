@@ -118,6 +118,23 @@ def test_bad_proposer_signature_rejected(world):
     assert proc.imported == 0
 
 
+def test_failed_fork_block_restores_prior_root(world):
+    sks, state, _fc, _db, proc = world
+    b1 = make_block(sks, state, 1, 3, b"\x33" * 32)
+    r1 = T.BeaconBlockAltair.hash_tree_root(b1["message"])
+    proc.process_blocks([b1]).result(timeout=60)
+    assert state.get_block_root_at_slot(1) == r1
+    # a competing fork block at the SAME slot with a bad signature must
+    # not shadow the imported root after it fails
+    fork = make_block(sks, state, 1, 5, b"\x44" * 32)
+    sig = bytearray(fork["signature"])
+    sig[10] ^= 1
+    fork["signature"] = bytes(sig)
+    with pytest.raises(BlockError):
+        proc.process_blocks([fork]).result(timeout=60)
+    assert state.get_block_root_at_slot(1) == r1
+
+
 def test_non_increasing_slots_rejected(world):
     sks, state, _fc, _db, proc = world
     b1 = make_block(sks, state, 2, 3, b"\x33" * 32)

@@ -73,10 +73,51 @@ def test_valid_update_advances_optimistic(world):
     assert lc.finalized_header["slot"] == 0
 
 
-def test_finalized_header_advances(world):
+def finality_proof(finalized):
+    """(branch, state_root) binding finalized header -> attested state."""
+    import hashlib
+
+    from lodestar_tpu.light_client.lightclient import (
+        FINALIZED_ROOT_DEPTH,
+        FINALIZED_ROOT_INDEX,
+    )
+
+    leaf = BeaconBlockHeader.hash_tree_root(finalized)
+    branch = [bytes([0x40 + i]) * 32 for i in range(FINALIZED_ROOT_DEPTH)]
+    node = leaf
+    for i in range(FINALIZED_ROOT_DEPTH):
+        if (FINALIZED_ROOT_INDEX >> i) & 1:
+            node = hashlib.sha256(branch[i] + node).digest()
+        else:
+            node = hashlib.sha256(node + branch[i]).digest()
+    return branch, node
+
+
+def test_finalized_header_advances_with_proof(world):
     sks, _pks, lc = world
-    up = signed_update(sks, header(9, 2), 10, finalized_header=header(3, 3))
-    lc.process_update(up)
+    fin = header(3, 3)
+    branch, state_root = finality_proof(fin)
+    attested = header(9, 2)
+    attested["state_root"] = state_root
+    # without the branch: rejected
+    with pytest.raises(ValidationError):
+        lc.process_update(
+            signed_update(sks, attested, 10, finalized_header=fin)
+        )
+    # tampered finalized header: rejected
+    with pytest.raises(ValidationError):
+        lc.process_update(
+            signed_update(
+                sks, attested, 10,
+                finalized_header=header(4, 3),
+                finality_branch=branch,
+            )
+        )
+    lc.process_update(
+        signed_update(
+            sks, attested, 10, finalized_header=fin, finality_branch=branch
+        )
+    )
     assert lc.finalized_header["slot"] == 3
 
 
