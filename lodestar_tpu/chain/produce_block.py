@@ -1,0 +1,90 @@
+"""Block production — assemble, compute state root, (optionally) sign.
+
+Reference: packages/beacon-node/src/chain/produceBlock/produceBlockBody.ts
+(body assembly from op pools + eth1 vote + randao reveal) and
+chain/produceBlock/index.ts (block shell + post-state root).  The op
+pools live in chain/op_pools.py; this module is the pure assembly step
+shared by the beacon API's produceBlockV2 and the test utilities.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .. import params
+from ..state_transition import state_transition
+from ..state_transition.accessors import get_beacon_proposer_index
+from ..state_transition.slot import process_slots
+from ..types import BeaconBlockHeader
+
+P = params.ACTIVE_PRESET
+_G2_INFINITY = bytes([0xC0]) + b"\x00" * 95
+
+
+def default_sync_aggregate() -> Dict:
+    """Empty participation + infinity signature (valid under
+    eth_fast_aggregate_verify)."""
+    return {
+        "sync_committee_bits": [False] * P.SYNC_COMMITTEE_SIZE,
+        "sync_committee_signature": _G2_INFINITY,
+    }
+
+
+def produce_block_body(
+    state,
+    randao_reveal: bytes,
+    *,
+    graffiti: bytes = b"\x00" * 32,
+    attestations: Optional[List[Dict]] = None,
+    proposer_slashings: Optional[List[Dict]] = None,
+    attester_slashings: Optional[List[Dict]] = None,
+    deposits: Optional[List[Dict]] = None,
+    voluntary_exits: Optional[List[Dict]] = None,
+    sync_aggregate: Optional[Dict] = None,
+    eth1_data: Optional[Dict] = None,
+) -> Dict:
+    """Assemble an altair block body (reference produceBlockBody.ts)."""
+    body = {
+        "randao_reveal": randao_reveal,
+        "eth1_data": dict(eth1_data or state.eth1_data),
+        "graffiti": graffiti,
+        "proposer_slashings": list(proposer_slashings or []),
+        "attester_slashings": list(attester_slashings or []),
+        "attestations": list(attestations or []),
+        "deposits": list(deposits or []),
+        "voluntary_exits": list(voluntary_exits or []),
+        "sync_aggregate": dict(sync_aggregate or default_sync_aggregate()),
+    }
+    return body
+
+
+def produce_block(
+    state,
+    slot: int,
+    randao_reveal: bytes,
+    **body_kwargs,
+) -> Tuple[Dict, object]:
+    """Build an unsigned block at `slot` on top of `state`.
+
+    Returns (block_value, post_state); block.state_root is the real
+    post-state root, so signing it yields an importable block."""
+    pre = state.clone()
+    if pre.slot < slot:
+        process_slots(pre, slot)
+    proposer_index = get_beacon_proposer_index(pre)
+    parent_root = BeaconBlockHeader.hash_tree_root(pre.latest_block_header)
+    body = produce_block_body(pre, randao_reveal, **body_kwargs)
+    block = {
+        "slot": slot,
+        "proposer_index": proposer_index,
+        "parent_root": parent_root,
+        "state_root": b"\x00" * 32,
+        "body": body,
+    }
+    post = state_transition(
+        pre,
+        {"message": block, "signature": b"\x00" * 96},
+        verify_state_root=False,
+    )
+    block["state_root"] = post.hash_tree_root()
+    return block, post

@@ -44,6 +44,14 @@ class ChainConfig:
     # version/epoch per fork, in FORK_ORDER
     fork_versions: Dict[ForkName, bytes] = field(default_factory=dict)
     fork_epochs: Dict[ForkName, int] = field(default_factory=dict)
+    # Runtime (non-preset) spec values — reference keeps these in
+    # chainConfig/presets/{mainnet,minimal}.ts rather than the preset.
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY: int = 256
+    SHARD_COMMITTEE_PERIOD: int = 256
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    CHURN_LIMIT_QUOTIENT: int = 65536
 
     def __post_init__(self):
         self._domain_cache: Dict[Tuple[bytes, bytes], bytes] = {}
@@ -135,6 +143,8 @@ MAINNET_CHAIN_CONFIG = ChainConfig(
 
 MINIMAL_CHAIN_CONFIG = ChainConfig(
     config_name="minimal",
+    SHARD_COMMITTEE_PERIOD=64,
+    CHURN_LIMIT_QUOTIENT=32,
     fork_versions={
         ForkName.phase0: bytes.fromhex("00000001"),
         ForkName.altair: bytes.fromhex("01000001"),
@@ -160,8 +170,10 @@ def create_chain_config(
 ) -> ChainConfig:
     """Derive a config (the reference's createBeaconConfig: chain config +
     genesis validators root -> cached domains)."""
-    return ChainConfig(
-        config_name=base.config_name,
+    import dataclasses
+
+    return dataclasses.replace(
+        base,
         genesis_validators_root=(
             base.genesis_validators_root
             if genesis_validators_root is None

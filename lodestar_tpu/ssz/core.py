@@ -403,7 +403,18 @@ def _elems_root(elem: SszType, value, limit: Optional[int]) -> bytes:
             None if limit is None else (limit * elem.fixed_size + 31) // 32
         )
         return merkleize_chunks(_pack_bytes(data), chunk_limit)
-    chunks = [elem.hash_tree_root(v) for v in value]
+    if isinstance(elem, ByteVector) and elem.length == 32:
+        # a 32-byte vector's root IS its value — skip per-element
+        # merkleization (the Bytes32-vector hot path: block/state roots,
+        # randao mixes in the beacon state)
+        chunks = []
+        for v in value:
+            b = bytes(v)
+            if len(b) != 32:
+                raise ValueError(f"ByteVector[32]: got {len(b)}")
+            chunks.append(b)
+    else:
+        chunks = [elem.hash_tree_root(v) for v in value]
     return merkleize_chunks(chunks, limit)
 
 

@@ -1,11 +1,19 @@
-"""State-transition layer: epoch caches + signature-set extraction.
+"""State-transition layer — the full beacon state machine.
 
-The reference's `@lodestar/state-transition` is a 12.6k-LoC beacon state
-machine; the TPU build reproduces the parts on the signature path
-(SURVEY.md §7 scope guard):
+Mirror of the reference's `@lodestar/state-transition`
+(packages/state-transition/src/):
 
   - `util`: epoch/slot math, swap-or-not shuffling (vectorized numpy —
     whole-registry batch shuffles instead of per-index loops),
+  - `accessors`: spec get_* over the columnar state (seeds, committees,
+    proposer/sync-committee rejection sampling),
+  - `state`: BeaconState — altair, struct-of-arrays columns + SSZ view,
+  - `slot` / `block` / `epoch` / `transition`: processSlots,
+    processBlock (header/randao/eth1/operations/sync aggregate), the
+    fully vectorized epoch transition, and stateTransition() itself
+    (reference: stateTransition.ts:42-113, block/index.ts,
+    epoch/index.ts),
+  - `genesis`: interop-style genesis + the eth1 DepositTree,
   - `EpochCache`: committee assignments + validator pubkey table (the
     Index2PubkeyCache analog whose storage IS the device pubkey table),
   - `signature_sets`: getBlockSignatureSets and the per-object
@@ -14,6 +22,15 @@ machine; the TPU build reproduces the parts on the signature path
 """
 
 from .epoch_cache import EpochCache  # noqa: F401
+from .block import BlockProcessError, process_block  # noqa: F401
+from .epoch import process_epoch  # noqa: F401
+from .genesis import DepositTree, create_genesis_state  # noqa: F401
+from .slot import process_slot, process_slots  # noqa: F401
+from .state import BeaconState, BeaconStateAltair  # noqa: F401
+from .transition import (  # noqa: F401
+    state_transition,
+    verify_proposer_signature,
+)
 from .signature_sets import (  # noqa: F401
     get_aggregate_and_proof_signature_set,
     get_attestation_signature_sets,

@@ -1,0 +1,235 @@
+"""State accessors — spec get_* helpers over the columnar BeaconState.
+
+Reference: packages/state-transition/src/util/{seed,validator,balance}.ts
+and cache/epochContext.ts (proposer/committee/sync-committee selection).
+Everything registry-shaped is a vectorized numpy pass; the rejection-
+sampling loops (proposer, sync committee) draw candidates from the
+whole-epoch permutation computed once by `shuffled_positions`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import params
+from .util import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    shuffled_positions,
+)
+
+P = params.ACTIVE_PRESET
+FAR_FUTURE = params.FAR_FUTURE_EPOCH
+
+
+def integer_squareroot(n: int) -> int:
+    return math.isqrt(n)
+
+
+def uint_to_bytes(n: int, length: int = 8) -> bytes:
+    return int(n).to_bytes(length, "little")
+
+
+# -- validator status (vectorized; spec is_active_validator et al) ----------
+
+
+def active_mask(state, epoch: int) -> np.ndarray:
+    return (state.activation_epoch <= epoch) & (epoch < state.exit_epoch)
+
+
+def get_active_validator_indices(state, epoch: int) -> np.ndarray:
+    return np.nonzero(active_mask(state, epoch))[0].astype(np.int64)
+
+
+def is_slashable_validator_mask(state, epoch: int) -> np.ndarray:
+    return (
+        (~state.slashed)
+        & (state.activation_epoch <= epoch)
+        & (epoch < state.withdrawable_epoch)
+    )
+
+
+def get_total_balance(state, indices) -> int:
+    """max(EFFECTIVE_BALANCE_INCREMENT, sum of effective balances)."""
+    total = int(state.effective_balance[np.asarray(indices, np.int64)].sum())
+    return max(P.EFFECTIVE_BALANCE_INCREMENT, total)
+
+
+def get_total_active_balance(state) -> int:
+    epoch = compute_epoch_at_slot(state.slot)
+    return get_total_balance(state, get_active_validator_indices(state, epoch))
+
+
+def get_validator_churn_limit(state) -> int:
+    epoch = compute_epoch_at_slot(state.slot)
+    active = int(active_mask(state, epoch).sum())
+    return max(
+        state.config.MIN_PER_EPOCH_CHURN_LIMIT,
+        active // state.config.CHURN_LIMIT_QUOTIENT,
+    )
+
+
+# -- randao / seeds ---------------------------------------------------------
+
+
+def get_randao_mix(state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % P.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_seed(state, epoch: int, domain_type: bytes) -> bytes:
+    mix = get_randao_mix(
+        state,
+        (epoch + P.EPOCHS_PER_HISTORICAL_VECTOR - P.MIN_SEED_LOOKAHEAD - 1)
+        % P.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+    return hashlib.sha256(domain_type + uint_to_bytes(epoch) + mix).digest()
+
+
+# -- block roots ------------------------------------------------------------
+
+
+def get_block_root_at_slot(state, slot: int) -> bytes:
+    assert slot < state.slot <= slot + P.SLOTS_PER_HISTORICAL_ROOT, (
+        "slot outside block-roots window"
+    )
+    return state.block_roots[slot % P.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, epoch: int) -> bytes:
+    return get_block_root_at_slot(state, compute_start_slot_at_epoch(epoch))
+
+
+# -- proposer selection (spec compute_proposer_index) -----------------------
+
+
+def compute_proposer_index(state, indices: np.ndarray, seed: bytes) -> int:
+    """Rejection-sample a proposer weighted by effective balance.
+
+    The shuffled candidate order for ALL i is one `shuffled_positions`
+    permutation (vectorized); the loop only walks it until acceptance
+    (expected ~2 draws at full effective balance)."""
+    total = len(indices)
+    assert total > 0, "no active validators"
+    perm = shuffled_positions(total, seed)
+    eff = state.effective_balance
+    max_eff = P.MAX_EFFECTIVE_BALANCE
+    i = 0
+    while True:
+        candidate = int(indices[perm[i % total]])
+        rand_bytes = hashlib.sha256(seed + uint_to_bytes(i // 32)).digest()
+        random_byte = rand_bytes[i % 32]
+        if int(eff[candidate]) * 255 >= max_eff * random_byte:
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(state) -> int:
+    epoch = compute_epoch_at_slot(state.slot)
+    seed = hashlib.sha256(
+        get_seed(state, epoch, params.DOMAIN_BEACON_PROPOSER)
+        + uint_to_bytes(state.slot)
+    ).digest()
+    # Memoized per (slot, seed): block processing asks for the proposer
+    # many times per block (header, randao, attestation rewards, sync
+    # aggregate), each a full-registry shuffle without this.
+    cache = getattr(state, "_proposer_cache", None)
+    if cache and cache[0] == (state.slot, seed):
+        return cache[1]
+    indices = get_active_validator_indices(state, epoch)
+    proposer = compute_proposer_index(state, indices, seed)
+    state._proposer_cache = ((state.slot, seed), proposer)
+    return proposer
+
+
+# -- sync committee (spec get_next_sync_committee) --------------------------
+
+
+def get_next_sync_committee_indices(state) -> List[int]:
+    epoch = compute_epoch_at_slot(state.slot) + 1
+    indices = get_active_validator_indices(state, epoch)
+    total = len(indices)
+    assert total > 0, "no active validators"
+    seed = get_seed(state, epoch, params.DOMAIN_SYNC_COMMITTEE)
+    perm = shuffled_positions(total, seed)
+    eff = state.effective_balance
+    max_eff = P.MAX_EFFECTIVE_BALANCE
+    out: List[int] = []
+    i = 0
+    while len(out) < P.SYNC_COMMITTEE_SIZE:
+        candidate = int(indices[perm[i % total]])
+        rand_bytes = hashlib.sha256(seed + uint_to_bytes(i // 32)).digest()
+        random_byte = rand_bytes[i % 32]
+        if int(eff[candidate]) * 255 >= max_eff * random_byte:
+            out.append(candidate)
+        i += 1
+    return out
+
+
+def get_next_sync_committee(state) -> dict:
+    """SyncCommittee value {pubkeys, aggregate_pubkey} for the next period."""
+    from ..crypto import bls as _bls
+    from ..crypto import curves as _curves
+
+    indices = get_next_sync_committee_indices(state)
+    pubkeys = [state.pubkeys[i] for i in indices]
+    points = [_curves.g1_decompress(pk) for pk in pubkeys]
+    agg = _bls.aggregate_pubkeys(points)
+    return {
+        "pubkeys": pubkeys,
+        "aggregate_pubkey": _curves.g1_compress(agg),
+    }
+
+
+# -- committees (spec get_beacon_committee over the state) ------------------
+
+
+def get_committee_count_per_slot(state, epoch: int) -> int:
+    from .util import compute_committee_count_per_slot
+
+    return compute_committee_count_per_slot(
+        int(active_mask(state, epoch).sum())
+    )
+
+
+def get_beacon_committee(state, slot: int, index: int) -> np.ndarray:
+    """Committee `index` at `slot` (one shuffle per epoch, sliced)."""
+    epoch = compute_epoch_at_slot(slot)
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(state, epoch, params.DOMAIN_BEACON_ATTESTER)
+    per_slot = get_committee_count_per_slot(state, epoch)
+    assert 0 <= index < per_slot, "committee index out of range"
+    committees_per_epoch = per_slot * P.SLOTS_PER_EPOCH
+    committee_global = (slot % P.SLOTS_PER_EPOCH) * per_slot + index
+    n = len(indices)
+    # Memoize the whole-epoch shuffle on the state (one shuffle per epoch
+    # serves every attestation in it — the EpochContext caching idea).
+    cache = getattr(state, "_shuffle_cache", None)
+    if cache is None:
+        cache = {}
+        state._shuffle_cache = cache
+    key = (epoch, seed)
+    shuffled = cache.get(key)
+    if shuffled is None:
+        shuffled = indices[shuffled_positions(n, seed)]
+        cache[key] = shuffled
+        if len(cache) > 4:
+            cache.pop(next(iter(cache)))
+    start = n * committee_global // committees_per_epoch
+    end = n * (committee_global + 1) // committees_per_epoch
+    return shuffled[start:end]
+
+
+def get_attesting_indices(
+    state, data: dict, aggregation_bits: Sequence[bool]
+) -> List[int]:
+    committee = get_beacon_committee(state, data["slot"], data["index"])
+    assert len(aggregation_bits) == len(committee), (
+        "aggregation bits length != committee size"
+    )
+    return sorted(
+        int(v) for v, b in zip(committee, aggregation_bits) if b
+    )

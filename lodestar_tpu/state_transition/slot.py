@@ -1,0 +1,45 @@
+"""Slot processing + the multifork stateTransition entry.
+
+Reference: packages/state-transition/src/slot/index.ts (processSlot),
+stateTransition.ts (stateTransition / processSlots; the
+eth2fastspec-style "cache roots then maybe epoch-transition" loop).
+Fork upgrades are a no-op here because the TPU build's canonical state
+IS the altair family (minimal config activates altair at epoch 0);
+phase0 pre-states are out of the replay window this framework targets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import params
+from ..types import BeaconBlockHeader
+from .epoch import process_epoch
+
+P = params.ACTIVE_PRESET
+ZERO_ROOT = b"\x00" * 32
+
+
+def process_slot(state) -> None:
+    """Cache the state/block roots for the slot being closed."""
+    previous_state_root = state.hash_tree_root()
+    state.state_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = (
+        previous_state_root
+    )
+    if state.latest_block_header["state_root"] == ZERO_ROOT:
+        state.latest_block_header["state_root"] = previous_state_root
+    state.block_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = (
+        BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+    )
+
+
+def process_slots(state, slot: int, metrics: Optional[Dict] = None) -> None:
+    """Advance state (in place) through empty slots up to `slot`."""
+    assert state.slot < slot, (
+        f"process_slots target {slot} not beyond state slot {state.slot}"
+    )
+    while state.slot < slot:
+        process_slot(state)
+        if (state.slot + 1) % P.SLOTS_PER_EPOCH == 0:
+            process_epoch(state)
+        state.slot += 1

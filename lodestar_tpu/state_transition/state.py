@@ -1,0 +1,393 @@
+"""BeaconState — altair, struct-of-arrays working representation.
+
+The reference keeps the state as a persistent SSZ tree-of-nodes ViewDU
+(reference: packages/state-transition/src/cache/stateCache.ts, types
+re-exported via types/src/altair/sszTypes.ts BeaconState).  On TPU-era
+hardware the profitable layout is the opposite: the per-validator
+columns (balances, effective balances, participation flags, inactivity
+scores, activation/exit epochs) live as contiguous numpy vectors so the
+whole epoch transition is a handful of vectorized array passes instead
+of a per-validator interpreter loop.  SSZ view (serialize /
+hash_tree_root) is materialized on demand from the columns.
+
+Reference parity map:
+  - field set:        types/src/altair/sszTypes.ts (BeaconState)
+  - clone-on-write:   stateTransition.ts:59 (state.clone() before mutate)
+  - hashTreeRoot:     stateTransition.ts:101-104 (verifyStateRoot)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import params
+from ..config.chain_config import ChainConfig
+from ..ssz import Bitvector, Bytes32, Container, List as SszList, Vector, uint8, uint64
+from ..types import (
+    BeaconBlockHeader,
+    Checkpoint,
+    Eth1Data,
+    Fork,
+    SyncCommittee,
+    Validator,
+)
+
+P = params.ACTIVE_PRESET
+
+# Full altair BeaconState SSZ type (reference: types/src/altair/sszTypes.ts)
+BeaconStateAltair = Container(
+    (
+        ("genesis_time", uint64),
+        ("genesis_validators_root", Bytes32),
+        ("slot", uint64),
+        ("fork", Fork),
+        ("latest_block_header", BeaconBlockHeader),
+        ("block_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ("state_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ("historical_roots", SszList(Bytes32, P.HISTORICAL_ROOTS_LIMIT)),
+        ("eth1_data", Eth1Data),
+        (
+            "eth1_data_votes",
+            SszList(
+                Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH
+            ),
+        ),
+        ("eth1_deposit_index", uint64),
+        ("validators", SszList(Validator, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("balances", SszList(uint64, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("randao_mixes", Vector(Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR)),
+        ("slashings", Vector(uint64, P.EPOCHS_PER_SLASHINGS_VECTOR)),
+        (
+            "previous_epoch_participation",
+            SszList(uint8, P.VALIDATOR_REGISTRY_LIMIT),
+        ),
+        (
+            "current_epoch_participation",
+            SszList(uint8, P.VALIDATOR_REGISTRY_LIMIT),
+        ),
+        ("justification_bits", Bitvector(params.JUSTIFICATION_BITS_LENGTH)),
+        ("previous_justified_checkpoint", Checkpoint),
+        ("current_justified_checkpoint", Checkpoint),
+        ("finalized_checkpoint", Checkpoint),
+        ("inactivity_scores", SszList(uint64, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("current_sync_committee", SyncCommittee),
+        ("next_sync_committee", SyncCommittee),
+    ),
+    name="BeaconStateAltair",
+)
+
+_U64 = np.uint64
+FAR_FUTURE = params.FAR_FUTURE_EPOCH
+
+
+@dataclass
+class BeaconState:
+    """Mutable working state; columns are numpy, the rest plain Python."""
+
+    config: ChainConfig
+    genesis_time: int = 0
+    genesis_validators_root: bytes = b"\x00" * 32
+    slot: int = 0
+    fork: Dict = field(
+        default_factory=lambda: Fork.default()
+    )
+    latest_block_header: Dict = field(
+        default_factory=lambda: BeaconBlockHeader.default()
+    )
+    block_roots: List[bytes] = field(
+        default_factory=lambda: [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+    )
+    state_roots: List[bytes] = field(
+        default_factory=lambda: [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+    )
+    historical_roots: List[bytes] = field(default_factory=list)
+    eth1_data: Dict = field(default_factory=lambda: Eth1Data.default())
+    eth1_data_votes: List[Dict] = field(default_factory=list)
+    eth1_deposit_index: int = 0
+    # -- validator registry, struct-of-arrays ------------------------------
+    pubkeys: List[bytes] = field(default_factory=list)
+    withdrawal_credentials: List[bytes] = field(default_factory=list)
+    effective_balance: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, _U64)
+    )
+    slashed: np.ndarray = field(default_factory=lambda: np.zeros(0, bool))
+    activation_eligibility_epoch: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, _U64)
+    )
+    activation_epoch: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, _U64)
+    )
+    exit_epoch: np.ndarray = field(default_factory=lambda: np.zeros(0, _U64))
+    withdrawable_epoch: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, _U64)
+    )
+    balances: np.ndarray = field(default_factory=lambda: np.zeros(0, _U64))
+    # ----------------------------------------------------------------------
+    randao_mixes: List[bytes] = field(
+        default_factory=lambda: [b"\x00" * 32] * P.EPOCHS_PER_HISTORICAL_VECTOR
+    )
+    slashings: np.ndarray = field(
+        default_factory=lambda: np.zeros(P.EPOCHS_PER_SLASHINGS_VECTOR, _U64)
+    )
+    previous_epoch_participation: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.uint8)
+    )
+    current_epoch_participation: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.uint8)
+    )
+    justification_bits: List[bool] = field(
+        default_factory=lambda: [False] * params.JUSTIFICATION_BITS_LENGTH
+    )
+    previous_justified_checkpoint: Dict = field(
+        default_factory=lambda: Checkpoint.default()
+    )
+    current_justified_checkpoint: Dict = field(
+        default_factory=lambda: Checkpoint.default()
+    )
+    finalized_checkpoint: Dict = field(
+        default_factory=lambda: Checkpoint.default()
+    )
+    inactivity_scores: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, _U64)
+    )
+    current_sync_committee: Dict = field(
+        default_factory=lambda: SyncCommittee.default()
+    )
+    next_sync_committee: Dict = field(
+        default_factory=lambda: SyncCommittee.default()
+    )
+
+    # -- registry ----------------------------------------------------------
+
+    @property
+    def num_validators(self) -> int:
+        return len(self.pubkeys)
+
+    def pubkey_index(self, pubkey: bytes) -> Optional[int]:
+        """O(1) pubkey → validator index (the pubkey2index cache; lazily
+        built, incrementally maintained by add_validator)."""
+        m = getattr(self, "_pubkey_map", None)
+        if m is None or len(m) != len(self.pubkeys):
+            m = {pk: i for i, pk in enumerate(self.pubkeys)}
+            self._pubkey_map = m
+        return m.get(bytes(pubkey))
+
+    def add_validator(
+        self,
+        pubkey: bytes,
+        withdrawal_credential: bytes,
+        amount: int,
+        *,
+        effective_balance: Optional[int] = None,
+        activation_eligibility_epoch: int = FAR_FUTURE,
+        activation_epoch: int = FAR_FUTURE,
+        exit_epoch: int = FAR_FUTURE,
+        withdrawable_epoch: int = FAR_FUTURE,
+    ) -> int:
+        """Append a validator (spec add_validator_to_registry)."""
+        if effective_balance is None:
+            effective_balance = min(
+                amount - amount % P.EFFECTIVE_BALANCE_INCREMENT,
+                P.MAX_EFFECTIVE_BALANCE,
+            )
+        self.pubkeys.append(bytes(pubkey))
+        self.withdrawal_credentials.append(bytes(withdrawal_credential))
+        m = getattr(self, "_pubkey_map", None)
+        if m is not None and len(m) == len(self.pubkeys) - 1:
+            m[bytes(pubkey)] = len(self.pubkeys) - 1
+
+        def _app(arr, v, dtype=_U64):
+            return np.append(arr, np.asarray([v], dtype))
+
+        self.effective_balance = _app(self.effective_balance, effective_balance)
+        self.slashed = _app(self.slashed, False, bool)
+        self.activation_eligibility_epoch = _app(
+            self.activation_eligibility_epoch, activation_eligibility_epoch
+        )
+        self.activation_epoch = _app(self.activation_epoch, activation_epoch)
+        self.exit_epoch = _app(self.exit_epoch, exit_epoch)
+        self.withdrawable_epoch = _app(
+            self.withdrawable_epoch, withdrawable_epoch
+        )
+        self.balances = _app(self.balances, amount)
+        self.previous_epoch_participation = _app(
+            self.previous_epoch_participation, 0, np.uint8
+        )
+        self.current_epoch_participation = _app(
+            self.current_epoch_participation, 0, np.uint8
+        )
+        self.inactivity_scores = _app(self.inactivity_scores, 0)
+        return self.num_validators - 1
+
+    def increase_balance(self, index: int, delta: int) -> None:
+        self.balances[index] = _U64(int(self.balances[index]) + int(delta))
+
+    def decrease_balance(self, index: int, delta: int) -> None:
+        self.balances[index] = _U64(
+            max(0, int(self.balances[index]) - int(delta))
+        )
+
+    # -- clone / SSZ view --------------------------------------------------
+
+    def clone(self) -> "BeaconState":
+        """Deep copy (the reference's state.clone() before mutation)."""
+        import copy
+
+        out = BeaconState(config=self.config)
+        out.genesis_time = self.genesis_time
+        out.genesis_validators_root = self.genesis_validators_root
+        out.slot = self.slot
+        out.fork = copy.deepcopy(self.fork)
+        out.latest_block_header = copy.deepcopy(self.latest_block_header)
+        out.block_roots = list(self.block_roots)
+        out.state_roots = list(self.state_roots)
+        out.historical_roots = list(self.historical_roots)
+        out.eth1_data = copy.deepcopy(self.eth1_data)
+        out.eth1_data_votes = copy.deepcopy(self.eth1_data_votes)
+        out.eth1_deposit_index = self.eth1_deposit_index
+        out.pubkeys = list(self.pubkeys)
+        out.withdrawal_credentials = list(self.withdrawal_credentials)
+        for col in (
+            "effective_balance",
+            "slashed",
+            "activation_eligibility_epoch",
+            "activation_epoch",
+            "exit_epoch",
+            "withdrawable_epoch",
+            "balances",
+            "slashings",
+            "previous_epoch_participation",
+            "current_epoch_participation",
+            "inactivity_scores",
+        ):
+            setattr(out, col, getattr(self, col).copy())
+        out.randao_mixes = list(self.randao_mixes)
+        out.justification_bits = list(self.justification_bits)
+        out.previous_justified_checkpoint = dict(
+            self.previous_justified_checkpoint
+        )
+        out.current_justified_checkpoint = dict(
+            self.current_justified_checkpoint
+        )
+        out.finalized_checkpoint = dict(self.finalized_checkpoint)
+        out.current_sync_committee = copy.deepcopy(self.current_sync_committee)
+        out.next_sync_committee = copy.deepcopy(self.next_sync_committee)
+        return out
+
+    def validators_value(self) -> List[Dict]:
+        return [
+            {
+                "pubkey": self.pubkeys[i],
+                "withdrawal_credentials": self.withdrawal_credentials[i],
+                "effective_balance": int(self.effective_balance[i]),
+                "slashed": bool(self.slashed[i]),
+                "activation_eligibility_epoch": int(
+                    self.activation_eligibility_epoch[i]
+                ),
+                "activation_epoch": int(self.activation_epoch[i]),
+                "exit_epoch": int(self.exit_epoch[i]),
+                "withdrawable_epoch": int(self.withdrawable_epoch[i]),
+            }
+            for i in range(self.num_validators)
+        ]
+
+    def to_value(self) -> Dict:
+        """Materialize the SSZ container value."""
+        return {
+            "genesis_time": self.genesis_time,
+            "genesis_validators_root": self.genesis_validators_root,
+            "slot": self.slot,
+            "fork": self.fork,
+            "latest_block_header": self.latest_block_header,
+            "block_roots": list(self.block_roots),
+            "state_roots": list(self.state_roots),
+            "historical_roots": list(self.historical_roots),
+            "eth1_data": self.eth1_data,
+            "eth1_data_votes": list(self.eth1_data_votes),
+            "eth1_deposit_index": self.eth1_deposit_index,
+            "validators": self.validators_value(),
+            "balances": [int(b) for b in self.balances],
+            "randao_mixes": list(self.randao_mixes),
+            "slashings": [int(s) for s in self.slashings],
+            "previous_epoch_participation": [
+                int(x) for x in self.previous_epoch_participation
+            ],
+            "current_epoch_participation": [
+                int(x) for x in self.current_epoch_participation
+            ],
+            "justification_bits": list(self.justification_bits),
+            "previous_justified_checkpoint": self.previous_justified_checkpoint,
+            "current_justified_checkpoint": self.current_justified_checkpoint,
+            "finalized_checkpoint": self.finalized_checkpoint,
+            "inactivity_scores": [int(x) for x in self.inactivity_scores],
+            "current_sync_committee": self.current_sync_committee,
+            "next_sync_committee": self.next_sync_committee,
+        }
+
+    @classmethod
+    def from_value(cls, value: Dict, config: ChainConfig) -> "BeaconState":
+        st = cls(config=config)
+        st.genesis_time = value["genesis_time"]
+        st.genesis_validators_root = value["genesis_validators_root"]
+        st.slot = value["slot"]
+        st.fork = dict(value["fork"])
+        st.latest_block_header = dict(value["latest_block_header"])
+        st.block_roots = list(value["block_roots"])
+        st.state_roots = list(value["state_roots"])
+        st.historical_roots = list(value["historical_roots"])
+        st.eth1_data = dict(value["eth1_data"])
+        st.eth1_data_votes = [dict(v) for v in value["eth1_data_votes"]]
+        st.eth1_deposit_index = value["eth1_deposit_index"]
+        vals = value["validators"]
+        st.pubkeys = [v["pubkey"] for v in vals]
+        st.withdrawal_credentials = [
+            v["withdrawal_credentials"] for v in vals
+        ]
+        st.effective_balance = np.asarray(
+            [v["effective_balance"] for v in vals], _U64
+        )
+        st.slashed = np.asarray([v["slashed"] for v in vals], bool)
+        st.activation_eligibility_epoch = np.asarray(
+            [v["activation_eligibility_epoch"] for v in vals], _U64
+        )
+        st.activation_epoch = np.asarray(
+            [v["activation_epoch"] for v in vals], _U64
+        )
+        st.exit_epoch = np.asarray([v["exit_epoch"] for v in vals], _U64)
+        st.withdrawable_epoch = np.asarray(
+            [v["withdrawable_epoch"] for v in vals], _U64
+        )
+        st.balances = np.asarray(value["balances"], _U64)
+        st.randao_mixes = list(value["randao_mixes"])
+        st.slashings = np.asarray(value["slashings"], _U64)
+        st.previous_epoch_participation = np.asarray(
+            value["previous_epoch_participation"], np.uint8
+        )
+        st.current_epoch_participation = np.asarray(
+            value["current_epoch_participation"], np.uint8
+        )
+        st.justification_bits = list(value["justification_bits"])
+        st.previous_justified_checkpoint = dict(
+            value["previous_justified_checkpoint"]
+        )
+        st.current_justified_checkpoint = dict(
+            value["current_justified_checkpoint"]
+        )
+        st.finalized_checkpoint = dict(value["finalized_checkpoint"])
+        st.inactivity_scores = np.asarray(value["inactivity_scores"], _U64)
+        st.current_sync_committee = dict(value["current_sync_committee"])
+        st.next_sync_committee = dict(value["next_sync_committee"])
+        return st
+
+    def hash_tree_root(self) -> bytes:
+        return BeaconStateAltair.hash_tree_root(self.to_value())
+
+    def serialize(self) -> bytes:
+        return BeaconStateAltair.serialize(self.to_value())
+
+    @classmethod
+    def deserialize(cls, data: bytes, config: ChainConfig) -> "BeaconState":
+        return cls.from_value(BeaconStateAltair.deserialize(data), config)

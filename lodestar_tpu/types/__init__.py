@@ -48,6 +48,29 @@ Checkpoint = Container(
     name="Checkpoint",
 )
 
+Fork = Container(
+    (
+        ("previous_version", Version),
+        ("current_version", Version),
+        ("epoch", Epoch),
+    ),
+    name="Fork",
+)
+
+Validator = Container(
+    (
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("effective_balance", Gwei),
+        ("slashed", Boolean),
+        ("activation_eligibility_epoch", Epoch),
+        ("activation_epoch", Epoch),
+        ("exit_epoch", Epoch),
+        ("withdrawable_epoch", Epoch),
+    ),
+    name="Validator",
+)
+
 AttestationData = Container(
     (
         ("slot", Slot),
@@ -129,21 +152,29 @@ AttesterSlashing = Container(
     name="AttesterSlashing",
 )
 
+DepositDataType = Container(
+    (
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+        ("signature", BLSSignature),
+    ),
+    name="DepositData",
+)
+
+DepositMessage = Container(
+    (
+        ("pubkey", BLSPubkey),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", Gwei),
+    ),
+    name="DepositMessage",
+)
+
 Deposit = Container(
     (
         ("proof", Vector(Bytes32, params.DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
-        (
-            "data",
-            Container(
-                (
-                    ("pubkey", BLSPubkey),
-                    ("withdrawal_credentials", Bytes32),
-                    ("amount", Gwei),
-                    ("signature", BLSSignature),
-                ),
-                name="DepositData",
-            ),
-        ),
+        ("data", DepositDataType),
     ),
     name="Deposit",
 )
@@ -156,6 +187,14 @@ VoluntaryExit = Container(
 SignedVoluntaryExit = Container(
     (("message", VoluntaryExit), ("signature", BLSSignature)),
     name="SignedVoluntaryExit",
+)
+
+HistoricalBatch = Container(
+    (
+        ("block_roots", Vector(Root, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ("state_roots", Vector(Root, P.SLOTS_PER_HISTORICAL_ROOT)),
+    ),
+    name="HistoricalBatch",
 )
 
 Eth1Data = Container(

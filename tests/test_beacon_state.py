@@ -1,0 +1,621 @@
+"""Full beacon state transition: genesis → blocks → justification.
+
+Covers the reference's state-transition behavior surface
+(packages/state-transition/src/stateTransition.ts, block/, epoch/):
+slot/epoch advance, block application with attestations, participation
+flag accounting, justification, rewards/penalties, registry changes
+(deposits, exits, slashings), sync-aggregate rewards, and SSZ
+state-root verification.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.chain.produce_block import default_sync_aggregate, produce_block
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.state_transition import (
+    BeaconState,
+    DepositTree,
+    create_genesis_state,
+    process_epoch,
+    process_slots,
+    state_transition,
+    verify_proposer_signature,
+)
+from lodestar_tpu.state_transition.accessors import (
+    active_mask,
+    compute_proposer_index,
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+    get_seed,
+)
+from lodestar_tpu.state_transition.block import (
+    BlockProcessError,
+    get_deposit_signing_root,
+    is_valid_indexed_attestation,
+    process_deposit,
+    slash_validator,
+)
+from lodestar_tpu.state_transition.epoch import (
+    EpochTransitionCache,
+    process_effective_balance_updates,
+    weigh_justification_and_finalization,
+)
+from lodestar_tpu.state_transition.util import (
+    compute_epoch_at_slot,
+    compute_shuffled_index,
+)
+
+P = params.ACTIVE_PRESET
+N_KEYS = 64
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0},
+    )
+    sks = [B.keygen(b"stf-val-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    return cfg, sks, pks
+
+
+@pytest.fixture(scope="module")
+def genesis(world):
+    cfg, sks, pks = world
+    return create_genesis_state(cfg, pks, genesis_time=1234)
+
+
+def _fake_reveal(slot: int) -> bytes:
+    return hashlib.sha256(b"reveal-%d" % slot).digest() * 3
+
+
+def _sign_randao(state, sk, slot: int) -> bytes:
+    epoch = compute_epoch_at_slot(slot)
+    domain = state.config.get_domain(slot, params.DOMAIN_RANDAO)
+    root = state.config.compute_signing_root(
+        uint64.hash_tree_root(epoch), domain
+    )
+    return B.sign_bytes(sk, root)
+
+
+def _attest_head(post, head_root: bytes):
+    """Full-participation attestations for `post.slot` (all committees)."""
+    slot = post.slot
+    epoch = compute_epoch_at_slot(slot)
+    start = epoch * P.SLOTS_PER_EPOCH
+    target_root = (
+        head_root
+        if start >= post.slot
+        else get_block_root_at_slot(post, start)
+    )
+    atts = []
+    for index in range(get_committee_count_per_slot(post, epoch)):
+        committee = get_beacon_committee(post, slot, index)
+        atts.append(
+            {
+                "aggregation_bits": [True] * len(committee),
+                "data": {
+                    "slot": slot,
+                    "index": index,
+                    "beacon_block_root": head_root,
+                    "source": dict(post.current_justified_checkpoint),
+                    "target": {"epoch": epoch, "root": target_root},
+                },
+                "signature": bytes([0xC0]) + b"\x00" * 95,
+            }
+        )
+    return atts
+
+
+def _run_chain(genesis, sks, end_slot: int):
+    """Produce a block every slot [1, end_slot], full attestations."""
+    state = genesis
+    prev_post = genesis
+    prev_head = None
+    for slot in range(1, end_slot + 1):
+        atts = (
+            _attest_head(prev_post, prev_head) if prev_head is not None else []
+        )
+        block, post = produce_block(
+            state, slot, _fake_reveal(slot), attestations=atts
+        )
+        prev_head = T.BeaconBlockAltair.hash_tree_root(block)
+        state = post
+        prev_post = post
+    return state
+
+
+# -- genesis ----------------------------------------------------------------
+
+
+def test_genesis_sanity(genesis):
+    st = genesis
+    assert st.num_validators == N_KEYS
+    assert active_mask(st, 0).all()
+    assert len(st.current_sync_committee["pubkeys"]) == P.SYNC_COMMITTEE_SIZE
+    # aggregate pubkey is the sum of the member points
+    agg = B.aggregate_pubkeys(
+        [C.g1_decompress(pk) for pk in st.current_sync_committee["pubkeys"]]
+    )
+    assert C.g1_compress(agg) == st.current_sync_committee["aggregate_pubkey"]
+    proposer = get_beacon_proposer_index(st)
+    assert 0 <= proposer < N_KEYS
+
+
+def test_state_ssz_roundtrip(genesis):
+    data = genesis.serialize()
+    st2 = BeaconState.deserialize(data, genesis.config)
+    assert st2.hash_tree_root() == genesis.hash_tree_root()
+    assert st2.serialize() == data
+    assert st2.num_validators == genesis.num_validators
+    assert (st2.balances == genesis.balances).all()
+
+
+def test_clone_is_independent(genesis):
+    c = genesis.clone()
+    c.balances[0] += np.uint64(17)
+    c.slot = 5
+    assert genesis.slot == 0
+    assert int(genesis.balances[0]) != int(c.balances[0])
+    assert c.hash_tree_root() != genesis.hash_tree_root()
+
+
+# -- proposer selection differential ----------------------------------------
+
+
+def test_proposer_index_matches_scalar_spec(genesis):
+    st = genesis
+    epoch = 0
+    seed = hashlib.sha256(
+        get_seed(st, epoch, params.DOMAIN_BEACON_PROPOSER)
+        + (3).to_bytes(8, "little")
+    ).digest()
+    indices = np.nonzero(active_mask(st, epoch))[0].astype(np.int64)
+
+    # scalar spec loop
+    i = 0
+    total = len(indices)
+    while True:
+        cand = int(
+            indices[compute_shuffled_index(i % total, total, seed)]
+        )
+        byte = hashlib.sha256(
+            seed + (i // 32).to_bytes(8, "little")
+        ).digest()[i % 32]
+        if int(st.effective_balance[cand]) * 255 >= (
+            P.MAX_EFFECTIVE_BALANCE * byte
+        ):
+            expected = cand
+            break
+        i += 1
+    assert compute_proposer_index(st, indices, seed) == expected
+
+
+# -- empty slots / epochs ---------------------------------------------------
+
+
+def test_empty_epochs_penalize_idle_validators(genesis):
+    st = genesis.clone()
+    before = st.balances.copy()
+    process_slots(st, 3 * P.SLOTS_PER_EPOCH)
+    # nobody attested: every active validator loses balance
+    assert (st.balances < before).all()
+    # participation rotated to empty
+    assert st.current_epoch_participation.sum() == 0
+    assert st.previous_epoch_participation.sum() == 0
+
+
+# -- chain with full participation ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chain_3_epochs(genesis, world):
+    _, sks, _ = world
+    return _run_chain(genesis, sks, 3 * P.SLOTS_PER_EPOCH + 1)
+
+
+def test_chain_justifies(chain_3_epochs):
+    st = chain_3_epochs
+    # after the 2->3 boundary: epochs 1 and 2 justified this transition;
+    # previous_justified still carries the pre-boundary value (genesis)
+    assert int(st.current_justified_checkpoint["epoch"]) == 2
+    assert int(st.previous_justified_checkpoint["epoch"]) == 0
+    assert st.justification_bits[0] and st.justification_bits[1]
+
+
+def test_chain_rewards_participants(genesis, chain_3_epochs):
+    st = chain_3_epochs
+    # everyone attested every slot: balances grew despite idle sync rewards
+    assert (
+        st.balances.astype(np.int64) > genesis.balances.astype(np.int64)
+    ).sum() >= st.num_validators * 3 // 4
+
+
+def test_chain_block_roots_linked(chain_3_epochs):
+    st = chain_3_epochs
+    # every recorded block root differs from its predecessor (chain moved)
+    roots = [
+        get_block_root_at_slot(st, s)
+        for s in range(st.slot - 8, st.slot)
+    ]
+    assert len(set(roots)) == len(roots)
+
+
+# -- finality rules (unit) --------------------------------------------------
+
+
+def _mk_cache(state):
+    return EpochTransitionCache(state)
+
+
+def test_weigh_justification_finalizes_rule1(genesis):
+    st = genesis.clone()
+    process_slots(st, 4 * P.SLOTS_PER_EPOCH - 1)  # state.slot in epoch 3
+    cache = _mk_cache(st)
+    root = st.block_roots[0]
+    st.current_justified_checkpoint = {"epoch": 2, "root": root}
+    st.previous_justified_checkpoint = {"epoch": 2, "root": root}
+    st.justification_bits = [True, True, False, False]
+    total = 100
+    # current epoch target supermajority -> justify epoch 3, finalize 2
+    weigh_justification_and_finalization(st, cache, total, 0, 67)
+    assert int(st.current_justified_checkpoint["epoch"]) == 3
+    assert int(st.finalized_checkpoint["epoch"]) == 2
+
+
+def test_weigh_justification_no_supermajority(genesis):
+    st = genesis.clone()
+    process_slots(st, 4 * P.SLOTS_PER_EPOCH - 1)
+    cache = _mk_cache(st)
+    before = dict(st.current_justified_checkpoint)
+    weigh_justification_and_finalization(st, cache, 100, 50, 50)
+    assert st.current_justified_checkpoint == before
+    assert int(st.finalized_checkpoint["epoch"]) == 0
+
+
+# -- deposits ---------------------------------------------------------------
+
+
+def test_deposit_new_validator_and_topup(genesis, world):
+    cfg, sks, pks = world
+    st = genesis.clone()
+    tree = DepositTree()
+
+    new_sk = B.keygen(b"deposit-fresh")
+    new_pk = C.g1_compress(B.sk_to_pk(new_sk))
+    wc = b"\x00" * 32
+    data = {
+        "pubkey": new_pk,
+        "withdrawal_credentials": wc,
+        "amount": P.MAX_EFFECTIVE_BALANCE,
+        "signature": b"\x00" * 96,
+    }
+    root = get_deposit_signing_root(cfg, data)
+    data["signature"] = B.sign_bytes(new_sk, root)
+    tree.push(data)
+
+    topup = {
+        "pubkey": pks[0],
+        "withdrawal_credentials": wc,
+        "amount": 5 * 10**9,
+        "signature": b"\x00" * 96,  # top-ups skip signature verification
+    }
+    tree.push(topup)
+
+    st.eth1_data = {
+        "deposit_root": tree.root(),
+        "deposit_count": 2,
+        "block_hash": b"\x11" * 32,
+    }
+    st.eth1_deposit_index = 0
+
+    n0 = st.num_validators
+    bal0 = int(st.balances[0])
+    process_deposit(st, {"proof": tree.proof(0), "data": data})
+    process_deposit(st, {"proof": tree.proof(1), "data": topup})
+    assert st.num_validators == n0 + 1
+    assert st.pubkeys[-1] == new_pk
+    assert int(st.balances[0]) == bal0 + 5 * 10**9
+    # fresh validator not yet active
+    assert int(st.activation_epoch[-1]) == params.FAR_FUTURE_EPOCH
+
+
+def test_deposit_bad_signature_ignored(genesis, world):
+    cfg, _, _ = world
+    st = genesis.clone()
+    tree = DepositTree()
+    data = {
+        "pubkey": C.g1_compress(B.sk_to_pk(B.keygen(b"bad-dep"))),
+        "withdrawal_credentials": b"\x00" * 32,
+        "amount": P.MAX_EFFECTIVE_BALANCE,
+        "signature": b"\x00" * 95 + b"\x01",
+    }
+    tree.push(data)
+    st.eth1_data = {
+        "deposit_root": tree.root(),
+        "deposit_count": 1,
+        "block_hash": b"\x11" * 32,
+    }
+    st.eth1_deposit_index = 0
+    n0 = st.num_validators
+    process_deposit(st, {"proof": tree.proof(0), "data": data})
+    # index consumed, validator NOT added
+    assert st.eth1_deposit_index == 1
+    assert st.num_validators == n0
+
+
+def test_deposit_bad_proof_rejected(genesis):
+    st = genesis.clone()
+    tree = DepositTree()
+    data = {
+        "pubkey": b"\xaa" * 48,
+        "withdrawal_credentials": b"\x00" * 32,
+        "amount": 10**9,
+        "signature": b"\x00" * 96,
+    }
+    tree.push(data)
+    st.eth1_data = {
+        "deposit_root": b"\xff" * 32,
+        "deposit_count": 1,
+        "block_hash": b"\x11" * 32,
+    }
+    st.eth1_deposit_index = 0
+    with pytest.raises(BlockProcessError):
+        process_deposit(st, {"proof": tree.proof(0), "data": data})
+
+
+# -- slashing ---------------------------------------------------------------
+
+
+def test_slash_validator_accounting(genesis):
+    st = genesis.clone()
+    process_slots(st, 2)
+    proposer = get_beacon_proposer_index(st)
+    target = 7 if proposer != 7 else 8  # whistleblower must differ
+    eff = int(st.effective_balance[target])
+    bal0 = int(st.balances[target])
+    slash_validator(st, target)
+    assert bool(st.slashed[target])
+    assert int(st.exit_epoch[target]) != params.FAR_FUTURE_EPOCH
+    assert (
+        int(st.withdrawable_epoch[target])
+        >= compute_epoch_at_slot(st.slot) + P.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    assert int(st.balances[target]) == bal0 - eff // (
+        P.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    )
+    assert int(st.slashings.sum()) == eff
+
+
+def test_proposer_slashing_via_block(genesis, world):
+    cfg, sks, _ = world
+    st = genesis.clone()
+    process_slots(st, 1)
+    victim = 12
+    h = {
+        "slot": 1,
+        "proposer_index": victim,
+        "parent_root": b"\x01" * 32,
+        "state_root": b"\x02" * 32,
+        "body_root": b"\x03" * 32,
+    }
+    h2 = dict(h, body_root=b"\x04" * 32)
+
+    def _sign_header(header):
+        domain = cfg.get_domain(
+            st.slot, params.DOMAIN_BEACON_PROPOSER, header["slot"]
+        )
+        root = cfg.compute_signing_root(
+            T.BeaconBlockHeader.hash_tree_root(header), domain
+        )
+        return B.sign_bytes(sks[victim], root)
+
+    slashing = {
+        "signed_header_1": {"message": h, "signature": _sign_header(h)},
+        "signed_header_2": {"message": h2, "signature": _sign_header(h2)},
+    }
+    from lodestar_tpu.state_transition.block import process_proposer_slashing
+
+    process_proposer_slashing(st, slashing, True)
+    assert bool(st.slashed[victim])
+
+
+def test_attester_slashing_double_vote(genesis):
+    st = genesis.clone()
+    process_slots(st, 1)
+    data1 = {
+        "slot": 0,
+        "index": 0,
+        "beacon_block_root": b"\x0a" * 32,
+        "source": {"epoch": 0, "root": b"\x00" * 32},
+        "target": {"epoch": 0, "root": b"\x0b" * 32},
+    }
+    data2 = dict(data1, beacon_block_root=b"\x0c" * 32)
+    sl = {
+        "attestation_1": {
+            "attesting_indices": [3, 5],
+            "data": data1,
+            "signature": b"\x00" * 96,
+        },
+        "attestation_2": {
+            "attesting_indices": [5, 9],
+            "data": data2,
+            "signature": b"\x00" * 96,
+        },
+    }
+    from lodestar_tpu.state_transition.block import process_attester_slashing
+
+    process_attester_slashing(st, sl, False)
+    assert bool(st.slashed[5])
+    assert not bool(st.slashed[3]) and not bool(st.slashed[9])
+
+
+# -- voluntary exit ---------------------------------------------------------
+
+
+def test_voluntary_exit(genesis, world):
+    cfg, sks, _ = world
+    st = genesis.clone()
+    # age the chain past SHARD_COMMITTEE_PERIOD epochs for validator 0
+    target_epoch = cfg.SHARD_COMMITTEE_PERIOD
+    st.slot = target_epoch * P.SLOTS_PER_EPOCH
+    msg = {"epoch": target_epoch, "validator_index": 0}
+    domain = cfg.get_domain(
+        st.slot, params.DOMAIN_VOLUNTARY_EXIT, msg["epoch"] * P.SLOTS_PER_EPOCH
+    )
+    root = cfg.compute_signing_root(
+        T.VoluntaryExit.hash_tree_root(msg), domain
+    )
+    signed = {"message": msg, "signature": B.sign_bytes(sks[0], root)}
+    from lodestar_tpu.state_transition.block import process_voluntary_exit
+
+    process_voluntary_exit(st, signed, True)
+    assert int(st.exit_epoch[0]) != params.FAR_FUTURE_EPOCH
+
+    # a too-young validator cannot exit
+    st2 = genesis.clone()
+    st2.slot = P.SLOTS_PER_EPOCH
+    msg2 = {"epoch": 0, "validator_index": 1}
+    with pytest.raises(BlockProcessError):
+        process_voluntary_exit(
+            st2, {"message": msg2, "signature": b"\x00" * 96}, False
+        )
+
+
+# -- sync aggregate ---------------------------------------------------------
+
+
+def test_sync_aggregate_rewards_and_signature(genesis, world):
+    cfg, sks, pks = world
+    st = genesis.clone()
+    process_slots(st, 2)
+
+    # sign the previous block root with every committee member
+    from lodestar_tpu.state_transition.block import process_sync_aggregate
+
+    prev_slot = st.slot - 1
+    domain = cfg.get_domain(st.slot, params.DOMAIN_SYNC_COMMITTEE, prev_slot)
+    root = cfg.compute_signing_root(
+        get_block_root_at_slot(st, prev_slot), domain
+    )
+    sk_of = {pks[i]: sks[i] for i in range(len(sks))}
+    committee_sks = [
+        sk_of[pk] for pk in st.current_sync_committee["pubkeys"]
+    ]
+    sig = B.aggregate_signatures(
+        [B.sign(sk, root) for sk in committee_sks]
+    )
+    agg = {
+        "sync_committee_bits": [True] * P.SYNC_COMMITTEE_SIZE,
+        "sync_committee_signature": C.g2_compress(sig),
+    }
+    before = st.balances.copy()
+    process_sync_aggregate(st, agg, True)
+    assert (st.balances >= before).all()
+    assert (st.balances > before).any()
+
+    # wrong signature rejected
+    bad = dict(agg, sync_committee_signature=C.g2_compress(B.sign(sks[0], b"x")))
+    with pytest.raises(BlockProcessError):
+        process_sync_aggregate(st, bad, True)
+
+
+def test_sync_aggregate_empty_participation_valid(genesis):
+    st = genesis.clone()
+    process_slots(st, 2)
+    from lodestar_tpu.state_transition.block import process_sync_aggregate
+
+    before = st.balances.copy()
+    process_sync_aggregate(st, default_sync_aggregate(), True)
+    # all absent: every committee member penalized
+    assert (st.balances <= before).all()
+
+
+# -- effective balance hysteresis ------------------------------------------
+
+
+def test_effective_balance_hysteresis(genesis):
+    st = genesis.clone()
+    cache = EpochTransitionCache(st)
+    inc = P.EFFECTIVE_BALANCE_INCREMENT
+    st.balances[0] = np.uint64(P.MAX_EFFECTIVE_BALANCE - inc // 4 + 1)
+    st.balances[1] = np.uint64(P.MAX_EFFECTIVE_BALANCE - 2 * inc)
+    process_effective_balance_updates(st, cache)
+    # small dip: hysteresis holds effective balance
+    assert int(st.effective_balance[0]) == P.MAX_EFFECTIVE_BALANCE
+    # big dip: effective balance drops
+    assert int(st.effective_balance[1]) == P.MAX_EFFECTIVE_BALANCE - 2 * inc
+
+
+# -- block-level verification ----------------------------------------------
+
+
+def test_state_root_and_proposer_signature(genesis, world):
+    cfg, sks, _ = world
+    block, post = produce_block(genesis, 1, _fake_reveal(1))
+    proposer = block["proposer_index"]
+
+    # correct state root passes full verification
+    domain = cfg.get_domain(1, params.DOMAIN_BEACON_PROPOSER)
+    root = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block), domain
+    )
+    signed = {"message": block, "signature": B.sign_bytes(sks[proposer], root)}
+    post2 = state_transition(
+        genesis, signed, verify_state_root=True, verify_proposer=True
+    )
+    assert post2.hash_tree_root() == block["state_root"]
+    assert verify_proposer_signature(post2, signed)
+
+    # corrupted state root fails
+    bad = dict(block, state_root=b"\xde" * 32)
+    with pytest.raises(BlockProcessError):
+        state_transition(genesis, {"message": bad, "signature": b"\x00" * 96})
+
+    # wrong proposer signature fails
+    wrong = {"message": block, "signature": B.sign_bytes(sks[proposer], b"no")}
+    with pytest.raises(BlockProcessError):
+        state_transition(
+            genesis, wrong, verify_state_root=False, verify_proposer=True
+        )
+
+
+def test_indexed_attestation_signature(genesis, world):
+    cfg, sks, _ = world
+    st = genesis.clone()
+    process_slots(st, 2)
+    committee = get_beacon_committee(st, 1, 0)
+    data = {
+        "slot": 1,
+        "index": 0,
+        "beacon_block_root": get_block_root_at_slot(st, 1),
+        "source": dict(st.current_justified_checkpoint),
+        "target": {"epoch": 0, "root": get_block_root_at_slot(st, 0)},
+    }
+    domain = cfg.get_domain(st.slot, params.DOMAIN_BEACON_ATTESTER, 1)
+    root = cfg.compute_signing_root(
+        T.AttestationData.hash_tree_root(data), domain
+    )
+    sig = B.aggregate_signatures(
+        [B.sign(sks[int(v)], root) for v in committee]
+    )
+    indexed = {
+        "attesting_indices": sorted(int(v) for v in committee),
+        "data": data,
+        "signature": C.g2_compress(sig),
+    }
+    assert is_valid_indexed_attestation(st, indexed)
+    bad = dict(indexed, signature=C.g2_compress(B.sign(sks[0], b"zz")))
+    assert not is_valid_indexed_attestation(st, bad)
