@@ -64,8 +64,169 @@ class ApiClient:
         return self._request("GET", "/eth/v1/beacon/genesis")["data"]
 
     def submit_pool_attestations(self, attestations: list):
+        """Attestation SSZ values; JSON-encoded on the wire."""
+        from ..types import Attestation
+        from .encoding import to_json
+
         return self._request(
-            "POST", "/eth/v1/beacon/pool/attestations", attestations
+            "POST",
+            "/eth/v1/beacon/pool/attestations",
+            [to_json(Attestation, a) for a in attestations],
+        )
+
+    def publish_block(self, signed_block: dict):
+        """signed_block is an SSZ value; encoded to API JSON here."""
+        from ..types import SignedBeaconBlockAltair
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/beacon/blocks",
+            to_json(SignedBeaconBlockAltair, signed_block),
+        )
+
+    def get_finality_checkpoints(self, state_id: str = "head") -> dict:
+        return self._request(
+            "GET", f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+    def get_block(self, block_id: str = "head") -> dict:
+        from ..types import SignedBeaconBlockAltair
+        from .encoding import from_json
+
+        payload = self._request("GET", f"/eth/v2/beacon/blocks/{block_id}")
+        return from_json(SignedBeaconBlockAltair, payload["data"])
+
+    # -- validator ---------------------------------------------------------
+
+    def get_proposer_duties(self, epoch: int) -> list:
+        data = self._request(
+            "GET", f"/eth/v1/validator/duties/proposer/{epoch}"
+        )["data"]
+        return [
+            {
+                "validator_index": int(d["validator_index"]),
+                "pubkey": bytes.fromhex(d["pubkey"][2:]),
+                "slot": int(d["slot"]),
+            }
+            for d in data
+        ]
+
+    def get_attester_duties(self, epoch: int, indices: list) -> list:
+        data = self._request(
+            "POST",
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+        return [
+            {k: int(v) for k, v in d.items()} for d in data
+        ]
+
+    def get_sync_committee_duties(self, epoch: int, indices: list) -> list:
+        data = self._request(
+            "POST",
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+        return [
+            {
+                "validator_index": int(d["validator_index"]),
+                "positions": [
+                    int(p) for p in d["validator_sync_committee_indices"]
+                ],
+            }
+            for d in data
+        ]
+
+    def produce_block_v2(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+    ) -> dict:
+        from ..types import BeaconBlockAltair
+        from .encoding import from_json
+
+        payload = self._request(
+            "GET",
+            f"/eth/v2/validator/blocks/{slot}"
+            f"?randao_reveal=0x{randao_reveal.hex()}"
+            f"&graffiti=0x{graffiti.hex()}",
+        )
+        return from_json(BeaconBlockAltair, payload["data"])
+
+    def get_aggregate_attestation(self, slot: int, attestation_data_root: bytes):
+        from ..types import Attestation
+        from .encoding import from_json
+
+        try:
+            payload = self._request(
+                "GET",
+                "/eth/v1/validator/aggregate_attestation"
+                f"?slot={slot}"
+                f"&attestation_data_root=0x{attestation_data_root.hex()}",
+            )
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return from_json(Attestation, payload["data"])
+
+    def publish_aggregate_and_proofs(self, signed_aggregates: list):
+        from ..types import SignedAggregateAndProof
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/validator/aggregate_and_proofs",
+            [to_json(SignedAggregateAndProof, s) for s in signed_aggregates],
+        )
+
+    def produce_attestation_data(self, committee_index: int, slot: int) -> dict:
+        from ..types import AttestationData
+        from .encoding import from_json
+
+        payload = self._request(
+            "GET",
+            "/eth/v1/validator/attestation_data"
+            f"?committee_index={committee_index}&slot={slot}",
+        )
+        return from_json(AttestationData, payload["data"])
+
+    def produce_sync_contribution(
+        self, slot: int, beacon_block_root: bytes, subcommittee_index: int
+    ):
+        from ..types import SyncCommitteeContribution
+        from .encoding import from_json
+
+        try:
+            payload = self._request(
+                "GET",
+                "/eth/v1/validator/sync_committee_contribution"
+                f"?slot={slot}&subcommittee_index={subcommittee_index}"
+                f"&beacon_block_root=0x{beacon_block_root.hex()}",
+            )
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return from_json(SyncCommitteeContribution, payload["data"])
+
+    def publish_contribution_and_proof(self, signed: dict):
+        from ..types import SignedContributionAndProof
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/validator/contribution_and_proofs",
+            [to_json(SignedContributionAndProof, signed)],
+        )
+
+    def submit_sync_committee_messages(self, messages: list):
+        from ..types import SyncCommitteeMessage
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/beacon/pool/sync_committees",
+            [to_json(SyncCommitteeMessage, m) for m in messages],
         )
 
     # -- config ------------------------------------------------------------

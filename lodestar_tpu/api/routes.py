@@ -29,13 +29,43 @@ ROUTES: Tuple[Route, ...] = (
     Route("GET", "/eth/v1/beacon/genesis", "get_genesis"),
     Route("GET", "/eth/v1/beacon/headers/{block_id}", "get_block_header"),
     Route("GET", "/eth/v2/beacon/blocks/{block_id}", "get_block"),
+    Route("POST", "/eth/v1/beacon/blocks", "publish_block"),
     Route("POST", "/eth/v1/beacon/pool/attestations", "submit_attestations"),
+    Route(
+        "POST", "/eth/v1/beacon/pool/sync_committees", "submit_sync_committees"
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+        "get_finality_checkpoints",
+    ),
     # config namespace (reference: routes/config.ts)
     Route("GET", "/eth/v1/config/spec", "get_spec"),
     # validator namespace (reference: routes/validator.ts)
     Route("GET", "/eth/v1/validator/duties/proposer/{epoch}", "get_proposer_duties"),
     Route(
         "POST", "/eth/v1/validator/duties/attester/{epoch}", "get_attester_duties"
+    ),
+    Route("POST", "/eth/v1/validator/duties/sync/{epoch}", "get_sync_duties"),
+    Route("GET", "/eth/v1/validator/attestation_data", "produce_attestation_data"),
+    Route(
+        "GET", "/eth/v1/validator/aggregate_attestation", "get_aggregate_attestation"
+    ),
+    Route(
+        "POST",
+        "/eth/v1/validator/aggregate_and_proofs",
+        "publish_aggregate_and_proofs",
+    ),
+    Route("GET", "/eth/v2/validator/blocks/{slot}", "produce_block_v2"),
+    Route(
+        "GET",
+        "/eth/v1/validator/sync_committee_contribution",
+        "produce_sync_contribution",
+    ),
+    Route(
+        "POST",
+        "/eth/v1/validator/contribution_and_proofs",
+        "publish_contributions",
     ),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)
     Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),

@@ -28,6 +28,7 @@ class DefaultHandlers:
         processor=None,
         bls_metrics=None,
         spec: Optional[dict] = None,
+        chain=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -35,6 +36,7 @@ class DefaultHandlers:
         self.processor = processor
         self.bls_metrics = bls_metrics
         self.spec = spec or {}
+        self.chain = chain  # BeaconChain for the stateful endpoints
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -95,6 +97,274 @@ class DefaultHandlers:
             }
         }
 
+    # -- chain-backed endpoints (reference: api/impl/{beacon,validator}) ---
+
+    def _need_chain(self):
+        if self.chain is None:
+            return 501, {"message": "no chain attached"}
+        return None
+
+    def get_proposer_duties(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        duties = self.chain.get_proposer_duties(int(params["epoch"]))
+        return 200, {
+            "data": [
+                {
+                    "pubkey": "0x" + d["pubkey"].hex(),
+                    "validator_index": str(d["validator_index"]),
+                    "slot": str(d["slot"]),
+                }
+                for d in duties
+            ]
+        }
+
+    def get_attester_duties(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        indices = [int(i) for i in (body or [])]
+        duties = self.chain.get_attester_duties(int(params["epoch"]), indices)
+        return 200, {
+            "data": [
+                {k: str(v) for k, v in d.items()} for d in duties
+            ]
+        }
+
+    def get_sync_duties(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        indices = [int(i) for i in (body or [])]
+        duties = self.chain.get_sync_committee_duties(
+            int(params["epoch"]), indices
+        )
+        return 200, {
+            "data": [
+                {
+                    "validator_index": str(d["validator_index"]),
+                    "validator_sync_committee_indices": [
+                        str(p) for p in d["positions"]
+                    ],
+                }
+                for d in duties
+            ]
+        }
+
+    def produce_block_v2(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import BeaconBlockAltair
+        from .encoding import to_json
+
+        reveal = bytes.fromhex(params["randao_reveal"][2:])
+        graffiti = (
+            bytes.fromhex(params["graffiti"][2:])
+            if "graffiti" in params
+            else b"\x00" * 32
+        )
+        block = self.chain.produce_block(int(params["slot"]), reveal, graffiti)
+        return 200, {
+            "version": "altair",
+            "data": to_json(BeaconBlockAltair, block),
+        }
+
+    def publish_block(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedBeaconBlockAltair
+        from .encoding import from_json
+
+        signed = from_json(SignedBeaconBlockAltair, body)
+        self.chain.process_block(signed)
+        return 200, None
+
+    def submit_attestations(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import Attestation
+        from .encoding import from_json
+
+        for att_json in body or []:
+            self.chain.add_attestation(from_json(Attestation, att_json))
+        return 200, None
+
+    def submit_sync_committees(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SyncCommitteeMessage
+        from .encoding import from_json
+        from .. import params as _p
+
+        head = self.chain.head_state
+        subnet_size = (
+            _p.ACTIVE_PRESET.SYNC_COMMITTEE_SIZE
+            // _p.SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        # pubkey -> committee positions, built once per request
+        positions_of = {}
+        for pos, cpk in enumerate(head.current_sync_committee["pubkeys"]):
+            positions_of.setdefault(cpk, []).append(pos)
+        for msg_json in body or []:
+            msg = from_json(SyncCommitteeMessage, msg_json)
+            pk = head.pubkeys[msg["validator_index"]]
+            for pos in positions_of.get(pk, ()):
+                subnet, idx = divmod(pos, subnet_size)
+                self.chain.sync_committee_message_pool.add(subnet, msg, idx)
+        return 200, None
+
+    def produce_sync_contribution(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        contrib = self.chain.sync_committee_message_pool.get_contribution(
+            int(params["slot"]),
+            bytes.fromhex(params["beacon_block_root"][2:]),
+            int(params["subcommittee_index"]),
+        )
+        if contrib is None:
+            return 404, {"message": "no contribution available"}
+        from ..types import SyncCommitteeContribution
+        from .encoding import to_json
+
+        return 200, {"data": to_json(SyncCommitteeContribution, contrib)}
+
+    def publish_contributions(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedContributionAndProof
+        from .encoding import from_json
+
+        for signed_json in body or []:
+            signed = from_json(SignedContributionAndProof, signed_json)
+            self.chain.sync_contribution_pool.add(
+                signed["message"]["contribution"]
+            )
+        return 200, None
+
+    def produce_attestation_data(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import AttestationData
+        from .encoding import to_json
+
+        data = self.chain.produce_attestation_data(
+            int(params["committee_index"]), int(params["slot"])
+        )
+        return 200, {"data": to_json(AttestationData, data)}
+
+    def get_aggregate_attestation(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import Attestation
+        from .encoding import to_json
+
+        agg = self.chain.attestation_pool.get_aggregate(
+            int(params["slot"]),
+            bytes.fromhex(params["attestation_data_root"][2:]),
+        )
+        if agg is None:
+            return 404, {"message": "no matching aggregate"}
+        return 200, {"data": to_json(Attestation, agg)}
+
+    def publish_aggregate_and_proofs(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedAggregateAndProof
+        from .encoding import from_json
+
+        for signed_json in body or []:
+            signed = from_json(SignedAggregateAndProof, signed_json)
+            self.chain.add_aggregate(signed)
+        return 200, None
+
+    def get_finality_checkpoints(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        st = self.chain.head_state
+
+        def _cp(cp):
+            return {"epoch": str(cp["epoch"]), "root": "0x" + cp["root"].hex()}
+
+        return 200, {
+            "data": {
+                "previous_justified": _cp(st.previous_justified_checkpoint),
+                "current_justified": _cp(st.current_justified_checkpoint),
+                "finalized": _cp(st.finalized_checkpoint),
+            }
+        }
+
+    def _lookup_block(self, block_id: str):
+        """(root, signed_block_value) or an error tuple."""
+        if self.chain.db is None:
+            return None, (501, {"message": "no db attached"})
+        try:
+            root = self.chain.resolve_block_id(block_id)
+        except ValueError:
+            return None, (400, {"message": f"invalid block id {block_id}"})
+        if root is None:
+            return None, (404, {"message": "block not found"})
+        signed = self.chain.db.block.get(root)
+        if signed is None:
+            return None, (404, {"message": "block not found"})
+        return (root, signed), None
+
+    def get_block(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        found, err = self._lookup_block(params["block_id"])
+        if err:
+            return err
+        _root, signed = found
+        from ..types import SignedBeaconBlockAltair
+        from .encoding import to_json
+
+        return 200, {
+            "version": "altair",
+            "data": to_json(SignedBeaconBlockAltair, signed),
+        }
+
+    def get_block_header(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        found, err = self._lookup_block(params["block_id"])
+        if err:
+            return err
+        root, signed = found
+        from ..types import BeaconBlockBodyAltair
+        from .encoding import to_json
+
+        block = signed["message"]
+        body_root = BeaconBlockBodyAltair.hash_tree_root(block["body"])
+        return 200, {
+            "data": {
+                "root": "0x" + root.hex(),
+                "canonical": True,
+                "header": {
+                    "message": {
+                        "slot": str(block["slot"]),
+                        "proposer_index": str(block["proposer_index"]),
+                        "parent_root": "0x" + block["parent_root"].hex(),
+                        "state_root": "0x" + block["state_root"].hex(),
+                        "body_root": "0x" + body_root.hex(),
+                    },
+                    "signature": "0x" + signed["signature"].hex(),
+                },
+            }
+        }
+
 
 class BeaconApiServer:
     def __init__(self, handlers, host: str = "127.0.0.1", port: int = 0):
@@ -102,11 +372,18 @@ class BeaconApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _respond(self, method):
-                m = match(method, self.path.split("?")[0])
+                from urllib.parse import parse_qsl, urlsplit
+
+                split = urlsplit(self.path)
+                m = match(method, split.path)
                 if m is None:
                     self._send(404, {"message": "route not found"})
                     return
                 route, params = m
+                # query params merge under the path params (reference:
+                # fastify querystring handling)
+                for k, v in parse_qsl(split.query):
+                    params.setdefault(k, v)
                 fn = getattr(outer_handlers, route.handler, None)
                 if fn is None:
                     self._send(501, {"message": f"{route.handler} not implemented"})

@@ -1,0 +1,365 @@
+"""BeaconChain — the chain composition: STF import, head, duties, pools.
+
+Reference: packages/beacon-node/src/chain/chain.ts (BeaconChain: clock,
+fork choice, regen, state caches, op pools, emitter, produceBlock,
+verifier selection via opts.blsVerifier) and chain/blocks/importBlock.ts
+(import side effects: fork choice insert, head update, finalization
+pruning, emitter events).
+
+Two verification planes, as in the reference:
+  - per-block signatures: batched through the injected BLS verifier
+    (the TPU service) via the signature-set extractors when provided,
+    else checked inside the state transition by the CPU oracle;
+  - the state transition itself (always).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import params
+from ..config.chain_config import ChainConfig
+from ..state_transition import state_transition
+from ..state_transition.accessors import (
+    get_beacon_committee,
+    get_committee_count_per_slot,
+    get_proposer_indices_for_epoch,
+)
+from ..state_transition.slot import process_slots
+from ..state_transition.util import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+)
+from ..types import BeaconBlockAltair, BeaconBlockHeader
+from ..utils.logger import get_logger
+from .emitter import ChainEvent, ChainEventEmitter
+from .op_pools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    OpPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
+from .produce_block import produce_block_from_pools
+from .regen import StateRegenerator
+from .seen_cache import SeenAttesters
+from ..fork_choice import ForkChoice, ProtoArray
+
+P = params.ACTIVE_PRESET
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        config: ChainConfig,
+        anchor_state,
+        *,
+        db=None,
+        bls_verifier=None,
+        emitter: Optional[ChainEventEmitter] = None,
+    ):
+        self.config = config
+        self.log = get_logger("chain")
+        self.emitter = emitter or ChainEventEmitter()
+        self.db = db
+        self.bls = bls_verifier  # optional batched signature service
+
+        anchor_root = BeaconBlockHeader.hash_tree_root(
+            dict(
+                anchor_state.latest_block_header,
+                state_root=anchor_state.hash_tree_root(),
+            )
+        )
+        self.anchor_root_hex = anchor_root.hex()
+        self.fork_choice = ForkChoice(
+            ProtoArray(
+                self.anchor_root_hex,
+                finalized_slot=anchor_state.slot,
+            ),
+            justified_root=self.anchor_root_hex,
+        )
+        self.regen = StateRegenerator(self.fork_choice, db)
+        self.regen.on_imported_block(anchor_root, anchor_state)
+
+        self.head_root_hex = self.anchor_root_hex
+        self._finalized_epoch = int(
+            anchor_state.finalized_checkpoint["epoch"]
+        )
+
+        # op pools (reference chain.ts constructor)
+        self.attestation_pool = AttestationPool()
+        self.aggregated_attestation_pool = AggregatedAttestationPool()
+        self.op_pool = OpPool()
+        self.sync_committee_message_pool = SyncCommitteeMessagePool()
+        self.sync_contribution_pool = SyncContributionAndProofPool()
+        self.seen_attesters = SeenAttesters()
+
+        self.imported_blocks = 0
+
+    # -- head --------------------------------------------------------------
+
+    @property
+    def head_state(self):
+        return self.regen._get_post_state(self.head_root_hex)
+
+    def get_head_root(self, slot: Optional[int] = None) -> bytes:
+        return bytes.fromhex(self.head_root_hex)
+
+    # -- block import (reference importBlock.ts) ---------------------------
+
+    def process_block(self, signed_block: dict) -> bytes:
+        block = signed_block["message"]
+        root = BeaconBlockAltair.hash_tree_root(block)
+        if self.fork_choice.has_block(root.hex()):
+            return root  # already imported
+
+        pre_state = self.regen.get_pre_state(block)
+
+        if self.bls is not None:
+            ok = self._verify_signatures_batched(pre_state, signed_block)
+            if not ok:
+                raise ValueError("block signature verification failed")
+            post = state_transition(
+                pre_state,
+                signed_block,
+                verify_state_root=True,
+                verify_proposer=False,
+                verify_signatures=False,
+            )
+        else:
+            post = state_transition(
+                pre_state,
+                signed_block,
+                verify_state_root=True,
+                verify_proposer=True,
+                verify_signatures=True,
+            )
+
+        # land it (fork choice + caches + db)
+        self.fork_choice.on_block(
+            block["slot"],
+            root.hex(),
+            block["parent_root"].hex(),
+            justified_epoch=int(post.current_justified_checkpoint["epoch"]),
+            finalized_epoch=int(post.finalized_checkpoint["epoch"]),
+        )
+        self.regen.on_imported_block(root, post)
+        if self.db is not None:
+            self.db.put_block(root, signed_block)
+        self.imported_blocks += 1
+        self.emitter.emit(ChainEvent.block, signed_block, root)
+
+        # FFG bookkeeping: move the proto array's justified/finalized
+        # filter + justified root as the chain justifies (reference
+        # forkChoice.updateCheckpoints)
+        jep = int(post.current_justified_checkpoint["epoch"])
+        if jep > self.fork_choice.proto.justified_epoch:
+            self.fork_choice.proto.justified_epoch = jep
+            jroot = post.current_justified_checkpoint["root"].hex()
+            if self.fork_choice.has_block(jroot):
+                self.fork_choice.justified_root = jroot
+            self.emitter.emit(
+                ChainEvent.justified,
+                dict(post.current_justified_checkpoint),
+            )
+        fin = int(post.finalized_checkpoint["epoch"])
+        if fin > self._finalized_epoch:
+            self._finalized_epoch = fin
+            self.fork_choice.proto.finalized_epoch = fin
+            self.regen.checkpoint_cache.prune_finalized(fin)
+            self.op_pool.prune_all(post)
+            self.emitter.emit(
+                ChainEvent.finalized, dict(post.finalized_checkpoint)
+            )
+
+        # head via proto-array vote accounting (reference updateHead)
+        try:
+            self.fork_choice.set_balances(
+                post.effective_balance.astype("int64")
+            )
+            self.head_root_hex = self.fork_choice.update_head()
+        except Exception:
+            self.head_root_hex = root.hex()
+        self.emitter.emit(
+            ChainEvent.head, bytes.fromhex(self.head_root_hex), block["slot"]
+        )
+        return root
+
+    def _verify_signatures_batched(self, pre_state, signed_block) -> bool:
+        """One batched job through the injected verifier service using the
+        wire signature-set extractors (reference
+        verifyBlocksSignatures.ts)."""
+        from ..state_transition.signature_sets import (
+            BeaconStateView,
+            get_block_signature_sets,
+        )
+
+        view = BeaconStateView.from_state(pre_state)
+        sets = get_block_signature_sets(view, signed_block)
+        if hasattr(self.bls, "verify_signature_sets_async"):
+            fut = self.bls.verify_signature_sets_async(sets)
+            return bool(fut.result(timeout=600))
+        return bool(self.bls.verify_signature_sets(sets))
+
+    # -- produce (reference produceBlock/index.ts) -------------------------
+
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+    ) -> dict:
+        head = self.head_state
+        block, _post = produce_block_from_pools(
+            head,
+            slot,
+            randao_reveal,
+            aggregated_attestation_pool=self.aggregated_attestation_pool,
+            op_pool=self.op_pool,
+            contribution_pool=self.sync_contribution_pool,
+            head_root=self.get_head_root(),
+            graffiti=graffiti,
+        )
+        return block
+
+    # -- duties (reference api/impl/validator/duties) ----------------------
+
+    def _state_at_epoch(self, epoch: int):
+        """Epoch-aligned state on the head chain (checkpoint-cached)."""
+        head = self.head_state
+        target = compute_start_slot_at_epoch(epoch)
+        if head.slot >= target:
+            if compute_epoch_at_slot(head.slot) == epoch:
+                return head
+            raise ValueError(f"epoch {epoch} is before the head epoch")
+        cp = {"epoch": epoch, "root": self.get_head_root()}
+        return self.regen.get_checkpoint_state(cp)
+
+    def get_proposer_duties(self, epoch: int) -> List[dict]:
+        state = self._state_at_epoch(epoch)
+        proposers = get_proposer_indices_for_epoch(state, epoch)
+        start = compute_start_slot_at_epoch(epoch)
+        return [
+            {
+                "validator_index": v,
+                "pubkey": state.pubkeys[v],
+                "slot": start + i,
+            }
+            for i, v in enumerate(proposers)
+        ]
+
+    def get_attester_duties(
+        self, epoch: int, indices: List[int]
+    ) -> List[dict]:
+        state = self._state_at_epoch(epoch)
+        wanted = set(indices)
+        duties = []
+        start = compute_start_slot_at_epoch(epoch)
+        for slot in range(start, start + P.SLOTS_PER_EPOCH):
+            for ci in range(get_committee_count_per_slot(state, epoch)):
+                committee = get_beacon_committee(state, slot, ci)
+                for pos, v in enumerate(committee):
+                    if int(v) in wanted:
+                        duties.append(
+                            {
+                                "validator_index": int(v),
+                                "committee_index": ci,
+                                "committee_length": len(committee),
+                                "validator_committee_index": pos,
+                                "slot": slot,
+                            }
+                        )
+        return duties
+
+    def get_sync_committee_duties(
+        self, epoch: int, indices: List[int]
+    ) -> List[dict]:
+        head = self.head_state
+        duties = []
+        for vindex in indices:
+            if vindex >= head.num_validators:
+                continue
+            pk = head.pubkeys[vindex]
+            positions = [
+                i
+                for i, cpk in enumerate(
+                    head.current_sync_committee["pubkeys"]
+                )
+                if cpk == pk
+            ]
+            if positions:
+                duties.append(
+                    {"validator_index": vindex, "positions": positions}
+                )
+        return duties
+
+    def resolve_block_id(self, block_id: str) -> Optional[bytes]:
+        """Spec block-id forms: head | genesis | finalized | <slot> |
+        0x<root> (reference: api/impl/beacon/blocks/utils.ts)."""
+        if block_id == "head":
+            return bytes.fromhex(self.head_root_hex)
+        if block_id == "genesis":
+            return bytes.fromhex(self.anchor_root_hex)
+        if block_id == "finalized":
+            root = self.head_state.finalized_checkpoint["root"]
+            return root if any(root) else bytes.fromhex(self.anchor_root_hex)
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        if block_id.isdigit():
+            # canonical chain walk: head ancestors via the proto array
+            slot = int(block_id)
+            pa = self.fork_choice.proto
+            idx = pa.indices.get(self.head_root_hex)
+            while idx is not None:
+                node = pa.nodes[idx]
+                if node.slot == slot:
+                    return bytes.fromhex(node.root)
+                if node.slot < slot:
+                    return None  # empty slot
+                idx = node.parent
+            return None
+        return None
+
+    def produce_attestation_data(
+        self, committee_index: int, slot: int
+    ) -> dict:
+        """AttestationData for the current head (reference:
+        api/impl/validator/produceAttestationData)."""
+        from ..state_transition.accessors import get_block_root_at_slot
+
+        head = self.head_state
+        head_root = self.get_head_root()
+        epoch = slot // P.SLOTS_PER_EPOCH
+        start = compute_start_slot_at_epoch(epoch)
+        target_root = (
+            head_root
+            if start >= head.slot
+            else get_block_root_at_slot(head, start)
+        )
+        return {
+            "slot": slot,
+            "index": committee_index,
+            "beacon_block_root": head_root,
+            "source": dict(head.current_justified_checkpoint),
+            "target": {"epoch": epoch, "root": target_root},
+        }
+
+    # -- gossip op ingress (reference chain.ts pool adders) ----------------
+
+    def add_attestation(self, attestation: dict) -> str:
+        status = self.attestation_pool.add(attestation)
+        self.emitter.emit(ChainEvent.attestation, attestation)
+        return status
+
+    def add_aggregate(self, aggregate_and_proof: dict) -> str:
+        return self.aggregated_attestation_pool.add(
+            aggregate_and_proof["message"]["aggregate"]
+            if "message" in aggregate_and_proof
+            else aggregate_and_proof
+        )
+
+    def prune_pools(self, clock_slot: int) -> None:
+        self.attestation_pool.prune(clock_slot)
+        self.aggregated_attestation_pool.prune(clock_slot)
+        self.sync_committee_message_pool.prune(clock_slot)
+        self.sync_contribution_pool.prune(clock_slot)

@@ -58,6 +58,56 @@ def produce_block_body(
     return body
 
 
+def produce_block_from_pools(
+    state,
+    slot: int,
+    randao_reveal: bytes,
+    *,
+    aggregated_attestation_pool=None,
+    op_pool=None,
+    contribution_pool=None,
+    head_root: Optional[bytes] = None,
+    graffiti: bytes = b"\x00" * 32,
+    eth1_data: Optional[Dict] = None,
+) -> Tuple[Dict, object]:
+    """produceBlockBody from the op pools (reference
+    produceBlockBody.ts:66-118): attestations ranked by participation,
+    slashings/exits still applicable, the merged sync contribution for
+    the parent root."""
+    pre = state.clone()
+    if pre.slot < slot:
+        process_slots(pre, slot)
+    attestations = (
+        aggregated_attestation_pool.get_attestations_for_block(pre)
+        if aggregated_attestation_pool is not None
+        else []
+    )
+    proposer_slashings, attester_slashings, voluntary_exits = (
+        op_pool.get_slashings_and_exits(pre)
+        if op_pool is not None
+        else ([], [], [])
+    )
+    sync_aggregate = None
+    if contribution_pool is not None and head_root is not None:
+        sync_aggregate = contribution_pool.produce_sync_aggregate(
+            slot - 1, head_root
+        )
+    # `pre` is already advanced to `slot` — reuse it so the epoch
+    # transition does not run a second time inside produce_block
+    return produce_block(
+        pre,
+        slot,
+        randao_reveal,
+        graffiti=graffiti,
+        eth1_data=eth1_data,
+        attestations=attestations,
+        proposer_slashings=proposer_slashings,
+        attester_slashings=attester_slashings,
+        voluntary_exits=voluntary_exits,
+        sync_aggregate=sync_aggregate,
+    )
+
+
 def produce_block(
     state,
     slot: int,

@@ -1,0 +1,185 @@
+"""State regeneration — replay blocks from the nearest cached state.
+
+Reference: packages/beacon-node/src/chain/regen/regen.ts
+(StateRegenerator: getPreState / getCheckpointState / getState walk the
+fork-choice DAG back to a cached state, then replay blocks from the db
+with the signature checks off — they were verified at import) and
+chain/regen/queued.ts (QueuedStateRegenerator: the same API behind a
+JobItemQueue so concurrent regen requests serialize).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import params
+from ..state_transition import state_transition
+from ..state_transition.slot import process_slots
+from ..state_transition.util import compute_start_slot_at_epoch
+from ..utils.logger import get_logger
+from ..utils.queue import JobItemQueue
+from .state_cache import CheckpointStateCache, StateContextCache
+
+P = params.ACTIVE_PRESET
+
+
+class RegenError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class StateRegenerator:
+    """Regen over (fork choice, db, caches).
+
+    Blocks are looked up in the hot db by root; states come from the
+    root-keyed LRU or the checkpoint cache, whichever is fewer replays
+    away (reference regen.ts getState)."""
+
+    def __init__(
+        self,
+        fork_choice,
+        db,
+        state_cache: Optional[StateContextCache] = None,
+        checkpoint_cache: Optional[CheckpointStateCache] = None,
+    ):
+        self.fork_choice = fork_choice
+        self.db = db
+        self.state_cache = state_cache or StateContextCache()
+        self.checkpoint_cache = checkpoint_cache or CheckpointStateCache()
+        # blockRoot(hex) -> stateRoot(hex), maintained on import
+        self.block_state_roots: Dict[str, str] = {}
+        self.log = get_logger("chain/regen")
+        self.replayed_blocks = 0
+
+    # -- bookkeeping (called by the import pipeline) -----------------------
+
+    def on_imported_block(self, block_root: bytes, post_state) -> None:
+        state_root = post_state.hash_tree_root().hex()
+        self.block_state_roots[block_root.hex()] = state_root
+        self.state_cache.add_with_root(state_root, post_state)
+
+    # -- public API (reference regen.ts) -----------------------------------
+
+    def get_state(self, state_root: str):
+        """State by exact state root: cache hit or RegenError (the
+        reference also refuses to regen by bare state root)."""
+        st = self.state_cache.get(state_root)
+        if st is None:
+            raise RegenError("STATE_NOT_IN_CACHE", state_root)
+        return st
+
+    def get_block_slot_state(self, block_root_hex: str, slot: int):
+        """State at `slot` on the chain of `block_root` (advancing through
+        empty slots as needed)."""
+        state = self._get_post_state(block_root_hex)
+        if state.slot > slot:
+            raise RegenError(
+                "SLOT_BEFORE_BLOCK",
+                f"slot {slot} < block state slot {state.slot}",
+            )
+        if state.slot == slot:
+            return state
+        advanced = state.clone()
+        process_slots(advanced, slot)
+        return advanced
+
+    def get_pre_state(self, block: dict):
+        """Pre-state for a block: parent's post-state advanced to the
+        block's slot (reference getPreState)."""
+        parent_hex = block["parent_root"].hex()
+        return self.get_block_slot_state(parent_hex, block["slot"])
+
+    def get_checkpoint_state(self, checkpoint: dict):
+        cached = self.checkpoint_cache.get(checkpoint)
+        if cached is not None:
+            return cached
+        root = checkpoint["root"]
+        root_hex = root.hex() if isinstance(root, bytes) else str(root)
+        state = self.get_block_slot_state(
+            root_hex, compute_start_slot_at_epoch(int(checkpoint["epoch"]))
+        )
+        self.checkpoint_cache.add(checkpoint, state)
+        return state
+
+    # -- internals ---------------------------------------------------------
+
+    def _get_post_state(self, block_root_hex: str):
+        """Post-state of an imported block: cache hit, else walk ancestors
+        to the nearest cached state and replay the gap from the db."""
+        state_root = self.block_state_roots.get(block_root_hex)
+        if state_root is not None:
+            st = self.state_cache.get(state_root)
+            if st is not None:
+                return st
+
+        # walk the proto array back to a block whose post-state is cached
+        pa = getattr(self.fork_choice, "proto", self.fork_choice)
+        idx = pa.indices.get(block_root_hex)
+        if idx is None:
+            raise RegenError("BLOCK_NOT_IN_FORKCHOICE", block_root_hex)
+        to_replay: List[str] = []
+        base_state = None
+        while idx is not None:
+            node = pa.nodes[idx]
+            sroot = self.block_state_roots.get(node.root)
+            if sroot is not None:
+                base_state = self.state_cache.get(sroot)
+                if base_state is not None:
+                    break
+            to_replay.append(node.root)
+            idx = node.parent
+        if base_state is None:
+            raise RegenError(
+                "NO_ANCHOR_STATE",
+                f"no cached ancestor state for {block_root_hex}",
+            )
+
+        state = base_state
+        for root_hex in reversed(to_replay):
+            signed = self.db.block.get(bytes.fromhex(root_hex))
+            if signed is None:
+                raise RegenError("BLOCK_NOT_IN_DB", root_hex)
+            # signatures were verified at import; state roots still checked
+            state = state_transition(
+                state,
+                signed,
+                verify_state_root=True,
+                verify_proposer=False,
+                verify_signatures=False,
+            )
+            self.replayed_blocks += 1
+            self.on_imported_block(bytes.fromhex(root_hex), state)
+        return state
+
+
+class QueuedStateRegenerator:
+    """StateRegenerator behind a JobItemQueue (reference regen/queued.ts:
+    serializes concurrent regen; queue cap 256)."""
+
+    MAX_QUEUE = 256
+
+    def __init__(self, regen: StateRegenerator, max_queue: int = MAX_QUEUE):
+        self.regen = regen
+        self._queue = JobItemQueue(self._run, max_length=max_queue)
+
+    def _run(self, job):
+        method, args = job
+        return getattr(self.regen, method)(*args)
+
+    def get_pre_state(self, block: dict):
+        return self._queue.push(("get_pre_state", (block,)))
+
+    def get_checkpoint_state(self, checkpoint: dict):
+        return self._queue.push(("get_checkpoint_state", (checkpoint,)))
+
+    def get_block_slot_state(self, block_root_hex: str, slot: int):
+        return self._queue.push(
+            ("get_block_slot_state", (block_root_hex, slot))
+        )
+
+    def get_state(self, state_root: str):
+        return self._queue.push(("get_state", (state_root,)))
+
+    def close(self) -> None:
+        self._queue.stop()

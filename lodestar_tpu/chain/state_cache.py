@@ -1,0 +1,129 @@
+"""State caches — by state root and by checkpoint.
+
+Reference: packages/beacon-node/src/chain/stateCache/stateContextCache.ts
+(root-keyed LRU, MAX_STATES = 3 * 32) and
+stateContextCheckpointsCache.ts (checkpoint-keyed, epoch-pruned,
+MAX_EPOCHS = 10).  States here are the columnar BeaconState
+(state_transition/state.py); entries are the live objects — callers
+clone before mutating, which is what stateTransition() does anyway.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+
+class StateContextCache:
+    """stateRoot(hex) -> BeaconState, LRU-bounded."""
+
+    MAX_STATES = 3 * 32  # reference: stateContextCache.ts
+
+    def __init__(self, max_states: int = MAX_STATES):
+        self.max_states = max_states
+        self._map: "OrderedDict[str, object]" = OrderedDict()
+
+    def get(self, state_root: str) -> Optional[object]:
+        st = self._map.get(state_root)
+        if st is not None:
+            self._map.move_to_end(state_root)
+        return st
+
+    def add(self, state) -> None:
+        root = state.hash_tree_root().hex()
+        if root in self._map:
+            self._map.move_to_end(root)
+            return
+        self._map[root] = state
+        while len(self._map) > self.max_states:
+            self._map.popitem(last=False)
+
+    def add_with_root(self, state_root: str, state) -> None:
+        """Add under a known root (skips re-hashing the state)."""
+        if state_root in self._map:
+            self._map.move_to_end(state_root)
+            return
+        self._map[state_root] = state
+        while len(self._map) > self.max_states:
+            self._map.popitem(last=False)
+
+    def delete(self, state_root: str) -> None:
+        self._map.pop(state_root, None)
+
+    def batch_delete(self, roots: List[str]) -> None:
+        for r in roots:
+            self.delete(r)
+
+    def prune(self, head_state_root: str) -> None:
+        """Drop everything but the head state (reference prune keeps the
+        head entry hot after a finalization sweep)."""
+        keep = self._map.get(head_state_root)
+        self._map.clear()
+        if keep is not None:
+            self._map[head_state_root] = keep
+
+    def clear(self) -> None:
+        self._map.clear()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    @property
+    def size(self) -> int:
+        return len(self._map)
+
+
+class CheckpointStateCache:
+    """(epoch, blockRoot hex) -> BeaconState at the epoch boundary.
+
+    Serves attestation/justification target states (reference:
+    stateContextCheckpointsCache.ts)."""
+
+    MAX_EPOCHS = 10
+
+    def __init__(self, max_epochs: int = MAX_EPOCHS):
+        self.max_epochs = max_epochs
+        self._map: Dict[Tuple[int, str], object] = {}
+        self._epochs: List[int] = []
+
+    @staticmethod
+    def _key(checkpoint: dict) -> Tuple[int, str]:
+        root = checkpoint["root"]
+        root_hex = root.hex() if isinstance(root, bytes) else str(root)
+        return (int(checkpoint["epoch"]), root_hex)
+
+    def get(self, checkpoint: dict) -> Optional[object]:
+        return self._map.get(self._key(checkpoint))
+
+    def add(self, checkpoint: dict, state) -> None:
+        key = self._key(checkpoint)
+        if key in self._map:
+            return
+        self._map[key] = state
+        if key[0] not in self._epochs:
+            self._epochs.append(key[0])
+            self._epochs.sort()
+        while len(self._epochs) > self.max_epochs:
+            self.prune_epoch(self._epochs[0])
+
+    def get_latest(self, block_root_hex: str, max_epoch: int):
+        """Most recent cached state for this root at epoch <= max_epoch."""
+        best = None
+        best_epoch = -1
+        for (epoch, root), state in self._map.items():
+            if root == block_root_hex and best_epoch < epoch <= max_epoch:
+                best, best_epoch = state, epoch
+        return best
+
+    def prune_epoch(self, epoch: int) -> None:
+        for key in [k for k in self._map if k[0] == epoch]:
+            del self._map[key]
+        if epoch in self._epochs:
+            self._epochs.remove(epoch)
+
+    def prune_finalized(self, finalized_epoch: int) -> None:
+        for e in [e for e in self._epochs if e < finalized_epoch]:
+            self.prune_epoch(e)
+
+    def __len__(self) -> int:
+        return len(self._map)

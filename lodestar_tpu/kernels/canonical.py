@@ -35,20 +35,26 @@ _R2_LIMBS = [int(v) for v in LY.MONT_R2]
 
 
 def _lex_cmp_const(t, c_limbs):
-    """(gt, lt) of exact limb planes t vs a python limb list."""
+    """(gt, lt) of exact limb planes t vs a python limb list.
+
+    Masks are carried as int32 0/1 and only compared to zero at the end:
+    Mosaic cannot lower the i8->i1 `arith.trunci` that bool-typed
+    `jnp.where(..., True, ...)` accumulators produce on real TPU."""
     c = C.const_plane(c_limbs, t)
-    gt_l = t > c
-    lt_l = t < c
+    one = jnp.ones((), jnp.int32)
+    gt_l = (t > c).astype(jnp.int32)
+    lt_l = (t < c).astype(jnp.int32)
     shape = t.shape[:-2] + t.shape[-1:]
-    decided = jnp.zeros(shape, bool)
-    gt = jnp.zeros(shape, bool)
-    lt = jnp.zeros(shape, bool)
+    decided = jnp.zeros(shape, jnp.int32)
+    gt = jnp.zeros(shape, jnp.int32)
+    lt = jnp.zeros(shape, jnp.int32)
     for i in range(t.shape[-2] - 1, -1, -1):
         g, l = gt_l[..., i, :], lt_l[..., i, :]
-        gt = jnp.where(~decided & g, True, gt)
-        lt = jnp.where(~decided & l, True, lt)
+        undecided = one - decided
+        gt = gt | (undecided * g)
+        lt = lt | (undecided * l)
         decided = decided | g | l
-    return gt, lt
+    return gt != 0, lt != 0
 
 
 def lex_gt_const(t, c_limbs):

@@ -145,6 +145,25 @@ def get_beacon_proposer_index(state) -> int:
     return proposer
 
 
+def get_proposer_indices_for_epoch(state, epoch: int) -> List[int]:
+    """All SLOTS_PER_EPOCH proposers from one epoch-aligned state.
+
+    The per-slot seed only mixes the slot number into the epoch seed, so
+    one state serves the whole epoch (reference:
+    epochContext.ts proposers / computeProposers)."""
+    assert compute_epoch_at_slot(state.slot) == epoch, (
+        "state must be in the target epoch"
+    )
+    base_seed = get_seed(state, epoch, params.DOMAIN_BEACON_PROPOSER)
+    indices = get_active_validator_indices(state, epoch)
+    out = []
+    start = compute_start_slot_at_epoch(epoch)
+    for slot in range(start, start + P.SLOTS_PER_EPOCH):
+        seed = hashlib.sha256(base_seed + uint_to_bytes(slot)).digest()
+        out.append(compute_proposer_index(state, indices, seed))
+    return out
+
+
 # -- sync committee (spec get_next_sync_committee) --------------------------
 
 

@@ -275,15 +275,25 @@ def process_attestation(
 
 
 def _base_rewards_vector(state) -> np.ndarray:
+    """Per-validator base rewards, memoized per epoch: effective
+    balances and the active set only change at epoch processing, so one
+    registry pass serves every attestation in the epoch (the reference
+    caches baseRewardPerIncrement on the EpochCache)."""
+    epoch = compute_epoch_at_slot(state.slot)
+    cached = getattr(state, "_base_reward_cache", None)
+    if cached is not None and cached[0] == (epoch, state.num_validators):
+        return cached[1]
     increment = P.EFFECTIVE_BALANCE_INCREMENT
     per_increment = (
         increment
         * P.BASE_REWARD_FACTOR
         // integer_squareroot(get_total_active_balance(state))
     )
-    return (
+    out = (
         state.effective_balance.astype(np.int64) // np.int64(increment)
     ) * np.int64(per_increment)
+    state._base_reward_cache = ((epoch, state.num_validators), out)
+    return out
 
 
 def is_valid_indexed_attestation(state, indexed: Dict) -> bool:

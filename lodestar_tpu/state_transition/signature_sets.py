@@ -37,9 +37,56 @@ class BeaconStateView:
     # slot -> block root for sync-aggregate signing (reference:
     # getSyncCommitteeSignatureSet reads state.blockRoots)
     block_roots: Dict[int, bytes] = field(default_factory=dict)
+    # previous-epoch committees (blocks carry prev-epoch attestations)
+    prev_epoch_cache: Optional[EpochCache] = None
 
     def get_block_root_at_slot(self, slot: int) -> bytes:
         return self.block_roots.get(slot, b"\x00" * 32)
+
+    def get_indexed_attestation(self, attestation: dict) -> dict:
+        """Dispatch to the committee cache of the attestation's epoch."""
+        epoch = compute_epoch_at_slot(attestation["data"]["slot"])
+        for cache in (self.epoch_cache, self.prev_epoch_cache):
+            if cache is not None and cache.epoch == epoch:
+                return cache.get_indexed_attestation(attestation)
+        raise ValueError(f"no committee cache for epoch {epoch}")
+
+    @classmethod
+    def from_state(cls, state) -> "BeaconStateView":
+        """Build the view from a full columnar BeaconState — the bridge
+        from the state machine to the wire extractors (the reference
+        passes CachedBeaconState straight through)."""
+        from .accessors import get_active_validator_indices, get_seed
+
+        epoch = compute_epoch_at_slot(state.slot)
+        sync_indices = [
+            state.pubkey_index(pk)
+            for pk in state.current_sync_committee["pubkeys"]
+        ]
+
+        def _cache(ep: int) -> EpochCache:
+            return EpochCache(
+                state.pubkeys,
+                ep,
+                get_seed(state, ep, params.DOMAIN_BEACON_ATTESTER),
+                active_indices=get_active_validator_indices(state, ep),
+                sync_committee_indices=sync_indices,
+            )
+
+        window = {
+            s: state.block_roots[s % params.SLOTS_PER_HISTORICAL_ROOT]
+            for s in range(
+                max(0, state.slot - params.SLOTS_PER_HISTORICAL_ROOT),
+                state.slot,
+            )
+        }
+        return cls(
+            config=state.config,
+            slot=state.slot,
+            epoch_cache=_cache(epoch),
+            block_roots=window,
+            prev_epoch_cache=_cache(epoch - 1) if epoch > 0 else None,
+        )
 
 
 def _block_types(config: ChainConfig, slot: int):
@@ -122,7 +169,7 @@ def get_attestation_signature_sets(
 ) -> List[WireSignatureSet]:
     return [
         get_indexed_attestation_signature_set(
-            state, state.epoch_cache.get_indexed_attestation(att)
+            state, state.get_indexed_attestation(att)
         )
         for att in signed_block["message"]["body"]["attestations"]
     ]

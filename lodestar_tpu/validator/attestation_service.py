@@ -1,18 +1,31 @@
-"""AttestationService — per-slot attestation duty execution.
+"""AttestationService — per-slot attestation + aggregation duties.
 
 Reference: packages/validator/src/services/attestation.ts (produce at
-slot/3, sign, submit) + services/attestationDuties.ts (per-epoch duty
-polling).  The api dependency is injected (any object with the
+slot/3, sign, submit; aggregate at 2/3 slot for selected aggregators) +
+services/attestationDuties.ts (per-epoch duty polling with selection
+proofs).  The api dependency is injected (any object with the
 duty/produce/submit methods), so tests and the replay harness can drive
 it without a live beacon node.
 """
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence
 
+from .. import params
+from ..types import AttestationData
 from ..utils.logger import get_logger
 from .store import SlashingError, ValidatorStore
+
+
+def is_aggregator(committee_length: int, selection_proof: bytes) -> bool:
+    """Spec is_aggregator: hash(slot signature) mod ceil(len/TARGET)."""
+    modulo = max(
+        1, committee_length // params.TARGET_AGGREGATORS_PER_COMMITTEE
+    )
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
 class AttestationService:
@@ -22,7 +35,10 @@ class AttestationService:
         self.log = logger or get_logger("validator/attestation")
         # epoch -> list of duty dicts {validator_index, committee_index, slot}
         self._duties: Dict[int, List[dict]] = {}
+        # (slot, committee_index) -> AttestationData produced this slot
+        self._produced_data: Dict[tuple, dict] = {}
         self.submitted = 0
+        self.submitted_aggregates = 0
         self.skipped_slashable = 0
 
     # -- duties (reference: attestationDuties.ts pollBeaconAttesters) ------
@@ -63,14 +79,54 @@ class AttestationService:
                     reason=str(e),
                 )
                 continue
+            # single-attester bits at the duty's committee position
+            length = duty.get("committee_length", 1)
+            pos = duty.get("validator_committee_index", 0)
+            bits = [i == pos for i in range(length)]
             submitted.append(
                 {
-                    "aggregation_bits": duty.get("aggregation_bits", [True]),
+                    "aggregation_bits": duty.get("aggregation_bits", bits),
                     "data": data,
-                    "signature": "0x" + sig.hex(),
+                    "signature": sig,
                 }
             )
         if submitted:
             self.api.submit_pool_attestations(submitted)
             self.submitted += len(submitted)
+        for ci, data in produced.items():
+            self._produced_data[(slot, ci)] = data
+        for old in [k for k in self._produced_data if k[0] < slot - 2]:
+            del self._produced_data[old]
         return len(submitted)
+
+    # -- aggregation (reference: attestation.ts 2/3-slot aggregate leg) ----
+
+    def run_aggregation_tasks(self, epoch: int, slot: int) -> int:
+        """For duties whose selection proof elects them aggregator:
+        fetch the pool aggregate, wrap + sign AggregateAndProof,
+        publish."""
+        published = []
+        for duty in self.duties_at_slot(epoch, slot):
+            vindex = duty["validator_index"]
+            data = self._produced_data.get((slot, duty["committee_index"]))
+            if data is None:
+                continue
+            proof = self.store.sign_selection_proof(vindex, slot)
+            if not is_aggregator(duty.get("committee_length", 1), proof):
+                continue
+            aggregate = self.api.get_aggregate_attestation(
+                slot, AttestationData.hash_tree_root(data)
+            )
+            if aggregate is None:
+                continue
+            message = {
+                "aggregator_index": vindex,
+                "aggregate": aggregate,
+                "selection_proof": proof,
+            }
+            signature = self.store.sign_aggregate_and_proof(vindex, message)
+            published.append({"message": message, "signature": signature})
+        if published:
+            self.api.publish_aggregate_and_proofs(published)
+            self.submitted_aggregates += len(published)
+        return len(published)

@@ -1,0 +1,64 @@
+"""BlockProposalService — per-epoch proposer duties, per-slot proposal.
+
+Reference: packages/validator/src/services/block.ts (BlockProposingService:
+on proposer slot → produceBlock → sign (slashing-protected) → publish)
+and services/blockDuties.ts (per-epoch duty polling with reorg-safe
+re-poll).  The api object is injected: any provider of
+get_proposer_duties / produce_block_v2 / publish_block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..utils.logger import get_logger
+from .store import SlashingError, ValidatorStore
+
+
+class BlockProposalService:
+    def __init__(self, store: ValidatorStore, api, graffiti: bytes = b"\x00" * 32, logger=None):
+        self.store = store
+        self.api = api
+        self.graffiti = graffiti
+        self.log = logger or get_logger("validator/block")
+        self._duties: Dict[int, List[dict]] = {}  # epoch -> duties
+        self.proposed = 0
+        self.skipped_slashable = 0
+
+    def poll_duties(self, epoch: int) -> None:
+        indices = sorted(self.store.sks)
+        duties = self.api.get_proposer_duties(epoch)
+        self._duties[epoch] = [
+            d for d in duties if d["validator_index"] in indices
+        ]
+        for old in [e for e in self._duties if e < epoch - 1]:
+            del self._duties[old]
+
+    def duties_at_slot(self, epoch: int, slot: int) -> List[dict]:
+        return [d for d in self._duties.get(epoch, []) if d["slot"] == slot]
+
+    def run_block_tasks(self, epoch: int, slot: int) -> int:
+        """Produce + sign + publish for every proposer duty at `slot`."""
+        published = 0
+        for duty in self.duties_at_slot(epoch, slot):
+            vindex = duty["validator_index"]
+            randao_reveal = self.store.sign_randao(vindex, slot)
+            block = self.api.produce_block_v2(
+                slot, randao_reveal, self.graffiti
+            )
+            try:
+                signature = self.store.sign_block(vindex, block)
+            except SlashingError as e:
+                self.skipped_slashable += 1
+                self.log.warn(
+                    "refusing slashable proposal",
+                    validator=vindex,
+                    reason=str(e),
+                )
+                continue
+            self.api.publish_block(
+                {"message": block, "signature": signature}
+            )
+            published += 1
+            self.proposed += 1
+        return published

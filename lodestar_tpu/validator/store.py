@@ -143,3 +143,107 @@ class ValidatorStore:
             ),
         )
         return C.g2_compress(B.sign(self.sks[validator_index], root))
+
+    # -- further signing entry points (reference validatorStore.ts) --------
+
+    def _sign_root(self, validator_index: int, object_root, domain_type, slot):
+        from ..ssz import uint64
+
+        root = self.config.compute_signing_root(
+            object_root, self.config.get_domain(slot, domain_type, slot)
+        )
+        return C.g2_compress(B.sign(self.sks[validator_index], root)), root
+
+    def sign_randao(self, validator_index: int, slot: int) -> bytes:
+        from ..ssz import uint64
+
+        epoch = slot // params.SLOTS_PER_EPOCH
+        sig, _ = self._sign_root(
+            validator_index,
+            uint64.hash_tree_root(epoch),
+            params.DOMAIN_RANDAO,
+            slot,
+        )
+        return sig
+
+    def sign_sync_committee_message(
+        self, validator_index: int, slot: int, beacon_block_root: bytes
+    ) -> dict:
+        sig, _ = self._sign_root(
+            validator_index,
+            beacon_block_root,
+            params.DOMAIN_SYNC_COMMITTEE,
+            slot,
+        )
+        return {
+            "slot": slot,
+            "beacon_block_root": beacon_block_root,
+            "validator_index": validator_index,
+            "signature": sig,
+        }
+
+    def sign_selection_proof(self, validator_index: int, slot: int) -> bytes:
+        from ..ssz import uint64
+
+        sig, _ = self._sign_root(
+            validator_index,
+            uint64.hash_tree_root(slot),
+            params.DOMAIN_SELECTION_PROOF,
+            slot,
+        )
+        return sig
+
+    def sign_aggregate_and_proof(
+        self, validator_index: int, aggregate_and_proof: dict
+    ) -> bytes:
+        slot = aggregate_and_proof["aggregate"]["data"]["slot"]
+        sig, _ = self._sign_root(
+            validator_index,
+            T.AggregateAndProof.hash_tree_root(aggregate_and_proof),
+            params.DOMAIN_AGGREGATE_AND_PROOF,
+            slot,
+        )
+        return sig
+
+    def sign_sync_selection_proof(
+        self, validator_index: int, slot: int, subcommittee_index: int
+    ) -> bytes:
+        from ..ssz import Container, uint64
+
+        selection_data = Container(
+            (("slot", uint64), ("subcommittee_index", uint64)),
+            name="SyncAggregatorSelectionData",
+        )
+        sig, _ = self._sign_root(
+            validator_index,
+            selection_data.hash_tree_root(
+                {"slot": slot, "subcommittee_index": subcommittee_index}
+            ),
+            params.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            slot,
+        )
+        return sig
+
+    def sign_contribution_and_proof(
+        self, validator_index: int, contribution_and_proof: dict
+    ) -> bytes:
+        slot = contribution_and_proof["contribution"]["slot"]
+        sig, _ = self._sign_root(
+            validator_index,
+            T.ContributionAndProof.hash_tree_root(contribution_and_proof),
+            params.DOMAIN_CONTRIBUTION_AND_PROOF,
+            slot,
+        )
+        return sig
+
+    def sign_voluntary_exit(
+        self, validator_index: int, epoch: int
+    ) -> dict:
+        msg = {"epoch": epoch, "validator_index": validator_index}
+        sig, _ = self._sign_root(
+            validator_index,
+            T.VoluntaryExit.hash_tree_root(msg),
+            params.DOMAIN_VOLUNTARY_EXIT,
+            epoch * params.SLOTS_PER_EPOCH,
+        )
+        return {"message": msg, "signature": sig}

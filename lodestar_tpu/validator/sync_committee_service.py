@@ -1,0 +1,100 @@
+"""SyncCommitteeService — sync-committee message + contribution duties.
+
+Reference: packages/validator/src/services/syncCommittee.ts
+(SyncCommitteeService: per-slot sign the head root, submit; aggregators
+produce SignedContributionAndProof) and services/syncCommitteeDuties.ts
+(per-period duty polling).  Aggregator selection follows the altair
+is_sync_committee_aggregator rule: sha256(selection_proof)[:8] %
+(subcommittee_size // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE) == 0.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List
+
+from .. import params
+from ..utils.logger import get_logger
+from .store import ValidatorStore
+
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+
+
+def is_sync_committee_aggregator(selection_proof: bytes) -> bool:
+    modulo = max(
+        1,
+        params.ACTIVE_PRESET.SYNC_COMMITTEE_SIZE
+        // params.SYNC_COMMITTEE_SUBNET_COUNT
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
+class SyncCommitteeService:
+    def __init__(self, store: ValidatorStore, api, logger=None):
+        self.store = store
+        self.api = api
+        self.log = logger or get_logger("validator/sync-committee")
+        # period -> duties [{validator_index, positions: [committee pos]}]
+        self._duties: Dict[int, List[dict]] = {}
+        self.submitted_messages = 0
+        self.submitted_contributions = 0
+
+    @staticmethod
+    def period_of(epoch: int) -> int:
+        return epoch // params.ACTIVE_PRESET.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+    def poll_duties(self, epoch: int) -> None:
+        period = self.period_of(epoch)
+        indices = sorted(self.store.sks)
+        self._duties[period] = self.api.get_sync_committee_duties(
+            epoch, indices
+        )
+        for old in [p for p in self._duties if p < period - 1]:
+            del self._duties[old]
+
+    def run_sync_committee_tasks(self, epoch: int, slot: int) -> int:
+        """Sign the head root with every duty; aggregators contribute."""
+        duties = self._duties.get(self.period_of(epoch), [])
+        if not duties:
+            return 0
+        head_root = self.api.get_head_root(slot)
+        subnet_size = (
+            params.ACTIVE_PRESET.SYNC_COMMITTEE_SIZE
+            // params.SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        n = 0
+        for duty in duties:
+            vindex = duty["validator_index"]
+            message = self.store.sign_sync_committee_message(
+                vindex, slot, head_root
+            )
+            for position in duty["positions"]:
+                subnet, index_in_subnet = divmod(position, subnet_size)
+                self.api.submit_sync_committee_message(
+                    subnet, message, index_in_subnet
+                )
+                n += 1
+                self.submitted_messages += 1
+                # aggregation duty (reference syncCommittee.ts aggregator leg)
+                proof = self.store.sign_sync_selection_proof(
+                    vindex, slot, subnet
+                )
+                if is_sync_committee_aggregator(proof):
+                    contribution = self.api.produce_sync_contribution(
+                        slot, head_root, subnet
+                    )
+                    if contribution is None:
+                        continue
+                    cap = {
+                        "aggregator_index": vindex,
+                        "contribution": contribution,
+                        "selection_proof": proof,
+                    }
+                    sig = self.store.sign_contribution_and_proof(vindex, cap)
+                    self.api.publish_contribution_and_proof(
+                        {"message": cap, "signature": sig}
+                    )
+                    self.submitted_contributions += 1
+        return n

@@ -1,0 +1,184 @@
+"""BeaconChain composition: import via STF, head, duties, pools, events.
+
+Reference: packages/beacon-node/src/chain/chain.ts + blocks/importBlock.ts
+(fork-choice insert, head update, event emission, finalization pruning)
+and api/impl/validator (duty computation).
+"""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.emitter import ChainEvent
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.state_transition import create_genesis_state, process_slots
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+)
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def chain_world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"chain-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=11)
+    chain = BeaconChain(cfg, genesis)
+    events = {"block": [], "head": [], "attestation": []}
+    chain.emitter.on(ChainEvent.block, lambda s, r: events["block"].append(r))
+    chain.emitter.on(
+        ChainEvent.head, lambda r, s: events["head"].append((r, s))
+    )
+    chain.emitter.on(
+        ChainEvent.attestation, lambda a: events["attestation"].append(a)
+    )
+    return cfg, sks, pks, genesis, chain, events
+
+
+def _sign_and_import(chain, cfg, sks, block):
+    domain = cfg.get_domain(
+        block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
+    )
+    root = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block), domain
+    )
+    return chain.process_block(
+        {
+            "message": block,
+            "signature": B.sign_bytes(sks[block["proposer_index"]], root),
+        }
+    )
+
+
+def _randao(chain, cfg, sks, slot):
+    head = chain.head_state.clone()
+    if head.slot < slot:
+        process_slots(head, slot)
+    proposer = get_beacon_proposer_index(head)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    domain = cfg.get_domain(slot, params.DOMAIN_RANDAO)
+    root = cfg.compute_signing_root(uint64.hash_tree_root(epoch), domain)
+    return B.sign_bytes(sks[proposer], root)
+
+
+def test_chain_import_and_head(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+
+    b1 = chain.produce_block(1, _randao(chain, cfg, sks, 1))
+    r1 = _sign_and_import(chain, cfg, sks, b1)
+    assert chain.head_root_hex == r1.hex()
+    assert chain.imported_blocks == 1
+    assert events["block"] and events["head"]
+
+    # duplicate import is a no-op
+    assert _sign_and_import(chain, cfg, sks, b1) == r1
+    assert chain.imported_blocks == 1
+
+    # gossip attestations -> pool -> aggregation -> next block
+    head_state = chain.head_state
+    data = None
+    for index in range(get_committee_count_per_slot(head_state, 0)):
+        committee = get_beacon_committee(head_state, 1, index)
+        data = {
+            "slot": 1,
+            "index": index,
+            "beacon_block_root": r1,
+            "source": dict(head_state.current_justified_checkpoint),
+            "target": {"epoch": 0, "root": get_block_root_at_slot(head_state, 0)},
+        }
+        n = len(committee)
+        for pos, vidx in enumerate(committee):
+            domain = cfg.get_domain(1, params.DOMAIN_BEACON_ATTESTER, 1)
+            sroot = cfg.compute_signing_root(
+                T.AttestationData.hash_tree_root(data), domain
+            )
+            chain.add_attestation(
+                {
+                    "aggregation_bits": [i == pos for i in range(n)],
+                    "data": data,
+                    "signature": B.sign_bytes(sks[int(vidx)], sroot),
+                }
+            )
+        agg = chain.attestation_pool.get_aggregate(
+            1, T.AttestationData.hash_tree_root(data)
+        )
+        chain.aggregated_attestation_pool.add(agg)
+    assert events["attestation"]
+
+    b2 = chain.produce_block(2, _randao(chain, cfg, sks, 2))
+    assert len(b2["body"]["attestations"]) >= 1
+    r2 = _sign_and_import(chain, cfg, sks, b2)
+    assert chain.head_root_hex == r2.hex()
+
+    # db-less chain still serves head state from the regen cache
+    post = chain.head_state
+    assert post.slot == 2
+    assert post.current_epoch_participation.sum() > 0
+
+
+def test_chain_rejects_bad_signature(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+    block = chain.produce_block(3, _randao(chain, cfg, sks, 3))
+    bad = {"message": block, "signature": b"\x11" * 96}
+    with pytest.raises(Exception):
+        chain.process_block(bad)
+    assert chain.head_root_hex != T.BeaconBlockAltair.hash_tree_root(block).hex()
+
+
+def test_proposer_duties(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+    duties = chain.get_proposer_duties(0)
+    assert len(duties) == P.SLOTS_PER_EPOCH
+    by_slot = {d["slot"]: d for d in duties}
+    # the block we imported at slot 1 was proposed by the duty holder
+    head = chain.head_state
+    st = genesis.clone()
+    process_slots(st, 1)
+    assert by_slot[1]["validator_index"] == get_beacon_proposer_index(st)
+    assert by_slot[1]["pubkey"] == pks[by_slot[1]["validator_index"]]
+
+
+def test_attester_duties_cover_registry(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+    duties = chain.get_attester_duties(0, list(range(N_KEYS)))
+    # every active validator attests exactly once per epoch
+    assert sorted(d["validator_index"] for d in duties) == list(range(N_KEYS))
+    for d in duties:
+        assert 0 <= d["validator_committee_index"] < d["committee_length"]
+
+
+def test_sync_committee_duties(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+    duties = chain.get_sync_committee_duties(0, list(range(N_KEYS)))
+    total_positions = sum(len(d["positions"]) for d in duties)
+    assert total_positions == P.SYNC_COMMITTEE_SIZE
+    for d in duties:
+        pk = pks[d["validator_index"]]
+        for pos in d["positions"]:
+            assert (
+                chain.head_state.current_sync_committee["pubkeys"][pos] == pk
+            )
+
+
+def test_next_epoch_duties_via_checkpoint_state(chain_world):
+    cfg, sks, pks, genesis, chain, events = chain_world
+    duties = chain.get_proposer_duties(1)
+    assert len(duties) == P.SLOTS_PER_EPOCH
+    assert all(
+        d["slot"] // P.SLOTS_PER_EPOCH == 1 for d in duties
+    )

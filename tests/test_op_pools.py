@@ -1,0 +1,312 @@
+"""Op pools: aggregation, selection, and pool-built blocks that verify.
+
+Reference: packages/beacon-node/src/chain/opPools/ — attestationPool
+naive aggregation, aggregatedAttestationPool block selection, opPool
+dedupe, sync message/contribution pools feeding the block SyncAggregate.
+The end-to-end test builds a block purely from pools and imports it with
+FULL signature verification.
+"""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.op_pools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    OpPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
+from lodestar_tpu.chain.produce_block import (
+    produce_block,
+    produce_block_from_pools,
+)
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.state_transition import (
+    create_genesis_state,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+)
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"pool-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=3)
+    return cfg, sks, pks, genesis
+
+
+def _att_data(state, slot, index, head_root):
+    epoch = slot // P.SLOTS_PER_EPOCH
+    start = epoch * P.SLOTS_PER_EPOCH
+    target_root = (
+        head_root if start >= state.slot else get_block_root_at_slot(state, start)
+    )
+    return {
+        "slot": slot,
+        "index": index,
+        "beacon_block_root": head_root,
+        "source": dict(state.current_justified_checkpoint),
+        "target": {"epoch": epoch, "root": target_root},
+    }
+
+
+def _sign_att(cfg, sk, state, data):
+    domain = cfg.get_domain(
+        state.slot, params.DOMAIN_BEACON_ATTESTER, data["slot"]
+    )
+    root = cfg.compute_signing_root(
+        T.AttestationData.hash_tree_root(data), domain
+    )
+    return B.sign_bytes(sk, root)
+
+
+def test_attestation_pool_aggregates(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2)
+    committee = get_beacon_committee(st, 1, 0)
+    head = get_block_root_at_slot(st, 1)
+    data = _att_data(st, 1, 0, head)
+
+    pool = AttestationPool()
+    n = len(committee)
+    for pos, vidx in enumerate(committee):
+        bits = [i == pos for i in range(n)]
+        att = {
+            "aggregation_bits": bits,
+            "data": data,
+            "signature": _sign_att(cfg, sks[int(vidx)], st, data),
+        }
+        status = pool.add(att)
+        assert status == ("added" if pos == 0 else "aggregated")
+        # duplicate is rejected
+        assert pool.add(att) == "already_known"
+
+    agg = pool.get_aggregate(1, T.AttestationData.hash_tree_root(data))
+    assert all(agg["aggregation_bits"])
+    # the aggregate signature is the valid aggregate over all members
+    from lodestar_tpu.state_transition.block import is_valid_indexed_attestation
+
+    indexed = {
+        "attesting_indices": sorted(int(v) for v in committee),
+        "data": data,
+        "signature": agg["signature"],
+    }
+    assert is_valid_indexed_attestation(st, indexed)
+
+
+def test_aggregated_pool_subset_and_ranking(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2)
+    committee = get_beacon_committee(st, 1, 0)
+    head = get_block_root_at_slot(st, 1)
+    data = _att_data(st, 1, 0, head)
+    n = len(committee)
+
+    pool = AggregatedAttestationPool()
+    full = {
+        "aggregation_bits": [True] * n,
+        "data": data,
+        "signature": bytes([0xC0]) + b"\x00" * 95,
+    }
+    assert pool.add(full) == "added"
+    subset = dict(full, aggregation_bits=[True] + [False] * (n - 1))
+    assert pool.add(subset) == "already_known"
+
+    atts = pool.get_attestations_for_block(st)
+    assert len(atts) == 1 and all(atts[0]["aggregation_bits"])
+
+    # attestation from the future is not includable
+    future = dict(full, data=dict(data, slot=st.slot))
+    pool.add(future)
+    assert len(pool.get_attestations_for_block(st)) == 1
+
+    pool.prune(clock_slot=2 + P.SLOTS_PER_EPOCH)
+    assert pool.size() == 1  # slot-1 pruned, slot-2 (future) survives
+    pool.prune(clock_slot=3 + P.SLOTS_PER_EPOCH)
+    assert pool.size() == 0
+
+
+def test_op_pool_dedupe_and_selection(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 1)
+    op = OpPool()
+    h1 = {
+        "slot": 1,
+        "proposer_index": 2,
+        "parent_root": b"\x01" * 32,
+        "state_root": b"\x02" * 32,
+        "body_root": b"\x03" * 32,
+    }
+    sl = {
+        "signed_header_1": {"message": h1, "signature": b"\x00" * 96},
+        "signed_header_2": {
+            "message": dict(h1, body_root=b"\x04" * 32),
+            "signature": b"\x00" * 96,
+        },
+    }
+    op.insert_proposer_slashing(sl)
+    op.insert_proposer_slashing(sl)  # dedupe
+    ps, atts, exits = op.get_slashings_and_exits(st)
+    assert len(ps) == 1 and not atts and not exits
+
+    # after the offender is slashed, selection skips it
+    st.slashed[2] = True
+    ps2, _, _ = op.get_slashings_and_exits(st)
+    assert not ps2
+    op.prune_all(st)
+    ps3, _, _ = op.get_slashings_and_exits(genesis)
+    assert not ps3
+
+
+def test_sync_pools_and_contribution(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2)
+    head = get_block_root_at_slot(st, 1)
+
+    domain = cfg.get_domain(st.slot, params.DOMAIN_SYNC_COMMITTEE, 1)
+    root = cfg.compute_signing_root(head, domain)
+    sk_of = {pks[i]: sks[i] for i in range(len(sks))}
+
+    msg_pool = SyncCommitteeMessagePool()
+    contrib_pool = SyncContributionAndProofPool()
+    subnet_size = P.SYNC_COMMITTEE_SIZE // params.SYNC_COMMITTEE_SUBNET_COUNT
+    for pos, pk in enumerate(st.current_sync_committee["pubkeys"]):
+        subnet, idx = divmod(pos, subnet_size)
+        msg = {
+            "slot": 1,
+            "beacon_block_root": head,
+            "validator_index": 0,
+            "signature": B.sign_bytes(sk_of[pk], root),
+        }
+        msg_pool.add(subnet, msg, idx)
+    for subnet in range(params.SYNC_COMMITTEE_SUBNET_COUNT):
+        contrib = msg_pool.get_contribution(1, head, subnet)
+        assert contrib is not None and all(contrib["aggregation_bits"])
+        assert contrib_pool.add(contrib) == "added"
+
+    agg = contrib_pool.produce_sync_aggregate(1, head)
+    assert all(agg["sync_committee_bits"])
+    # the merged signature verifies inside process_sync_aggregate
+    from lodestar_tpu.state_transition.block import process_sync_aggregate
+
+    process_sync_aggregate(st, agg, True)
+
+
+def test_block_from_pools_verifies_end_to_end(world):
+    """The produceBlock path: pools -> block -> full verification."""
+    cfg, sks, pks, genesis = world
+
+    # block 1: empty
+    b1, post1 = produce_block(
+        genesis, 1, _signed_reveal(cfg, sks, genesis, 1)
+    )
+    head1 = T.BeaconBlockAltair.hash_tree_root(b1)
+
+    # gossip: every committee member attests block 1...
+    agg_pool = AggregatedAttestationPool()
+    att_pool = AttestationPool()
+    epoch = 1 // P.SLOTS_PER_EPOCH
+    for index in range(get_committee_count_per_slot(post1, epoch)):
+        committee = get_beacon_committee(post1, 1, index)
+        data = _att_data(post1, 1, index, head1)
+        n = len(committee)
+        for pos, vidx in enumerate(committee):
+            att_pool.add(
+                {
+                    "aggregation_bits": [i == pos for i in range(n)],
+                    "data": data,
+                    "signature": _sign_att(cfg, sks[int(vidx)], post1, data),
+                }
+            )
+        agg_pool.add(
+            att_pool.get_aggregate(1, T.AttestationData.hash_tree_root(data))
+        )
+
+    # ...and the sync committee signs it
+    msg_pool = SyncCommitteeMessagePool()
+    contrib_pool = SyncContributionAndProofPool()
+    domain = cfg.get_domain(2, params.DOMAIN_SYNC_COMMITTEE, 1)
+    sroot = cfg.compute_signing_root(head1, domain)
+    sk_of = {pks[i]: sks[i] for i in range(len(pks))}
+    subnet_size = P.SYNC_COMMITTEE_SIZE // params.SYNC_COMMITTEE_SUBNET_COUNT
+    for pos, pk in enumerate(post1.current_sync_committee["pubkeys"]):
+        subnet, idx = divmod(pos, subnet_size)
+        msg_pool.add(
+            subnet,
+            {
+                "slot": 1,
+                "beacon_block_root": head1,
+                "validator_index": 0,
+                "signature": B.sign_bytes(sk_of[pk], sroot),
+            },
+            idx,
+        )
+    for subnet in range(params.SYNC_COMMITTEE_SUBNET_COUNT):
+        contrib_pool.add(msg_pool.get_contribution(1, head1, subnet))
+
+    # block 2 assembled from the pools, then fully verified
+    b2, post2 = produce_block_from_pools(
+        post1,
+        2,
+        _signed_reveal(cfg, sks, post1, 2),
+        aggregated_attestation_pool=agg_pool,
+        op_pool=OpPool(),
+        contribution_pool=contrib_pool,
+        head_root=head1,
+    )
+    assert len(b2["body"]["attestations"]) >= 1
+    assert all(b2["body"]["sync_aggregate"]["sync_committee_bits"])
+
+    pdomain = cfg.get_domain(2, params.DOMAIN_BEACON_PROPOSER)
+    proot = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(b2), pdomain
+    )
+    signed = {
+        "message": b2,
+        "signature": B.sign_bytes(sks[b2["proposer_index"]], proot),
+    }
+    post = state_transition(
+        post1,
+        signed,
+        verify_state_root=True,
+        verify_proposer=True,
+        verify_signatures=True,
+    )
+    assert post.hash_tree_root() == b2["state_root"]
+    # attesters got their participation flags
+    assert post.current_epoch_participation.sum() > 0
+
+
+def _signed_reveal(cfg, sks, state, slot):
+    pre = state.clone()
+    process_slots(pre, slot)
+    proposer = get_beacon_proposer_index(pre)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    domain = cfg.get_domain(slot, params.DOMAIN_RANDAO)
+    root = cfg.compute_signing_root(uint64.hash_tree_root(epoch), domain)
+    return B.sign_bytes(sks[proposer], root)

@@ -1,0 +1,176 @@
+"""State regen + state caches over a real imported chain.
+
+Reference behavior: packages/beacon-node/src/chain/regen/regen.ts
+(getPreState / getCheckpointState replay from the nearest cached state),
+chain/stateCache/stateContextCache.ts (LRU), queued.ts (serialized API).
+"""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.produce_block import produce_block
+from lodestar_tpu.chain.regen import (
+    QueuedStateRegenerator,
+    RegenError,
+    StateRegenerator,
+)
+from lodestar_tpu.chain.state_cache import (
+    CheckpointStateCache,
+    StateContextCache,
+)
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.fork_choice import ForkChoice, ProtoArray
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+
+P = params.ACTIVE_PRESET
+N_BLOCKS = 4
+
+
+@pytest.fixture(scope="module")
+def imported_chain(tmp_path_factory):
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"regen-%d" % i) for i in range(16)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=7)
+    genesis_root = T.BeaconBlockHeader.hash_tree_root(
+        dict(genesis.latest_block_header, state_root=genesis.hash_tree_root())
+    ).hex()
+
+    fork_choice = ForkChoice(
+        ProtoArray(finalized_root=genesis_root), justified_root=genesis_root
+    )
+    db = BeaconDb(str(tmp_path_factory.mktemp("regen-db") / "kv"))
+    regen = StateRegenerator(fork_choice, db)
+    regen.block_state_roots[genesis_root] = genesis.hash_tree_root().hex()
+    regen.state_cache.add(genesis)
+
+    state = genesis
+    roots = [genesis_root]
+    posts = [genesis]
+    for slot in range(1, N_BLOCKS + 1):
+        block, post = produce_block(
+            state, slot, hashlib.sha256(b"rv%d" % slot).digest() * 3
+        )
+        root = T.BeaconBlockAltair.hash_tree_root(block)
+        signed = {"message": block, "signature": b"\x00" * 96}
+        fork_choice.on_block(slot, root.hex(), block["parent_root"].hex())
+        db.put_block(root, signed)
+        regen.on_imported_block(root, post)
+        state = post
+        roots.append(root.hex())
+        posts.append(post)
+    yield cfg, regen, roots, posts
+    db.close()
+
+
+def test_pre_state_cached(imported_chain):
+    _, regen, roots, posts = imported_chain
+    # pre-state of a would-be block at head+1 == head post-state advanced
+    st = regen.get_block_slot_state(roots[-1], N_BLOCKS)
+    assert st.hash_tree_root() == posts[-1].hash_tree_root()
+    advanced = regen.get_block_slot_state(roots[-1], N_BLOCKS + 2)
+    assert advanced.slot == N_BLOCKS + 2
+    # the cached head state must not have been mutated by the advance
+    assert posts[-1].slot == N_BLOCKS
+
+
+def test_replay_after_eviction(imported_chain):
+    _, regen, roots, posts = imported_chain
+    # evict every post-state; keep only genesis
+    for post in posts[1:]:
+        regen.state_cache.delete(post.hash_tree_root().hex())
+    before = regen.replayed_blocks
+    st = regen.get_block_slot_state(roots[-1], N_BLOCKS)
+    assert st.hash_tree_root() == posts[-1].hash_tree_root()
+    assert regen.replayed_blocks == before + N_BLOCKS
+
+
+def test_get_pre_state_for_block(imported_chain):
+    _, regen, roots, posts = imported_chain
+    fake_next = {
+        "parent_root": bytes.fromhex(roots[2]),
+        "slot": 3,
+    }
+    st = regen.get_pre_state(fake_next)
+    assert st.slot == 3
+    # equals block-3's pre-state: post of block 2 advanced to slot 3
+    manual = posts[2].clone()
+    from lodestar_tpu.state_transition import process_slots
+
+    process_slots(manual, 3)
+    assert st.hash_tree_root() == manual.hash_tree_root()
+
+
+def test_checkpoint_state(imported_chain):
+    _, regen, roots, posts = imported_chain
+    cp = {"epoch": 1, "root": bytes.fromhex(roots[-1])}
+    st = regen.get_checkpoint_state(cp)
+    assert st.slot == P.SLOTS_PER_EPOCH
+    # second call is a cache hit (same object)
+    assert regen.get_checkpoint_state(cp) is st
+
+
+def test_regen_errors(imported_chain):
+    _, regen, roots, posts = imported_chain
+    with pytest.raises(RegenError):
+        regen.get_state("ab" * 32)
+    with pytest.raises(RegenError):
+        regen.get_block_slot_state("cd" * 32, 5)
+    with pytest.raises(RegenError):
+        regen.get_block_slot_state(roots[-1], 0)  # slot before block
+
+
+def test_state_cache_lru_bounds():
+    cache = StateContextCache(max_states=3)
+
+    class FakeState:
+        def __init__(self, n):
+            self.n = n
+
+        def hash_tree_root(self):
+            return bytes([self.n]) * 32
+
+    for i in range(5):
+        cache.add(FakeState(i))
+    assert len(cache) == 3
+    assert cache.get((b"\x00" * 32).hex()) is None  # oldest evicted
+    assert cache.get((b"\x04" * 32).hex()) is not None
+    cache.prune((b"\x04" * 32).hex())
+    assert len(cache) == 1
+
+
+def test_checkpoint_cache_pruning():
+    cache = CheckpointStateCache(max_epochs=2)
+    for epoch in range(4):
+        cache.add({"epoch": epoch, "root": b"\xaa" * 32}, object())
+    assert len(cache) == 2
+    assert cache.get({"epoch": 0, "root": b"\xaa" * 32}) is None
+    assert cache.get({"epoch": 3, "root": b"\xaa" * 32}) is not None
+    latest = cache.get_latest((b"\xaa" * 32).hex(), max_epoch=10)
+    assert latest is cache.get({"epoch": 3, "root": b"\xaa" * 32})
+    cache.prune_finalized(4)
+    assert len(cache) == 0
+
+
+def test_queued_regen(imported_chain):
+    _, regen, roots, posts = imported_chain
+    q = QueuedStateRegenerator(regen)
+    try:
+        fut = q.get_block_slot_state(roots[1], 1)
+        assert fut.result(timeout=30).hash_tree_root() == posts[
+            1
+        ].hash_tree_root()
+        bad = q.get_state("ee" * 32)
+        with pytest.raises(RegenError):
+            bad.result(timeout=30)
+    finally:
+        q.close()

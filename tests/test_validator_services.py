@@ -1,0 +1,152 @@
+"""Block-proposal + sync-committee duty services (validator client).
+
+Reference: packages/validator/src/services/block.ts,
+syncCommittee.ts, blockDuties.ts, syncCommitteeDuties.ts — duty
+polling, produce/sign/publish, slashing-protection refusal, aggregator
+selection.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.validator import (
+    BlockProposalService,
+    SyncCommitteeService,
+    ValidatorStore,
+)
+from lodestar_tpu.validator import sync_committee_service as scs_mod
+
+P = params.ACTIVE_PRESET
+
+
+@pytest.fixture()
+def store():
+    sks = {i: B.keygen(b"vsvc-%d" % i) for i in range(2)}
+    return ValidatorStore(MAINNET_CHAIN_CONFIG, sks)
+
+
+class FakeBlockApi:
+    def __init__(self):
+        self.published = []
+
+    def get_proposer_duties(self, epoch):
+        return [
+            {"validator_index": 0, "slot": epoch * P.SLOTS_PER_EPOCH + 5},
+            {"validator_index": 99, "slot": epoch * P.SLOTS_PER_EPOCH + 6},
+        ]
+
+    def produce_block_v2(self, slot, randao_reveal, graffiti):
+        return {
+            "slot": slot,
+            "proposer_index": 0,
+            "parent_root": b"\x01" * 32,
+            "state_root": b"\x02" * 32,
+            "body": dict(
+                T.BeaconBlockBodyAltair.default(), randao_reveal=randao_reveal
+            ),
+        }
+
+    def publish_block(self, signed):
+        self.published.append(signed)
+
+
+def test_block_service_proposes_and_protects(store):
+    api = FakeBlockApi()
+    svc = BlockProposalService(store, api)
+    svc.poll_duties(0)
+    # duty for foreign validator 99 filtered out
+    assert len(svc._duties[0]) == 1
+    assert svc.run_block_tasks(0, 5) == 1
+    assert len(api.published) == 1
+    signed = api.published[0]
+    # published signature verifies against the store's pubkey
+    root = store.config.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(signed["message"]),
+        store.config.get_domain(5, params.DOMAIN_BEACON_PROPOSER, 5),
+    )
+    assert B.verify_bytes(store.pubkeys[0], root, signed["signature"])
+    # same-slot re-proposal is refused by slashing protection
+    svc2 = BlockProposalService(store, api)
+    svc2.poll_duties(0)
+    assert svc2.run_block_tasks(0, 5) == 0
+    assert svc2.skipped_slashable == 1
+    # nothing scheduled at another slot
+    assert svc.run_block_tasks(0, 7) == 0
+
+
+class FakeSyncApi:
+    def __init__(self):
+        self.messages = []
+        self.contributions = []
+        self.head = b"\x77" * 32
+
+    def get_sync_committee_duties(self, epoch, indices):
+        return [{"validator_index": 0, "positions": [0, 130]}]
+
+    def get_head_root(self, slot):
+        return self.head
+
+    def submit_sync_committee_message(self, subnet, message, index_in_subnet):
+        self.messages.append((subnet, message, index_in_subnet))
+
+    def produce_sync_contribution(self, slot, root, subnet):
+        size = P.SYNC_COMMITTEE_SIZE // params.SYNC_COMMITTEE_SUBNET_COUNT
+        return {
+            "slot": slot,
+            "beacon_block_root": root,
+            "subcommittee_index": subnet,
+            "aggregation_bits": [True] + [False] * (size - 1),
+            "signature": bytes([0xC0]) + b"\x00" * 95,
+        }
+
+    def publish_contribution_and_proof(self, signed):
+        self.contributions.append(signed)
+
+
+def test_sync_committee_service(store, monkeypatch):
+    api = FakeSyncApi()
+    svc = SyncCommitteeService(store, api)
+    svc.poll_duties(0)
+    monkeypatch.setattr(
+        scs_mod, "is_sync_committee_aggregator", lambda proof: True
+    )
+    n = svc.run_sync_committee_tasks(0, 3)
+    assert n == 2  # two positions
+    subnet_size = P.SYNC_COMMITTEE_SIZE // params.SYNC_COMMITTEE_SUBNET_COUNT
+    subnets = sorted(s for s, _, _ in api.messages)
+    assert subnets == sorted([0, 130 // subnet_size])
+    # message signature verifies over the head root
+    _, message, _ = api.messages[0]
+    root = store.config.compute_signing_root(
+        api.head, store.config.get_domain(3, params.DOMAIN_SYNC_COMMITTEE, 3)
+    )
+    assert B.verify_bytes(store.pubkeys[0], root, message["signature"])
+    # aggregator leg produced signed contributions
+    assert len(api.contributions) == 2
+    cap = api.contributions[0]
+    root = store.config.compute_signing_root(
+        T.ContributionAndProof.hash_tree_root(cap["message"]),
+        store.config.get_domain(3, params.DOMAIN_CONTRIBUTION_AND_PROOF, 3),
+    )
+    assert B.verify_bytes(store.pubkeys[0], root, cap["signature"])
+
+
+def test_aggregator_selection_distribution():
+    # ~1/modulo of random proofs select as aggregator
+    hits = sum(
+        1
+        for i in range(256)
+        if scs_mod.is_sync_committee_aggregator(i.to_bytes(96, "big"))
+    )
+    modulo = max(
+        1,
+        P.SYNC_COMMITTEE_SIZE
+        // params.SYNC_COMMITTEE_SUBNET_COUNT
+        // scs_mod.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    assert 0 < hits < 256
+    assert abs(hits - 256 // modulo) < 256 // modulo  # loose band
