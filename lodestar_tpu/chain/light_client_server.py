@@ -1,0 +1,159 @@
+"""LightClientServer — produce light-client updates from imported blocks.
+
+Reference: packages/beacon-node/src/chain/lightClient/index.ts
+(LightClientServer: onImportBlock -> persist best update per period,
+latest finality/optimistic updates, bootstrap by block root).  An
+imported block's sync_aggregate attests its parent; the parent's
+post-state supplies the finality and next-sync-committee merkle
+branches (produced here with ssz.container_branch — the
+persistent-merkle-tree getSingleProof analog).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import params
+from ..light_client.lightclient import LightClientUpdate, sync_period
+from ..ssz.core import container_branch, container_branches
+from ..state_transition.state import BeaconStateAltair
+from ..types import BeaconBlockBodyAltair, BeaconBlockHeader
+from ..utils.logger import get_logger
+from .emitter import ChainEvent
+
+P = params.ACTIVE_PRESET
+
+
+def _block_header_value(block: dict) -> dict:
+    return {
+        "slot": block["slot"],
+        "proposer_index": block["proposer_index"],
+        "parent_root": block["parent_root"],
+        "state_root": block["state_root"],
+        "body_root": BeaconBlockBodyAltair.hash_tree_root(block["body"]),
+    }
+
+
+class LightClientServer:
+    def __init__(self, chain):
+        self.chain = chain
+        self.log = get_logger("chain/lightclient")
+        self.best_update_by_period: Dict[int, LightClientUpdate] = {}
+        self.latest_finality_update: Optional[LightClientUpdate] = None
+        self.latest_optimistic_update: Optional[LightClientUpdate] = None
+        self.produced = 0
+        chain.emitter.on(ChainEvent.block, self.on_imported_block)
+
+    # -- production (reference: lightClient/index.ts onImportBlock) --------
+
+    def on_imported_block(self, signed_block: dict, root: bytes) -> None:
+        block = signed_block["message"]
+        agg = block["body"].get("sync_aggregate")
+        if agg is None or not any(agg["sync_committee_bits"]):
+            return
+        parent_hex = block["parent_root"].hex()
+        try:
+            attested_state = self.chain.regen._get_post_state(parent_hex)
+        except Exception as e:  # parent state unavailable: skip quietly
+            self.log.warn("no attested state for light client", error=str(e))
+            return
+        if self.chain.db is not None:
+            parent_signed = self.chain.db.get_block_anywhere(
+                block["parent_root"]
+            )
+        else:
+            parent_signed = None
+        if parent_signed is not None:
+            attested_header = _block_header_value(parent_signed["message"])
+        else:
+            # anchor parent: its header lives in the state
+            attested_header = dict(attested_state.latest_block_header)
+            if attested_header["state_root"] == b"\x00" * 32:
+                attested_header["state_root"] = (
+                    attested_state.hash_tree_root()
+                )
+
+        # one field-root pass serves both proofs (the validator-registry
+        # merkleization dominates; see ssz.container_branches)
+        state_value = attested_state.to_value()
+        (
+            (_leaf, nsc_branch, _nd, _ni),
+            (_froot, fin_branch, _fd, _fi),
+        ) = container_branches(
+            BeaconStateAltair,
+            state_value,
+            [["next_sync_committee"], ["finalized_checkpoint", "root"]],
+        )
+
+        finalized_header = None
+        finality_branch = None
+        fin_root = attested_state.finalized_checkpoint["root"]
+        if any(fin_root) and self.chain.db is not None:
+            # archived finalized blocks remain reachable via the root
+            # index (the Archiver migrates them out of the hot repo)
+            fin_signed = self.chain.db.get_block_anywhere(fin_root)
+            if fin_signed is not None:
+                finalized_header = _block_header_value(fin_signed["message"])
+                finality_branch = fin_branch
+
+        update = LightClientUpdate(
+            attested_header=attested_header,
+            sync_committee_bits=list(agg["sync_committee_bits"]),
+            sync_committee_signature=agg["sync_committee_signature"],
+            signature_slot=block["slot"],
+            finalized_header=finalized_header,
+            finality_branch=finality_branch,
+            next_sync_committee=dict(
+                attested_state.next_sync_committee
+            ),
+            next_sync_committee_branch=nsc_branch,
+        )
+        self.produced += 1
+
+        period = sync_period(attested_header["slot"])
+        best = self.best_update_by_period.get(period)
+        # spec is_better_update (simplified): finality wins over raw
+        # participation; participation breaks ties
+        new_rank = (
+            update.finalized_header is not None,
+            sum(update.sync_committee_bits),
+        )
+        if best is None or new_rank > (
+            best.finalized_header is not None,
+            sum(best.sync_committee_bits),
+        ):
+            self.best_update_by_period[period] = update
+        self.latest_optimistic_update = update
+        if finalized_header is not None:
+            self.latest_finality_update = update
+        self.chain.emitter.emit(ChainEvent.light_client_update, update)
+
+    # -- serving (reference: lightClient/index.ts getUpdate/getBootstrap) --
+
+    def get_update(self, period: int) -> Optional[LightClientUpdate]:
+        return self.best_update_by_period.get(period)
+
+    def get_finality_update(self) -> Optional[LightClientUpdate]:
+        return self.latest_finality_update
+
+    def get_optimistic_update(self) -> Optional[LightClientUpdate]:
+        return self.latest_optimistic_update
+
+    def get_bootstrap(self, block_root: bytes) -> Optional[dict]:
+        """{header, current_sync_committee, branch} for a trusted root."""
+        if self.chain.db is None:
+            return None
+        signed = self.chain.db.get_block_anywhere(block_root)
+        if signed is None:
+            return None
+        header = _block_header_value(signed["message"])
+        state = self.chain.regen._get_post_state(block_root.hex())
+        state_value = state.to_value()
+        _leaf, branch, depth, index = container_branch(
+            BeaconStateAltair, state_value, ["current_sync_committee"]
+        )
+        return {
+            "header": header,
+            "current_sync_committee": dict(state.current_sync_committee),
+            "current_sync_committee_branch": branch,
+        }

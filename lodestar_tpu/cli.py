@@ -1,0 +1,293 @@
+"""lodestar-tpu CLI — beacon / validator / lightclient / bench entry.
+
+Mirror of the reference's packages/cli (reference: cli/src/index.ts,
+cli/src/cmds/{beacon,validator,lightclient}/): argument groups per
+subcommand, preset/network selection via flags, composed over the same
+library surface the tests drive.  Kept argparse-native (no yargs
+analog needed) and import-light so `--help` is instant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="lodestar-tpu",
+        description="TPU-native beacon chain framework "
+        "(capability mirror of ChainSafe Lodestar)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    beacon = sub.add_parser("beacon", help="run a beacon node")
+    beacon.add_argument("--preset", default=None, choices=["mainnet", "minimal"])
+    beacon.add_argument("--db-path", default=None)
+    beacon.add_argument("--api-port", type=int, default=9596)
+    beacon.add_argument("--genesis-time", type=int, default=None)
+    beacon.add_argument(
+        "--validators", type=int, default=16,
+        help="dev-mode interop validator count",
+    )
+    beacon.add_argument(
+        "--slots", type=int, default=0,
+        help="dev mode: self-propose this many slots then exit (0 = serve forever)",
+    )
+
+    validator = sub.add_parser("validator", help="run a validator client")
+    validator.add_argument("--beacon-urls", nargs="+", required=True)
+    validator.add_argument(
+        "--interop-indices", type=int, nargs="+", required=True,
+        help="interop validator indices to run (keys derived as in dev mode)",
+    )
+    validator.add_argument("--slots", type=int, default=1)
+
+    bench = sub.add_parser("bench", help="run the headline TPU benchmark")
+    bench.add_argument("--mode", default="wire", choices=["wire", "decoded"])
+
+    lc = sub.add_parser("lightclient", help="run a light client (in-process demo)")
+    lc.add_argument("--slots", type=int, default=2)
+
+    return parser
+
+
+def _interop_keys(n: int):
+    from .crypto import bls as B
+    from .crypto import curves as C
+
+    sks = [B.keygen(b"lodestar-tpu-interop-%d" % i) for i in range(n)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    return sks, pks
+
+
+def _dev_chain(args):
+    from .chain.chain import BeaconChain
+    from .config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from .db import BeaconDb
+    from .params import ForkName
+    from .state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        genesis_time=(
+            args.genesis_time
+            if getattr(args, "genesis_time", None) is not None
+            else int(time.time())
+        ),
+        fork_epochs={ForkName.altair: 0},
+    )
+    sks, pks = _interop_keys(args.validators)
+    genesis = create_genesis_state(
+        cfg, pks, genesis_time=cfg.genesis_time
+    )
+    chain = BeaconChain(cfg, genesis, db=BeaconDb(args.db_path))
+    return cfg, sks, pks, chain
+
+
+def cmd_beacon(args) -> int:
+    from .api.server import BeaconApiServer, DefaultHandlers
+    from .chain.archiver import Archiver
+    from .chain.light_client_server import LightClientServer
+
+    cfg, sks, pks, chain = _dev_chain(args)
+    Archiver(chain)
+    LightClientServer(chain)
+    server = BeaconApiServer(
+        DefaultHandlers(
+            genesis_time=cfg.genesis_time,
+            genesis_validators_root=cfg.genesis_validators_root,
+            chain=chain,
+        ),
+        port=args.api_port,
+    )
+    server.listen()
+    print(
+        json.dumps(
+            {
+                "msg": "beacon node up",
+                "api_port": server.port,
+                "validators": len(pks),
+                "genesis_time": cfg.genesis_time,
+            }
+        )
+    )
+    try:
+        if args.slots:
+            # dev mode: self-propose through the validator services
+            from .api.client import ApiClient
+            from .validator import BlockProposalService, ValidatorStore
+
+            from . import params as _p
+
+            client = ApiClient([f"http://127.0.0.1:{server.port}"], timeout=120)
+            store = ValidatorStore(cfg, dict(enumerate(sks)))
+            svc = BlockProposalService(store, client)
+            for slot in range(1, args.slots + 1):
+                epoch = slot // _p.SLOTS_PER_EPOCH
+                if not svc.duties_at_slot(epoch, slot):
+                    svc.poll_duties(epoch)
+                n = svc.run_block_tasks(epoch, slot)
+                print(
+                    json.dumps(
+                        {"slot": slot, "proposed": n, "head": chain.head_root_hex[:16]}
+                    )
+                )
+            return 0
+        while True:  # serve until interrupted
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        server.close()
+    return 0
+
+
+def cmd_validator(args) -> int:
+    from .api.client import ApiClient
+    from .config import MAINNET_CHAIN_CONFIG
+    from .validator import (
+        AttestationService,
+        BlockProposalService,
+        ValidatorStore,
+    )
+    from . import params as _p
+
+    client = ApiClient(args.beacon_urls, timeout=120)
+    genesis = client.get_genesis()
+    sks, _pks = _interop_keys(max(args.interop_indices) + 1)
+    store = ValidatorStore(
+        MAINNET_CHAIN_CONFIG, {i: sks[i] for i in args.interop_indices}
+    )
+    blocks = BlockProposalService(store, client)
+    atts = AttestationService(store, client)
+    for slot in range(1, args.slots + 1):
+        epoch = slot // _p.SLOTS_PER_EPOCH
+        blocks.poll_duties(epoch)
+        atts.poll_duties(epoch)
+        proposed = blocks.run_block_tasks(epoch, slot)
+        attested = atts.run_attestation_tasks(epoch, slot)
+        aggregated = atts.run_aggregation_tasks(epoch, slot)
+        print(
+            json.dumps(
+                {
+                    "slot": slot,
+                    "proposed": proposed,
+                    "attested": attested,
+                    "aggregated": aggregated,
+                }
+            )
+        )
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import os
+    import runpy
+
+    os.environ["BENCH_MODE"] = args.mode
+    runpy.run_path(
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
+        run_name="__main__",
+    )
+    return 0
+
+
+def cmd_lightclient(args) -> int:
+    # in-process demo: a dev chain produces sync-aggregated blocks, the
+    # LightClientServer emits updates, and a Lightclient follows them
+    from types import SimpleNamespace
+
+    from . import params as _p
+    from .chain.light_client_server import LightClientServer
+    from .chain.produce_block import produce_block
+    from .crypto import bls as B
+    from .crypto import curves as C
+    from .light_client.lightclient import Lightclient
+    from .ssz import uint64
+    from .state_transition import process_slots
+    from .state_transition.accessors import get_beacon_proposer_index
+    from . import types as T
+
+    ns = SimpleNamespace(
+        preset=None, db_path=None, api_port=0, genesis_time=0,
+        validators=16, slots=args.slots,
+    )
+    cfg, sks, pks, chain = _dev_chain(ns)
+    server = LightClientServer(chain)
+    anchor_header = dict(chain.head_state.latest_block_header)
+    anchor_header["state_root"] = chain.head_state.hash_tree_root()
+    client = Lightclient(
+        cfg, anchor_header, chain.head_state.current_sync_committee["pubkeys"]
+    )
+    print(json.dumps({"msg": "lightclient bootstrapped", "slot": 0}))
+
+    sk_of = {pks[i]: sks[i] for i in range(len(pks))}
+    for slot in range(1, args.slots + 1):
+        head = chain.head_state
+        pre = head.clone()
+        if pre.slot < slot:
+            process_slots(pre, slot)
+        proposer = get_beacon_proposer_index(pre)
+        epoch = slot // _p.SLOTS_PER_EPOCH
+        reveal = B.sign_bytes(
+            sks[proposer],
+            cfg.compute_signing_root(
+                uint64.hash_tree_root(epoch),
+                cfg.get_domain(slot, _p.DOMAIN_RANDAO),
+            ),
+        )
+        sync_aggregate = None
+        if slot > 1:  # the aggregate attests the parent block
+            sroot = cfg.compute_signing_root(
+                chain.get_head_root(),
+                cfg.get_domain(slot, _p.DOMAIN_SYNC_COMMITTEE, slot - 1),
+            )
+            committee = head.current_sync_committee["pubkeys"]
+            sig = B.aggregate_signatures(
+                [B.sign(sk_of[pk], sroot) for pk in committee]
+            )
+            sync_aggregate = {
+                "sync_committee_bits": [True] * _p.SYNC_COMMITTEE_SIZE,
+                "sync_committee_signature": C.g2_compress(sig),
+            }
+        block, _post = produce_block(
+            head, slot, reveal, sync_aggregate=sync_aggregate
+        )
+        broot = cfg.compute_signing_root(
+            T.BeaconBlockAltair.hash_tree_root(block),
+            cfg.get_domain(slot, _p.DOMAIN_BEACON_PROPOSER, slot),
+        )
+        chain.process_block(
+            {"message": block, "signature": B.sign_bytes(sks[proposer], broot)}
+        )
+        update = server.get_optimistic_update()
+        if update is not None:
+            client.process_update(update)
+        print(
+            json.dumps(
+                {
+                    "slot": slot,
+                    "lc_optimistic_slot": client.optimistic_header["slot"],
+                    "updates_produced": server.produced,
+                }
+            )
+        )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {
+        "beacon": cmd_beacon,
+        "validator": cmd_validator,
+        "bench": cmd_bench,
+        "lightclient": cmd_lightclient,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

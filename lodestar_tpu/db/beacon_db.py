@@ -25,6 +25,11 @@ class BeaconDb:
         self.block_archive = Repository(
             db, Bucket.block_archive, T.SignedBeaconBlockAltair
         )
+        # root -> slot key for archived blocks (reference:
+        # blockArchiveRootIndex in db/repositories/blockArchive.ts)
+        self.block_archive_root_index = Repository(
+            db, Bucket.block_archive_root_index
+        )
         self.state_archive = Repository(db, Bucket.state_archive)
         self.proposer_slashing = Repository(
             db, Bucket.proposer_slashing, T.ProposerSlashing
@@ -40,8 +45,26 @@ class BeaconDb:
     def put_block(self, root: bytes, signed_block: dict) -> None:
         self.block.put(root, signed_block)
 
-    def archive_block(self, slot: int, signed_block: dict) -> None:
+    def archive_block(
+        self, slot: int, signed_block: dict, root: bytes = None
+    ) -> None:
         self.block_archive.put(_slot_key(slot), signed_block)
+        if root is not None:
+            self.block_archive_root_index.put(root, _slot_key(slot))
+
+    def get_block_anywhere(self, root: bytes):
+        """Hot repo first, then the slot-keyed archive via the root
+        index — blocks survive archiver migration for readers."""
+        signed = self.block.get(root)
+        if signed is not None:
+            return signed
+        slot_key = self.block_archive_root_index.get(root)
+        if slot_key is None:
+            return None
+        return self.block_archive.get(slot_key)
+
+    def archive_state(self, slot: int, state_bytes: bytes) -> None:
+        self.state_archive.put(_slot_key(slot), state_bytes)
 
     def close(self) -> None:
         self.controller.close()

@@ -32,6 +32,7 @@ class Bucket(enum.IntEnum):
     bls_to_execution_change = 9
     light_client_update = 10
     backfilled_ranges = 11
+    block_archive_root_index = 12
 
 
 class Repository(Generic[T]):

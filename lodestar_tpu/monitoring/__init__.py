@@ -1,0 +1,7 @@
+"""Remote monitoring — periodic metric snapshots to a collector URL.
+
+Mirror of the reference's packages/beacon-node/src/monitoring/
+(MonitoringService posting beaconnodestats to a remote endpoint).
+"""
+
+from .service import MonitoringService  # noqa: F401

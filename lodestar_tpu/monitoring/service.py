@@ -1,0 +1,123 @@
+"""MonitoringService — push node stats to a remote collector.
+
+Reference: packages/beacon-node/src/monitoring/service.ts
+(MonitoringService: collect client/system/beacon stats on an interval,
+POST JSON to the configured endpoint with a collect timeout) and
+monitoring/clientStats.ts (the beaconnodestats/validatorstats shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logger import get_logger
+
+CLIENT_NAME = "lodestar-tpu"
+CLIENT_VERSION = "0.3.0"
+
+
+class MonitoringService:
+    def __init__(
+        self,
+        endpoint: str,
+        *,
+        chain=None,
+        bls_metrics=None,
+        interval_s: float = 60.0,
+        collect_system: bool = True,
+        timeout_s: float = 10.0,
+    ):
+        self.endpoint = endpoint
+        self.chain = chain
+        self.bls_metrics = bls_metrics
+        self.interval_s = interval_s
+        self.collect_system = collect_system
+        self.timeout_s = timeout_s
+        self.log = get_logger("monitoring")
+        self.sent = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- stats collection (reference: clientStats.ts) ----------------------
+
+    def collect(self) -> List[Dict]:
+        now_ms = int(time.time() * 1000)
+        common = {
+            "version": 1,
+            "timestamp": now_ms,
+            "client_name": CLIENT_NAME,
+            "client_version": CLIENT_VERSION,
+        }
+        beacon = dict(common, process="beaconnode")
+        if self.chain is not None:
+            try:
+                head = self.chain.head_state
+                beacon.update(
+                    {
+                        "head_slot": int(head.slot),
+                        "finalized_epoch": int(
+                            head.finalized_checkpoint["epoch"]
+                        ),
+                        "validators": int(head.num_validators),
+                        "imported_blocks": int(self.chain.imported_blocks),
+                    }
+                )
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                pass
+        if self.bls_metrics is not None:
+            beacon["bls_success_jobs"] = int(
+                self.bls_metrics.success_jobs.value
+            )
+        stats = [beacon]
+        if self.collect_system:
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            stats.append(
+                dict(
+                    common,
+                    process="system",
+                    cpu_process_seconds_total=ru.ru_utime + ru.ru_stime,
+                    memory_process_bytes=ru.ru_maxrss * 1024,
+                )
+            )
+        return stats
+
+    def send(self) -> bool:
+        data = json.dumps(self.collect()).encode()
+        req = urllib.request.Request(
+            self.endpoint,
+            data=data,
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                self.sent += 1
+                return True
+        except Exception as e:  # noqa: BLE001 - remote is best-effort
+            self.failures += 1
+            self.log.warn("monitoring send failed", error=str(e))
+            return False
+
+    # -- lifecycle (reference: service.ts start/stop) ----------------------
+
+    def start(self) -> None:
+        def _loop():
+            while not self._stop.wait(self.interval_s):
+                self.send()
+
+        self._thread = threading.Thread(
+            target=_loop, name="monitoring", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

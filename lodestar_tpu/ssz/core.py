@@ -422,6 +422,82 @@ def hash_tree_root(sztype: SszType, value) -> bytes:
     return sztype.hash_tree_root(value)
 
 
+def _merkle_branch(chunks: Sequence[bytes], index: int) -> PyList[bytes]:
+    """Sibling path for leaf `index` in the padded binary tree of
+    `chunks` (bottom-up order, matching is_valid_merkle_branch)."""
+    leaves = _next_pow2(len(chunks))
+    depth = leaves.bit_length() - 1
+    level = list(chunks)
+    branch: PyList[bytes] = []
+    pos = index
+    for d in range(depth):
+        sibling = pos ^ 1
+        branch.append(
+            level[sibling] if sibling < len(level) else _ZERO_HASHES[d]
+        )
+        nxt = []
+        for i in range(0, len(level), 2):
+            left = level[i]
+            right = level[i + 1] if i + 1 < len(level) else _ZERO_HASHES[d]
+            nxt.append(digest(left + right))
+        level = nxt
+        pos //= 2
+    return branch
+
+
+def container_branch(
+    ctype: "Container", value, path: Sequence[str], _chunks=None
+) -> Tuple[bytes, PyList[bytes], int, int]:
+    """Merkle proof of a (possibly nested) container field.
+
+    Returns (leaf, branch, depth, index) such that
+    is_valid_merkle_branch(leaf, branch, depth, index, ctype.hash_tree_root
+    (value)) holds — the producer side of the light-client proofs
+    (reference: the @chainsafe/persistent-merkle-tree getSingleProof the
+    light-client server relies on).  `_chunks` lets container_branches
+    share one field-root pass across proofs."""
+    if not isinstance(ctype, Container):
+        raise TypeError("container_branch walks Container types")
+    if not path:
+        return ctype.hash_tree_root(value), [], 0, 0
+    name = path[0]
+    names = [fname for fname, _ in ctype.fields]
+    idx = names.index(name)
+    chunks = (
+        _chunks
+        if _chunks is not None
+        else [ftype.hash_tree_root(value[fname]) for fname, ftype in ctype.fields]
+    )
+    here_branch = _merkle_branch(chunks, idx)
+    here_depth = len(here_branch)
+    sub_type = ctype.fields[idx][1]
+    leaf, sub_branch, sub_depth, sub_index = (
+        container_branch(sub_type, value[name], path[1:])
+        if len(path) > 1
+        else (chunks[idx], [], 0, 0)
+    )
+    return (
+        leaf,
+        sub_branch + here_branch,
+        sub_depth + here_depth,
+        idx * (1 << sub_depth) + sub_index,
+    )
+
+
+def container_branches(
+    ctype: "Container", value, paths: Sequence[Sequence[str]]
+) -> PyList[Tuple[bytes, PyList[bytes], int, int]]:
+    """Several proofs over one value with ONE top-level field-root pass
+    (the expensive part: e.g. the validator registry merkleization)."""
+    chunks = [
+        ftype.hash_tree_root(value[fname]) for fname, ftype in ctype.fields
+    ]
+    return [
+        container_branch(ctype, value, path, _chunks=chunks)
+        for path in paths
+    ]
+
+
 def is_valid_merkle_branch(
     leaf: bytes, branch: Sequence[bytes], depth: int, index: int, root: bytes
 ) -> bool:

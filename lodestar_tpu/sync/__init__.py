@@ -1,0 +1,16 @@
+"""Sync — transport-agnostic chain synchronization drivers.
+
+Mirror of the reference's packages/beacon-node/src/sync/: RangeSync
+(batched by-range download → import), UnknownBlockSync (fetch-by-root
+parent resolution), and the sync state machine the node/API report.
+The network transport itself is out of the TPU scope (SURVEY.md §2.4
+P9); block sources are injected callables with the reqresp shapes
+(get_blocks_by_range(start_slot, count), get_blocks_by_root(roots)).
+"""
+
+from .range_sync import (  # noqa: F401
+    BlockSource,
+    RangeSync,
+    SyncState,
+    UnknownBlockSync,
+)

@@ -1,0 +1,162 @@
+"""LightClientServer: produced updates validate in the Lightclient.
+
+Reference: packages/beacon-node/src/chain/lightClient/index.ts producing
+what packages/light-client/src consumes — the round trip proves the
+merkle branches (ssz.container_branch) and committee handling are
+mutually consistent.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.light_client_server import LightClientServer
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.light_client.lightclient import Lightclient, ValidationError
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import is_valid_merkle_branch
+from lodestar_tpu.ssz.core import container_branch
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.state import BeaconStateAltair
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def lc_world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"lcs-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=21)
+    chain = BeaconChain(cfg, genesis, db=BeaconDb())
+    server = LightClientServer(chain)
+    return cfg, sks, pks, genesis, chain, server
+
+
+def _import_block(chain, cfg, sks, slot, sync_signers=None):
+    """Produce + sign + import a block; optionally with a full sync
+    aggregate signed by `sync_signers` (pubkey->sk map)."""
+    from lodestar_tpu.chain.produce_block import produce_block
+    from lodestar_tpu.ssz import uint64
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+
+    head = chain.head_state
+    pre = head.clone()
+    if pre.slot < slot:
+        process_slots(pre, slot)
+    proposer = get_beacon_proposer_index(pre)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    reveal = B.sign_bytes(
+        sks[proposer],
+        cfg.compute_signing_root(
+            uint64.hash_tree_root(epoch), cfg.get_domain(slot, params.DOMAIN_RANDAO)
+        ),
+    )
+    sync_aggregate = None
+    if sync_signers is not None:
+        prev_root = chain.get_head_root()
+        domain = cfg.get_domain(slot, params.DOMAIN_SYNC_COMMITTEE, slot - 1)
+        sroot = cfg.compute_signing_root(prev_root, domain)
+        committee = head.current_sync_committee["pubkeys"]
+        sig = B.aggregate_signatures(
+            [B.sign(sync_signers[pk], sroot) for pk in committee]
+        )
+        sync_aggregate = {
+            "sync_committee_bits": [True] * P.SYNC_COMMITTEE_SIZE,
+            "sync_committee_signature": C.g2_compress(sig),
+        }
+    block, _post = produce_block(
+        head, slot, reveal, sync_aggregate=sync_aggregate
+    )
+    domain = cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot)
+    root = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block), domain
+    )
+    return chain.process_block(
+        {"message": block, "signature": B.sign_bytes(sks[proposer], root)}
+    )
+
+
+def test_container_branch_spec_gindices(lc_world):
+    cfg, sks, pks, genesis, chain, server = lc_world
+    value = genesis.to_value()
+    root = genesis.hash_tree_root()
+    leaf, branch, depth, index = container_branch(
+        BeaconStateAltair, value, ["next_sync_committee"]
+    )
+    # spec NEXT_SYNC_COMMITTEE gindex 55 = 2**5 + 23
+    assert (depth, index) == (5, 23)
+    assert is_valid_merkle_branch(leaf, branch, depth, index, root)
+
+    leaf, branch, depth, index = container_branch(
+        BeaconStateAltair, value, ["finalized_checkpoint", "root"]
+    )
+    # spec FINALIZED_ROOT gindex 105 = 2**6 + 41
+    assert (depth, index) == (6, 41)
+    assert is_valid_merkle_branch(leaf, branch, depth, index, root)
+
+
+def test_server_update_validates_in_client(lc_world):
+    cfg, sks, pks, genesis, chain, server = lc_world
+    sk_of = {pks[i]: sks[i] for i in range(N_KEYS)}
+
+    _import_block(chain, cfg, sks, 1)  # parent for the attested header
+    assert server.produced == 0  # empty sync aggregate: nothing produced
+    _import_block(chain, cfg, sks, 2, sync_signers=sk_of)
+    assert server.produced == 1
+
+    update = server.get_optimistic_update()
+    assert update is not None
+    assert update.signature_slot == 2
+    assert update.attested_header["slot"] == 1
+    assert update.next_sync_committee_branch is not None
+
+    # bootstrap the client at genesis and feed it the produced update
+    anchor_header = dict(genesis.latest_block_header)
+    anchor_header["state_root"] = genesis.hash_tree_root()
+    client = Lightclient(
+        cfg, anchor_header, genesis.current_sync_committee["pubkeys"]
+    )
+    client.process_update(update)
+    assert client.optimistic_header["slot"] == 1
+    # the committee rotation was installed for the next period
+    assert len(client.committees) == 2
+
+    # a tampered committee branch must be rejected
+    bad = LightClientUpdateCopy(update)
+    bad.next_sync_committee_branch = [
+        b"\x00" * 32 for _ in update.next_sync_committee_branch
+    ]
+    with pytest.raises(ValidationError):
+        client.process_update(bad)
+
+
+def LightClientUpdateCopy(u):
+    from dataclasses import replace
+
+    return replace(u)
+
+
+def test_bootstrap(lc_world):
+    cfg, sks, pks, genesis, chain, server = lc_world
+    head_root = chain.get_head_root()
+    boot = server.get_bootstrap(head_root)
+    assert boot is not None
+    state = chain.regen._get_post_state(head_root.hex())
+    assert is_valid_merkle_branch(
+        T.SyncCommittee.hash_tree_root(boot["current_sync_committee"]),
+        boot["current_sync_committee_branch"],
+        5,
+        22,  # current_sync_committee is field 22 of the altair state
+        state.hash_tree_root(),
+    )

@@ -1,0 +1,226 @@
+"""Archiver, range/unknown-block sync, monitoring, CLI.
+
+Reference: chain/archiver/archiveBlocks.ts (hot→cold migration on
+finality), sync/range/range.ts + sync/unknownBlock.ts (batched import,
+parent resolution), monitoring/service.ts (remote stats), cli/src/cmds
+(beacon dev mode self-proposing).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.archiver import Archiver
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.emitter import ChainEvent
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.monitoring import MonitoringService
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.state_transition import create_genesis_state, process_slots
+from lodestar_tpu.state_transition.accessors import get_beacon_proposer_index
+from lodestar_tpu.sync import RangeSync, SyncState, UnknownBlockSync
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"nsvc-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=31)
+    return cfg, sks, pks, genesis
+
+
+def _import_block(chain, cfg, sks, slot):
+    head = chain.head_state
+    pre = head.clone()
+    if pre.slot < slot:
+        process_slots(pre, slot)
+    proposer = get_beacon_proposer_index(pre)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    reveal = B.sign_bytes(
+        sks[proposer],
+        cfg.compute_signing_root(
+            uint64.hash_tree_root(epoch),
+            cfg.get_domain(slot, params.DOMAIN_RANDAO),
+        ),
+    )
+    from lodestar_tpu.chain.produce_block import produce_block
+
+    block, _post = produce_block(head, slot, reveal)
+    root = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block),
+        cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
+    )
+    signed = {
+        "message": block,
+        "signature": B.sign_bytes(sks[proposer], root),
+    }
+    chain.process_block(signed)
+    return signed
+
+
+def test_archiver_migrates_on_finality(world):
+    cfg, sks, pks, genesis = world
+    chain = BeaconChain(cfg, genesis, db=BeaconDb())
+    archiver = Archiver(chain)
+    signed = [_import_block(chain, cfg, sks, s) for s in (1, 2, 3)]
+    roots = [T.BeaconBlockAltair.hash_tree_root(s["message"]) for s in signed]
+    assert all(chain.db.block.has(r) for r in roots)
+
+    # simulate finality covering those slots
+    chain.emitter.emit(
+        ChainEvent.finalized, {"epoch": 1, "root": roots[-1]}
+    )
+    assert archiver.archived_blocks == 3
+    # hot repo drained, archive keyed by slot
+    assert not any(chain.db.block.has(r) for r in roots)
+    for s in (1, 2, 3):
+        archived = chain.db.block_archive.get(s.to_bytes(8, "big"))
+        assert archived is not None
+        assert archived["message"]["slot"] == s
+    assert archiver.archived_states == 1
+
+
+class ListSource:
+    def __init__(self, signed_blocks):
+        self.blocks = list(signed_blocks)
+        self.by_root = {
+            T.BeaconBlockAltair.hash_tree_root(s["message"]): s
+            for s in signed_blocks
+        }
+
+    def get_blocks_by_range(self, start_slot, count):
+        return [
+            s
+            for s in self.blocks
+            if start_slot <= s["message"]["slot"] < start_slot + count
+        ]
+
+    def get_blocks_by_root(self, roots):
+        return [self.by_root[r] for r in roots if r in self.by_root]
+
+
+def test_range_sync(world):
+    cfg, sks, pks, genesis = world
+    chain_a = BeaconChain(cfg, genesis)
+    blocks = [_import_block(chain_a, cfg, sks, s) for s in (1, 2, 3, 4)]
+
+    chain_b = BeaconChain(cfg, genesis)
+    sync = RangeSync(chain_b)
+    n = sync.sync_to(ListSource(blocks), target_slot=4)
+    assert n == 4
+    assert sync.state == SyncState.synced
+    assert chain_b.head_root_hex == chain_a.head_root_hex
+    assert sync.status()["is_syncing"] is False
+
+    # a corrupted batch stalls the sync with an error
+    chain_c = BeaconChain(cfg, genesis)
+    bad = [dict(blocks[0], signature=b"\x99" * 96)] + blocks[1:]
+    bad[0] = {"message": blocks[0]["message"], "signature": b"\x99" * 96}
+    with pytest.raises(Exception):
+        RangeSync(chain_c).sync_to(ListSource(bad), target_slot=4)
+
+
+def test_unknown_block_sync(world):
+    cfg, sks, pks, genesis = world
+    chain_a = BeaconChain(cfg, genesis)
+    blocks = [_import_block(chain_a, cfg, sks, s) for s in (1, 2, 3)]
+    head_root = T.BeaconBlockAltair.hash_tree_root(blocks[-1]["message"])
+
+    chain_b = BeaconChain(cfg, genesis)
+    ub = UnknownBlockSync(chain_b)
+    n = ub.on_unknown_block(ListSource(blocks), head_root)
+    assert n == 3
+    assert chain_b.head_root_hex == chain_a.head_root_hex
+
+    # unknown root with no source data raises
+    with pytest.raises(LookupError):
+        ub.on_unknown_block(ListSource([]), b"\xaa" * 32)
+
+
+def test_monitoring_service(world):
+    cfg, sks, pks, genesis = world
+    chain = BeaconChain(cfg, genesis)
+    received = []
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(length)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        svc = MonitoringService(
+            f"http://127.0.0.1:{server.server_address[1]}/api", chain=chain
+        )
+        assert svc.send()
+        assert svc.sent == 1
+        stats = received[0]
+        beacon = next(s for s in stats if s["process"] == "beaconnode")
+        assert beacon["client_name"] == "lodestar-tpu"
+        assert beacon["head_slot"] == 0
+        system = next(s for s in stats if s["process"] == "system")
+        assert system["memory_process_bytes"] > 0
+    finally:
+        server.shutdown()
+
+    # unreachable endpoint: counted, not raised
+    svc2 = MonitoringService("http://127.0.0.1:1/api")
+    assert not svc2.send()
+    assert svc2.failures == 1
+
+
+def test_cli_beacon_dev_mode(capsys):
+    from lodestar_tpu.cli import main
+
+    rc = main(
+        [
+            "beacon",
+            "--validators",
+            "8",
+            "--api-port",
+            "0",
+            "--genesis-time",
+            "0",
+            "--slots",
+            "2",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert lines[0]["msg"] == "beacon node up"
+    proposed = [l for l in lines[1:] if "slot" in l]
+    assert len(proposed) == 2
+    assert all(p["proposed"] == 1 for p in proposed)
+
+
+def test_cli_help_and_bad_command():
+    from lodestar_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--help"])
+    with pytest.raises(SystemExit):
+        main(["no-such-command"])
