@@ -152,6 +152,36 @@ class ApiClient:
         )
         return from_json(BeaconBlockAltair, payload["data"])
 
+    def submit_proposer_slashing(self, slashing: dict):
+        from ..types import ProposerSlashing
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/beacon/pool/proposer_slashings",
+            to_json(ProposerSlashing, slashing),
+        )
+
+    def submit_attester_slashing(self, slashing: dict):
+        from ..types import AttesterSlashing
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/beacon/pool/attester_slashings",
+            to_json(AttesterSlashing, slashing),
+        )
+
+    def submit_voluntary_exit(self, signed_exit: dict):
+        from ..types import SignedVoluntaryExit
+        from .encoding import to_json
+
+        return self._request(
+            "POST",
+            "/eth/v1/beacon/pool/voluntary_exits",
+            to_json(SignedVoluntaryExit, signed_exit),
+        )
+
     def get_aggregate_attestation(self, slot: int, attestation_data_root: bytes):
         from ..types import Attestation
         from .encoding import from_json

@@ -35,6 +35,19 @@ ROUTES: Tuple[Route, ...] = (
         "POST", "/eth/v1/beacon/pool/sync_committees", "submit_sync_committees"
     ),
     Route(
+        "POST",
+        "/eth/v1/beacon/pool/proposer_slashings",
+        "submit_proposer_slashing",
+    ),
+    Route(
+        "POST",
+        "/eth/v1/beacon/pool/attester_slashings",
+        "submit_attester_slashing",
+    ),
+    Route(
+        "POST", "/eth/v1/beacon/pool/voluntary_exits", "submit_voluntary_exit"
+    ),
+    Route(
         "GET",
         "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
         "get_finality_checkpoints",

@@ -260,6 +260,51 @@ class DefaultHandlers:
         )
         return 200, {"data": to_json(AttestationData, data)}
 
+    def submit_proposer_slashing(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import ProposerSlashing
+        from .encoding import from_json
+
+        slashing = from_json(ProposerSlashing, body)
+        try:
+            self.chain.validate_proposer_slashing(slashing)
+        except Exception as e:
+            return 400, {"message": f"invalid proposer slashing: {e}"}
+        self.chain.op_pool.insert_proposer_slashing(slashing)
+        return 200, None
+
+    def submit_attester_slashing(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import AttesterSlashing
+        from .encoding import from_json
+
+        slashing = from_json(AttesterSlashing, body)
+        try:
+            self.chain.validate_attester_slashing(slashing)
+        except Exception as e:
+            return 400, {"message": f"invalid attester slashing: {e}"}
+        self.chain.op_pool.insert_attester_slashing(slashing)
+        return 200, None
+
+    def submit_voluntary_exit(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedVoluntaryExit
+        from .encoding import from_json
+
+        signed = from_json(SignedVoluntaryExit, body)
+        try:
+            self.chain.validate_voluntary_exit(signed)
+        except Exception as e:
+            return 400, {"message": f"invalid voluntary exit: {e}"}
+        self.chain.op_pool.insert_voluntary_exit(signed)
+        return 200, None
+
     def get_aggregate_attestation(self, params, body):
         err = self._need_chain()
         if err:

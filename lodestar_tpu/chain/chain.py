@@ -56,6 +56,7 @@ class BeaconChain:
         *,
         db=None,
         bls_verifier=None,
+        eth1=None,
         emitter: Optional[ChainEventEmitter] = None,
     ):
         self.config = config
@@ -63,6 +64,7 @@ class BeaconChain:
         self.emitter = emitter or ChainEventEmitter()
         self.db = db
         self.bls = bls_verifier  # optional batched signature service
+        self.eth1 = eth1  # optional Eth1DepositDataTracker
 
         anchor_root = BeaconBlockHeader.hash_tree_root(
             dict(
@@ -219,6 +221,7 @@ class BeaconChain:
             contribution_pool=self.sync_contribution_pool,
             head_root=self.get_head_root(),
             graffiti=graffiti,
+            eth1=self.eth1,
         )
         return block
 
@@ -343,6 +346,27 @@ class BeaconChain:
             "source": dict(head.current_justified_checkpoint),
             "target": {"epoch": epoch, "root": target_root},
         }
+
+    # -- op validation at pool ingress (reference chain/validation/*) ------
+    # Each op is dry-run through its own state-transition handler on a
+    # head-state clone (full checks including signatures): an op the STF
+    # would reject must never enter the pool, where it would poison
+    # every subsequent block production.
+
+    def validate_voluntary_exit(self, signed_exit: dict) -> None:
+        from ..state_transition.block import process_voluntary_exit
+
+        process_voluntary_exit(self.head_state.clone(), signed_exit, True)
+
+    def validate_proposer_slashing(self, slashing: dict) -> None:
+        from ..state_transition.block import process_proposer_slashing
+
+        process_proposer_slashing(self.head_state.clone(), slashing, True)
+
+    def validate_attester_slashing(self, slashing: dict) -> None:
+        from ..state_transition.block import process_attester_slashing
+
+        process_attester_slashing(self.head_state.clone(), slashing, True)
 
     # -- gossip op ingress (reference chain.ts pool adders) ----------------
 

@@ -225,6 +225,12 @@ class OpPool:
             if idx < state.num_validators
             and int(state.exit_epoch[idx]) == params.FAR_FUTURE_EPOCH
             and bool(slashable[idx])
+            # the remaining process_voluntary_exit preconditions: a
+            # selected-but-inapplicable exit would fail the whole block
+            and epoch >= e["message"]["epoch"]
+            and epoch
+            >= int(state.activation_epoch[idx])
+            + state.config.SHARD_COMMITTEE_PERIOD
         ][: P.MAX_VOLUNTARY_EXITS]
         return proposer, attester, exits
 

@@ -69,6 +69,8 @@ def produce_block_from_pools(
     head_root: Optional[bytes] = None,
     graffiti: bytes = b"\x00" * 32,
     eth1_data: Optional[Dict] = None,
+    deposits: Optional[List[Dict]] = None,
+    eth1=None,
 ) -> Tuple[Dict, object]:
     """produceBlockBody from the op pools (reference
     produceBlockBody.ts:66-118): attestations ranked by participation,
@@ -77,6 +79,13 @@ def produce_block_from_pools(
     pre = state.clone()
     if pre.slot < slot:
         process_slots(pre, slot)
+    if eth1 is not None:
+        # eth1 vote/deposit accounting MUST see the slot-advanced state:
+        # a voting-period boundary resets eth1_data_votes (reference
+        # computes getEth1DataAndDeposits on the proposal-slot state)
+        bundle = eth1.get_eth1_data_and_deposits(pre)
+        eth1_data = bundle["eth1_data"]
+        deposits = bundle["deposits"]
     attestations = (
         aggregated_attestation_pool.get_attestations_for_block(pre)
         if aggregated_attestation_pool is not None
@@ -100,6 +109,7 @@ def produce_block_from_pools(
         randao_reveal,
         graffiti=graffiti,
         eth1_data=eth1_data,
+        deposits=deposits,
         attestations=attestations,
         proposer_slashings=proposer_slashings,
         attester_slashings=attester_slashings,

@@ -52,6 +52,17 @@ def _build_parser() -> argparse.ArgumentParser:
     lc = sub.add_parser("lightclient", help="run a light client (in-process demo)")
     lc.add_argument("--slots", type=int, default=2)
 
+    flare = sub.add_parser(
+        "flare", help="operator debug tool: self-slash test validators"
+    )
+    flare.add_argument(
+        "action", choices=["self-slash-proposer", "self-slash-attester"]
+    )
+    flare.add_argument("--beacon-urls", nargs="+", required=True)
+    flare.add_argument("--interop-indices", type=int, nargs="+", required=True)
+    flare.add_argument("--slot", type=int, default=1)
+    flare.add_argument("--epoch", type=int, default=0)
+
     return parser
 
 
@@ -64,21 +75,28 @@ def _interop_keys(n: int):
     return sks, pks
 
 
+def _dev_config(genesis_time=0):
+    """The dev-mode chain config (altair at genesis) shared by the
+    beacon, validator, lightclient, and flare subcommands."""
+    from .config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from .params import ForkName
+
+    return create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        genesis_time=genesis_time,
+        fork_epochs={ForkName.altair: 0},
+    )
+
+
 def _dev_chain(args):
     from .chain.chain import BeaconChain
-    from .config import MAINNET_CHAIN_CONFIG, create_chain_config
     from .db import BeaconDb
-    from .params import ForkName
     from .state_transition import create_genesis_state
 
-    cfg = create_chain_config(
-        MAINNET_CHAIN_CONFIG,
-        genesis_time=(
-            args.genesis_time
-            if getattr(args, "genesis_time", None) is not None
-            else int(time.time())
-        ),
-        fork_epochs={ForkName.altair: 0},
+    cfg = _dev_config(
+        args.genesis_time
+        if getattr(args, "genesis_time", None) is not None
+        else int(time.time())
     )
     sks, pks = _interop_keys(args.validators)
     genesis = create_genesis_state(
@@ -279,6 +297,26 @@ def cmd_lightclient(args) -> int:
     return 0
 
 
+def cmd_flare(args) -> int:
+    from .api.client import ApiClient
+    from .flare import self_slash_attester, self_slash_proposer
+
+    client = ApiClient(args.beacon_urls, timeout=60)
+    cfg = _dev_config()  # dev fork schedule; domains must match the node
+    sks, _pks = _interop_keys(max(args.interop_indices) + 1)
+    if args.action == "self-slash-proposer":
+        for idx in args.interop_indices:
+            self_slash_proposer(cfg, client, sks[idx], idx, args.slot)
+            print(json.dumps({"self_slashed_proposer": idx}))
+    else:
+        keys = [sks[i] for i in args.interop_indices]
+        self_slash_attester(
+            cfg, client, keys, args.interop_indices, args.epoch
+        )
+        print(json.dumps({"self_slashed_attesters": args.interop_indices}))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     return {
@@ -286,6 +324,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "validator": cmd_validator,
         "bench": cmd_bench,
         "lightclient": cmd_lightclient,
+        "flare": cmd_flare,
     }[args.command](args)
 
 

@@ -1,0 +1,17 @@
+"""Eth1 — deposit tracking + eth1Data voting for block production.
+
+Mirror of the reference's packages/beacon-node/src/eth1/
+(Eth1DepositDataTracker, Eth1DepositsCache, Eth1DataCache, the
+getEth1DataAndDeposits entry for produceBlockBody).  The JSON-RPC
+provider is injected (any object with get_block_by_number /
+get_deposit_events) — the transport itself is outside the TPU scope.
+"""
+
+from .deposit_tracker import (  # noqa: F401
+    Eth1Block,
+    Eth1DataCache,
+    Eth1DepositDataTracker,
+    Eth1DepositsCache,
+    DepositEvent,
+    get_eth1_vote,
+)

@@ -1,0 +1,248 @@
+"""Eth1 deposit tracking + eth1Data voting.
+
+Reference: packages/beacon-node/src/eth1/eth1DepositDataTracker.ts
+(follow the eth1 chain at ETH1_FOLLOW_DISTANCE, ingest deposit events,
+maintain the deposit merkle tree, serve {eth1Data, deposits} to block
+production), eth1/eth1DepositsCache.ts, eth1/eth1DataCache.ts, and
+eth1/utils/eth1Vote.ts (get_eth1_vote: pick the majority vote among
+valid-range eth1 blocks).
+
+The deposit tree is the same incremental merkle tree the state
+transition verifies against (state_transition/genesis.py DepositTree),
+so proofs produced here pass process_deposit's branch check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Sequence
+
+from .. import params
+from ..state_transition.genesis import DepositTree
+from ..utils.logger import get_logger
+
+P = params.ACTIVE_PRESET
+
+ETH1_FOLLOW_DISTANCE = 2048  # spec; reference chainConfig
+SECONDS_PER_ETH1_BLOCK = 14
+
+
+@dataclass(frozen=True)
+class Eth1Block:
+    block_number: int
+    block_hash: bytes
+    timestamp: int
+
+
+@dataclass(frozen=True)
+class DepositEvent:
+    index: int
+    block_number: int
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    signature: bytes
+
+    def deposit_data(self) -> dict:
+        return {
+            "pubkey": self.pubkey,
+            "withdrawal_credentials": self.withdrawal_credentials,
+            "amount": self.amount,
+            "signature": self.signature,
+        }
+
+
+class Eth1Provider(Protocol):
+    def get_block_by_number(self, number: int) -> Optional[Eth1Block]: ...
+
+    def get_deposit_events(
+        self, from_block: int, to_block: int
+    ) -> List[DepositEvent]: ...
+
+    def get_block_number(self) -> int: ...
+
+
+class Eth1DepositsCache:
+    """Ordered deposit events + the incremental merkle tree
+    (reference eth1DepositsCache.ts)."""
+
+    def __init__(self):
+        self.events: List[DepositEvent] = []
+        self.tree = DepositTree()
+        self.log = get_logger("eth1/deposits")
+
+    @property
+    def highest_index(self) -> int:
+        return len(self.events) - 1
+
+    def add(self, events: Sequence[DepositEvent]) -> None:
+        for ev in sorted(events, key=lambda e: e.index):
+            if ev.index < len(self.events):
+                continue  # already ingested
+            if ev.index != len(self.events):
+                raise ValueError(
+                    f"non-consecutive deposit index {ev.index}, "
+                    f"have {len(self.events)}"
+                )
+            self.events.append(ev)
+            self.tree.push(ev.deposit_data())
+
+    def get_deposits(
+        self, deposit_index: int, deposit_count: int
+    ) -> List[dict]:
+        """Deposit operations [deposit_index, ...) with proofs against the
+        tree at `deposit_count` leaves (spec process_deposit shape)."""
+        n = min(
+            deposit_count - deposit_index, P.MAX_DEPOSITS
+        )
+        if n <= 0:
+            return []
+        if deposit_count > len(self.events):
+            raise ValueError("deposit_count beyond ingested events")
+        snapshot = DepositTree()
+        for ev in self.events[:deposit_count]:
+            snapshot.push(ev.deposit_data())
+        out = []
+        for i in range(deposit_index, deposit_index + n):
+            out.append(
+                {
+                    "proof": snapshot.proof(i),
+                    "data": self.events[i].deposit_data(),
+                }
+            )
+        return out
+
+    def root_at_count(self, deposit_count: int) -> bytes:
+        snapshot = DepositTree()
+        for ev in self.events[:deposit_count]:
+            snapshot.push(ev.deposit_data())
+        return snapshot.root()
+
+
+class Eth1DataCache:
+    """timestamp-ordered eth1Data candidates (reference eth1DataCache.ts)."""
+
+    def __init__(self):
+        self.by_timestamp: Dict[int, dict] = {}
+
+    def add(self, timestamp: int, eth1_data: dict) -> None:
+        self.by_timestamp[timestamp] = dict(eth1_data)
+
+    def get_in_range(self, start: int, end: int) -> List[dict]:
+        return [
+            v
+            for t, v in sorted(self.by_timestamp.items())
+            if start <= t <= end
+        ]
+
+
+def _voting_period_start(state) -> int:
+    period_slots = P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH
+    slots_into = state.slot % period_slots
+    return state.genesis_time + (state.slot - slots_into) * P.SECONDS_PER_SLOT
+
+
+def get_eth1_vote(state, data_cache: Eth1DataCache) -> dict:
+    """Spec get_eth1_vote: majority among votes for candidates in the
+    valid range, else the current eth1_data (reference eth1Vote.ts)."""
+    period_start = _voting_period_start(state)
+    start = period_start - ETH1_FOLLOW_DISTANCE * 2 * SECONDS_PER_ETH1_BLOCK
+    end = period_start - ETH1_FOLLOW_DISTANCE * SECONDS_PER_ETH1_BLOCK
+    candidates = [
+        d
+        for d in data_cache.get_in_range(start, end)
+        if d["deposit_count"] >= state.eth1_data["deposit_count"]
+    ]
+    if not candidates:
+        return dict(state.eth1_data)
+
+    from ..types import Eth1Data
+
+    def _key(d):
+        return Eth1Data.hash_tree_root(d)
+
+    candidate_roots = {_key(d): d for d in candidates}
+    tally: Dict[bytes, int] = {r: 0 for r in candidate_roots}
+    for vote in state.eth1_data_votes:
+        r = _key(vote)
+        if r in tally:
+            tally[r] += 1
+    best_root = max(
+        tally, key=lambda r: (tally[r], candidates.index(candidate_roots[r]) * -1)
+    )
+    if tally[best_root] == 0:
+        return dict(candidates[-1])  # freshest candidate when no votes yet
+    return dict(candidate_roots[best_root])
+
+
+class Eth1DepositDataTracker:
+    """Follow the eth1 chain; serve {eth1_data, deposits} for block
+    production (reference eth1DepositDataTracker.ts
+    getEth1DataAndDeposits)."""
+
+    def __init__(self, provider: Eth1Provider):
+        self.provider = provider
+        self.deposits = Eth1DepositsCache()
+        self.data_cache = Eth1DataCache()
+        self.last_processed_block = -1
+        self.log = get_logger("eth1/tracker")
+
+    def update(self) -> int:
+        """Ingest new blocks/deposits up to the follow distance.
+
+        Events are pushed into the ONE running tree in block order, so
+        each followed block's {root, count} comes from an O(depth)
+        incremental root — no per-block tree rebuilds (a full catch-up
+        is O(blocks * depth + deposits))."""
+        head = self.provider.get_block_number()
+        target = head - ETH1_FOLLOW_DISTANCE
+        if target <= self.last_processed_block:
+            return 0
+        events = self.provider.get_deposit_events(
+            self.last_processed_block + 1, target
+        )
+        by_block: Dict[int, List[DepositEvent]] = {}
+        for ev in sorted(events, key=lambda e: e.index):
+            by_block.setdefault(ev.block_number, []).append(ev)
+        ingested = 0
+        for number in range(self.last_processed_block + 1, target + 1):
+            if number in by_block:
+                self.deposits.add(by_block[number])
+            blk = self.provider.get_block_by_number(number)
+            if blk is None:
+                continue
+            self.data_cache.add(
+                blk.timestamp,
+                {
+                    "deposit_root": self.deposits.tree.root(),
+                    "deposit_count": len(self.deposits.events),
+                    "block_hash": blk.block_hash,
+                },
+            )
+            ingested += 1
+        self.last_processed_block = target
+        return ingested
+
+    def get_eth1_data_and_deposits(self, state) -> dict:
+        """The produceBlockBody entry (reference: index.ts
+        getEth1DataAndDeposits).  Deposits are proven against the
+        eth1_data that will be IN EFFECT during process_operations —
+        the new vote if this block's vote reaches majority (the
+        reference's pickEth1Vote + getDeposits accounting)."""
+        from ..types import Eth1Data
+
+        vote = get_eth1_vote(state, self.data_cache)
+        period_slots = P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH
+        vote_root = Eth1Data.hash_tree_root(vote)
+        votes_with_ours = 1 + sum(
+            1
+            for v in state.eth1_data_votes
+            if Eth1Data.hash_tree_root(v) == vote_root
+        )
+        effective = (
+            vote if votes_with_ours * 2 > period_slots else state.eth1_data
+        )
+        deposits = self.deposits.get_deposits(
+            state.eth1_deposit_index, effective["deposit_count"]
+        )
+        return {"eth1_data": vote, "deposits": deposits}

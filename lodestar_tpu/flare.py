@@ -1,0 +1,100 @@
+"""flare — operator debug tool: intentionally self-slash test validators.
+
+Mirror of the reference's packages/flare (cmds/selfSlashProposer.ts,
+cmds/selfSlashAttester.ts): sign two conflicting messages with a
+validator's own key and submit the resulting slashing object to a
+beacon node's pool, to exercise the slashing pipeline end to end on
+devnets.  Signing here deliberately bypasses the ValidatorStore's
+slashing protection — producing the slashable pair IS the tool's job.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import params
+from . import types as T
+from .config.chain_config import ChainConfig
+from .crypto import bls as B
+
+
+def make_proposer_slashing(
+    config: ChainConfig, sk: int, proposer_index: int, slot: int
+) -> dict:
+    """Two different headers for the same slot, both validly signed."""
+
+    def _signed(body_root: bytes) -> dict:
+        header = {
+            "slot": slot,
+            "proposer_index": proposer_index,
+            "parent_root": b"\x00" * 32,
+            "state_root": b"\x00" * 32,
+            "body_root": body_root,
+        }
+        root = config.compute_signing_root(
+            T.BeaconBlockHeader.hash_tree_root(header),
+            config.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
+        )
+        return {"message": header, "signature": B.sign_bytes(sk, root)}
+
+    return {
+        "signed_header_1": _signed(b"\x01" * 32),
+        "signed_header_2": _signed(b"\x02" * 32),
+    }
+
+
+def make_attester_slashing(
+    config: ChainConfig,
+    sks: List[int],
+    indices: List[int],
+    target_epoch: int,
+) -> dict:
+    """A double vote: same target epoch, different beacon block roots."""
+
+    def _signed(block_root: bytes) -> dict:
+        data = {
+            "slot": target_epoch * params.SLOTS_PER_EPOCH,
+            "index": 0,
+            "beacon_block_root": block_root,
+            "source": {"epoch": max(target_epoch - 1, 0), "root": b"\x00" * 32},
+            "target": {"epoch": target_epoch, "root": block_root},
+        }
+        root = config.compute_signing_root(
+            T.AttestationData.hash_tree_root(data),
+            config.get_domain(
+                data["slot"], params.DOMAIN_BEACON_ATTESTER, data["slot"]
+            ),
+        )
+        sig = B.aggregate_signatures([B.sign(sk, root) for sk in sks])
+        from .crypto import curves as C
+
+        return {
+            "attesting_indices": sorted(indices),
+            "data": data,
+            "signature": C.g2_compress(sig),
+        }
+
+    return {
+        "attestation_1": _signed(b"\x0a" * 32),
+        "attestation_2": _signed(b"\x0b" * 32),
+    }
+
+
+def self_slash_proposer(
+    config: ChainConfig, api, sk: int, proposer_index: int, slot: int
+) -> dict:
+    slashing = make_proposer_slashing(config, sk, proposer_index, slot)
+    api.submit_proposer_slashing(slashing)
+    return slashing
+
+
+def self_slash_attester(
+    config: ChainConfig,
+    api,
+    sks: List[int],
+    indices: List[int],
+    target_epoch: int,
+) -> dict:
+    slashing = make_attester_slashing(config, sks, indices, target_epoch)
+    api.submit_attester_slashing(slashing)
+    return slashing

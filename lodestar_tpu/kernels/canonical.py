@@ -104,12 +104,17 @@ def fp_sgn0(x):
 
 def fp2_sgn(x01):
     """Lexicographic Fp2 sign, imaginary part compared first (mirrors
-    crypto/fields.py fp2_sgn / the ZCash G2 compressed sort)."""
+    crypto/fields.py fp2_sgn / the ZCash G2 compressed sort).
+
+    int32 select, not a bool-payload jnp.where — Mosaic cannot lower
+    the i8->i1 trunci a select over i1 operands produces on real TPU
+    (same issue as _lex_cmp_const above)."""
     v1 = canonical_plus(x01[1])
     v0 = canonical_plus(x01[0])
-    s1 = lex_gt_const(v1, HALF_P_PLUS_LIMBS)
-    s0 = lex_gt_const(v0, HALF_P_PLUS_LIMBS)
-    return jnp.where(~is_zero_plus(v1), s1, s0)
+    s1 = lex_gt_const(v1, HALF_P_PLUS_LIMBS).astype(jnp.int32)
+    s0 = lex_gt_const(v0, HALF_P_PLUS_LIMBS).astype(jnp.int32)
+    use1 = (~is_zero_plus(v1)).astype(jnp.int32)
+    return (use1 * s1 + (1 - use1) * s0) != 0
 
 
 def fp2_sgn0(x01):

@@ -345,7 +345,6 @@ def _gather_pk(table_x, table_y, idx, kmask):
     return (ox, oy, oz), (oinf[0] != 0)
 
 
-@jax.jit
 def verify_batch_device(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,
@@ -353,6 +352,10 @@ def verify_batch_device(
     sig_inf, rwords, valid,
 ):
     """Full RLC batch verification of N padded sets on device.
+
+    NOT wrapped in one outer jit on purpose: each pallas stage compiles
+    as its OWN program (the monolithic graph OOM-kills the AOT compile
+    helper at ~30 min), with the elementwise glue in small jits below.
 
     Returns (batch_ok: bool[], sig_sub_ok: bool[N]).  Padding/invalid
     lanes are excluded via `valid`; sets whose (aggregate) pubkey or
@@ -375,7 +378,6 @@ def verify_batch_device(
     )
 
 
-@jax.jit
 def verify_batch_device_wire(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,
@@ -419,6 +421,56 @@ def _k_mont4(a0, a1, a2, a3, *outs):
         ref[...] = C.redc(C.mul_cols_shared(r[...], _R2_LIMBS, LY.NC))
 
 
+# -- jitted elementwise glue (kept OUT of the pallas stages so each
+# pallas kernel stays its own bounded compile unit) -------------------------
+
+
+@jax.jit
+def _j_substitute(live, pk0, pk1, pk2, sx0, sx1, sy0, sy1):
+    """Dead lanes -> generator points (keeps every lane on-curve)."""
+    n = live.shape[0]
+    px = C.select(live, pk0, _bcast(_G1X, n))
+    py = C.select(live, pk1, _bcast(_G1Y, n))
+    pz = C.select(live, pk2, _bcast(_ONE, n))
+    sx = F2.select2(
+        live, (sx0, sx1), (_bcast(_G2X[0], n), _bcast(_G2X[1], n))
+    )
+    sy = F2.select2(
+        live, (sy0, sy1), (_bcast(_G2Y[0], n), _bcast(_G2Y[1], n))
+    )
+    return px, py, pz, sx, sy
+
+
+@jax.jit
+def _j_sum_lanes(px0, px1, py0, py1, pz0, pz1, pinf):
+    (jX, jY, jZ), j_inf = CV.sum_points_lanes(
+        CV.FP2_OPS,
+        ((px0, px1), (py0, py1), (pz0, pz1)),
+        pinf[0] != 0,
+    )
+    return (*jX, *jY, *jZ, j_inf[None, :].astype(jnp.int32))
+
+
+@jax.jit
+def _j_product12(fpartial, live_mask):
+    fprod = jax.tree_util.tree_leaves(
+        KP.product12_lanes(_unflatten_f12(fpartial), live_mask)
+    )
+    return tuple(fprod)
+
+
+@jax.jit
+def _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid):
+    sub_ok = (sub[0] != 0) | ~live
+    batch_ok = (
+        (ok2[0, 0] != 0)
+        & jnp.all(sub_ok)
+        & ~jnp.any(pk_inf & (valid != 0))
+        & ~jnp.any(sig_bad & (valid != 0))
+    )
+    return batch_ok, sub_ok
+
+
 def _batch_core(
     table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid
 ):
@@ -435,14 +487,9 @@ def _batch_core(
     live = (valid != 0) & ~pk_inf & ~sig_bad
 
     # Substitute generators for dead lanes so every lane stays on-curve.
-    g1x, g1y, one = _bcast(_G1X, n), _bcast(_G1Y, n), _bcast(_ONE, n)
-    px = C.select(live, pk[0], g1x)
-    py = C.select(live, pk[1], g1y)
-    pz = C.select(live, pk[2], one)
-    g2x = (_bcast(_G2X[0], n), _bcast(_G2X[1], n))
-    g2y = (_bcast(_G2Y[0], n), _bcast(_G2Y[1], n))
-    sx = F2.select2(live, (sig_x0, sig_x1), g2x)
-    sy = F2.select2(live, (sig_y0, sig_y1), g2y)
+    px, py, pz, sx, sy = _j_substitute(
+        live, pk[0], pk[1], pk[2], sig_x0, sig_x1, sig_y0, sig_y1
+    )
 
     live_i = live[None, :].astype(jnp.int32)
     zero_row = jnp.zeros((1, n), jnp.int32)
@@ -471,15 +518,9 @@ def _batch_core(
         sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, excl, n
     )
     # cross-lane butterfly in plain XLA: 128 partials -> total in every lane
-    (jX, jY, jZ), j_inf = CV.sum_points_lanes(
-        CV.FP2_OPS,
-        ((px0, px1), (py0, py1), (pz0, pz1)),
-        pinf[0] != 0,
+    jx0, jx1, jy0, jy1, jz0, jz1, jinf = _j_sum_lanes(
+        px0, px1, py0, py1, pz0, pz1, pinf
     )
-    jx0, jx1 = jX
-    jy0, jy1 = jY
-    jz0, jz1 = jZ
-    jinf = j_inf[None, :].astype(jnp.int32)
     # [NL, BT] planes: every lane holds the aggregate point
     ax0, ax1, ay0, ay1, ainf = _tiled(
         _k_affine_g2,
@@ -512,10 +553,7 @@ def _batch_core(
     )
 
     fpartial = _prod(fN, live_i, n)
-    ones = jnp.ones((BT,), bool)
-    fprod = jax.tree_util.tree_leaves(
-        KP.product12_lanes(_unflatten_f12(fpartial), ones)
-    )
+    fprod = _j_product12(tuple(fpartial), jnp.ones((BT,), bool))
     ok2 = _tiled(
         _k_final_one,
         (ainf, *fprod, *fA),
@@ -524,14 +562,7 @@ def _batch_core(
         BT,
     )[0]
 
-    sub_ok = (sub[0] != 0) | ~live
-    batch_ok = (
-        (ok2[0, 0] != 0)
-        & jnp.all(sub_ok)
-        & ~jnp.any(pk_inf & (valid != 0))
-        & ~jnp.any(sig_bad & (valid != 0))
-    )
-    return batch_ok, sub_ok
+    return _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid)
 
 
 def _sum_g2(x0, x1, y0, y1, z0, z1, excl, n):
@@ -561,7 +592,6 @@ def _prod(fN, live_i, n):
     )(live_i, *fN)
 
 
-@jax.jit
 def verify_each_device(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,
@@ -585,7 +615,6 @@ def verify_each_device(
     )
 
 
-@jax.jit
 def verify_each_device_wire(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,
@@ -614,14 +643,10 @@ def _each_core(table_x, table_y, idx, kmask, msgM, sigM, sig_bad, valid):
     (pk, pk_inf) = _gather_pk(table_x, table_y, idx, kmask)
     live = (valid != 0) & ~pk_inf & ~sig_bad
 
-    g1x, g1y, one = _bcast(_G1X, n), _bcast(_G1Y, n), _bcast(_ONE, n)
-    px = C.select(live, pk[0], g1x)
-    py = C.select(live, pk[1], g1y)
-    pz = C.select(live, pk[2], one)
-    g2x = (_bcast(_G2X[0], n), _bcast(_G2X[1], n))
-    g2y = (_bcast(_G2Y[0], n), _bcast(_G2Y[1], n))
-    sx = F2.select2(live, (sig_x0, sig_x1), g2x)
-    sy = F2.select2(live, (sig_y0, sig_y1), g2y)
+    px, py, pz, sx, sy = _j_substitute(
+        live, pk[0], pk[1], pk[2], sig_x0, sig_x1, sig_y0, sig_y1
+    )
+    g1x, one = _bcast(_G1X, n), _bcast(_ONE, n)
 
     zero_row = jnp.zeros((1, n), jnp.int32)
     sub = _tiled(

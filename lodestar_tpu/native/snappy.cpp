@@ -1,0 +1,229 @@
+// Snappy raw-block codec + CRC32C — the native compression runtime.
+//
+// Equivalent of the reference's @chainsafe/snappy-stream (reqresp
+// framing) and snappyjs (gossip raw blocks) native/WASM dependencies
+// (reference: SURVEY.md §2.3).  Implements the snappy format spec:
+//   - raw block: uncompressed-length varint + literal/copy tag stream,
+//     greedy 4-byte hash matching (the format, not a port of any
+//     implementation),
+//   - crc32c (Castagnoli) for the framed stream's masked checksums.
+//
+// Exposed via ctypes (no pybind11 in this image): flat C ABI.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32c (table-driven, Castagnoli polynomial 0x82f63b78)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t snappy_crc32c(const uint8_t* data, size_t n) {
+  crc_init();
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < n; i++)
+    c = crc_table[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+// ---------------------------------------------------------------------------
+// varint
+// ---------------------------------------------------------------------------
+
+static size_t put_varint(uint8_t* dst, uint64_t v) {
+  size_t i = 0;
+  while (v >= 0x80) { dst[i++] = (uint8_t)(v | 0x80); v >>= 7; }
+  dst[i++] = (uint8_t)v;
+  return i;
+}
+
+static int get_varint(const uint8_t* src, size_t n, uint64_t* out,
+                      size_t* used) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && shift < 64; i++) {
+    v |= (uint64_t)(src[i] & 0x7f) << shift;
+    if (!(src[i] & 0x80)) { *out = v; *used = i + 1; return 0; }
+    shift += 7;
+  }
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// compression (greedy hash-table matcher)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t load32(const uint8_t* p) {
+  uint32_t v; memcpy(&v, p, 4); return v;
+}
+
+static inline uint32_t hash4(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> 18;  // 14-bit table
+}
+
+static uint8_t* emit_literal(uint8_t* dst, const uint8_t* src, size_t len) {
+  if (len == 0) return dst;
+  size_t n = len - 1;
+  if (n < 60) {
+    *dst++ = (uint8_t)(n << 2);
+  } else if (n < (1u << 8)) {
+    *dst++ = 60 << 2; *dst++ = (uint8_t)n;
+  } else if (n < (1u << 16)) {
+    *dst++ = 61 << 2; *dst++ = (uint8_t)n; *dst++ = (uint8_t)(n >> 8);
+  } else if (n < (1u << 24)) {
+    *dst++ = 62 << 2; *dst++ = (uint8_t)n; *dst++ = (uint8_t)(n >> 8);
+    *dst++ = (uint8_t)(n >> 16);
+  } else {
+    *dst++ = 63 << 2; *dst++ = (uint8_t)n; *dst++ = (uint8_t)(n >> 8);
+    *dst++ = (uint8_t)(n >> 16); *dst++ = (uint8_t)(n >> 24);
+  }
+  memcpy(dst, src, len);
+  return dst + len;
+}
+
+static uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t len) {
+  // emit copies in chunks of at most 64
+  while (len >= 68) {
+    *dst++ = (2 << 0) | (63 << 2);  // copy-2, len 64
+    *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+    len -= 64;
+  }
+  if (len > 64) {
+    *dst++ = (2 << 0) | (59 << 2);  // len 60
+    *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+    len -= 60;
+  }
+  if (len >= 12 || offset >= 2048) {
+    *dst++ = (uint8_t)((2 << 0) | ((len - 1) << 2));
+    *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+  } else {
+    *dst++ = (uint8_t)((1 << 0) | ((len - 4) << 2) |
+                       ((offset >> 8) << 5));
+    *dst++ = (uint8_t)offset;
+  }
+  return dst;
+}
+
+// dst must have room for snappy_max_compressed_length(n)
+size_t snappy_max_compressed_length(size_t n) {
+  return 32 + n + n / 6;
+}
+
+size_t snappy_compress(const uint8_t* src, size_t n, uint8_t* dst) {
+  uint8_t* out = dst;
+  out += put_varint(out, n);
+  if (n == 0) return (size_t)(out - dst);
+
+  static const size_t kTableBits = 14;
+  uint16_t table[1 << kTableBits];
+  memset(table, 0, sizeof(table));
+
+  size_t ip = 0, anchor = 0;
+  // blocks of 64KB so the 16-bit table offsets stay valid
+  while (ip < n) {
+    size_t block_start = ip;
+    size_t block_end = block_start + 65536 < n ? block_start + 65536 : n;
+    memset(table, 0, sizeof(table));
+    anchor = ip;
+    if (block_end - block_start >= 15) {
+      size_t limit = block_end - 4;
+      ip++;
+      while (ip < limit) {
+        uint32_t cur = load32(src + ip);
+        uint32_t h = hash4(cur) & ((1 << kTableBits) - 1);
+        size_t cand = block_start + table[h];
+        table[h] = (uint16_t)(ip - block_start);
+        if (cand < ip && load32(src + cand) == cur) {
+          // extend the match
+          size_t len = 4;
+          while (ip + len < block_end && src[cand + len] == src[ip + len])
+            len++;
+          out = emit_literal(out, src + anchor, ip - anchor);
+          out = emit_copy(out, ip - cand, len);
+          ip += len;
+          anchor = ip;
+        } else {
+          ip++;
+        }
+      }
+    }
+    out = emit_literal(out, src + anchor, block_end - anchor);
+    ip = block_end;
+  }
+  return (size_t)(out - dst);
+}
+
+// returns uncompressed size, or (size_t)-1 on malformed input;
+// call with dst=NULL to query the size first
+size_t snappy_uncompressed_length(const uint8_t* src, size_t n) {
+  uint64_t len; size_t used;
+  if (get_varint(src, n, &len, &used) != 0) return (size_t)-1;
+  return (size_t)len;
+}
+
+size_t snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                         size_t dst_cap) {
+  uint64_t total; size_t used;
+  if (get_varint(src, n, &total, &used) != 0) return (size_t)-1;
+  if (total > dst_cap) return (size_t)-1;
+  size_t ip = used, op = 0;
+  while (ip < n) {
+    uint8_t tag = src[ip++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t extra = len - 60;
+        if (ip + extra > n) return (size_t)-1;
+        len = 0;
+        for (size_t i = 0; i < extra; i++) len |= (size_t)src[ip + i] << (8 * i);
+        len += 1;
+        ip += extra;
+      }
+      if (ip + len > n || op + len > total) return (size_t)-1;
+      memcpy(dst + op, src + ip, len);
+      ip += len; op += len;
+    } else {
+      size_t len, offset;
+      if (kind == 1) {
+        if (ip >= n) return (size_t)-1;
+        len = ((tag >> 2) & 7) + 4;
+        offset = ((size_t)(tag >> 5) << 8) | src[ip++];
+      } else if (kind == 2) {
+        if (ip + 2 > n) return (size_t)-1;
+        len = (tag >> 2) + 1;
+        offset = (size_t)src[ip] | ((size_t)src[ip + 1] << 8);
+        ip += 2;
+      } else {
+        if (ip + 4 > n) return (size_t)-1;
+        len = (tag >> 2) + 1;
+        offset = (size_t)src[ip] | ((size_t)src[ip + 1] << 8) |
+                 ((size_t)src[ip + 2] << 16) | ((size_t)src[ip + 3] << 24);
+        ip += 4;
+      }
+      if (offset == 0 || offset > op || op + len > total) return (size_t)-1;
+      // overlapping copies are byte-by-byte by definition
+      for (size_t i = 0; i < len; i++) dst[op + i] = dst[op - offset + i];
+      op += len;
+    }
+  }
+  return op == total ? op : (size_t)-1;
+}
+
+}  // extern "C"

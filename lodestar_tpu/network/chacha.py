@@ -1,0 +1,115 @@
+"""ChaCha20-Poly1305 AEAD (RFC 8439) — the noise-transport cipher.
+
+Equivalent of the reference's `@chainsafe/as-chacha20poly1305` WASM
+dependency (SURVEY.md §2.3; libp2p noise encryption).  Implemented from
+RFC 8439: the ChaCha20 quarter-round block function, Poly1305 over the
+AAD/ciphertext layout, constant structure matching the RFC test
+vectors (exercised in tests/test_chacha.py).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _rotl32(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & _MASK32
+
+
+def _quarter(state, a, b, c, d):
+    state[a] = (state[a] + state[b]) & _MASK32
+    state[d] = _rotl32(state[d] ^ state[a], 16)
+    state[c] = (state[c] + state[d]) & _MASK32
+    state[b] = _rotl32(state[b] ^ state[c], 12)
+    state[a] = (state[a] + state[b]) & _MASK32
+    state[d] = _rotl32(state[d] ^ state[a], 8)
+    state[c] = (state[c] + state[d]) & _MASK32
+    state[b] = _rotl32(state[b] ^ state[c], 7)
+
+
+def _chacha20_block(key: bytes, counter: int, nonce: bytes) -> bytes:
+    state = [
+        0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+        *struct.unpack("<8I", key),
+        counter,
+        *struct.unpack("<3I", nonce),
+    ]
+    working = list(state)
+    for _ in range(10):
+        _quarter(working, 0, 4, 8, 12)
+        _quarter(working, 1, 5, 9, 13)
+        _quarter(working, 2, 6, 10, 14)
+        _quarter(working, 3, 7, 11, 15)
+        _quarter(working, 0, 5, 10, 15)
+        _quarter(working, 1, 6, 11, 12)
+        _quarter(working, 2, 7, 8, 13)
+        _quarter(working, 3, 4, 9, 14)
+    out = [(w + s) & _MASK32 for w, s in zip(working, state)]
+    return struct.pack("<16I", *out)
+
+
+def chacha20_xor(key: bytes, counter: int, nonce: bytes, data: bytes) -> bytes:
+    out = bytearray()
+    for i in range(0, len(data), 64):
+        block = _chacha20_block(key, counter + i // 64, nonce)
+        chunk = data[i : i + 64]
+        out += bytes(a ^ b for a, b in zip(chunk, block))
+    return bytes(out)
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little")
+    r &= 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i : i + 16]
+        n = int.from_bytes(block + b"\x01", "little")
+        acc = ((acc + n) * r) % p
+    return ((acc + s) % (1 << 128)).to_bytes(16, "little")
+
+
+def _pad16(data: bytes) -> bytes:
+    return b"\x00" * ((-len(data)) % 16)
+
+
+def _mac_data(aad: bytes, ciphertext: bytes) -> bytes:
+    return (
+        aad
+        + _pad16(aad)
+        + ciphertext
+        + _pad16(ciphertext)
+        + struct.pack("<QQ", len(aad), len(ciphertext))
+    )
+
+
+def seal(key: bytes, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+    """AEAD encrypt: ciphertext || 16-byte tag."""
+    if len(key) != 32 or len(nonce) != 12:
+        raise ValueError("key must be 32 bytes, nonce 12")
+    otk = _chacha20_block(key, 0, nonce)[:32]
+    ciphertext = chacha20_xor(key, 1, nonce, plaintext)
+    tag = _poly1305(otk, _mac_data(aad, ciphertext))
+    return ciphertext + tag
+
+
+def open_(
+    key: bytes, nonce: bytes, sealed: bytes, aad: bytes = b""
+) -> Optional[bytes]:
+    """AEAD decrypt; None on authentication failure."""
+    if len(sealed) < 16:
+        return None
+    ciphertext, tag = sealed[:-16], sealed[-16:]
+    otk = _chacha20_block(key, 0, nonce)[:32]
+    expected = _poly1305(otk, _mac_data(aad, ciphertext))
+    # constant-time compare
+    diff = 0
+    for a, b in zip(tag, expected):
+        diff |= a ^ b
+    if diff:
+        return None
+    return chacha20_xor(key, 1, nonce, ciphertext)

@@ -1,0 +1,18 @@
+"""Prover — verify execution-layer proofs against trusted roots.
+
+Mirror of the reference's packages/prover (verified execution API: the
+light-client-derived executionStateRoot anchors eth_getProof /
+eth_getCode verification).  keccak256 and the MPT walk are implemented
+from their specifications (no pycryptodome/@ethereumjs in this image).
+"""
+
+from .keccak import keccak256  # noqa: F401
+from .mpt import (  # noqa: F401
+    ProofError,
+    rlp_decode,
+    rlp_encode,
+    verify_account_proof,
+    verify_code,
+    verify_proof,
+    verify_storage_proof,
+)

@@ -1,0 +1,201 @@
+"""RLP decoding + Merkle-Patricia-Trie proof verification.
+
+Reference: packages/prover/src/ (verifyAccount/verifyCode against
+eth_getProof responses) — the proof engine the reference delegates to
+@ethereumjs/trie; implemented here from the MPT specification: RLP
+items, hex-prefix encoded paths, branch/extension/leaf node walk
+hashed with keccak256.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+from .keccak import keccak256
+
+RlpItem = Union[bytes, List["RlpItem"]]
+
+
+class ProofError(ValueError):
+    pass
+
+
+# -- RLP --------------------------------------------------------------------
+
+
+def rlp_decode(data: bytes) -> RlpItem:
+    item, rest = _rlp_decode_item(data)
+    if rest:
+        raise ProofError("trailing RLP bytes")
+    return item
+
+
+def _rlp_decode_item(data: bytes) -> Tuple[RlpItem, bytes]:
+    if not data:
+        raise ProofError("empty RLP")
+    prefix = data[0]
+    if prefix < 0x80:
+        return bytes([prefix]), data[1:]
+    if prefix < 0xB8:  # short string
+        length = prefix - 0x80
+        return data[1 : 1 + length], data[1 + length :]
+    if prefix < 0xC0:  # long string
+        len_len = prefix - 0xB7
+        length = int.from_bytes(data[1 : 1 + len_len], "big")
+        start = 1 + len_len
+        return data[start : start + length], data[start + length :]
+    if prefix < 0xF8:  # short list
+        length = prefix - 0xC0
+        return _rlp_decode_list(data[1 : 1 + length]), data[1 + length :]
+    len_len = prefix - 0xF7
+    length = int.from_bytes(data[1 : 1 + len_len], "big")
+    start = 1 + len_len
+    return (
+        _rlp_decode_list(data[start : start + length]),
+        data[start + length :],
+    )
+
+
+def _rlp_decode_list(data: bytes) -> List[RlpItem]:
+    out = []
+    while data:
+        item, data = _rlp_decode_item(data)
+        out.append(item)
+    return out
+
+
+def rlp_encode(item: RlpItem) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        b = bytes(item)
+        if len(b) == 1 and b[0] < 0x80:
+            return b
+        return _rlp_len(len(b), 0x80) + b
+    body = b"".join(rlp_encode(x) for x in item)
+    return _rlp_len(len(body), 0xC0) + body
+
+
+def _rlp_len(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    nb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(nb)]) + nb
+
+
+# -- MPT proof walk ---------------------------------------------------------
+
+
+def _nibbles(key: bytes) -> List[int]:
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0x0F)
+    return out
+
+
+def _decode_hp(path: bytes) -> Tuple[List[int], bool]:
+    """Hex-prefix: returns (nibbles, is_leaf)."""
+    if not path:
+        raise ProofError("empty hex-prefix path")
+    flag = path[0] >> 4
+    is_leaf = bool(flag & 2)
+    nibs = []
+    if flag & 1:  # odd length
+        nibs.append(path[0] & 0x0F)
+    for b in path[1:]:
+        nibs.append(b >> 4)
+        nibs.append(b & 0x0F)
+    return nibs, is_leaf
+
+
+def verify_proof(
+    root: bytes, key: bytes, proof: Sequence[bytes]
+) -> Optional[bytes]:
+    """Walk `proof` (ordered RLP node list) from `root` along
+    keccak(key)'s nibbles; returns the value, None for a proven
+    absence, or raises ProofError on an invalid proof."""
+    nodes = {keccak256(p): p for p in proof}
+    nibbles = _nibbles(key)
+    expected = root
+    pos = 0
+    while True:
+        node_rlp = nodes.get(expected)
+        if node_rlp is None:
+            raise ProofError(f"missing proof node {expected.hex()[:16]}")
+        node = rlp_decode(node_rlp)
+        if not isinstance(node, list):
+            raise ProofError("trie node is not a list")
+        if len(node) == 17:  # branch
+            if pos == len(nibbles):
+                value = node[16]
+                return bytes(value) if value else None
+            child = node[nibbles[pos]]
+            pos += 1
+            if child == b"":
+                return None  # proven absent
+            if isinstance(child, list):  # embedded short node
+                node_rlp_embedded = rlp_encode(child)
+                nodes[keccak256(node_rlp_embedded)] = node_rlp_embedded
+                expected = keccak256(node_rlp_embedded)
+                continue
+            if len(child) != 32:
+                raise ProofError("branch child is not a hash")
+            expected = bytes(child)
+        elif len(node) == 2:  # extension or leaf
+            path_nibs, is_leaf = _decode_hp(bytes(node[0]))
+            if nibbles[pos : pos + len(path_nibs)] != path_nibs:
+                return None  # path diverges: proven absent
+            pos += len(path_nibs)
+            if is_leaf:
+                if pos != len(nibbles):
+                    return None
+                return bytes(node[1])
+            nxt = node[1]
+            if isinstance(nxt, list):
+                emb = rlp_encode(nxt)
+                nodes[keccak256(emb)] = emb
+                expected = keccak256(emb)
+                continue
+            if len(nxt) != 32:
+                raise ProofError("extension target is not a hash")
+            expected = bytes(nxt)
+        else:
+            raise ProofError(f"bad trie node arity {len(node)}")
+
+
+# -- the prover surface (reference: prover/src/verified_requests) -----------
+
+
+def verify_account_proof(
+    state_root: bytes, address: bytes, proof: Sequence[bytes]
+) -> Optional[dict]:
+    """eth_getProof account leg: returns {nonce, balance, storage_hash,
+    code_hash} or None if the account is proven absent."""
+    value = verify_proof(state_root, keccak256(address), proof)
+    if value is None:
+        return None
+    fields = rlp_decode(value)
+    if not isinstance(fields, list) or len(fields) != 4:
+        raise ProofError("bad account RLP")
+    nonce, balance, storage_hash, code_hash = fields
+    return {
+        "nonce": int.from_bytes(bytes(nonce), "big"),
+        "balance": int.from_bytes(bytes(balance), "big"),
+        "storage_hash": bytes(storage_hash),
+        "code_hash": bytes(code_hash),
+    }
+
+
+def verify_storage_proof(
+    storage_hash: bytes, slot: bytes, proof: Sequence[bytes]
+) -> int:
+    """eth_getProof storage leg: the slot's value (0 if absent)."""
+    value = verify_proof(storage_hash, keccak256(slot), proof)
+    if value is None:
+        return 0
+    inner = rlp_decode(value)
+    return int.from_bytes(bytes(inner), "big")
+
+
+def verify_code(code: bytes, code_hash: bytes) -> bool:
+    """eth_getCode against the proven account code hash."""
+    return keccak256(code) == code_hash

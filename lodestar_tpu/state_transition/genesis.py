@@ -31,13 +31,25 @@ class DepositTree:
 
     root() mixes in the leaf count (the +1 level process_deposit's
     branch check expects); proof(i) returns DEPTH siblings plus the
-    count chunk as the final branch element."""
+    count chunk as the final branch element.  push/root are O(DEPTH)
+    via the deposit contract's partial-branch algorithm (the eth1
+    tracker calls root() once per followed block); proof() rebuilds
+    levels and is O(n) — it only runs per produced deposit op."""
 
     def __init__(self):
         self.leaves: List[bytes] = []
+        self._branch: List[bytes] = [b"\x00" * 32] * DEPTH
 
     def push(self, deposit_data: Dict) -> None:
-        self.leaves.append(DepositDataType.hash_tree_root(deposit_data))
+        node = DepositDataType.hash_tree_root(deposit_data)
+        self.leaves.append(node)
+        size = len(self.leaves)
+        for h in range(DEPTH):
+            if size & 1:
+                self._branch[h] = node
+                break
+            node = hashlib.sha256(self._branch[h] + node).digest()
+            size >>= 1
 
     def _levels(self) -> List[List[bytes]]:
         levels = [list(self.leaves)]
@@ -55,9 +67,17 @@ class DepositTree:
         return len(self.leaves).to_bytes(32, "little")
 
     def root(self) -> bytes:
-        levels = self._levels()
-        top = levels[DEPTH][0] if levels[DEPTH] else _ZERO_HASHES[DEPTH]
-        return hashlib.sha256(top + self._count_chunk()).digest()
+        """O(DEPTH) root from the partial branch (deposit contract
+        get_deposit_root), count mixed in."""
+        node = b"\x00" * 32
+        size = len(self.leaves)
+        for h in range(DEPTH):
+            if size & 1:
+                node = hashlib.sha256(self._branch[h] + node).digest()
+            else:
+                node = hashlib.sha256(node + _ZERO_HASHES[h]).digest()
+            size >>= 1
+        return hashlib.sha256(node + self._count_chunk()).digest()
 
     def proof(self, index: int) -> List[bytes]:
         assert 0 <= index < len(self.leaves)

@@ -1,0 +1,56 @@
+"""GC observability — collection counts and pause durations.
+
+Equivalent of the reference's `gc-stats` native dependency (SURVEY.md
+§2.3; the reference feeds nodejs_gc_* metrics from it).  CPython's gc
+exposes callbacks, so no native hook is needed: start/stop events are
+timed per generation and exported through the metrics registry.
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+from typing import Dict, Optional
+
+
+class GcStats:
+    def __init__(self, registry=None):
+        self.collections: Dict[int, int] = {0: 0, 1: 0, 2: 0}
+        self.collected: Dict[int, int] = {0: 0, 1: 0, 2: 0}
+        self.pause_seconds: Dict[int, float] = {0: 0.0, 1: 0.0, 2: 0.0}
+        self._start: Optional[float] = None
+        self._registry = registry
+        self._installed = False
+
+    def _callback(self, phase: str, info: dict) -> None:
+        gen = info.get("generation", 0)
+        if phase == "start":
+            self._start = time.perf_counter()
+        elif phase == "stop":
+            if self._start is not None:
+                self.pause_seconds[gen] += time.perf_counter() - self._start
+                self._start = None
+            self.collections[gen] += 1
+            self.collected[gen] += info.get("collected", 0)
+
+    def install(self) -> "GcStats":
+        if not self._installed:
+            gc.callbacks.append(self._callback)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            try:
+                gc.callbacks.remove(self._callback)
+            except ValueError:
+                pass
+            self._installed = False
+
+    def snapshot(self) -> dict:
+        """Prometheus-style flat view (nodejs_gc_runs_total analog)."""
+        return {
+            "gc_runs_total": dict(self.collections),
+            "gc_collected_total": dict(self.collected),
+            "gc_pause_seconds_total": dict(self.pause_seconds),
+        }

@@ -1,0 +1,74 @@
+"""ChaCha20-Poly1305 against the RFC 8439 test vectors."""
+
+import os
+
+import pytest
+
+from lodestar_tpu.network.chacha import (
+    _chacha20_block,
+    _poly1305,
+    chacha20_xor,
+    open_,
+    seal,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def test_chacha20_block_rfc_vector():
+    # RFC 8439 §2.3.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    block = _chacha20_block(key, 1, nonce)
+    assert block.hex().startswith("10f1e7e4d13b5915500fdd1fa32071c4")
+
+
+def test_chacha20_encrypt_rfc_vector():
+    # RFC 8439 §2.4.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = chacha20_xor(key, 1, nonce, plaintext)
+    assert ct.hex().startswith("6e2e359a2568f98041ba0728dd0d6981")
+    assert chacha20_xor(key, 1, nonce, ct) == plaintext
+
+
+def test_poly1305_rfc_vector():
+    # RFC 8439 §2.5.2
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a8"
+        "0103808afb0db2fd4abff6af4149f51b"
+    )
+    msg = b"Cryptographic Forum Research Group"
+    assert _poly1305(key, msg).hex() == "a8061dc1305136c6c22b8baf0c0127a9"
+
+
+def test_aead_rfc_vector():
+    # RFC 8439 §2.8.2
+    key = bytes.fromhex(
+        "808182838485868788898a8b8c8d8e8f"
+        "909192939495969798999a9b9c9d9e9f"
+    )
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    sealed = seal(key, nonce, plaintext, aad)
+    assert sealed[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+    assert open_(key, nonce, sealed, aad) == plaintext
+
+
+def test_aead_rejects_tampering():
+    key, nonce = os.urandom(32), os.urandom(12)
+    sealed = bytearray(seal(key, nonce, b"secret message", b"aad"))
+    sealed[0] ^= 1
+    assert open_(key, nonce, bytes(sealed), b"aad") is None
+    # wrong aad
+    good = seal(key, nonce, b"secret message", b"aad")
+    assert open_(key, nonce, good, b"wrong") is None
+    assert open_(key, nonce, good, b"aad") == b"secret message"

@@ -1,0 +1,118 @@
+"""flare self-slashing over the API + GC stats.
+
+Reference: packages/flare/src/cmds/selfSlash{Proposer,Attester}.ts —
+the slashing lands in the pool, gets included in a block, and the
+offender ends up slashed through the full state transition; gc-stats
+equivalent (utils/gc_stats.py).
+"""
+
+import gc
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.flare import self_slash_attester, self_slash_proposer
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.utils.gc_stats import GcStats
+
+P = params.ACTIVE_PRESET
+
+
+@pytest.fixture(scope="module")
+def flare_world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"flare-%d" % i) for i in range(16)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=1)
+    chain = BeaconChain(cfg, genesis)
+    server = BeaconApiServer(DefaultHandlers(chain=chain))
+    server.listen()
+    client = ApiClient([f"http://127.0.0.1:{server.port}"], timeout=30)
+    yield cfg, sks, chain, client
+    server.close()
+
+
+def test_self_slash_proposer_end_to_end(flare_world):
+    cfg, sks, chain, client = flare_world
+    victim = 3
+    self_slash_proposer(cfg, client, sks[victim], victim, slot=1)
+    ps, _, _ = chain.op_pool.get_slashings_and_exits(chain.head_state)
+    assert len(ps) == 1
+
+    # the slashing flows from the pool into a produced block and the
+    # state transition slashes the offender
+    block = chain.produce_block(1, b"\x07" * 96)
+    assert len(block["body"]["proposer_slashings"]) == 1
+    from lodestar_tpu.state_transition import state_transition
+
+    post = state_transition(
+        chain.head_state,
+        {"message": block, "signature": b"\x00" * 96},
+        verify_state_root=True,
+        verify_signatures=False,
+    )
+    assert bool(post.slashed[victim])
+
+
+def test_self_slash_attester_over_api(flare_world):
+    cfg, sks, chain, client = flare_world
+    indices = [5, 6]
+    slashing = self_slash_attester(
+        cfg, client, [sks[i] for i in indices], indices, target_epoch=0
+    )
+    # valid double vote: both indexed attestations verify
+    from lodestar_tpu.state_transition.block import (
+        is_slashable_attestation_data,
+    )
+
+    assert is_slashable_attestation_data(
+        slashing["attestation_1"]["data"], slashing["attestation_2"]["data"]
+    )
+    _, atts, _ = chain.op_pool.get_slashings_and_exits(chain.head_state)
+    assert len(atts) == 1
+
+
+def test_voluntary_exit_pool_route_validates(flare_world):
+    from lodestar_tpu.api.client import ApiError
+
+    cfg, sks, chain, client = flare_world
+    # unsigned + too-young exit: rejected at ingress, pool stays clean
+    with pytest.raises(ApiError) as exc:
+        client.submit_voluntary_exit(
+            {
+                "message": {"epoch": 0, "validator_index": 9},
+                "signature": b"\x00" * 96,
+            }
+        )
+    assert exc.value.status == 400
+    _, _, exits = chain.op_pool.get_slashings_and_exits(chain.head_state)
+    assert exits == []
+    # block production keeps working after the rejected submission
+    block = chain.produce_block(2, b"\x09" * 96)
+    assert block["body"]["voluntary_exits"] == []
+
+
+def test_gc_stats():
+    stats = GcStats().install()
+    try:
+        junk = [[object() for _ in range(100)] for _ in range(100)]
+        del junk
+        gc.collect()
+        snap = stats.snapshot()
+        assert sum(snap["gc_runs_total"].values()) >= 1
+        assert sum(snap["gc_pause_seconds_total"].values()) >= 0
+    finally:
+        stats.uninstall()
+    before = sum(stats.collections.values())
+    gc.collect()
+    assert sum(stats.collections.values()) == before  # uninstalled

@@ -1,0 +1,143 @@
+"""Prover: keccak256 vectors, RLP, MPT proof verification.
+
+Reference: packages/prover/src — account/storage/code verification
+against eth_getProof-shaped data.  Tries here are constructed by hand
+from the MPT spec so the proofs are exact.
+"""
+
+import pytest
+
+from lodestar_tpu.prover import (
+    ProofError,
+    keccak256,
+    rlp_decode,
+    rlp_encode,
+    verify_account_proof,
+    verify_code,
+    verify_proof,
+    verify_storage_proof,
+)
+from lodestar_tpu.prover.mpt import _decode_hp, _nibbles
+
+pytestmark = pytest.mark.smoke
+
+
+def test_keccak256_vectors():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # > rate-sized input exercises multi-block absorption
+    assert keccak256(b"a" * 200).hex() == keccak256(b"a" * 200).hex()
+    assert keccak256(b"a" * 135) != keccak256(b"a" * 136)
+
+
+def test_rlp_roundtrip():
+    cases = [
+        b"",
+        b"\x01",
+        b"\x7f",
+        b"\x80",
+        b"dog",
+        b"x" * 60,
+        [b"cat", b"dog"],
+        [b"", [b"a", [b"b"]], b"c" * 56],
+    ]
+    for case in cases:
+        assert rlp_decode(rlp_encode(case)) == case
+    # canonical single bytes
+    assert rlp_encode(b"\x05") == b"\x05"
+    assert rlp_encode(b"dog") == b"\x83dog"
+
+
+def _hp(nibbles, is_leaf):
+    flag = 2 if is_leaf else 0
+    if len(nibbles) % 2:
+        first = bytes([((flag | 1) << 4) | nibbles[0]])
+        rest = nibbles[1:]
+    else:
+        first = bytes([flag << 4])
+        rest = nibbles
+    body = bytes(
+        (rest[i] << 4) | rest[i + 1] for i in range(0, len(rest), 2)
+    )
+    return first + body
+
+
+def test_single_leaf_trie_proof():
+    key = b"\x11" * 20
+    value = rlp_encode([b"\x01", b"\x64", b"\xaa" * 32, b"\xbb" * 32])
+    path = _nibbles(keccak256(key))
+    leaf = rlp_encode([_hp(path, True), value])
+    root = keccak256(leaf)
+
+    assert verify_proof(root, keccak256(key), [leaf]) == value
+    account = verify_account_proof(root, key, [leaf])
+    assert account == {
+        "nonce": 1,
+        "balance": 100,
+        "storage_hash": b"\xaa" * 32,
+        "code_hash": b"\xbb" * 32,
+    }
+    # absent key: leaf path diverges -> None
+    other = b"\x22" * 20
+    assert verify_account_proof(root, other, [leaf]) is None
+    # missing node raises
+    with pytest.raises(ProofError):
+        verify_proof(b"\x00" * 32, keccak256(key), [leaf])
+
+
+def test_branch_trie_proof():
+    # two keys whose hashed paths differ at the first nibble
+    keys = [b"k1", b"k2", b"k3", b"k4", b"k5"]
+    k1 = keys[0]
+    k2 = next(
+        k
+        for k in keys[1:]
+        if _nibbles(keccak256(k))[0] != _nibbles(keccak256(k1))[0]
+    )
+    v1, v2 = rlp_encode(b"value-one"), rlp_encode(b"value-two")
+
+    n1, n2 = _nibbles(keccak256(k1)), _nibbles(keccak256(k2))
+    leaf1 = rlp_encode([_hp(n1[1:], True), v1])
+    leaf2 = rlp_encode([_hp(n2[1:], True), v2])
+    branch = [b""] * 17
+    branch[n1[0]] = keccak256(leaf1)
+    branch[n2[0]] = keccak256(leaf2)
+    branch_rlp = rlp_encode(branch)
+    root = keccak256(branch_rlp)
+
+    assert verify_proof(root, keccak256(k1), [branch_rlp, leaf1]) == v1
+    assert verify_proof(root, keccak256(k2), [branch_rlp, leaf2]) == v2
+    # a key into an empty branch slot is proven absent
+    empty_slot_key = next(
+        k
+        for k in (b"q%d" % i for i in range(100))
+        if not branch[_nibbles(keccak256(k))[0]]
+    )
+    assert verify_proof(root, keccak256(empty_slot_key), [branch_rlp]) is None
+
+
+def test_storage_and_code():
+    slot = b"\x00" * 32
+    value = rlp_encode(b"\x2a")  # 42
+    path = _nibbles(keccak256(slot))
+    leaf = rlp_encode([_hp(path, True), value])
+    root = keccak256(leaf)
+    assert verify_storage_proof(root, slot, [leaf]) == 42
+    # absent slot -> 0
+    assert verify_storage_proof(root, b"\x01" + b"\x00" * 31, [leaf]) == 0
+
+    code = b"\x60\x80\x60\x40"
+    assert verify_code(code, keccak256(code))
+    assert not verify_code(code, b"\x00" * 32)
+
+
+def test_hex_prefix_roundtrip():
+    for nibs in ([], [5], [1, 2, 3], [0xF, 0xE, 0xD, 0xC]):
+        for leaf in (True, False):
+            decoded, is_leaf = _decode_hp(_hp(nibs, leaf))
+            assert decoded == nibs
+            assert is_leaf == leaf

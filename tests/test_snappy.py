@@ -1,0 +1,82 @@
+"""Native snappy codec + eth2 framing round trips and known vectors.
+
+Reference surfaces: @chainsafe/snappy-stream (reqresp ssz_snappy) +
+snappyjs (gossip raw blocks); crc32c vectors are the RFC 3720 check
+values.
+"""
+
+import os
+import random
+
+import pytest
+
+from lodestar_tpu.network import snappy as S
+
+pytestmark = pytest.mark.smoke
+
+if not S.native_available():  # pragma: no cover
+    pytest.skip("libsnappy_tpu.so not built", allow_module_level=True)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / common crc32c check values
+    assert S.crc32c(b"") == 0
+    assert S.crc32c(b"123456789") == 0xE3069283
+    assert S.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_raw_roundtrip_various():
+    rng = random.Random(7)
+    cases = [
+        b"",
+        b"a",
+        b"ab" * 3,
+        b"hello hello hello hello hello",  # repetitive -> copies
+        bytes(rng.randrange(256) for _ in range(1000)),  # incompressible
+        b"\x00" * 100000,  # highly compressible, multi-64KB-block
+        os.urandom(70000),
+    ]
+    for data in cases:
+        comp = S.compress(data)
+        assert S.decompress(comp) == data
+    # compressible data actually shrinks (snappy copies cap at 64 bytes,
+    # so ~3 bytes per 64 -> ~21x on constant input)
+    assert len(S.compress(b"\x00" * 100000)) < 6000
+
+
+def test_decompress_rejects_garbage():
+    with pytest.raises(S.SnappyError):
+        S.decompress(b"\xff" * 40)
+    # declared length beyond cap
+    big = S.compress(b"x" * 1000)
+    with pytest.raises(S.SnappyError):
+        S.decompress(big, max_len=10)
+
+
+def test_framed_roundtrip():
+    for data in (b"", b"tiny", b"z" * 200000, os.urandom(100000)):
+        framed = S.frame_compress(data)
+        assert framed.startswith(b"\xff\x06\x00\x00sNaPpY")
+        assert S.frame_decompress(framed) == data
+
+
+def test_framed_checksum_detects_corruption():
+    framed = bytearray(S.frame_compress(b"payload payload payload"))
+    framed[-1] ^= 0x01
+    with pytest.raises(S.SnappyError):
+        S.frame_decompress(bytes(framed))
+
+
+def test_reqresp_chunk_roundtrip():
+    from lodestar_tpu import types as T
+
+    att = T.AttestationData.default()
+    ssz = T.AttestationData.serialize(att)
+    chunk = S.encode_reqresp_chunk(ssz)
+    assert S.decode_reqresp_chunk(chunk) == ssz
+    assert T.AttestationData.deserialize(S.decode_reqresp_chunk(chunk)) == att
+
+    # declared-length mismatch rejected
+    tampered = S._uvarint(len(ssz) + 1) + S.frame_compress(ssz)
+    with pytest.raises(S.SnappyError):
+        S.decode_reqresp_chunk(tampered)
