@@ -1,0 +1,149 @@
+"""Gossip topics, message encoding, and an in-memory pubsub bus.
+
+Reference: packages/beacon-node/src/network/gossip/topic.ts (topic
+strings `/eth2/{forkDigest}/{name}/ssz_snappy`), gossip/encoding.ts
+(raw-snappy payloads; altair message-id =
+sha256(MESSAGE_DOMAIN_VALID_SNAPPY + len(topic)_8le + topic +
+decompressed)[:20]), and gossip/gossipsub.ts (publish/subscribe over
+topic meshes).  The wire transport (libp2p) stays out of scope
+(SURVEY.md §2.4 P9); `InMemoryGossipBus` provides the same
+publish/subscribe/seen-dedup semantics in process so multi-node flows
+are testable end to end.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import snappy as S
+from ..utils.logger import get_logger
+
+MESSAGE_DOMAIN_VALID_SNAPPY = bytes.fromhex("01000000")
+MESSAGE_DOMAIN_INVALID_SNAPPY = bytes.fromhex("00000000")
+
+
+class GossipTopicName(str, enum.Enum):
+    beacon_block = "beacon_block"
+    beacon_aggregate_and_proof = "beacon_aggregate_and_proof"
+    beacon_attestation = "beacon_attestation_{subnet}"
+    voluntary_exit = "voluntary_exit"
+    proposer_slashing = "proposer_slashing"
+    attester_slashing = "attester_slashing"
+    sync_committee_contribution_and_proof = (
+        "sync_committee_contribution_and_proof"
+    )
+    sync_committee = "sync_committee_{subnet}"
+    light_client_finality_update = "light_client_finality_update"
+    light_client_optimistic_update = "light_client_optimistic_update"
+
+
+def topic_string(
+    fork_digest: bytes, name: GossipTopicName, subnet: Optional[int] = None
+) -> str:
+    """`/eth2/{digest}/{name}/ssz_snappy` (reference topic.ts)."""
+    base = name.value
+    if "{subnet}" in base:
+        if subnet is None:
+            raise ValueError(f"{name} requires a subnet")
+        base = base.format(subnet=subnet)
+    return f"/eth2/{fork_digest.hex()}/{base}/ssz_snappy"
+
+
+def parse_topic(topic: str) -> Tuple[bytes, str]:
+    """-> (fork_digest, topic name with subnet suffix)."""
+    parts = topic.split("/")
+    if (
+        len(parts) != 5
+        or parts[1] != "eth2"
+        or parts[4] != "ssz_snappy"
+    ):
+        raise ValueError(f"malformed gossip topic {topic}")
+    return bytes.fromhex(parts[2]), parts[3]
+
+
+# one gossip size cap shared by decode and message-id classification
+GOSSIP_MAX_UNCOMPRESSED = 1 << 23
+
+
+def encode_message(ssz_bytes: bytes) -> bytes:
+    """Gossip payloads are RAW snappy blocks (encoding.ts)."""
+    return S.compress(ssz_bytes)
+
+
+def decode_message(data: bytes, max_len: int = GOSSIP_MAX_UNCOMPRESSED) -> bytes:
+    return S.decompress(data, max_len)
+
+
+def compute_message_id(
+    topic: str, data: bytes, max_len: int = GOSSIP_MAX_UNCOMPRESSED
+) -> bytes:
+    """altair message-id (encoding.ts:51-58); falls back to the
+    invalid-snappy domain over the raw data when decompression fails
+    OR the declared size exceeds the gossip cap (so the id
+    classification always agrees with what decode_message accepts)."""
+    topic_bytes = topic.encode()
+    try:
+        payload = S.decompress(data, max_len)
+        vec = (
+            MESSAGE_DOMAIN_VALID_SNAPPY
+            + len(topic_bytes).to_bytes(8, "little")
+            + topic_bytes
+            + payload
+        )
+    except S.SnappyError:
+        vec = (
+            MESSAGE_DOMAIN_INVALID_SNAPPY
+            + len(topic_bytes).to_bytes(8, "little")
+            + topic_bytes
+            + data
+        )
+    return hashlib.sha256(vec).digest()[:20]
+
+
+class InMemoryGossipBus:
+    """Topic fanout with per-node handlers and seen-message dedup —
+    the gossipsub mesh semantics without the libp2p wire."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Tuple[str, Callable]]] = defaultdict(list)
+        self._seen: Dict[str, set] = defaultdict(set)
+        self.log = get_logger("network/gossip")
+        self.published = 0
+        self.delivered = 0
+        self.duplicates = 0
+
+    def subscribe(self, node_id: str, topic: str, handler: Callable) -> None:
+        self._subs[topic].append((node_id, handler))
+
+    def unsubscribe(self, node_id: str, topic: str) -> None:
+        self._subs[topic] = [
+            (nid, h) for nid, h in self._subs[topic] if nid != node_id
+        ]
+
+    def publish(self, from_node: str, topic: str, data: bytes) -> int:
+        """Deliver to every OTHER subscriber that has not seen the id."""
+        msg_id = compute_message_id(topic, data)
+        self.published += 1
+        # the publisher has seen its own message: a relayed copy must
+        # not echo back (gossipsub inserts published ids into seenCache)
+        self._seen[from_node].add(msg_id)
+        delivered = 0
+        for node_id, handler in list(self._subs[topic]):
+            if node_id == from_node:
+                continue
+            if msg_id in self._seen[node_id]:
+                self.duplicates += 1
+                continue
+            self._seen[node_id].add(msg_id)
+            try:
+                handler(topic, data)
+                delivered += 1
+                self.delivered += 1
+            except Exception as e:  # noqa: BLE001 - subscriber isolation
+                self.log.warn(
+                    "gossip handler failed", topic=topic, error=str(e)
+                )
+        return delivered
