@@ -264,6 +264,46 @@ class ApiClient:
     def get_spec(self) -> dict:
         return self._request("GET", "/eth/v1/config/spec")["data"]
 
+    # -- events (SSE; reference: routes/events.ts eventstream) -------------
+
+    def stream_events(
+        self,
+        topics,
+        on_event,
+        max_events: int = 0,
+        timeout: float = 10.0,
+    ) -> int:
+        """Blocking SSE subscription; calls on_event(topic, data_dict).
+        Returns the number of events received."""
+        path = (
+            "/eth/v1/events?topics="
+            + ",".join(topics)
+            + f"&max_events={max_events}&timeout={timeout}"
+        )
+        last: Optional[Exception] = None
+        for base in self.base_urls:  # same failover as _request
+            req = urllib.request.Request(base.rstrip("/") + path, method="GET")
+            received = 0
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout + 5
+                ) as resp:
+                    event_name = None
+                    for raw in resp:
+                        line = raw.decode().rstrip("\n")
+                        if line.startswith("event: "):
+                            event_name = line[len("event: "):]
+                        elif line.startswith("data: ") and event_name:
+                            on_event(
+                                event_name, json.loads(line[len("data: "):])
+                            )
+                            received += 1
+                            event_name = None
+                return received
+            except urllib.error.URLError as e:
+                last = e
+        raise ApiError(0, f"all base urls failed: {last}")
+
     # -- lodestar introspection --------------------------------------------
 
     def dump_gossip_queue(self, gossip_type: str) -> dict:

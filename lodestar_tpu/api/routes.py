@@ -80,6 +80,8 @@ ROUTES: Tuple[Route, ...] = (
         "/eth/v1/validator/contribution_and_proofs",
         "publish_contributions",
     ),
+    # events namespace (reference: routes/events.ts — SSE stream)
+    Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)
     Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),
     Route("GET", "/eth/v1/lodestar/bls-metrics", "get_bls_metrics"),

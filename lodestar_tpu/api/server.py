@@ -305,6 +305,94 @@ class DefaultHandlers:
         self.chain.op_pool.insert_voluntary_exit(signed)
         return 200, None
 
+    def get_events(self, params, body):
+        """SSE stream of chain events (reference routes/events.ts):
+        `?topics=head,block,finalized_checkpoint` and an optional
+        `max_events` bound (tests/polling clients)."""
+        err = self._need_chain()
+        if err:
+            return err
+        import queue as _queue
+
+        from ..chain.emitter import ChainEvent
+
+        topics = [
+            t
+            for t in (params.get("topics") or "head,block").split(",")
+            if t
+        ]
+        max_events = int(params.get("max_events", 0)) or None
+        # clamp the client-supplied lifetime: a quiet chain must not pin
+        # server threads/subscriptions for arbitrary client-chosen time
+        timeout = min(float(params.get("timeout", 10.0)), 600.0)
+        q: "_queue.Queue" = _queue.Queue()
+        emitter = self.chain.emitter
+        subs = []
+
+        def _sub(topic, event, encode):
+            cb = emitter.on(event, lambda *a: q.put((topic, encode(*a))))
+            subs.append((event, cb))
+
+        if "head" in topics:
+            _sub(
+                "head",
+                ChainEvent.head,
+                lambda root, slot: {
+                    "slot": str(slot),
+                    "block": "0x" + root.hex(),
+                },
+            )
+        if "block" in topics:
+            _sub(
+                "block",
+                ChainEvent.block,
+                lambda signed, root: {
+                    "slot": str(signed["message"]["slot"]),
+                    "block": "0x" + root.hex(),
+                },
+            )
+        if "finalized_checkpoint" in topics:
+            _sub(
+                "finalized_checkpoint",
+                ChainEvent.finalized,
+                lambda cp: {
+                    "epoch": str(cp["epoch"]),
+                    "block": "0x" + cp["root"].hex(),
+                },
+            )
+
+        def stream():
+            import json as _json
+            import time as _time
+
+            sent = 0
+            deadline = _time.time() + timeout
+            last_write = _time.time()
+            try:
+                while max_events is None or sent < max_events:
+                    remaining = deadline - _time.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        topic, data = q.get(timeout=min(remaining, 1.0))
+                    except _queue.Empty:
+                        # heartbeat comment frame: surfaces client
+                        # disconnects (BrokenPipeError) on a quiet chain
+                        if _time.time() - last_write >= 10.0:
+                            yield b": keep-alive\n\n"
+                            last_write = _time.time()
+                        continue
+                    yield (
+                        f"event: {topic}\ndata: {_json.dumps(data)}\n\n"
+                    ).encode()
+                    last_write = _time.time()
+                    sent += 1
+            finally:
+                for event, cb in subs:
+                    emitter.off(event, cb)
+
+        return 200, stream()
+
     def get_aggregate_attestation(self, params, body):
         err = self._need_chain()
         if err:
@@ -449,6 +537,20 @@ class BeaconApiServer:
                 self._send(status, payload)
 
             def _send(self, status, payload):
+                if hasattr(payload, "__next__"):  # SSE stream generator
+                    self.send_response(status)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    try:
+                        for frame in payload:
+                            self.wfile.write(frame)
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    finally:
+                        payload.close()
+                    return
                 data = b"" if payload is None else json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

@@ -105,15 +105,33 @@ def compute_message_id(
 
 class InMemoryGossipBus:
     """Topic fanout with per-node handlers and seen-message dedup —
-    the gossipsub mesh semantics without the libp2p wire."""
+    the gossipsub mesh semantics without the libp2p wire.  Seen caches
+    are FIFO-bounded per node (gossipsub's seenCache is TTL-bounded;
+    a count bound gives the same no-unbounded-growth property here)."""
 
-    def __init__(self):
+    SEEN_CAP = 8192
+
+    def __init__(self, seen_cap: int = SEEN_CAP):
+        from collections import deque
+
+        self.seen_cap = seen_cap
         self._subs: Dict[str, List[Tuple[str, Callable]]] = defaultdict(list)
         self._seen: Dict[str, set] = defaultdict(set)
+        self._seen_order: Dict[str, "deque"] = defaultdict(deque)
         self.log = get_logger("network/gossip")
         self.published = 0
         self.delivered = 0
         self.duplicates = 0
+
+    def _mark_seen(self, node_id: str, msg_id: bytes) -> None:
+        seen = self._seen[node_id]
+        if msg_id in seen:
+            return
+        seen.add(msg_id)
+        order = self._seen_order[node_id]
+        order.append(msg_id)
+        while len(order) > self.seen_cap:
+            seen.discard(order.popleft())
 
     def subscribe(self, node_id: str, topic: str, handler: Callable) -> None:
         self._subs[topic].append((node_id, handler))
@@ -129,7 +147,7 @@ class InMemoryGossipBus:
         self.published += 1
         # the publisher has seen its own message: a relayed copy must
         # not echo back (gossipsub inserts published ids into seenCache)
-        self._seen[from_node].add(msg_id)
+        self._mark_seen(from_node, msg_id)
         delivered = 0
         for node_id, handler in list(self._subs[topic]):
             if node_id == from_node:
@@ -137,7 +155,7 @@ class InMemoryGossipBus:
             if msg_id in self._seen[node_id]:
                 self.duplicates += 1
                 continue
-            self._seen[node_id].add(msg_id)
+            self._mark_seen(node_id, msg_id)
             try:
                 handler(topic, data)
                 delivered += 1

@@ -1,0 +1,150 @@
+"""Peer score book + status tracking.
+
+Reference: packages/beacon-node/src/network/peers/score/ (PeerRpcScore:
+bounded score with exponential decay, ban thresholds, per-action
+penalties) and peers/peerManager.ts (status handshake relevance:
+fork digest match + finalized checkpoint sanity).  The wire transport
+stays out of scope; the book is the reusable policy layer the sync and
+gossip drivers consult.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+# reference: score/constants.ts
+GOODBYE_BAN_SCORE = -50.0
+MIN_SCORE_BEFORE_DISCONNECT = -20.0
+MAX_SCORE = 100.0
+MIN_SCORE = -100.0
+SCORE_HALFLIFE_S = 600.0
+
+
+class PeerAction(str, enum.Enum):
+    """score/index.ts PeerAction -> penalty."""
+
+    fatal = "fatal"
+    low_tolerance = "low_tolerance"
+    mid_tolerance = "mid_tolerance"
+    high_tolerance = "high_tolerance"
+
+
+PEER_ACTION_SCORE = {
+    PeerAction.fatal: MIN_SCORE,
+    PeerAction.low_tolerance: -10.0,
+    PeerAction.mid_tolerance: -5.0,
+    PeerAction.high_tolerance: -1.0,
+}
+
+
+class ScoreState(str, enum.Enum):
+    healthy = "Healthy"
+    disconnected = "Disconnected"
+    banned = "Banned"
+
+
+@dataclass
+class PeerStatus:
+    """The status handshake (reference: reqresp Status payload)."""
+
+    fork_digest: bytes
+    finalized_root: bytes
+    finalized_epoch: int
+    head_root: bytes
+    head_slot: int
+
+
+@dataclass
+class _PeerRecord:
+    score: float = 0.0
+    last_update: float = field(default_factory=time.time)
+    status: Optional[PeerStatus] = None
+
+
+class PeerScoreBook:
+    def __init__(self, clock=time.time):
+        self._peers: Dict[str, _PeerRecord] = {}
+        self._clock = clock
+
+    def _record(self, peer_id: str) -> _PeerRecord:
+        rec = self._peers.get(peer_id)
+        if rec is None:
+            rec = _PeerRecord(last_update=self._clock())
+            self._peers[peer_id] = rec
+        return rec
+
+    def _decay(self, rec: _PeerRecord) -> None:
+        now = self._clock()
+        dt = now - rec.last_update
+        if dt > 0:
+            rec.score *= math.exp(-math.log(2) * dt / SCORE_HALFLIFE_S)
+            rec.last_update = now
+
+    def apply_action(self, peer_id: str, action: PeerAction) -> float:
+        rec = self._record(peer_id)
+        self._decay(rec)
+        rec.score = max(MIN_SCORE, min(MAX_SCORE, rec.score + PEER_ACTION_SCORE[action]))
+        return rec.score
+
+    def add(self, peer_id: str, delta: float) -> float:
+        rec = self._record(peer_id)
+        self._decay(rec)
+        rec.score = max(MIN_SCORE, min(MAX_SCORE, rec.score + delta))
+        return rec.score
+
+    def score(self, peer_id: str) -> float:
+        rec = self._record(peer_id)
+        self._decay(rec)
+        return rec.score
+
+    def state(self, peer_id: str) -> ScoreState:
+        s = self.score(peer_id)
+        if s <= GOODBYE_BAN_SCORE:
+            return ScoreState.banned
+        if s <= MIN_SCORE_BEFORE_DISCONNECT:
+            return ScoreState.disconnected
+        return ScoreState.healthy
+
+    # -- status handshake (peerManager.ts assertPeerRelevance) -------------
+
+    def on_status(self, peer_id: str, status: PeerStatus) -> None:
+        self._record(peer_id).status = status
+
+    def status_of(self, peer_id: str) -> Optional[PeerStatus]:
+        return self._peers.get(peer_id, _PeerRecord()).status
+
+    def is_relevant(
+        self,
+        status: PeerStatus,
+        our_fork_digest: bytes,
+        our_finalized_epoch: int,
+        root_at_epoch=None,
+    ) -> bool:
+        """assertPeerRelevance: matching fork digest; if the peer's
+        finalized epoch is at or behind ours, its finalized root must
+        match OUR canonical root at that epoch (`root_at_epoch(epoch)
+        -> Optional[bytes]`, e.g. a block_roots/archive lookup) — a
+        peer finalized on a different history is irrelevant."""
+        if status.fork_digest != our_fork_digest:
+            return False
+        if (
+            status.finalized_epoch <= our_finalized_epoch
+            and root_at_epoch is not None
+        ):
+            ours = root_at_epoch(status.finalized_epoch)
+            if ours is not None and status.finalized_root != ours:
+                return False
+        return True
+
+    def best_peers(self, min_state: ScoreState = ScoreState.healthy):
+        """Healthy peers, best score first (range-sync peer selection)."""
+        out = [
+            (pid, self.score(pid))
+            for pid in self._peers
+            if self.state(pid) == min_state
+        ]
+        return [pid for pid, _ in sorted(out, key=lambda t: -t[1])]

@@ -1,0 +1,153 @@
+"""Peer score book + the SSE events stream.
+
+Reference: network/peers/score (decayed bounded scores, ban states,
+relevance handshake) and routes/events.ts (head/block SSE topics).
+"""
+
+import threading
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.network.peers import (
+    PeerAction,
+    PeerScoreBook,
+    PeerStatus,
+    ScoreState,
+)
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.state_transition import create_genesis_state, process_slots
+from lodestar_tpu.state_transition.accessors import get_beacon_proposer_index
+
+P = params.ACTIVE_PRESET
+
+
+def test_peer_scores_decay_and_ban():
+    now = [1000.0]
+    book = PeerScoreBook(clock=lambda: now[0])
+    assert book.state("p1") == ScoreState.healthy
+
+    book.apply_action("p1", PeerAction.mid_tolerance)  # -5
+    book.apply_action("p1", PeerAction.mid_tolerance)
+    book.apply_action("p1", PeerAction.mid_tolerance)
+    book.apply_action("p1", PeerAction.mid_tolerance)
+    book.apply_action("p1", PeerAction.low_tolerance)  # -30 total
+    assert book.state("p1") == ScoreState.disconnected
+
+    book.apply_action("p2", PeerAction.fatal)
+    assert book.state("p2") == ScoreState.banned
+
+    # exponential half-life decay recovers the disconnected peer
+    now[0] += 600.0 * 4
+    assert book.state("p1") == ScoreState.healthy
+    # score is clamped
+    for _ in range(30):
+        book.add("p3", 10.0)
+    assert book.score("p3") == 100.0
+    assert book.best_peers()[0] == "p3"
+
+
+def test_peer_relevance():
+    book = PeerScoreBook()
+    ours = b"\x01\x02\x03\x04"
+    status = PeerStatus(
+        fork_digest=ours,
+        finalized_root=b"\xaa" * 32,
+        finalized_epoch=5,
+        head_root=b"\xbb" * 32,
+        head_slot=200,
+    )
+    book.on_status("p", status)
+    assert book.status_of("p") == status
+    assert book.is_relevant(status, ours, our_finalized_epoch=3)
+    # wrong network
+    assert not book.is_relevant(status, b"\xff" * 4, 3)
+    # peer finalized at/behind us on a DIFFERENT history -> irrelevant
+    assert not book.is_relevant(
+        status, ours, 7, root_at_epoch=lambda e: b"\xcc" * 32
+    )
+    assert book.is_relevant(
+        status, ours, 7, root_at_epoch=lambda e: b"\xaa" * 32
+    )
+    # unknown local root at that epoch: cannot judge, accept
+    assert book.is_relevant(status, ours, 7, root_at_epoch=lambda e: None)
+    # peer finalized AHEAD of us: no root check possible
+    assert book.is_relevant(
+        status, ours, 2, root_at_epoch=lambda e: b"\xcc" * 32
+    )
+
+
+def test_events_stream_over_http():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"evt-%d" % i) for i in range(16)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=4)
+    chain = BeaconChain(cfg, genesis)
+    server = BeaconApiServer(DefaultHandlers(chain=chain))
+    server.listen()
+    client = ApiClient([f"http://127.0.0.1:{server.port}"], timeout=30)
+
+    got = []
+    done = threading.Event()
+
+    def listen():
+        client.stream_events(
+            ["head", "block"],
+            lambda topic, data: got.append((topic, data)),
+            max_events=2,
+            timeout=20.0,
+        )
+        done.set()
+
+    t = threading.Thread(target=listen, daemon=True)
+    t.start()
+    # wait until the SSE handler's emitter subscriptions are attached
+    # (no fixed sleep: that races on a loaded machine)
+    import time
+
+    from lodestar_tpu.chain.emitter import ChainEvent
+
+    deadline = time.time() + 10
+    while time.time() < deadline and not (
+        chain.emitter._subs[ChainEvent.head]
+        and chain.emitter._subs[ChainEvent.block]
+    ):
+        time.sleep(0.05)
+    assert chain.emitter._subs[ChainEvent.head], "subscription never attached"
+
+    # propose + import one block -> block and head events fire
+    pre = genesis.clone()
+    process_slots(pre, 1)
+    proposer = get_beacon_proposer_index(pre)
+    reveal = B.sign_bytes(
+        sks[proposer],
+        cfg.compute_signing_root(
+            uint64.hash_tree_root(0), cfg.get_domain(1, params.DOMAIN_RANDAO)
+        ),
+    )
+    block = chain.produce_block(1, reveal)
+    root = cfg.compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block),
+        cfg.get_domain(1, params.DOMAIN_BEACON_PROPOSER, 1),
+    )
+    chain.process_block(
+        {"message": block, "signature": B.sign_bytes(sks[proposer], root)}
+    )
+
+    assert done.wait(timeout=25), "event stream did not complete"
+    topics = sorted(t_ for t_, _ in got)
+    assert topics == ["block", "head"]
+    for _topic, data in got:
+        assert data["block"].startswith("0x")
+        assert data["slot"] == "1"
+    server.close()
