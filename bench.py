@@ -25,21 +25,154 @@ midpoint 5.0e4 sets/s as the baseline denominator.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 BENCH_MODE=decoded runs the pre-decoded-planes benchmark instead.
+
+Failure modes are BOUNDED (round 3 lost its bench artifact to a silent
+9-minute hang on a dead TPU tunnel — BENCH_r03.json rc=1/parsed=null):
+  - a subprocess backend-init probe with a hard timeout runs FIRST; a
+    sick tunnel yields one JSON diagnosis line instead of a hang,
+  - a watchdog thread bounds the whole run (BENCH_DEADLINE, default 20
+    min) and emits a JSON diagnosis if anything blocks mid-run.
+BENCH_PLATFORM=cpu skips the probe and runs on the (slow, interpret-mode)
+CPU backend — debugging only.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 os.environ.setdefault("XLA_FLAGS", "")
+
+BENCH_INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
+BENCH_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", "1200"))
+
+
+def _metric_name() -> str:
+    if os.environ.get("BENCH_MODE", "wire") == "decoded":
+        return "bls_signature_sets_verified_per_s_decoded"
+    return "bls_signature_sets_verified_per_s"
+
+
+def _emit_failure(stage: str, detail: str) -> None:
+    """One machine-readable diagnosis line on stdout (the driver parses
+    stdout for the JSON record; a traceback alone parses to nothing)."""
+    print(
+        json.dumps(
+            {
+                "metric": _metric_name(),
+                "value": 0.0,
+                "unit": "sets/s",
+                "vs_baseline": 0.0,
+                "error": f"{stage}: {detail}"[-2000:],
+            }
+        ),
+        flush=True,
+    )
+
+
+def _probe_backend() -> None:
+    """Initialize the TPU backend in a THROWAWAY subprocess with a hard
+    timeout, so an unresponsive axon tunnel is diagnosed instead of
+    hanging this process (jax backend init is not interruptible once
+    started).  Exits the process with a JSON diagnosis on failure."""
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "import jax.numpy as jnp\n"
+        "assert int(jnp.arange(4).sum()) == 6\n"
+        "print('PROBE_OK', d[0].platform, len(d))\n"
+    )
+    try:
+        # Own process group: if backend init forks a helper that inherits
+        # the pipes, killing the group (not just the child) keeps the
+        # timeout airtight — otherwise run() blocks draining the pipes.
+        p = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+        )
+        out, err = p.communicate(timeout=BENCH_INIT_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        _emit_failure(
+            "backend-init-probe",
+            f"TPU backend init exceeded {BENCH_INIT_TIMEOUT_S:.0f}s "
+            "(axon tunnel unresponsive?)",
+        )
+        sys.exit(1)
+    ok_lines = [l for l in out.splitlines() if l.startswith("PROBE_OK")]
+    if p.returncode != 0 or not ok_lines:
+        _emit_failure(
+            "backend-init-probe",
+            (err or out).strip().splitlines()[-1]
+            if (err or out).strip()
+            else f"probe exited rc={p.returncode}",
+        )
+        sys.exit(1)
+    platform = ok_lines[-1].split()[1]
+    if platform == "cpu":
+        # A silent CPU fallback must not publish interpret-mode numbers
+        # as the TPU headline (BENCH_PLATFORM=cpu is the explicit opt-in).
+        _emit_failure(
+            "backend-init-probe",
+            "backend initialized but resolved to 'cpu' "
+            "(TPU plugin missing / silent fallback)",
+        )
+        sys.exit(1)
+    print(f"# probe: {ok_lines[-1]}", file=sys.stderr)
+
+
+_WATCHDOG_ARMED = False
+
+
+def _arm_watchdog() -> None:
+    """Bound the whole bench run: emit a JSON diagnosis and hard-exit if
+    anything (device sync, remote compile) blocks past the deadline."""
+    global _WATCHDOG_ARMED
+    if _WATCHDOG_ARMED:
+        return
+    _WATCHDOG_ARMED = True
+
+    def _fire():
+        _emit_failure(
+            "deadline",
+            f"bench exceeded {BENCH_DEADLINE_S:.0f}s "
+            "(device sync or remote compile blocked?)",
+        )
+        os._exit(1)
+
+    t = threading.Timer(BENCH_DEADLINE_S, _fire)
+    t.daemon = True
+    t.start()
+
+
+_BENCH_PLATFORM = os.environ.get("BENCH_PLATFORM", "tpu")
+if _BENCH_PLATFORM not in ("tpu", "cpu"):
+    _emit_failure("config", f"BENCH_PLATFORM={_BENCH_PLATFORM!r} not in {{tpu,cpu}}")
+    sys.exit(2)
+
+if __name__ == "__main__" and _BENCH_PLATFORM == "tpu":
+    _arm_watchdog()  # armed BEFORE the probe: the deadline covers it too
+    _probe_backend()
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+if _BENCH_PLATFORM == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -113,7 +246,7 @@ def main_wire():
     print(
         json.dumps(
             {
-                "metric": "bls_signature_sets_verified_per_s",
+                "metric": _metric_name(),
                 "value": round(sets_per_s, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
@@ -172,7 +305,7 @@ def main_decoded():
     print(
         json.dumps(
             {
-                "metric": "bls_signature_sets_verified_per_s_decoded",
+                "metric": _metric_name(),
                 "value": round(sets_per_s, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
@@ -182,6 +315,11 @@ def main_decoded():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE", "wire") == "decoded":
-        sys.exit(main_decoded())
-    sys.exit(main_wire())
+    _arm_watchdog()
+    try:
+        if os.environ.get("BENCH_MODE", "wire") == "decoded":
+            sys.exit(main_decoded())
+        sys.exit(main_wire())
+    except Exception as e:  # noqa: BLE001 — diagnosis line, then re-raise
+        _emit_failure("run", f"{type(e).__name__}: {e}")
+        raise
