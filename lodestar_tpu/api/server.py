@@ -179,7 +179,17 @@ class DefaultHandlers:
         from .encoding import from_json
 
         signed = from_json(SignedBeaconBlockAltair, body)
-        self.chain.process_block(signed)
+        # proposer boost: timely iff the block arrives before 1/3 slot
+        # (reference: forkChoice.ts onBlock blockDelaySec vs
+        # SECONDS_PER_SLOT / INTERVALS_PER_SLOT)
+        import time as _time
+
+        from .. import params as _p
+
+        slot = int(signed["message"]["slot"])
+        delay = _time.time() - (self.genesis_time + slot * _p.SECONDS_PER_SLOT)
+        timely = 0 <= delay < _p.SECONDS_PER_SLOT / 3
+        self.chain.process_block(signed, timely=timely)
         return 200, None
 
     def submit_attestations(self, params, body):
@@ -288,6 +298,9 @@ class DefaultHandlers:
         except Exception as e:
             return 400, {"message": f"invalid attester slashing: {e}"}
         self.chain.op_pool.insert_attester_slashing(slashing)
+        # equivocators lose their fork-choice influence immediately
+        # (reference: chain emitter attesterSlashing -> forkChoice)
+        self.chain.on_attester_slashing(slashing)
         return 200, None
 
     def submit_voluntary_exit(self, params, body):

@@ -109,7 +109,10 @@ class BeaconChain:
 
     # -- block import (reference importBlock.ts) ---------------------------
 
-    def process_block(self, signed_block: dict) -> bytes:
+    def process_block(self, signed_block: dict, timely: bool = False) -> bytes:
+        """Import one signed block.  `timely` marks a proposal that
+        arrived before 1/3 slot — it receives the proposer score boost
+        (reference: forkChoice.ts onBlock blockDelaySec gate)."""
         block = signed_block["message"]
         root = BeaconBlockAltair.hash_tree_root(block)
         if self.fork_choice.has_block(root.hex()):
@@ -145,6 +148,10 @@ class BeaconChain:
             justified_epoch=int(post.current_justified_checkpoint["epoch"]),
             finalized_epoch=int(post.finalized_checkpoint["epoch"]),
         )
+        # clock surrogate: a block at a later slot clears any stale boost
+        self.fork_choice.set_current_slot(int(block["slot"]))
+        if timely:
+            self.fork_choice.on_timely_block(root.hex(), int(block["slot"]))
         self.regen.on_imported_block(root, post)
         if self.db is not None:
             self.db.put_block(root, signed_block)
@@ -170,6 +177,11 @@ class BeaconChain:
             self.fork_choice.proto.finalized_epoch = fin
             self.regen.checkpoint_cache.prune_finalized(fin)
             self.op_pool.prune_all(post)
+            froot = post.finalized_checkpoint["root"].hex()
+            if self.fork_choice.has_block(froot):
+                # drop pre-finalized proto nodes (reference maybePrune;
+                # no-op below the prune threshold)
+                self.fork_choice.prune(froot)
             self.emitter.emit(
                 ChainEvent.finalized, dict(post.finalized_checkpoint)
             )
@@ -367,6 +379,14 @@ class BeaconChain:
         from ..state_transition.block import process_attester_slashing
 
         process_attester_slashing(self.head_state.clone(), slashing, True)
+
+    def on_attester_slashing(self, slashing: dict) -> None:
+        """Zero the equivocating validators' fork-choice influence
+        (reference: chain.ts emitter AttesterSlashing ->
+        forkChoice.onAttesterSlashing)."""
+        a1 = set(int(i) for i in slashing["attestation_1"]["attesting_indices"])
+        a2 = set(int(i) for i in slashing["attestation_2"]["attesting_indices"])
+        self.fork_choice.on_attester_slashing(sorted(a1 & a2))
 
     # -- gossip op ingress (reference chain.ts pool adders) ----------------
 

@@ -5,11 +5,17 @@ each validator whose latest message or effective balance changed,
 subtract the old balance at the old vote target and add the new balance
 at the new target.  Here the per-validator loop is numpy-vectorized
 (np.add.at scatter), matching the framework's batch-first shape.
+
+Equivocating (slashed) validators need no special case at this layer:
+ForkChoice removes them from the latest-message map, so their new vote
+index is already -1 and the unconditional old-balance subtraction backs
+their standing vote out exactly once (the reference's
+computeDeltas.ts:47-63 semantics, achieved structurally).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import List
 
 import numpy as np
 

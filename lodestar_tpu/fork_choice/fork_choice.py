@@ -1,21 +1,35 @@
 """ForkChoice facade: latest messages + head computation.
 
 Reference: packages/fork-choice/src/forkChoice/forkChoice.ts — tracks
-per-validator latest messages (epoch, block root), queues attestations
-from future slots, converts votes to proto-array score changes on
-update_head, and exposes the IForkChoice surface the chain/processor
-layers consume (hasBlock/getHead/onBlock/onAttestation).
+per-validator latest messages (epoch, block root), converts votes to
+proto-array score changes on update_head, and exposes the IForkChoice
+surface the chain/processor layers consume (hasBlock/getHead/onBlock/
+onAttestation).
+
+Hardening (round 4):
+  - proposer boost: `on_timely_block` records the current slot's timely
+    proposal; `update_head` applies the transient boost score =
+    (total_active_balance / SLOTS_PER_EPOCH) * PROPOSER_SCORE_BOOST%
+    (reference: forkChoice.ts:1188-1215 computeProposerBoostScore);
+    `on_tick_slot` clears it at the slot boundary.
+  - equivocation: `on_attester_slashing` zeroes the slashed validators'
+    votes permanently (reference: forkChoice.ts onAttesterSlashing ->
+    computeDeltas.ts:47-63).
+  - prune: `prune(finalized_root)` forwards to ProtoArray.maybe_prune.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
 from .compute_deltas import compute_deltas
-from .proto_array import ProtoArray
+from .proto_array import ProtoArray, ProtoNode
+
+SLOTS_PER_EPOCH = 32
+PROPOSER_SCORE_BOOST_PCT = 40  # config presets mainnet.ts:73
 
 
 @dataclass
@@ -30,16 +44,27 @@ class ForkChoice:
         proto_array: ProtoArray,
         justified_root: str,
         balances: Optional[np.ndarray] = None,
+        proposer_score_boost_pct: int = PROPOSER_SCORE_BOOST_PCT,
+        slots_per_epoch: int = SLOTS_PER_EPOCH,
     ):
         self.proto = proto_array
         self.justified_root = justified_root
         self.balances = (
             balances if balances is not None else np.zeros(0, np.int64)
         )
+        self.proposer_score_boost_pct = proposer_score_boost_pct
+        self.slots_per_epoch = slots_per_epoch
         self._latest: Dict[int, LatestMessage] = {}
         # vote state at the last update_head (for delta computation)
         self._applied_votes: Dict[int, str] = {}
         self._applied_balances = np.zeros_like(self.balances)
+        # all known equivocators: their future attestations are ignored;
+        # removal from _latest backs their standing vote out on the next
+        # update_head (no extra delta plumbing needed)
+        self._equivocating: set[int] = set()
+        # current slot's timely proposal (cleared every slot tick)
+        self.proposer_boost_root: Optional[str] = None
+        self._boost_slot: Optional[int] = None
 
     # -- block / attestation ingestion ------------------------------------
 
@@ -62,21 +87,59 @@ class ForkChoice:
             self.proto.finalized_epoch if finalized_epoch is None else finalized_epoch,
         )
 
+    def on_timely_block(self, root: str, slot: Optional[int] = None) -> None:
+        """Arm the proposer boost for a block arriving before 1/3 slot
+        (reference: forkChoice.ts onBlock's blockDelaySec gate)."""
+        self.proposer_boost_root = root
+        self._boost_slot = slot
+
+    def on_tick_slot(self) -> None:
+        """Slot boundary: the boost is strictly per-slot."""
+        self.proposer_boost_root = None
+        self._boost_slot = None
+
+    def set_current_slot(self, slot: int) -> None:
+        """Clock surrogate for clock-less compositions (BeaconChain):
+        any evidence that time moved past the boosted slot clears the
+        boost (reference: forkChoice.ts updateTime)."""
+        if self._boost_slot is not None and slot > self._boost_slot:
+            self.on_tick_slot()
+
     def on_attestation(self, validator_index: int, epoch: int, root: str) -> None:
-        """Track the validator's latest message (newest epoch wins)."""
+        """Track the validator's latest message (newest epoch wins).
+        Equivocating validators' messages are dead on arrival."""
+        if validator_index in self._equivocating:
+            return
         cur = self._latest.get(validator_index)
         if cur is None or epoch > cur.epoch:
             self._latest[validator_index] = LatestMessage(epoch, root)
+
+    def on_attester_slashing(self, indices: Iterable[int]) -> None:
+        """Zero the slashed validators' fork-choice influence, once and
+        permanently (reference: computeDeltas.ts:47-63).  Dropping the
+        validator from the latest-message map makes the next
+        compute_deltas back out its standing vote (new index -1), and
+        the on_attestation guard keeps it out forever."""
+        for v in indices:
+            self._equivocating.add(v)
+            self._latest.pop(v, None)
 
     def set_balances(self, balances: np.ndarray) -> None:
         self.balances = np.asarray(balances, np.int64)
 
     # -- head (reference: forkChoice.updateHead) ---------------------------
 
+    def _proposer_boost_score(self) -> int:
+        """Committee-weight approximation of one slot's attesters
+        (reference: forkChoice.ts computeProposerBoostScore)."""
+        total = int(self.balances.sum())
+        return (total // self.slots_per_epoch) * self.proposer_score_boost_pct // 100
+
     def update_head(self) -> str:
         n_val = max(
             len(self.balances),
             (max(self._latest) + 1) if self._latest else 0,
+            (max(self._applied_votes) + 1) if self._applied_votes else 0,
             len(self._applied_balances),
         )
         old_votes = np.full(n_val, -1, np.int64)
@@ -97,9 +160,31 @@ class ForkChoice:
         deltas = compute_deltas(
             len(self.proto), old_votes, new_votes, old_bal, new_bal
         )
+        boost = None
+        if (
+            self.proposer_boost_root is not None
+            and self.proposer_boost_root in self.proto
+        ):
+            boost = (self.proposer_boost_root, self._proposer_boost_score())
         self.proto.apply_score_changes(
-            deltas, self.proto.justified_epoch, self.proto.finalized_epoch
+            deltas,
+            self.proto.justified_epoch,
+            self.proto.finalized_epoch,
+            proposer_boost=boost,
         )
         self._applied_votes = {v: m.root for v, m in self._latest.items()}
         self._applied_balances = new_bal
         return self.proto.find_head(self.justified_root)
+
+    # -- prune (reference: forkChoice.prune) -------------------------------
+
+    def prune(self, finalized_root: str) -> List[ProtoNode]:
+        removed = self.proto.maybe_prune(finalized_root)
+        if removed:
+            # standing votes for pruned roots resolve to "not in indices"
+            # next update (outside the tree == pre-finalization, ignored)
+            gone = {n.root for n in removed}
+            self._applied_votes = {
+                v: r for v, r in self._applied_votes.items() if r not in gone
+            }
+        return removed

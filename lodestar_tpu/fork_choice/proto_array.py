@@ -3,14 +3,22 @@
 Reference: packages/fork-choice/src/protoArray/protoArray.ts.  Nodes are
 stored in insertion order (parents before children), so score/weight
 propagation is two linear passes: deltas apply backwards (child -> parent
-accumulation) and best-child/best-descendant links update in the same
-backward sweep; head lookup is O(1) through the cached best-descendant.
+accumulation), then best-child/best-descendant links update in a second
+backward sweep over fully-coherent weights; head lookup is O(1) through
+the cached best-descendant.
+
+Hardening (reference parity, round 4):
+  - proposer boost: a transient score added to the current slot's timely
+    block and removed on the next score application
+    (protoArray.ts:137-150 currentBoost/previousBoost accounting);
+  - prune below finalized: drops pre-finalized nodes and remaps indices
+    (protoArray.ts:525-600 maybePrune).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -29,6 +37,11 @@ class ProtoArrayError(Exception):
     pass
 
 
+# Pruning at small offsets costs more than it saves
+# (reference: protoArray.ts DEFAULT_PRUNE_THRESHOLD = 256).
+DEFAULT_PRUNE_THRESHOLD = 256
+
+
 class ProtoArray:
     def __init__(
         self,
@@ -36,11 +49,16 @@ class ProtoArray:
         finalized_slot: int = 0,
         justified_epoch: int = 0,
         finalized_epoch: int = 0,
+        prune_threshold: int = DEFAULT_PRUNE_THRESHOLD,
     ):
         self.nodes: List[ProtoNode] = []
         self.indices: Dict[str, int] = {}
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        self.prune_threshold = prune_threshold
+        # (root, score) applied last round, to be backed out next round
+        # (reference: protoArray.ts previousProposerBoost)
+        self.previous_proposer_boost: Optional[Tuple[str, int]] = None
         self.on_block(
             finalized_slot, finalized_root, None, justified_epoch, finalized_epoch
         )
@@ -82,25 +100,43 @@ class ProtoArray:
         deltas: List[int],
         justified_epoch: int,
         finalized_epoch: int,
+        proposer_boost: Optional[Tuple[str, int]] = None,
     ) -> None:
         """Apply per-node weight deltas and refresh all links.
 
-        `deltas` is indexed like `nodes` (computeDeltas output).  One
-        backward sweep both accumulates child deltas into parents and
-        re-evaluates best-child links (children precede their updates).
+        `deltas` is indexed like `nodes` (computeDeltas output).
+        `proposer_boost` is (root, score) for the current slot's timely
+        block; last round's boost is automatically backed out — the boost
+        is transient, living exactly one score application.
+
+        Two backward sweeps, like the reference: weights must be fully
+        coherent before any best-child comparison, otherwise an
+        equal-weight tiebreak can settle on a sibling whose delta had not
+        landed yet (protoArray.ts:121-186).
         """
         if len(deltas) != len(self.nodes):
             raise ProtoArrayError("invalid deltas length")
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        boost_root, boost_score = proposer_boost or (None, 0)
+        prev_root, prev_score = self.previous_proposer_boost or (None, 0)
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
-            node.weight += deltas[i]
+            d = deltas[i]
+            if node.root == boost_root:
+                d += boost_score
+            if node.root == prev_root:
+                d -= prev_score
+            node.weight += d
             if node.weight < 0:
                 raise ProtoArrayError(f"negative weight at {node.root}")
             if node.parent is not None:
-                deltas[node.parent] += deltas[i]
+                deltas[node.parent] += d
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
                 self._maybe_update_best_child(node.parent, i)
+        self.previous_proposer_boost = proposer_boost
 
     # -- head (reference: protoArray.ts findHead) --------------------------
 
@@ -114,6 +150,37 @@ class ProtoArray:
         if not self._node_is_viable_for_head(head):
             raise ProtoArrayError("head is not viable")
         return head.root
+
+    # -- prune (reference: protoArray.ts maybePrune) -----------------------
+
+    def maybe_prune(self, finalized_root: str) -> List[ProtoNode]:
+        """Drop all nodes before the finalized one; remap indices.
+
+        Returns the removed nodes (the archiver migrates their data).
+        No-op below `prune_threshold` — pruning tiny prefixes costs more
+        than it saves.
+        """
+        fin = self.indices.get(finalized_root)
+        if fin is None:
+            raise ProtoArrayError(f"unknown finalized root {finalized_root}")
+        if fin < self.prune_threshold:
+            return []
+        removed = self.nodes[:fin]
+        for node in removed:
+            del self.indices[node.root]
+        self.nodes = self.nodes[fin:]
+        for root in self.indices:
+            self.indices[root] -= fin
+        for node in self.nodes:
+            if node.parent is not None:
+                node.parent = node.parent - fin if node.parent >= fin else None
+            for attr in ("best_child", "best_descendant"):
+                v = getattr(node, attr)
+                if v is not None:
+                    if v < fin:
+                        raise ProtoArrayError(f"{attr} points below finalized")
+                    setattr(node, attr, v - fin)
+        return removed
 
     # -- internals ---------------------------------------------------------
 
@@ -155,9 +222,8 @@ class ProtoArray:
         if not best_viable:
             self._change_best_child(parent_idx, child_idx)
             return
-        # ties break toward the LOWER root-hash order? The reference
-        # breaks ties by preferring the existing best unless strictly
-        # greater weight (with root-order tiebreak on equal weight).
+        # Prefer the existing best unless strictly greater weight, with a
+        # root-order tiebreak on exact equality (reference semantics).
         if child.weight > best.weight or (
             child.weight == best.weight and child.root > best.root
         ):

@@ -74,6 +74,9 @@ class BeaconNode:
             has_block_root=self.fork_choice.has_block,
         )
         self.clock.on_slot(self.processor.on_clock_slot)
+        # proposer boost is strictly per-slot (reference: forkChoice.ts
+        # onBlock/updateTime)
+        self.clock.on_slot(lambda _slot: self.fork_choice.on_tick_slot())
 
         self.api: Optional[BeaconApiServer] = None
         if opts.serve_api:

@@ -101,3 +101,122 @@ def test_weights_accumulate_to_ancestors():
     assert pa.nodes[pa.indices["a"]].weight == 10
     assert pa.nodes[pa.indices["genesis"]].weight == 10
     assert pa.find_head("genesis") == "c"
+
+
+# -- round-4 hardening: proposer boost, equivocation, prune ----------------
+
+
+def test_proposer_boost_tips_balanced_fork():
+    """Balancing attack: two equal-weight forks; the timely proposal on
+    the lighter side wins via the transient boost, then loses it
+    (reference: protoArray.ts currentBoost/previousBoost)."""
+    pa = make_chain()
+    fc = ForkChoice(
+        pa, "genesis", np.array([32, 32], np.int64), slots_per_epoch=1
+    )
+    fc.on_attestation(0, 1, "b")
+    fc.on_attestation(1, 1, "c")
+    # equal vote weight: tiebreak (root order) picks c
+    assert fc.update_head() == "c"
+    # a timely proposal builds on b: boost (40% of 64) tips the fork
+    fc.on_timely_block("b")
+    assert fc.update_head() == "b"
+    # next slot: boost cleared, applied boost backed out -> c again
+    fc.on_tick_slot()
+    assert fc.update_head() == "c"
+
+
+def test_proposer_boost_is_transient():
+    """The boost never persists in node weights."""
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([10], np.int64), slots_per_epoch=1)
+    fc.on_attestation(0, 1, "c")
+    fc.on_timely_block("b")
+    fc.update_head()
+    boosted = pa.nodes[pa.indices["b"]].weight
+    assert boosted > 0
+    fc.on_tick_slot()
+    fc.update_head()
+    assert pa.nodes[pa.indices["b"]].weight == 0
+
+
+def test_equivocating_validator_removed_permanently():
+    """A slashed validator's standing vote is backed out once and its
+    later messages are ignored (reference: computeDeltas.ts:47-63)."""
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([10, 1], np.int64))
+    fc.on_attestation(0, 1, "b")
+    fc.on_attestation(1, 1, "c")
+    assert fc.update_head() == "b"
+    fc.on_attester_slashing([0])
+    assert fc.update_head() == "c"
+    assert pa.nodes[pa.indices["b"]].weight == 0
+    # the equivocator's new vote is dead on arrival
+    fc.on_attestation(0, 9, "b")
+    assert fc.update_head() == "c"
+    # double-slash is a no-op (process once)
+    fc.on_attester_slashing([0])
+    assert fc.update_head() == "c"
+
+
+def test_equivocation_balancing_attack():
+    """An attacker flip-flopping between forks cannot keep both heavy
+    once slashed: all its weight vanishes."""
+    pa = make_chain()
+    fc = ForkChoice(pa, "genesis", np.array([100, 1, 1], np.int64))
+    fc.on_attestation(1, 1, "b")
+    fc.on_attestation(2, 1, "c")
+    fc.on_attestation(0, 1, "b")
+    assert fc.update_head() == "b"
+    fc.on_attestation(0, 2, "c")  # flip
+    assert fc.update_head() == "c"
+    fc.on_attester_slashing([0])
+    # honest weights only: 1 vs 1, tiebreak -> c; attacker gone from both
+    fc.update_head()
+    assert pa.nodes[pa.indices["b"]].weight == 1
+    assert pa.nodes[pa.indices["c"]].weight == 1
+
+
+def test_prune_below_finalized():
+    pa = ProtoArray("genesis", prune_threshold=0)
+    for i in range(1, 10):
+        pa.on_block(i, f"n{i}", "genesis" if i == 1 else f"n{i-1}", 0, 0)
+    pa.on_block(10, "tip_a", "n9", 0, 0)
+    pa.on_block(10, "tip_b", "n9", 0, 0)
+    fc = ForkChoice(pa, "genesis", np.array([3, 2], np.int64))
+    fc.on_attestation(0, 1, "tip_a")
+    fc.on_attestation(1, 1, "tip_b")
+    assert fc.update_head() == "tip_a"
+    removed = fc.prune("n5")
+    assert [n.root for n in removed] == ["genesis"] + [f"n{i}" for i in range(1, 5)]
+    assert "genesis" not in pa
+    assert pa.nodes[0].root == "n5" and pa.nodes[0].parent is None
+    # votes still tracked; head from the new anchor still works
+    fc.justified_root = "n5"
+    assert fc.update_head() == "tip_a"
+    # vote movement after prune applies deltas at remapped indices
+    fc.on_attestation(1, 2, "tip_a")
+    assert fc.update_head() == "tip_a"
+    assert pa.nodes[pa.indices["tip_b"]].weight == 0
+
+
+def test_prune_threshold_noop():
+    pa = ProtoArray("genesis")  # default threshold 256
+    pa.on_block(1, "a", "genesis", 0, 0)
+    assert pa.maybe_prune("a") == []
+    assert "genesis" in pa
+
+
+def test_prune_drops_votes_for_pruned_roots():
+    pa = ProtoArray("genesis", prune_threshold=0)
+    pa.on_block(1, "a", "genesis", 0, 0)
+    pa.on_block(2, "b", "a", 0, 0)
+    fc = ForkChoice(pa, "genesis", np.array([5], np.int64))
+    fc.on_attestation(0, 1, "a")
+    fc.update_head()
+    fc.prune("b")
+    fc.justified_root = "b"
+    # the old vote's root is gone; no negative-weight explosion
+    fc.on_attestation(0, 2, "b")
+    assert fc.update_head() == "b"
+    assert pa.nodes[pa.indices["b"]].weight == 5
