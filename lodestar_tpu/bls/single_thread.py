@@ -1,0 +1,92 @@
+"""CpuBlsVerifier — the single-thread CPU fallback verifier.
+
+Mirror of the reference's BlsSingleThreadVerifier (reference:
+packages/beacon-node/src/chain/bls/singleThread.ts): verifies every set
+synchronously on the host with the ground-truth crypto oracle — the
+latency fast path for proposer signatures and the fallback when no TPU
+is attached (the reference's herumi/main-thread role).  Implements the
+same IBlsVerifier surface as TpuBlsVerifier so chain/node compositions
+swap freely (reference: chain.ts:196-198 verifier selection).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..utils.metrics import BlsPoolMetrics
+from .signature_set import SignatureSet, WireSignatureSet
+
+
+class CpuBlsVerifier:
+    """Host-CPU IBlsVerifier over a pubkey registry.
+
+    `pubkeys` maps validator index -> affine G1 point (the same
+    ground-truth points a PubkeyTable holds); `table` may be passed
+    instead to share the node's registry.
+    """
+
+    def __init__(
+        self,
+        pubkeys: Optional[Sequence] = None,
+        table=None,
+        metrics: Optional[BlsPoolMetrics] = None,
+    ):
+        self._pubkeys = pubkeys
+        self._table = table
+        self.metrics = metrics or BlsPoolMetrics()
+        self.max_job_sets = 128
+
+    def _pubkey(self, index: int):
+        if self._pubkeys is not None:
+            return self._pubkeys[index]
+        return self._table.host_affine(index)
+
+    def can_accept_work(self) -> bool:
+        return True
+
+    def verify_signature_sets(self, sets, opts=None) -> bool:
+        verdicts = [self._verify_one(s) for s in sets]
+        good = sum(verdicts)
+        self.metrics.success_jobs.inc(good)
+        self.metrics.invalid_sets.inc(len(sets) - good)
+        return all(verdicts)
+
+    def verify_signature_sets_individually(self, sets) -> List[bool]:
+        return [self._verify_one(s) for s in sets]
+
+    def _verify_one(self, s) -> bool:
+        from ..crypto import bls as CB
+        from ..crypto import curves as C
+        from ..crypto import pairing as CP
+
+        dec: SignatureSet = s.decode() if isinstance(s, WireSignatureSet) else s
+        if dec.signature is None:
+            return False
+        if not C.is_on_curve(C.FP2_OPS, dec.signature):
+            return False
+        if not C.g2_subgroup_check(dec.signature):
+            return False
+        if dec.external_pubkeys is not None:
+            keys = []
+            for pk in dec.external_pubkeys:
+                if (
+                    pk is None
+                    or not C.is_on_curve(C.FP_OPS, pk)
+                    or not C.g1_subgroup_check(pk)
+                ):
+                    return False
+                keys.append(pk)
+        else:
+            try:
+                keys = [self._pubkey(i) for i in dec.indices]
+            except (IndexError, KeyError):
+                return False
+        agg = C.multi_add(C.FP_OPS, keys)
+        if agg is None:  # aggregate pubkey at infinity never verifies
+            return False
+        return CP.multi_pairing_is_one(
+            [(agg, dec.message), (CB.NEG_G1_GEN, dec.signature)]
+        )
+
+    def close(self) -> None:
+        pass

@@ -43,6 +43,66 @@ class SeenAttesters:
 SeenAggregators = SeenAttesters  # same structure, keyed per (epoch, aggregator)
 
 
+class SeenBlockProposers:
+    """(slot, proposer) dedup — a proposer publishes once per slot
+    (reference: seenCache/seenBlockProposers.ts)."""
+
+    def __init__(self, max_slots: int = 64):
+        self.max_slots = max_slots
+        self._by_slot: Dict[int, set] = {}
+
+    def is_known(self, slot: int, proposer: int) -> bool:
+        return proposer in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, proposer: int) -> None:
+        self._by_slot.setdefault(slot, set()).add(proposer)
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s < current_slot - self.max_slots:
+                del self._by_slot[s]
+
+
+class SeenSyncCommitteeMessages:
+    """(slot, subnet, validator) dedup — one message per member per slot
+    per subnet (reference: seenCache/seenCommittee.ts)."""
+
+    def __init__(self, max_slots: int = 3):
+        self.max_slots = max_slots
+        self._by_slot: Dict[int, set] = {}
+
+    def is_known(self, slot: int, subnet: int, index: int) -> bool:
+        return (subnet, index) in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, subnet: int, index: int) -> None:
+        self._by_slot.setdefault(slot, set()).add((subnet, index))
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s < current_slot - self.max_slots:
+                del self._by_slot[s]
+
+
+class SeenContributionAndProof:
+    """(slot, subnet, aggregator) dedup for sync contributions
+    (reference: seenCache/seenCommitteeContribution.ts)."""
+
+    def __init__(self, max_slots: int = 3):
+        self.max_slots = max_slots
+        self._by_slot: Dict[int, set] = {}
+
+    def is_known(self, slot: int, subnet: int, aggregator: int) -> bool:
+        return (subnet, aggregator) in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, subnet: int, aggregator: int) -> None:
+        self._by_slot.setdefault(slot, set()).add((subnet, aggregator))
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s < current_slot - self.max_slots:
+                del self._by_slot[s]
+
+
 class SeenAttestationDatas(Generic[V]):
     """Per-slot LRU-ish cache: serialized AttestationData -> derived V.
 

@@ -25,10 +25,11 @@ from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
+from .. import params
 from .compute_deltas import compute_deltas
 from .proto_array import ProtoArray, ProtoNode
 
-SLOTS_PER_EPOCH = 32
+SLOTS_PER_EPOCH = params.SLOTS_PER_EPOCH  # preset-aware
 PROPOSER_SCORE_BOOST_PCT = 40  # config presets mainnet.ts:73
 
 
@@ -89,7 +90,13 @@ class ForkChoice:
 
     def on_timely_block(self, root: str, slot: Optional[int] = None) -> None:
         """Arm the proposer boost for a block arriving before 1/3 slot
-        (reference: forkChoice.ts onBlock's blockDelaySec gate)."""
+        (reference: forkChoice.ts onBlock's blockDelaySec gate).
+
+        First block wins: the spec only boosts when no boost is armed
+        (`if store.proposer_boost_root == Root()`), so an equivocating
+        proposer cannot move the boost to its second block."""
+        if self.proposer_boost_root is not None:
+            return
         self.proposer_boost_root = root
         self._boost_slot = slot
 

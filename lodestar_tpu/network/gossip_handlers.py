@@ -1,0 +1,175 @@
+"""Per-topic gossip handlers: bytes -> SSZ -> validator -> side effects.
+
+Mirror of the reference's gossipHandlers.ts (reference:
+packages/beacon-node/src/network/processor/gossipHandlers.ts): each
+topic maps to an SSZ type, a validator from chain/validation, and the
+ACCEPT-side effects (which the validators already apply — pool inserts,
+fork-choice updates).  Handlers return the GossipAction verdict so the
+bus/peer layer can score the sender (gossipsub REJECT/IGNORE).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from .. import types as T
+from ..chain.seen_cache import SeenBlockProposers
+from ..chain.validation import (
+    GossipAction,
+    GossipValidationError,
+    GossipValidators,
+)
+from ..utils.logger import get_logger
+from .gossip import (
+    GossipTopicName,
+    InMemoryGossipBus,
+    decode_message,
+    parse_topic,
+    topic_string,
+)
+
+
+class GossipHandlers:
+    """Binds a chain's validators to the gossip bus.
+
+    `results` counts verdicts per topic for tests/metrics; invalid
+    payload bytes (bad snappy / bad SSZ) are REJECTs, like the
+    reference's message deserialization errors.
+    """
+
+    def __init__(self, chain, verifier, current_slot_fn=None):
+        self.chain = chain
+        self.validators = GossipValidators(
+            chain, verifier, current_slot_fn=current_slot_fn
+        )
+        self.log = get_logger("network/gossip_handlers")
+        self.seen_block_proposers = SeenBlockProposers()
+        self.results: Dict[str, Dict[str, int]] = {}
+
+    def _block_is_timely(self, slot: int) -> bool:
+        """Measured arrival delay < 1/3 slot (reference: forkChoice.ts
+        onBlock blockDelaySec) — never a static flag, or a withheld
+        block could claim the proposer boost."""
+        import time as _time
+
+        from .. import params as _p
+
+        genesis_time = getattr(self.chain.config, "genesis_time", None)
+        if not genesis_time:
+            return False
+        delay = _time.time() - (genesis_time + slot * _p.SECONDS_PER_SLOT)
+        return 0 <= delay < _p.SECONDS_PER_SLOT / 3
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, topic: str, data: bytes) -> GossipAction | None:
+        """Returns None on ACCEPT, else the failure action."""
+        _digest, name = parse_topic(topic)
+        try:
+            payload = decode_message(data)
+            action = self._dispatch(name, payload)
+        except GossipValidationError as e:
+            self._count(name, e.action.value)
+            self.log.debug("gossip rejected", topic=name, reason=e.reason)
+            return e.action
+        except Exception as e:  # undecodable payload or import failure
+            self._count(name, "reject")
+            self.log.debug("gossip undecodable", topic=name, error=str(e))
+            return GossipAction.REJECT
+        self._count(name, "accept")
+        return action
+
+    def _count(self, name: str, verdict: str) -> None:
+        self.results.setdefault(name, {}).setdefault(verdict, 0)
+        self.results[name][verdict] += 1
+
+    def _dispatch(self, name: str, payload: bytes) -> None:
+        v = self.validators
+        if name == "beacon_block":
+            signed = T.SignedBeaconBlockAltair.deserialize(payload)
+            slot = int(signed["message"]["slot"])
+            proposer = int(signed["message"]["proposer_index"])
+            # one block per proposer per slot at the gossip layer
+            # (reference: validation/block.ts seenBlockProposers check)
+            if self.seen_block_proposers.is_known(slot, proposer):
+                raise GossipValidationError(
+                    GossipAction.IGNORE, "proposer already seen this slot"
+                )
+            self.chain.process_block(
+                signed, timely=self._block_is_timely(slot)
+            )
+            self.seen_block_proposers.add(slot, proposer)
+            return None
+        if name == "beacon_aggregate_and_proof":
+            v.validate_aggregate_and_proof(
+                T.SignedAggregateAndProof.deserialize(payload)
+            )
+            return None
+        if name.startswith("beacon_attestation_"):
+            v.validate_attestation(T.Attestation.deserialize(payload))
+            return None
+        if name == "voluntary_exit":
+            v.validate_voluntary_exit_gossip(
+                T.SignedVoluntaryExit.deserialize(payload)
+            )
+            return None
+        if name == "proposer_slashing":
+            v.validate_proposer_slashing_gossip(
+                T.ProposerSlashing.deserialize(payload)
+            )
+            return None
+        if name == "attester_slashing":
+            v.validate_attester_slashing_gossip(
+                T.AttesterSlashing.deserialize(payload)
+            )
+            return None
+        if name == "sync_committee_contribution_and_proof":
+            v.validate_contribution_and_proof(
+                T.SignedContributionAndProof.deserialize(payload)
+            )
+            return None
+        if name.startswith("sync_committee_"):
+            subnet = int(name.rsplit("_", 1)[1])
+            v.validate_sync_committee_message(
+                T.SyncCommitteeMessage.deserialize(payload), subnet
+            )
+            return None
+        raise GossipValidationError(
+            GossipAction.REJECT, f"no handler for topic {name}"
+        )
+
+    # -- subscriptions (reference: network.ts subscribeGossipCoreTopics) ---
+
+    def subscribe_all(
+        self,
+        bus: InMemoryGossipBus,
+        node_id: str,
+        fork_digest: bytes,
+        attnets: Tuple[int, ...] = (0,),
+        syncnets: Tuple[int, ...] = (0,),
+    ) -> None:
+        topics = [
+            topic_string(fork_digest, GossipTopicName.beacon_block),
+            topic_string(
+                fork_digest, GossipTopicName.beacon_aggregate_and_proof
+            ),
+            topic_string(fork_digest, GossipTopicName.voluntary_exit),
+            topic_string(fork_digest, GossipTopicName.proposer_slashing),
+            topic_string(fork_digest, GossipTopicName.attester_slashing),
+            topic_string(
+                fork_digest,
+                GossipTopicName.sync_committee_contribution_and_proof,
+            ),
+        ]
+        topics += [
+            topic_string(
+                fork_digest, GossipTopicName.beacon_attestation, subnet=s
+            )
+            for s in attnets
+        ]
+        topics += [
+            topic_string(fork_digest, GossipTopicName.sync_committee, subnet=s)
+            for s in syncnets
+        ]
+        for t in topics:
+            bus.subscribe(node_id, t, self.handle)

@@ -300,6 +300,85 @@ def get_aggregate_and_proof_signature_set(
     )
 
 
+# -- sync-committee gossip objects (reference: chain/validation/
+# syncCommittee.ts, syncCommitteeContributionAndProof.ts) -------------------
+
+
+def get_sync_committee_message_signature_set(
+    state: BeaconStateView, message: dict
+) -> WireSignatureSet:
+    """A SyncCommitteeMessage signs the beacon block root with
+    DOMAIN_SYNC_COMMITTEE at the message slot."""
+    root = _signing_root(
+        state.config,
+        state.slot,
+        params.DOMAIN_SYNC_COMMITTEE,
+        message["slot"],
+        T.Root.hash_tree_root(message["beacon_block_root"]),
+    )
+    return WireSignatureSet.single(
+        message["validator_index"], root, message["signature"]
+    )
+
+
+def get_sync_committee_selection_proof_signature_set(
+    state: BeaconStateView, contribution_and_proof: dict
+) -> WireSignatureSet:
+    """Selection proof over SyncAggregatorSelectionData{slot, subnet}."""
+    contribution = contribution_and_proof["contribution"]
+    data = {
+        "slot": contribution["slot"],
+        "subcommittee_index": contribution["subcommittee_index"],
+    }
+    root = _signing_root(
+        state.config,
+        state.slot,
+        params.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        contribution["slot"],
+        T.SyncAggregatorSelectionData.hash_tree_root(data),
+    )
+    return WireSignatureSet.single(
+        contribution_and_proof["aggregator_index"],
+        root,
+        contribution_and_proof["selection_proof"],
+    )
+
+
+def get_contribution_and_proof_signature_set(
+    state: BeaconStateView, signed: dict
+) -> WireSignatureSet:
+    """The aggregator's signature over the ContributionAndProof."""
+    msg = signed["message"]
+    root = _signing_root(
+        state.config,
+        state.slot,
+        params.DOMAIN_CONTRIBUTION_AND_PROOF,
+        msg["contribution"]["slot"],
+        T.ContributionAndProof.hash_tree_root(msg),
+    )
+    return WireSignatureSet.single(
+        msg["aggregator_index"], root, signed["signature"]
+    )
+
+
+def get_contribution_signature_set(
+    state: BeaconStateView,
+    contribution: dict,
+    participant_indices,
+) -> WireSignatureSet:
+    """The contribution's aggregate over the subcommittee participants."""
+    root = _signing_root(
+        state.config,
+        state.slot,
+        params.DOMAIN_SYNC_COMMITTEE,
+        contribution["slot"],
+        T.Root.hash_tree_root(contribution["beacon_block_root"]),
+    )
+    return WireSignatureSet.aggregate(
+        participant_indices, root, contribution["signature"]
+    )
+
+
 # -- the block-level aggregator (reference: signatureSets/index.ts:26-73) ---
 
 

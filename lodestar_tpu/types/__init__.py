@@ -282,6 +282,14 @@ SyncCommitteeContribution = Container(
     name="SyncCommitteeContribution",
 )
 
+SyncAggregatorSelectionData = Container(
+    (
+        ("slot", Slot),
+        ("subcommittee_index", uint64),
+    ),
+    name="SyncAggregatorSelectionData",
+)
+
 ContributionAndProof = Container(
     (
         ("aggregator_index", ValidatorIndex),
