@@ -1,0 +1,551 @@
+"""Generate the spec-test fixture set under tests/fixtures/.
+
+PROVENANCE (read tests/fixtures/README.md): this sealed build
+environment has no network egress and no independent BLS/consensus
+implementation (no py_ecc, no eth2spec), so these vectors are generated
+from THIS repo's ground-truth CPU oracle (lodestar_tpu/crypto/*) and
+columnar state-transition — the same shapes and directory format as
+ethereum/bls12-381-tests v0.1.1 and ethereum/consensus-spec-tests
+v1.3.0 (reference: packages/beacon-node/test/spec/
+specTestVersioning.ts:17-31), so upstream archives drop in unchanged.
+
+What the fixtures DO guarantee: byte-exact regression sealing of the
+oracle + STF (any refactor that changes a signature byte, a state root,
+or a serialization fails the spec tier), and cross-ENGINE agreement
+(the pallas and einsum paths are tested against the same oracle
+elsewhere).  What they CANNOT guarantee without upstream files:
+cross-IMPLEMENTATION agreement.  The oracle's own correctness is
+carried by the always-on algebraic invariant tier
+(tests/test_hash_to_curve.py, tests/test_crypto_ref.py: curve/subgroup/
+pairing-bilinearity identities that any wrong constant breaks).
+
+Usage: python dev/gen_spec_fixtures.py [--out tests/fixtures]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.network.snappy import frame_compress
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_committee,
+    get_block_root_at_slot,
+)
+from lodestar_tpu.state_transition.slot import process_slots
+
+P = params.ACTIVE_PRESET
+N_VAL = 32
+
+CFG = dataclasses.replace(
+    create_chain_config(MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}),
+    SHARD_COMMITTEE_PERIOD=0,  # recorded in meta.json; runner must match
+)
+
+
+def hx(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def write_json(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+
+
+def write_ssz(case_dir: str, name: str, data: bytes) -> None:
+    os.makedirs(case_dir, exist_ok=True)
+    with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
+        f.write(frame_compress(data))
+
+
+# -- bls (ethereum/bls12-381-tests format) ----------------------------------
+
+
+def gen_bls(out: str) -> None:
+    sks = [B.keygen(b"spec-bls-%d" % i) for i in range(8)]
+    pks = [B.sk_to_pk(sk) for sk in sks]
+    msgs = [bytes([i]) * 32 for i in range(4)]
+
+    # sign: {input: {privkey, message}, output: signature}
+    for i, (sk, msg) in enumerate(zip(sks[:4], msgs)):
+        sig = B.sign(sk, msg)
+        write_json(
+            os.path.join(out, "bls", "sign", f"sign_case_{i}.json"),
+            {
+                "input": {
+                    "privkey": "0x" + sk.to_bytes(32, "big").hex(),
+                    "message": hx(msg),
+                },
+                "output": hx(C.g2_compress(sig)),
+            },
+        )
+
+    # verify: valid / tampered-message / wrong-pubkey / infinity cases
+    cases = []
+    for i in range(3):
+        sig = C.g2_compress(B.sign(sks[i], msgs[i]))
+        cases.append((C.g1_compress(pks[i]), msgs[i], sig, True))
+        cases.append((C.g1_compress(pks[i]), msgs[(i + 1) % 4], sig, False))
+        cases.append((C.g1_compress(pks[i + 1]), msgs[i], sig, False))
+    inf_pk = b"\xc0" + b"\x00" * 47
+    inf_sig = b"\xc0" + b"\x00" * 95
+    cases.append((inf_pk, msgs[0], C.g2_compress(B.sign(sks[0], msgs[0])), False))
+    cases.append((C.g1_compress(pks[0]), msgs[0], inf_sig, False))
+    for i, (pk, msg, sig, ok) in enumerate(cases):
+        write_json(
+            os.path.join(out, "bls", "verify", f"verify_case_{i}.json"),
+            {
+                "input": {
+                    "pubkey": hx(pk),
+                    "message": hx(msg),
+                    "signature": hx(sig),
+                },
+                "output": ok,
+            },
+        )
+
+    # aggregate: list of sigs -> aggregate; empty -> null
+    sigs = [B.sign(sks[i], msgs[0]) for i in range(4)]
+    write_json(
+        os.path.join(out, "bls", "aggregate", "aggregate_case_0.json"),
+        {
+            "input": [hx(C.g2_compress(s)) for s in sigs],
+            "output": hx(C.g2_compress(B.aggregate_signatures(sigs))),
+        },
+    )
+    write_json(
+        os.path.join(out, "bls", "aggregate", "aggregate_case_empty.json"),
+        {"input": [], "output": None},
+    )
+
+    # fast_aggregate_verify: n pubkeys, one message
+    for i, n in enumerate((1, 3, 8)):
+        msg = msgs[1]
+        agg = B.aggregate_signatures([B.sign(sks[j], msg) for j in range(n)])
+        write_json(
+            os.path.join(
+                out, "bls", "fast_aggregate_verify", f"fav_case_{i}.json"
+            ),
+            {
+                "input": {
+                    "pubkeys": [hx(C.g1_compress(pks[j])) for j in range(n)],
+                    "message": hx(msg),
+                    "signature": hx(C.g2_compress(agg)),
+                },
+                "output": True,
+            },
+        )
+    # tampered
+    agg = B.aggregate_signatures([B.sign(sks[j], msgs[1]) for j in range(3)])
+    write_json(
+        os.path.join(out, "bls", "fast_aggregate_verify", "fav_bad.json"),
+        {
+            "input": {
+                "pubkeys": [hx(C.g1_compress(pks[j])) for j in range(3)],
+                "message": hx(msgs[2]),
+                "signature": hx(C.g2_compress(agg)),
+            },
+            "output": False,
+        },
+    )
+    # infinity pubkey in the set must fail
+    write_json(
+        os.path.join(out, "bls", "fast_aggregate_verify", "fav_inf.json"),
+        {
+            "input": {
+                "pubkeys": [hx(inf_pk), hx(C.g1_compress(pks[0]))],
+                "message": hx(msgs[1]),
+                "signature": hx(C.g2_compress(agg)),
+            },
+            "output": False,
+        },
+    )
+
+    # aggregate_verify: distinct messages
+    pairs = [(sks[i], msgs[i]) for i in range(3)]
+    agg = B.aggregate_signatures([B.sign(sk, m) for sk, m in pairs])
+    write_json(
+        os.path.join(out, "bls", "aggregate_verify", "av_case_0.json"),
+        {
+            "input": {
+                "pubkeys": [
+                    hx(C.g1_compress(B.sk_to_pk(sk))) for sk, _ in pairs
+                ],
+                "messages": [hx(m) for _, m in pairs],
+                "signature": hx(C.g2_compress(agg)),
+            },
+            "output": True,
+        },
+    )
+    write_json(
+        os.path.join(out, "bls", "aggregate_verify", "av_bad.json"),
+        {
+            "input": {
+                "pubkeys": [
+                    hx(C.g1_compress(B.sk_to_pk(sk))) for sk, _ in pairs
+                ],
+                "messages": [hx(msgs[3])] * 3,
+                "signature": hx(C.g2_compress(agg)),
+            },
+            "output": False,
+        },
+    )
+
+
+def gen_hash_to_curve(out: str) -> None:
+    """ethereum/bls12-381-tests hash_to_G2 shape: msg -> uncompressed
+    affine coordinates (x = "a,b" over Fp2)."""
+    for i, msg in enumerate(
+        (b"", b"abc", b"abcdef0123456789", b"spec fixture message %d" % 7)
+    ):
+        x, y = hash_to_g2(msg)
+        write_json(
+            os.path.join(out, "hash_to_curve", f"h2c_case_{i}.json"),
+            {
+                "input": {"msg": msg.decode()},
+                "output": {
+                    "x": f"{hex(x[0])},{hex(x[1])}",
+                    "y": f"{hex(y[0])},{hex(y[1])}",
+                },
+            },
+        )
+
+
+# -- consensus (ethereum/consensus-spec-tests directory shapes) -------------
+
+
+def build_world():
+    sks = [B.keygen(b"spec-val-%d" % i) for i in range(N_VAL)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(CFG, pks, genesis_time=2)
+    return sks, pks, genesis
+
+
+def _sign_root(sk, root) -> bytes:
+    return C.g2_compress(B.sign(sk, root))
+
+
+def _att_signing_root(state, data) -> bytes:
+    slot = data["target"]["epoch"] * P.SLOTS_PER_EPOCH
+    return CFG.compute_signing_root(
+        T.AttestationData.hash_tree_root(data),
+        CFG.get_domain(state.slot, params.DOMAIN_BEACON_ATTESTER, slot),
+    )
+
+
+def _make_attestation(state, sks, slot, index=0):
+    committee = get_beacon_committee(state, slot, index)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    start = epoch * P.SLOTS_PER_EPOCH
+    target_root = (
+        get_block_root_at_slot(state, start)
+        if start < state.slot
+        else b"\x00" * 32
+    )
+    data = {
+        "slot": slot,
+        "index": index,
+        "beacon_block_root": get_block_root_at_slot(state, slot),
+        "source": dict(state.current_justified_checkpoint),
+        "target": {"epoch": epoch, "root": target_root},
+    }
+    root = _att_signing_root(state, data)
+    sigs = [B.sign(sks[int(v)], root) for v in committee]
+    return {
+        "aggregation_bits": [True] * len(committee),
+        "data": data,
+        "signature": C.g2_compress(B.aggregate_signatures(sigs)),
+    }
+
+
+def gen_operations(out: str) -> None:
+    from lodestar_tpu.state_transition.block import (
+        process_attestation,
+        process_attester_slashing,
+        process_proposer_slashing,
+        process_sync_aggregate,
+        process_voluntary_exit,
+    )
+
+    sks, pks, genesis = build_world()
+    base = os.path.join(out, "consensus", "altair", "operations")
+
+    def case(op_name, case_name, op_type, op_value, apply_fn, valid=True):
+        case_dir = os.path.join(base, op_name, case_name)
+        pre = genesis.clone()
+        process_slots(pre, 2)
+        write_ssz(case_dir, "pre", pre.serialize())
+        write_ssz(case_dir, op_name, op_type.serialize(op_value))
+        meta = {
+            "config": {
+                "fork": "altair",
+                "fork_epochs": {"altair": 0},
+                "SHARD_COMMITTEE_PERIOD": 0,
+            },
+            "bls_setting": 1,  # signatures must be verified
+        }
+        if valid:
+            apply_fn(pre, op_value, True)
+            write_ssz(case_dir, "post", pre.serialize())
+        else:
+            failed = False
+            try:
+                apply_fn(pre, op_value, True)
+            except Exception:
+                failed = True  # no post file = must fail
+            if not failed:
+                raise RuntimeError(f"{op_name}/{case_name} unexpectedly valid")
+        write_json(os.path.join(case_dir, "meta.json"), meta)
+
+    # attestation: valid + wrong-target-epoch invalid
+    state2 = genesis.clone()
+    process_slots(state2, 2)
+    att = _make_attestation(state2, sks, slot=1)
+    case("attestation", "valid", T.Attestation, att, process_attestation)
+    bad = dict(att, data=dict(att["data"], target={"epoch": 5, "root": b"\x00" * 32}))
+    case(
+        "attestation", "invalid_target", T.Attestation, bad,
+        process_attestation, valid=False,
+    )
+
+    # proposer slashing
+    def signed_header(proposer, body_root):
+        header = {
+            "slot": 0,
+            "proposer_index": proposer,
+            "parent_root": b"\x11" * 32,
+            "state_root": b"\x00" * 32,
+            "body_root": body_root,
+        }
+        root = CFG.compute_signing_root(
+            T.BeaconBlockHeader.hash_tree_root(header),
+            CFG.get_domain(0, params.DOMAIN_BEACON_PROPOSER, 0),
+        )
+        return {"message": header, "signature": _sign_root(sks[proposer], root)}
+
+    ps = {
+        "signed_header_1": signed_header(2, b"\x01" * 32),
+        "signed_header_2": signed_header(2, b"\x02" * 32),
+    }
+    case(
+        "proposer_slashing", "valid", T.ProposerSlashing, ps,
+        process_proposer_slashing,
+    )
+    ps_bad = {
+        "signed_header_1": signed_header(2, b"\x03" * 32),
+        "signed_header_2": signed_header(2, b"\x03" * 32),  # same header
+    }
+    case(
+        "proposer_slashing", "invalid_same_header", T.ProposerSlashing,
+        ps_bad, process_proposer_slashing, valid=False,
+    )
+
+    # attester slashing (double vote by committee of slot 1)
+    def indexed(state, data, indices):
+        root = _att_signing_root(state, data)
+        sigs = [B.sign(sks[int(v)], root) for v in indices]
+        return {
+            "attesting_indices": sorted(int(v) for v in indices),
+            "data": data,
+            "signature": C.g2_compress(B.aggregate_signatures(sigs)),
+        }
+
+    committee = get_beacon_committee(state2, 1, 0)
+    d1 = dict(att["data"])
+    d2 = dict(att["data"], beacon_block_root=b"\x77" * 32)
+    aslash = {
+        "attestation_1": indexed(state2, d1, committee),
+        "attestation_2": indexed(state2, d2, committee),
+    }
+    case(
+        "attester_slashing", "valid", T.AttesterSlashing, aslash,
+        process_attester_slashing,
+    )
+
+    # voluntary exit (SHARD_COMMITTEE_PERIOD=0 in this config)
+    exit_msg = {"epoch": 0, "validator_index": 5}
+    root = CFG.compute_signing_root(
+        T.VoluntaryExit.hash_tree_root(exit_msg),
+        CFG.get_domain(0, params.DOMAIN_VOLUNTARY_EXIT, 0),
+    )
+    ve = {"message": exit_msg, "signature": _sign_root(sks[5], root)}
+    case(
+        "voluntary_exit", "valid", T.SignedVoluntaryExit, ve,
+        process_voluntary_exit,
+    )
+    ve_bad = {"message": exit_msg, "signature": _sign_root(sks[6], root)}
+    case(
+        "voluntary_exit", "invalid_signature", T.SignedVoluntaryExit, ve_bad,
+        process_voluntary_exit, valid=False,
+    )
+
+    # sync aggregate: participants sign the PREVIOUS slot's block root
+    state2b = genesis.clone()
+    process_slots(state2b, 2)
+    prev_root = get_block_root_at_slot(state2b, 1)
+    sync_root = CFG.compute_signing_root(
+        T.Root.hash_tree_root(prev_root),
+        CFG.get_domain(state2b.slot, params.DOMAIN_SYNC_COMMITTEE, 1),
+    )
+    bits = [False] * P.SYNC_COMMITTEE_SIZE
+    participants = []
+    for pos in range(0, 8):
+        bits[pos] = True
+        pk = state2b.current_sync_committee["pubkeys"][pos]
+        participants.append(int(state2b.pubkey_index(pk)))
+    agg = B.aggregate_signatures(
+        [B.sign(sks[v], sync_root) for v in participants]
+    )
+    sa = {
+        "sync_committee_bits": bits,
+        "sync_committee_signature": C.g2_compress(agg),
+    }
+    case(
+        "sync_aggregate", "valid", T.SyncAggregate, sa, process_sync_aggregate
+    )
+
+
+def gen_epoch_processing(out: str) -> None:
+    from lodestar_tpu.state_transition.epoch import (
+        EpochTransitionCache,
+        process_effective_balance_updates,
+        process_justification_and_finalization,
+        process_registry_updates,
+        process_rewards_and_penalties,
+        process_slashings,
+        process_sync_committee_updates,
+    )
+
+    steps = {
+        "justification_and_finalization": process_justification_and_finalization,
+        "rewards_and_penalties": process_rewards_and_penalties,
+        "registry_updates": process_registry_updates,
+        "slashings": process_slashings,
+        "effective_balance_updates": process_effective_balance_updates,
+        "sync_committee_updates": process_sync_committee_updates,
+    }
+    sks, pks, genesis = build_world()
+    base = os.path.join(out, "consensus", "altair", "epoch_processing")
+
+    # a state at the last slot of epoch 0 with full participation
+    pre0 = genesis.clone()
+    process_slots(pre0, P.SLOTS_PER_EPOCH - 1)
+    pre0.current_epoch_participation[:] = 0b111
+    pre0.previous_epoch_participation[:] = 0b111
+
+    for name, fn in steps.items():
+        case_dir = os.path.join(base, name, "full_participation")
+        state = pre0.clone()
+        write_ssz(case_dir, "pre", state.serialize())
+        fn(state, EpochTransitionCache(state))
+        write_ssz(case_dir, "post", state.serialize())
+        write_json(
+            os.path.join(case_dir, "meta.json"),
+            {"config": {"fork": "altair", "fork_epochs": {"altair": 0}}},
+        )
+
+
+def gen_ssz_static(out: str) -> None:
+    sks, pks, genesis = build_world()
+    state2 = genesis.clone()
+    process_slots(state2, 2)
+    att = _make_attestation(state2, sks, slot=1)
+    values = {
+        "AttestationData": (T.AttestationData, att["data"]),
+        "Attestation": (T.Attestation, att),
+        "Checkpoint": (T.Checkpoint, {"epoch": 3, "root": b"\x09" * 32}),
+        "BeaconBlockHeader": (
+            T.BeaconBlockHeader,
+            {
+                "slot": 7,
+                "proposer_index": 3,
+                "parent_root": b"\x01" * 32,
+                "state_root": b"\x02" * 32,
+                "body_root": b"\x03" * 32,
+            },
+        ),
+        "SyncCommitteeMessage": (
+            T.SyncCommitteeMessage,
+            {
+                "slot": 1,
+                "beacon_block_root": b"\x04" * 32,
+                "validator_index": 9,
+                "signature": b"\x05" * 96,
+            },
+        ),
+        "SyncAggregatorSelectionData": (
+            T.SyncAggregatorSelectionData,
+            {"slot": 11, "subcommittee_index": 2},
+        ),
+        "VoluntaryExit": (
+            T.VoluntaryExit,
+            {"epoch": 1, "validator_index": 4},
+        ),
+        "Fork": (
+            T.Fork,
+            {
+                "previous_version": b"\x00\x00\x00\x00",
+                "current_version": b"\x01\x00\x00\x00",
+                "epoch": 0,
+            },
+        ),
+        "BeaconStateAltair": (None, None),  # handled below
+    }
+    base = os.path.join(out, "consensus", "altair", "ssz_static")
+    for name, (typ, value) in values.items():
+        case_dir = os.path.join(base, name, "case_0")
+        if name == "BeaconStateAltair":
+            data = state2.serialize()
+            root = state2.hash_tree_root()
+        else:
+            data = typ.serialize(value)
+            root = typ.hash_tree_root(value)
+        write_ssz(case_dir, "serialized", data)
+        write_json(os.path.join(case_dir, "roots.json"), {"root": hx(root)})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests",
+            "fixtures",
+        ),
+    )
+    args = ap.parse_args()
+    for sub in ("bls", "hash_to_curve", "consensus"):
+        shutil.rmtree(os.path.join(args.out, sub), ignore_errors=True)
+    print("generating bls ...")
+    gen_bls(args.out)
+    print("generating hash_to_curve ...")
+    gen_hash_to_curve(args.out)
+    print("generating operations ...")
+    gen_operations(args.out)
+    print("generating epoch_processing ...")
+    gen_epoch_processing(args.out)
+    print("generating ssz_static ...")
+    gen_ssz_static(args.out)
+    print("done:", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,64 +43,50 @@ class SeenAttesters:
 SeenAggregators = SeenAttesters  # same structure, keyed per (epoch, aggregator)
 
 
-class SeenBlockProposers:
-    """(slot, proposer) dedup — a proposer publishes once per slot
+class SlotWindowedSeen:
+    """Generic slot-windowed first-seen dedup: (slot, *key) membership
+    with per-slot pruning.  One structure serves block proposers, sync
+    messages, and contributions (reference: the seenCache family's
+    shared shape)."""
+
+    def __init__(self, max_slots: int):
+        self.max_slots = max_slots
+        self._by_slot: Dict[int, set] = {}
+
+    def is_known(self, slot: int, *key) -> bool:
+        return key in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, *key) -> None:
+        self._by_slot.setdefault(slot, set()).add(key)
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s < current_slot - self.max_slots:
+                del self._by_slot[s]
+
+
+class SeenBlockProposers(SlotWindowedSeen):
+    """(slot, proposer) — a proposer publishes once per slot
     (reference: seenCache/seenBlockProposers.ts)."""
 
     def __init__(self, max_slots: int = 64):
-        self.max_slots = max_slots
-        self._by_slot: Dict[int, set] = {}
-
-    def is_known(self, slot: int, proposer: int) -> bool:
-        return proposer in self._by_slot.get(slot, ())
-
-    def add(self, slot: int, proposer: int) -> None:
-        self._by_slot.setdefault(slot, set()).add(proposer)
-
-    def prune(self, current_slot: int) -> None:
-        for s in list(self._by_slot):
-            if s < current_slot - self.max_slots:
-                del self._by_slot[s]
+        super().__init__(max_slots)
 
 
-class SeenSyncCommitteeMessages:
-    """(slot, subnet, validator) dedup — one message per member per slot
-    per subnet (reference: seenCache/seenCommittee.ts)."""
+class SeenSyncCommitteeMessages(SlotWindowedSeen):
+    """(slot, subnet, validator) — one message per member per slot per
+    subnet (reference: seenCache/seenCommittee.ts)."""
 
     def __init__(self, max_slots: int = 3):
-        self.max_slots = max_slots
-        self._by_slot: Dict[int, set] = {}
-
-    def is_known(self, slot: int, subnet: int, index: int) -> bool:
-        return (subnet, index) in self._by_slot.get(slot, ())
-
-    def add(self, slot: int, subnet: int, index: int) -> None:
-        self._by_slot.setdefault(slot, set()).add((subnet, index))
-
-    def prune(self, current_slot: int) -> None:
-        for s in list(self._by_slot):
-            if s < current_slot - self.max_slots:
-                del self._by_slot[s]
+        super().__init__(max_slots)
 
 
-class SeenContributionAndProof:
-    """(slot, subnet, aggregator) dedup for sync contributions
-    (reference: seenCache/seenCommitteeContribution.ts)."""
+class SeenContributionAndProof(SlotWindowedSeen):
+    """(slot, subnet, aggregator) (reference:
+    seenCache/seenCommitteeContribution.ts)."""
 
     def __init__(self, max_slots: int = 3):
-        self.max_slots = max_slots
-        self._by_slot: Dict[int, set] = {}
-
-    def is_known(self, slot: int, subnet: int, aggregator: int) -> bool:
-        return (subnet, aggregator) in self._by_slot.get(slot, ())
-
-    def add(self, slot: int, subnet: int, aggregator: int) -> None:
-        self._by_slot.setdefault(slot, set()).add((subnet, aggregator))
-
-    def prune(self, current_slot: int) -> None:
-        for s in list(self._by_slot):
-            if s < current_slot - self.max_slots:
-                del self._by_slot[s]
+        super().__init__(max_slots)
 
 
 class SeenAttestationDatas(Generic[V]):

@@ -108,11 +108,30 @@ class GossipValidators:
         EpochContext)."""
         head_root = self.chain.head_root_hex
         if self._view_cache is None or self._view_cache[0] != head_root:
+            head = self.chain.head_state
+            # pubkey -> sync-committee positions, built once per head
+            # (O(1) lookups on the per-message hot path)
+            sync_positions: dict = {}
+            for i, pk in enumerate(head.current_sync_committee["pubkeys"]):
+                sync_positions.setdefault(bytes(pk), []).append(i)
             self._view_cache = (
                 head_root,
-                BeaconStateView.from_state(self.chain.head_state),
+                BeaconStateView.from_state(head),
+                sync_positions,
             )
         return self._view_cache[1]
+
+    def _committee(self, slot: int, index: int):
+        """Beacon committee for any epoch the view covers (the current
+        epoch cache asserts its own epoch; previous-epoch objects
+        dispatch to prev_epoch_cache — reference EpochContext's
+        per-epoch shufflings)."""
+        view = self._view()
+        epoch = slot // params.SLOTS_PER_EPOCH
+        for cache in (view.epoch_cache, view.prev_epoch_cache):
+            if cache is not None and cache.epoch == epoch:
+                return cache.get_beacon_committee(slot, index)
+        _ignore(f"no committee cache for epoch {epoch}")
 
     def _current_slot(self) -> int:
         if self.current_slot_fn is not None:
@@ -139,15 +158,37 @@ class GossipValidators:
 
     # -- beacon_attestation_{subnet} (reference: validation/attestation.ts)
 
-    def validate_attestation(self, attestation: dict) -> dict:
-        """Unaggregated attestation: exactly one bit, fresh attester,
-        known root, valid signature.  Returns the indexed attestation."""
+    def validate_attestation(
+        self, attestation: dict, subnet: Optional[int] = None
+    ) -> dict:
+        """Unaggregated attestation: exactly one bit, correct subnet,
+        fresh attester, known root, valid signature.  Returns the
+        indexed attestation."""
         data = attestation["data"]
         self._check_slot_window(int(data["slot"]))
         bits = attestation["aggregation_bits"]
         if sum(1 for b in bits if b) != 1:
             _reject("not exactly one aggregation bit")
         view = self._view()
+        if subnet is not None:
+            # compute_subnet_for_attestation (p2p spec): wrong-subnet
+            # publication is spam and must REJECT
+            epoch = int(data["slot"]) // params.SLOTS_PER_EPOCH
+            cache = next(
+                (
+                    c
+                    for c in (view.epoch_cache, view.prev_epoch_cache)
+                    if c is not None and c.epoch == epoch
+                ),
+                view.epoch_cache,
+            )
+            expected = (
+                (int(data["slot"]) % params.SLOTS_PER_EPOCH)
+                * cache.committees_per_slot
+                + int(data["index"])
+            ) % params.ATTESTATION_SUBNET_COUNT
+            if subnet != expected:
+                _reject(f"wrong subnet {subnet} (expected {expected})")
         try:
             indexed = view.get_indexed_attestation(attestation)
         except Exception as e:  # unknown epoch/committee shape
@@ -188,9 +229,7 @@ class GossipValidators:
             indexed = view.get_indexed_attestation(aggregate)
         except Exception as e:
             _reject(f"no committee: {e}")
-        committee = view.epoch_cache.get_beacon_committee(
-            slot, int(data["index"])
-        )
+        committee = self._committee(slot, int(data["index"]))
         if aggregator not in [int(v) for v in committee]:
             _reject("aggregator not in committee")
         if not _hash_mod(
@@ -220,12 +259,11 @@ class GossipValidators:
 
     def _sync_committee_positions(self, validator_index: int) -> List[int]:
         head = self.chain.head_state
-        pk = head.pubkeys[validator_index]
-        return [
-            i
-            for i, cpk in enumerate(head.current_sync_committee["pubkeys"])
-            if cpk == pk
-        ]
+        if validator_index >= head.num_validators:
+            return []
+        self._view()  # ensure the position map is built for this head
+        pk = bytes(head.pubkeys[validator_index])
+        return self._view_cache[2].get(pk, [])
 
     def validate_sync_committee_message(
         self, message: dict, subnet: int
@@ -278,8 +316,9 @@ class GossipValidators:
             // params.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
         ):
             _reject("selection proof does not select sync aggregator")
-        if not self._sync_committee_positions(aggregator):
-            _reject("aggregator not in sync committee")
+        positions = self._sync_committee_positions(aggregator)
+        if not any(p // SYNC_SUBCOMMITTEE_SIZE == subnet for p in positions):
+            _reject(f"aggregator not in sync subcommittee {subnet}")
         # participants: subcommittee positions -> validator indices
         head = self.chain.head_state
         participants = []

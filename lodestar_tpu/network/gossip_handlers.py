@@ -45,6 +45,7 @@ class GossipHandlers:
         self.log = get_logger("network/gossip_handlers")
         self.seen_block_proposers = SeenBlockProposers()
         self.results: Dict[str, Dict[str, int]] = {}
+        self._last_pruned_slot = 0
 
     def _block_is_timely(self, slot: int) -> bool:
         """Measured arrival delay < 1/3 slot (reference: forkChoice.ts
@@ -83,9 +84,23 @@ class GossipHandlers:
         self.results.setdefault(name, {}).setdefault(verdict, 0)
         self.results[name][verdict] += 1
 
+    def _prune(self, slot: int) -> None:
+        if slot > self._last_pruned_slot:
+            self._last_pruned_slot = slot
+            self.seen_block_proposers.prune(slot)
+            self.validators.prune(slot)
+
+    def on_clock_slot(self, slot: int) -> None:
+        """Wire to the node Clock; also called opportunistically when an
+        imported block advances the slot, so caches are bounded even in
+        clock-less compositions."""
+        self._prune(slot)
+
     def _dispatch(self, name: str, payload: bytes) -> None:
         v = self.validators
         if name == "beacon_block":
+            from ..chain.regen import RegenError
+
             signed = T.SignedBeaconBlockAltair.deserialize(payload)
             slot = int(signed["message"]["slot"])
             proposer = int(signed["message"]["proposer_index"])
@@ -95,10 +110,19 @@ class GossipHandlers:
                 raise GossipValidationError(
                     GossipAction.IGNORE, "proposer already seen this slot"
                 )
-            self.chain.process_block(
-                signed, timely=self._block_is_timely(slot)
-            )
+            try:
+                self.chain.process_block(
+                    signed, timely=self._block_is_timely(slot)
+                )
+            except RegenError as e:
+                # unknown parent / missing state: not the sender's fault
+                # — IGNORE (and park for reprocess at the processor
+                # layer), never penalize (p2p spec IGNORE condition)
+                raise GossipValidationError(
+                    GossipAction.IGNORE, f"pre-state unavailable: {e}"
+                )
             self.seen_block_proposers.add(slot, proposer)
+            self._prune(slot)
             return None
         if name == "beacon_aggregate_and_proof":
             v.validate_aggregate_and_proof(
@@ -106,7 +130,10 @@ class GossipHandlers:
             )
             return None
         if name.startswith("beacon_attestation_"):
-            v.validate_attestation(T.Attestation.deserialize(payload))
+            subnet = int(name.rsplit("_", 1)[1])
+            v.validate_attestation(
+                T.Attestation.deserialize(payload), subnet=subnet
+            )
             return None
         if name == "voluntary_exit":
             v.validate_voluntary_exit_gossip(

@@ -1,0 +1,225 @@
+"""Spec-test vectors: bls, hash_to_curve, operations, epoch, ssz_static.
+
+Mirror of the reference's spec runners (reference:
+packages/beacon-node/test/spec/{bls/bls.ts,presets/operations.ts,
+presets/epoch_processing.ts,presets/ssz_static.ts} via the enforcing
+iterator in spec/utils/specTestIterator.ts:22-30): absent fixtures are
+FAILURES, every fixture directory must be consumed, every runner must
+find cases.  See tests/fixtures/README.md for vector provenance.
+"""
+
+import dataclasses
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.spec_test_util import (
+    check_all_consumed,
+    iter_case_dirs,
+    iter_json_cases,
+    maybe_read_ssz_snappy,
+    read_json_roots,
+    read_meta,
+    read_ssz_snappy,
+)
+from lodestar_tpu.state_transition.state import BeaconState
+
+pytestmark = [pytest.mark.smoke, pytest.mark.spec]
+
+CFG = dataclasses.replace(
+    create_chain_config(MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}),
+    SHARD_COMMITTEE_PERIOD=0,
+)
+
+
+def _unhex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+# -- bls (reference: test/spec/bls/bls.ts runners) --------------------------
+
+
+def test_bls_sign_vectors():
+    for name, case in iter_json_cases("bls", "sign"):
+        sk = int.from_bytes(_unhex(case["input"]["privkey"]), "big")
+        msg = _unhex(case["input"]["message"])
+        sig = C.g2_compress(B.sign(sk, msg))
+        assert sig == _unhex(case["output"]), name
+
+
+def test_bls_verify_vectors():
+    for name, case in iter_json_cases("bls", "verify"):
+        try:
+            pk = C.g1_decompress(_unhex(case["input"]["pubkey"]))
+            sig = C.g2_decompress(_unhex(case["input"]["signature"]))
+            ok = (
+                pk is not None
+                and sig is not None
+                and C.g1_subgroup_check(pk)
+                and C.g2_subgroup_check(sig)
+                and B.verify(pk, _unhex(case["input"]["message"]), sig)
+            )
+        except ValueError:
+            ok = False
+        assert ok == case["output"], name
+
+
+def test_bls_aggregate_vectors():
+    for name, case in iter_json_cases("bls", "aggregate"):
+        sigs = [C.g2_decompress(_unhex(s)) for s in case["input"]]
+        if not sigs:
+            assert case["output"] is None, name
+            continue
+        agg = C.g2_compress(B.aggregate_signatures(sigs))
+        assert agg == _unhex(case["output"]), name
+
+
+def test_bls_fast_aggregate_verify_vectors():
+    for name, case in iter_json_cases("bls", "fast_aggregate_verify"):
+        inp = case["input"]
+        try:
+            pks = [C.g1_decompress(_unhex(p)) for p in inp["pubkeys"]]
+            sig = C.g2_decompress(_unhex(inp["signature"]))
+            if any(p is None for p in pks) or sig is None:
+                ok = False
+            else:
+                agg = B.aggregate_pubkeys(pks)
+                ok = B.verify(agg, _unhex(inp["message"]), sig)
+        except ValueError:
+            ok = False
+        assert ok == case["output"], name
+
+
+def test_bls_aggregate_verify_vectors():
+    from lodestar_tpu.crypto import pairing as CP
+
+    for name, case in iter_json_cases("bls", "aggregate_verify"):
+        inp = case["input"]
+        pks = [C.g1_decompress(_unhex(p)) for p in inp["pubkeys"]]
+        sig = C.g2_decompress(_unhex(inp["signature"]))
+        pairs = [
+            (pk, hash_to_g2(_unhex(m)))
+            for pk, m in zip(pks, inp["messages"])
+        ]
+        ok = CP.multi_pairing_is_one(
+            [(pk, hm) for pk, hm in pairs] + [(B.NEG_G1_GEN, sig)]
+        )
+        assert ok == case["output"], name
+
+
+def test_hash_to_curve_vectors():
+    for name, case in iter_json_cases("hash_to_curve"):
+        msg = case["input"]["msg"].encode()
+        x, y = hash_to_g2(msg)
+        ex = [int(v, 16) for v in case["output"]["x"].split(",")]
+        ey = [int(v, 16) for v in case["output"]["y"].split(",")]
+        assert [x[0], x[1]] == ex and [y[0], y[1]] == ey, name
+
+
+# -- consensus: operations (reference: presets/operations.ts) ---------------
+
+OPERATION_TYPES = {
+    "attestation": (T.Attestation, "process_attestation"),
+    "proposer_slashing": (T.ProposerSlashing, "process_proposer_slashing"),
+    "attester_slashing": (T.AttesterSlashing, "process_attester_slashing"),
+    "voluntary_exit": (T.SignedVoluntaryExit, "process_voluntary_exit"),
+    "sync_aggregate": (T.SyncAggregate, "process_sync_aggregate"),
+}
+
+
+def test_operations_vectors():
+    from lodestar_tpu.state_transition import block as BL
+
+    consumed = {}
+    for op_name, (typ, fn_name) in OPERATION_TYPES.items():
+        fn = getattr(BL, fn_name)
+        consumed[op_name] = 0
+        for case_dir in iter_case_dirs(
+            "consensus", "altair", "operations", op_name
+        ):
+            consumed[op_name] += 1
+            pre = BeaconState.deserialize(
+                read_ssz_snappy(case_dir, "pre"), CFG
+            )
+            op = typ.deserialize(read_ssz_snappy(case_dir, op_name))
+            post_bytes = maybe_read_ssz_snappy(case_dir, "post")
+            if post_bytes is None:
+                with pytest.raises(Exception):
+                    fn(pre, op, True)
+            else:
+                fn(pre, op, True)
+                assert pre.serialize() == post_bytes, case_dir
+    check_all_consumed(consumed, "consensus", "altair", "operations")
+
+
+# -- consensus: epoch processing (reference: presets/epoch_processing.ts) ---
+
+
+def test_epoch_processing_vectors():
+    from lodestar_tpu.state_transition import epoch as EP
+
+    consumed = {}
+    steps = (
+        "justification_and_finalization",
+        "rewards_and_penalties",
+        "registry_updates",
+        "slashings",
+        "effective_balance_updates",
+        "sync_committee_updates",
+    )
+    for step in steps:
+        fn = getattr(EP, f"process_{step}")
+        consumed[step] = 0
+        for case_dir in iter_case_dirs(
+            "consensus", "altair", "epoch_processing", step
+        ):
+            consumed[step] += 1
+            pre = BeaconState.deserialize(
+                read_ssz_snappy(case_dir, "pre"), CFG
+            )
+            fn(pre, EP.EpochTransitionCache(pre))
+            assert pre.serialize() == read_ssz_snappy(case_dir, "post"), (
+                case_dir
+            )
+    check_all_consumed(consumed, "consensus", "altair", "epoch_processing")
+
+
+# -- consensus: ssz_static (reference: presets/ssz_static.ts) ---------------
+
+
+def test_ssz_static_vectors():
+    consumed = {}
+    for type_name in (
+        "AttestationData",
+        "Attestation",
+        "Checkpoint",
+        "BeaconBlockHeader",
+        "SyncCommitteeMessage",
+        "SyncAggregatorSelectionData",
+        "VoluntaryExit",
+        "Fork",
+        "BeaconStateAltair",
+    ):
+        consumed[type_name] = 0
+        for case_dir in iter_case_dirs(
+            "consensus", "altair", "ssz_static", type_name
+        ):
+            consumed[type_name] += 1
+            data = read_ssz_snappy(case_dir, "serialized")
+            root = _unhex(read_json_roots(case_dir)["root"])
+            if type_name == "BeaconStateAltair":
+                state = BeaconState.deserialize(data, CFG)
+                assert state.hash_tree_root() == root, case_dir
+                assert state.serialize() == data, case_dir
+            else:
+                typ = getattr(T, type_name)
+                value = typ.deserialize(data)
+                assert typ.hash_tree_root(value) == root, case_dir
+                assert typ.serialize(value) == data, case_dir
+    check_all_consumed(consumed, "consensus", "altair", "ssz_static")
