@@ -57,6 +57,7 @@ class BeaconChain:
         db=None,
         bls_verifier=None,
         eth1=None,
+        execution=None,
         emitter: Optional[ChainEventEmitter] = None,
     ):
         self.config = config
@@ -65,6 +66,11 @@ class BeaconChain:
         self.db = db
         self.bls = bls_verifier  # optional batched signature service
         self.eth1 = eth1  # optional Eth1DepositDataTracker
+        self.execution = execution  # optional IExecutionEngine
+        # beacon root -> execution block hash (payload-carrying blocks)
+        self._execution_block_hash: Dict[str, bytes] = {}
+        # roots imported optimistically (EL said SYNCING/ACCEPTED)
+        self.optimistic_roots: set = set()
 
         anchor_root = BeaconBlockHeader.hash_tree_root(
             dict(
@@ -119,6 +125,12 @@ class BeaconChain:
             return root  # already imported
 
         pre_state = self.regen.get_pre_state(block)
+
+        # Execution-payload leg: runs alongside signatures + the state
+        # transition (reference: chain/blocks/verifyBlock.ts:87-104
+        # Promise.all).  Altair bodies carry no payload, so this leg is
+        # a no-op until the bellatrix types flow through.
+        self._verify_execution_payload(block, root.hex())
 
         if self.bls is not None:
             ok = self._verify_signatures_batched(pre_state, signed_block)
@@ -181,7 +193,10 @@ class BeaconChain:
             if self.fork_choice.has_block(froot):
                 # drop pre-finalized proto nodes (reference maybePrune;
                 # no-op below the prune threshold)
-                self.fork_choice.prune(froot)
+                removed = self.fork_choice.prune(froot)
+                for node in removed:
+                    self._execution_block_hash.pop(node.root, None)
+                    self.optimistic_roots.discard(node.root)
             self.emitter.emit(
                 ChainEvent.finalized, dict(post.finalized_checkpoint)
             )
@@ -197,7 +212,89 @@ class BeaconChain:
         self.emitter.emit(
             ChainEvent.head, bytes.fromhex(self.head_root_hex), block["slot"]
         )
+        self._notify_forkchoice()
         return root
+
+    def _verify_execution_payload(
+        self, block: dict, root_hex: Optional[str] = None
+    ) -> None:
+        """The third verification leg (reference: verifyBlock.ts
+        verifyBlocksExecutionPayload -> engine notifyNewPayload).
+
+        VALID -> proceed; SYNCING/ACCEPTED -> optimistic import (the
+        root is tracked and the head stays execution-unverified until
+        the EL catches up); INVALID -> the block is invalid; an EL
+        outage (ELERROR/UNAVAILABLE or a transport failure) is
+        RETRYABLE — surfaced as ExecutionEngineUnavailable, never as
+        block invalidity (the gossip layer IGNOREs it)."""
+        body = block.get("body", {})
+        payload = (
+            body.get("execution_payload") if isinstance(body, dict) else None
+        )
+        if payload is None:
+            return
+        if self.execution is None:
+            raise ValueError("execution payload present but no engine wired")
+        from ..execution import (
+            ExecutePayloadStatus,
+            ExecutionEngineUnavailable,
+        )
+
+        if root_hex is None:
+            root_hex = BeaconBlockAltair.hash_tree_root(block).hex()
+        try:
+            st = self.execution.notify_new_payload(payload)
+        except ExecutionEngineUnavailable:
+            raise
+        except Exception as e:  # transport failure = outage, retryable
+            raise ExecutionEngineUnavailable(str(e))
+        if st.status == ExecutePayloadStatus.VALID:
+            self._execution_block_hash[root_hex] = bytes(
+                payload["block_hash"]
+            )
+            self.optimistic_roots.discard(root_hex)
+        elif st.status in (
+            ExecutePayloadStatus.SYNCING,
+            ExecutePayloadStatus.ACCEPTED,
+        ):
+            self._execution_block_hash[root_hex] = bytes(
+                payload["block_hash"]
+            )
+            self.optimistic_roots.add(root_hex)
+        elif st.status in (
+            ExecutePayloadStatus.ELERROR,
+            ExecutePayloadStatus.UNAVAILABLE,
+        ):
+            raise ExecutionEngineUnavailable(
+                f"EL outage: {st.status.value} ({st.validation_error})"
+            )
+        else:
+            raise ValueError(
+                f"execution payload rejected: {st.status.value} "
+                f"({st.validation_error})"
+            )
+
+    def _notify_forkchoice(self) -> None:
+        """Push the beacon head to the EL after head updates (reference:
+        importBlock.ts -> executionEngine.notifyForkchoiceUpdate)."""
+        if self.execution is None:
+            return
+        head_hash = self._execution_block_hash.get(self.head_root_hex)
+        if head_hash is None:
+            return  # pre-merge head
+        fin = self.head_state.finalized_checkpoint["root"].hex()
+        fin_hash = self._execution_block_hash.get(fin, b"\x00" * 32)
+        from ..execution import ExecutePayloadStatus
+
+        try:
+            r = self.execution.notify_forkchoice_update(
+                head_hash, head_hash, fin_hash
+            )
+            # the EL confirming the head resolves its optimistic status
+            if r.status == ExecutePayloadStatus.VALID:
+                self.optimistic_roots.discard(self.head_root_hex)
+        except Exception as e:  # noqa: BLE001 - EL outage must not kill import
+            self.log.warn("engine forkchoiceUpdated failed", error=str(e))
 
     def _verify_signatures_batched(self, pre_state, signed_block) -> bool:
         """One batched job through the injected verifier service using the

@@ -172,7 +172,10 @@ class GossipValidators:
         view = self._view()
         if subnet is not None:
             # compute_subnet_for_attestation (p2p spec): wrong-subnet
-            # publication is spam and must REJECT
+            # publication is spam and must REJECT.  If no committee
+            # cache covers the epoch we cannot decide -> IGNORE (same
+            # dispatch as _committee; never judge with the wrong epoch's
+            # committees_per_slot).
             epoch = int(data["slot"]) // params.SLOTS_PER_EPOCH
             cache = next(
                 (
@@ -180,8 +183,10 @@ class GossipValidators:
                     for c in (view.epoch_cache, view.prev_epoch_cache)
                     if c is not None and c.epoch == epoch
                 ),
-                view.epoch_cache,
+                None,
             )
+            if cache is None:
+                _ignore(f"no committee cache for epoch {epoch}")
             expected = (
                 (int(data["slot"]) % params.SLOTS_PER_EPOCH)
                 * cache.committees_per_slot

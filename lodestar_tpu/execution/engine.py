@@ -1,0 +1,68 @@
+"""Engine API interface types.
+
+Reference: packages/beacon-node/src/execution/engine/interface.ts —
+ExecutePayloadStatus and the IExecutionEngine verbs.  Payloads travel
+as plain dicts shaped like the bellatrix ExecutionPayload SSZ container
+(types are defined alongside so serialization is available when the
+bellatrix state transition lands).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+
+class ExecutionEngineUnavailable(Exception):
+    """The EL could not answer (outage / transport failure) — a
+    RETRYABLE condition, never evidence the block is invalid."""
+
+
+class ExecutePayloadStatus(str, enum.Enum):
+    """interface.ts:11-31."""
+
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+    ELERROR = "ELERROR"
+    UNAVAILABLE = "UNAVAILABLE"
+
+
+@dataclass
+class ExecutionPayloadStatus:
+    status: ExecutePayloadStatus
+    latest_valid_hash: Optional[str] = None  # 0x-hex
+    validation_error: Optional[str] = None
+
+
+@dataclass
+class ForkchoiceUpdateResult:
+    status: ExecutePayloadStatus
+    latest_valid_hash: Optional[str] = None
+    payload_id: Optional[str] = None  # set when attributes were provided
+
+
+@dataclass
+class PayloadAttributes:
+    """engine_forkchoiceUpdated payload-build request (interface.ts)."""
+
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes
+
+
+class IExecutionEngine(Protocol):
+    def notify_new_payload(self, payload: dict) -> ExecutionPayloadStatus: ...
+
+    def notify_forkchoice_update(
+        self,
+        head_block_hash: bytes,
+        safe_block_hash: bytes,
+        finalized_block_hash: bytes,
+        payload_attributes: Optional[PayloadAttributes] = None,
+    ) -> ForkchoiceUpdateResult: ...
+
+    def get_payload(self, payload_id: str) -> dict: ...

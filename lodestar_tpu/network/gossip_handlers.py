@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, Tuple
 
 from .. import types as T
+from ..chain.regen import RegenError
 from ..chain.seen_cache import SeenBlockProposers
 from ..chain.validation import (
     GossipAction,
@@ -99,7 +100,7 @@ class GossipHandlers:
     def _dispatch(self, name: str, payload: bytes) -> None:
         v = self.validators
         if name == "beacon_block":
-            from ..chain.regen import RegenError
+            from ..execution import ExecutionEngineUnavailable
 
             signed = T.SignedBeaconBlockAltair.deserialize(payload)
             slot = int(signed["message"]["slot"])
@@ -114,12 +115,13 @@ class GossipHandlers:
                 self.chain.process_block(
                     signed, timely=self._block_is_timely(slot)
                 )
-            except RegenError as e:
-                # unknown parent / missing state: not the sender's fault
-                # — IGNORE (and park for reprocess at the processor
-                # layer), never penalize (p2p spec IGNORE condition)
+            except (RegenError, ExecutionEngineUnavailable) as e:
+                # unknown parent / missing state / EL outage: not the
+                # sender's fault — IGNORE (and park for reprocess at
+                # the processor layer), never penalize (p2p spec
+                # IGNORE conditions)
                 raise GossipValidationError(
-                    GossipAction.IGNORE, f"pre-state unavailable: {e}"
+                    GossipAction.IGNORE, f"not verifiable now: {e}"
                 )
             self.seen_block_proposers.add(slot, proposer)
             self._prune(slot)

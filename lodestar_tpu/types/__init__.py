@@ -17,6 +17,7 @@ from ..ssz import (
     Bitvector,
     Boolean,
     ByteList,
+    ByteVector,
     Bytes4,
     Bytes32,
     Bytes48,
@@ -25,6 +26,7 @@ from ..ssz import (
     List,
     Vector,
     uint64,
+    uint256,
 )
 
 P = params.ACTIVE_PRESET
@@ -368,4 +370,43 @@ ssz = SimpleNamespace(
     Epoch=Epoch,
     Slot=Slot,
     Root=Root,
+)
+
+
+# -- bellatrix execution payload (reference: types/src/bellatrix/
+# sszTypes.ts; consumed by the execution engine layer — the bellatrix
+# state transition lands on top of these) -----------------------------------
+
+Transaction = ByteList(1_073_741_824)  # MAX_BYTES_PER_TRANSACTION
+_payload_header_fields = (
+    ("parent_hash", Bytes32),
+    ("fee_recipient", ByteVector(20)),
+    ("state_root", Bytes32),
+    ("receipts_root", Bytes32),
+    ("logs_bloom", ByteVector(256)),
+    ("prev_randao", Bytes32),
+    ("block_number", uint64),
+    ("gas_limit", uint64),
+    ("gas_used", uint64),
+    ("timestamp", uint64),
+    ("extra_data", ByteList(32)),
+    ("base_fee_per_gas", uint256),
+)
+
+ExecutionPayload = Container(
+    _payload_header_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions", List(Transaction, 1_048_576)),
+    ),
+    name="ExecutionPayload",
+)
+
+ExecutionPayloadHeader = Container(
+    _payload_header_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions_root", Bytes32),
+    ),
+    name="ExecutionPayloadHeader",
 )

@@ -1,0 +1,196 @@
+"""Execution layer: engine mock semantics, HTTP+JWT wire, chain leg.
+
+Reference behaviors: packages/beacon-node/src/execution/engine/
+{mock.ts,http.ts,interface.ts} and the payload leg of
+chain/blocks/verifyBlock.ts:87-104.
+"""
+
+import pytest
+
+from lodestar_tpu import types as T
+from lodestar_tpu.execution import (
+    EngineApiServer,
+    ExecutePayloadStatus,
+    ExecutionEngineHttp,
+    ExecutionEngineMock,
+    PayloadAttributes,
+)
+from lodestar_tpu.execution.engine_http import (
+    EngineHttpError,
+    jwt_encode_hs256,
+    jwt_verify_hs256,
+)
+from lodestar_tpu.execution.engine_mock import ZERO_HASH, compute_block_hash
+
+pytestmark = pytest.mark.smoke
+
+ATTRS = PayloadAttributes(
+    timestamp=1234, prev_randao=b"\x07" * 32,
+    suggested_fee_recipient=b"\x0a" * 20,
+)
+
+
+def test_mock_build_then_import_payload():
+    el = ExecutionEngineMock()
+    r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    assert r.status == ExecutePayloadStatus.VALID and r.payload_id
+    payload = el.get_payload(r.payload_id)
+    # payload ids are one-shot
+    with pytest.raises(ValueError):
+        el.get_payload(r.payload_id)
+    # the built payload imports as VALID and extends the tree
+    st = el.notify_new_payload(payload)
+    assert st.status == ExecutePayloadStatus.VALID
+    assert bytes(payload["block_hash"]) in el.valid_blocks
+    # fcU to the new head
+    r2 = el.notify_forkchoice_update(
+        payload["block_hash"], payload["block_hash"], ZERO_HASH
+    )
+    assert r2.status == ExecutePayloadStatus.VALID
+    assert el.head == bytes(payload["block_hash"])
+
+
+def test_mock_rejects_corrupt_hash_and_syncs_unknown_parent():
+    el = ExecutionEngineMock()
+    r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    payload = el.get_payload(r.payload_id)
+    bad = dict(payload, block_hash=b"\xff" * 32)
+    assert (
+        el.notify_new_payload(bad).status
+        == ExecutePayloadStatus.INVALID_BLOCK_HASH
+    )
+    orphan = dict(payload, parent_hash=b"\xee" * 32)
+    orphan["block_hash"] = compute_block_hash(orphan)
+    assert el.notify_new_payload(orphan).status == ExecutePayloadStatus.SYNCING
+    # fcU to an unknown head also reports SYNCING
+    assert (
+        el.notify_forkchoice_update(b"\xdd" * 32, ZERO_HASH, ZERO_HASH).status
+        == ExecutePayloadStatus.SYNCING
+    )
+
+
+def test_payload_ssz_roundtrip_from_mock():
+    el = ExecutionEngineMock()
+    r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    payload = el.get_payload(r.payload_id)
+    data = T.ExecutionPayload.serialize(payload)
+    back = T.ExecutionPayload.deserialize(data)
+    assert T.ExecutionPayload.serialize(back) == data
+    assert bytes(back["block_hash"]) == bytes(payload["block_hash"])
+
+
+def test_jwt_roundtrip_and_rejections():
+    import time
+
+    secret = b"\x42" * 32
+    tok = jwt_encode_hs256(secret, {"iat": int(time.time())})
+    assert "iat" in jwt_verify_hs256(secret, tok)
+    with pytest.raises(ValueError):
+        jwt_verify_hs256(b"\x43" * 32, tok)  # wrong secret
+    stale = jwt_encode_hs256(secret, {"iat": int(time.time()) - 3600})
+    with pytest.raises(ValueError):
+        jwt_verify_hs256(secret, stale)
+
+
+@pytest.fixture
+def wired():
+    secret = b"\x11" * 32
+    el = ExecutionEngineMock()
+    server = EngineApiServer(el, secret)
+    server.listen()
+    client = ExecutionEngineHttp(
+        f"http://127.0.0.1:{server.port}", secret, timeout=10
+    )
+    yield el, server, client
+    server.close()
+
+
+def test_http_client_full_flow(wired):
+    el, server, client = wired
+    r = client.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    assert r.status == ExecutePayloadStatus.VALID and r.payload_id
+    payload = client.get_payload(r.payload_id)
+    st = client.notify_new_payload(payload)
+    assert st.status == ExecutePayloadStatus.VALID
+    assert st.latest_valid_hash == "0x" + bytes(payload["block_hash"]).hex()
+    # errors surface as EngineHttpError (one-shot payload id)
+    with pytest.raises(EngineHttpError):
+        client.get_payload(r.payload_id)
+
+
+def test_http_rejects_bad_jwt(wired):
+    el, server, client = wired
+    bad = ExecutionEngineHttp(
+        f"http://127.0.0.1:{server.port}", b"\x99" * 32, timeout=10
+    )
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        bad.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH)
+
+
+def test_chain_execution_leg_optimistic_and_invalid():
+    """The chain-side payload leg, driven directly (altair bodies carry
+    no payload; this exercises the bellatrix-ready plumbing)."""
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"el-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    el = ExecutionEngineMock()
+    chain = BeaconChain(
+        cfg, create_genesis_state(cfg, pks, genesis_time=2), execution=el
+    )
+
+    def shell(slot, payload):
+        return {
+            "slot": slot,
+            "proposer_index": 0,
+            "parent_root": b"\x00" * 32,
+            "state_root": b"\x00" * 32,
+            "body": {
+                "randao_reveal": b"\x00" * 96,
+                "eth1_data": {
+                    "deposit_root": b"\x00" * 32,
+                    "deposit_count": 0,
+                    "block_hash": b"\x00" * 32,
+                },
+                "graffiti": b"\x00" * 32,
+                "proposer_slashings": [],
+                "attester_slashings": [],
+                "attestations": [],
+                "deposits": [],
+                "voluntary_exits": [],
+                "sync_aggregate": {
+                    "sync_committee_bits": [False] * 512,
+                    "sync_committee_signature": b"\x00" * 96,
+                },
+                "execution_payload": payload,
+            },
+        }
+
+    r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    payload = el.get_payload(r.payload_id)
+    block = shell(1, payload)
+    chain._verify_execution_payload(block)  # VALID: tracked, not optimistic
+    root = T.BeaconBlockAltair.hash_tree_root(block).hex()
+    assert root in chain._execution_block_hash
+    assert root not in chain.optimistic_roots
+
+    orphan = dict(payload, parent_hash=b"\xee" * 32)
+    orphan["block_hash"] = compute_block_hash(orphan)
+    block2 = shell(2, orphan)
+    chain._verify_execution_payload(block2)  # SYNCING: optimistic import
+    root2 = T.BeaconBlockAltair.hash_tree_root(block2).hex()
+    assert root2 in chain.optimistic_roots
+
+    bad = dict(payload, block_hash=b"\xff" * 32)
+    with pytest.raises(ValueError):
+        chain._verify_execution_payload(shell(3, bad))

@@ -150,7 +150,13 @@ def test_operations_vectors():
             op = typ.deserialize(read_ssz_snappy(case_dir, op_name))
             post_bytes = maybe_read_ssz_snappy(case_dir, "post")
             if post_bytes is None:
-                with pytest.raises(Exception):
+                # the op must fail with the STF's own validation error —
+                # an unrelated crash (TypeError etc.) must NOT pass
+                from lodestar_tpu.state_transition.block import (
+                    BlockProcessError,
+                )
+
+                with pytest.raises(BlockProcessError):
                     fn(pre, op, True)
             else:
                 fn(pre, op, True)
