@@ -112,6 +112,15 @@ class ApiClient:
             for d in data
         ]
 
+    def get_liveness(self, epoch: int, indices: list) -> dict:
+        """{validator index -> live?} (the doppelganger probe)."""
+        data = self._request(
+            "POST",
+            f"/eth/v1/validator/liveness/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+        return {int(d["index"]): bool(d["is_live"]) for d in data}
+
     def get_attester_duties(self, epoch: int, indices: list) -> list:
         data = self._request(
             "POST",

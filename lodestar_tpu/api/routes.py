@@ -60,6 +60,7 @@ ROUTES: Tuple[Route, ...] = (
         "POST", "/eth/v1/validator/duties/attester/{epoch}", "get_attester_duties"
     ),
     Route("POST", "/eth/v1/validator/duties/sync/{epoch}", "get_sync_duties"),
+    Route("POST", "/eth/v1/validator/liveness/{epoch}", "get_liveness"),
     Route("GET", "/eth/v1/validator/attestation_data", "produce_attestation_data"),
     Route(
         "GET", "/eth/v1/validator/aggregate_attestation", "get_aggregate_attestation"

@@ -120,6 +120,34 @@ class DefaultHandlers:
             ]
         }
 
+    def get_liveness(self, params, body):
+        """Per-validator liveness for an epoch, from head-state epoch
+        participation (reference: routes/validator.ts getLiveness,
+        consumed by the doppelganger service)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from ..state_transition.util import compute_epoch_at_slot
+
+        epoch = int(params["epoch"])
+        indices = [int(i) for i in (body or [])]
+        head = self.chain.head_state
+        head_epoch = compute_epoch_at_slot(head.slot)
+        if epoch == head_epoch:
+            participation = head.current_epoch_participation
+        elif epoch == head_epoch - 1:
+            participation = head.previous_epoch_participation
+        else:
+            return 400, {
+                "message": f"liveness only for epochs {head_epoch - 1}..."
+                f"{head_epoch} (requested {epoch})"
+            }
+        data = []
+        for i in indices:
+            live = 0 <= i < head.num_validators and int(participation[i]) != 0
+            data.append({"index": str(i), "is_live": bool(live)})
+        return 200, {"data": data}
+
     def get_attester_duties(self, params, body):
         err = self._need_chain()
         if err:

@@ -129,8 +129,11 @@ class BeaconChain:
         # Execution-payload leg: runs alongside signatures + the state
         # transition (reference: chain/blocks/verifyBlock.ts:87-104
         # Promise.all).  Altair bodies carry no payload, so this leg is
-        # a no-op until the bellatrix types flow through.
-        self._verify_execution_payload(block, root.hex())
+        # a no-op until the bellatrix types flow through.  Bookkeeping
+        # (_execution_block_hash / optimistic_roots) is recorded only
+        # AFTER the whole import lands, so invalid-block spam cannot
+        # grow the maps.
+        exec_result = self._verify_execution_payload(block)
 
         if self.bls is not None:
             ok = self._verify_signatures_batched(pre_state, signed_block)
@@ -162,6 +165,11 @@ class BeaconChain:
         )
         # clock surrogate: a block at a later slot clears any stale boost
         self.fork_choice.set_current_slot(int(block["slot"]))
+        if exec_result is not None:
+            block_hash, optimistic = exec_result
+            self._execution_block_hash[root.hex()] = block_hash
+            if optimistic:
+                self.optimistic_roots.add(root.hex())
         if timely:
             self.fork_choice.on_timely_block(root.hex(), int(block["slot"]))
         self.regen.on_imported_block(root, post)
@@ -215,24 +223,24 @@ class BeaconChain:
         self._notify_forkchoice()
         return root
 
-    def _verify_execution_payload(
-        self, block: dict, root_hex: Optional[str] = None
-    ) -> None:
+    def _verify_execution_payload(self, block: dict):
         """The third verification leg (reference: verifyBlock.ts
         verifyBlocksExecutionPayload -> engine notifyNewPayload).
 
-        VALID -> proceed; SYNCING/ACCEPTED -> optimistic import (the
-        root is tracked and the head stays execution-unverified until
-        the EL catches up); INVALID -> the block is invalid; an EL
-        outage (ELERROR/UNAVAILABLE or a transport failure) is
-        RETRYABLE — surfaced as ExecutionEngineUnavailable, never as
-        block invalidity (the gossip layer IGNOREs it)."""
+        Returns None for payload-less blocks, else
+        (block_hash, optimistic) — the CALLER records the bookkeeping
+        after the whole import succeeds, so failed imports leave no
+        residue.  VALID -> optimistic=False; SYNCING/ACCEPTED ->
+        optimistic=True; INVALID -> the block is invalid; an EL outage
+        (ELERROR/UNAVAILABLE or a transport failure) is RETRYABLE —
+        surfaced as ExecutionEngineUnavailable, never as block
+        invalidity (the gossip layer IGNOREs it)."""
         body = block.get("body", {})
         payload = (
             body.get("execution_payload") if isinstance(body, dict) else None
         )
         if payload is None:
-            return
+            return None
         if self.execution is None:
             raise ValueError("execution payload present but no engine wired")
         from ..execution import (
@@ -240,8 +248,6 @@ class BeaconChain:
             ExecutionEngineUnavailable,
         )
 
-        if root_hex is None:
-            root_hex = BeaconBlockAltair.hash_tree_root(block).hex()
         try:
             st = self.execution.notify_new_payload(payload)
         except ExecutionEngineUnavailable:
@@ -249,30 +255,23 @@ class BeaconChain:
         except Exception as e:  # transport failure = outage, retryable
             raise ExecutionEngineUnavailable(str(e))
         if st.status == ExecutePayloadStatus.VALID:
-            self._execution_block_hash[root_hex] = bytes(
-                payload["block_hash"]
-            )
-            self.optimistic_roots.discard(root_hex)
-        elif st.status in (
+            return bytes(payload["block_hash"]), False
+        if st.status in (
             ExecutePayloadStatus.SYNCING,
             ExecutePayloadStatus.ACCEPTED,
         ):
-            self._execution_block_hash[root_hex] = bytes(
-                payload["block_hash"]
-            )
-            self.optimistic_roots.add(root_hex)
-        elif st.status in (
+            return bytes(payload["block_hash"]), True
+        if st.status in (
             ExecutePayloadStatus.ELERROR,
             ExecutePayloadStatus.UNAVAILABLE,
         ):
             raise ExecutionEngineUnavailable(
                 f"EL outage: {st.status.value} ({st.validation_error})"
             )
-        else:
-            raise ValueError(
-                f"execution payload rejected: {st.status.value} "
-                f"({st.validation_error})"
-            )
+        raise ValueError(
+            f"execution payload rejected: {st.status.value} "
+            f"({st.validation_error})"
+        )
 
     def _notify_forkchoice(self) -> None:
         """Push the beacon head to the EL after head updates (reference:

@@ -45,6 +45,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help="interop validator indices to run (keys derived as in dev mode)",
     )
     validator.add_argument("--slots", type=int, default=1)
+    validator.add_argument(
+        "--slashing-db-path", default=None,
+        help="durable slashing-protection DB (survives restarts)",
+    )
+    validator.add_argument(
+        "--doppelganger-protection", action="store_true",
+        help="delay duties until keys prove silent on the network",
+    )
 
     bench = sub.add_parser("bench", help="run the headline TPU benchmark")
     bench.add_argument("--mode", default="wire", choices=["wire", "decoded"])
@@ -177,13 +185,46 @@ def cmd_validator(args) -> int:
     client = ApiClient(args.beacon_urls, timeout=120)
     genesis = client.get_genesis()
     sks, _pks = _interop_keys(max(args.interop_indices) + 1)
+    doppelganger = None
+    if args.doppelganger_protection:
+        from .validator import DoppelgangerService
+
+        genesis_time = int(genesis["genesis_time"])
+
+        def _wall_epoch() -> int:
+            return max(
+                0,
+                int(time.time() - genesis_time)
+                // (_p.SECONDS_PER_SLOT * _p.SLOTS_PER_EPOCH),
+            )
+
+        def _liveness(epoch, indices):
+            # a probe failure means "cannot verify yet" — the epoch must
+            # not count toward the watch window (None = no data)
+            try:
+                return client.get_liveness(epoch, indices)
+            except Exception as e:  # noqa: BLE001 - probe is best-effort
+                print(json.dumps({"doppelganger_probe_error": str(e)}))
+                return None
+
+        doppelganger = DoppelgangerService(
+            liveness_fn=_liveness,
+            current_epoch_fn=_wall_epoch,
+        )
     store = ValidatorStore(
-        MAINNET_CHAIN_CONFIG, {i: sks[i] for i in args.interop_indices}
+        MAINNET_CHAIN_CONFIG,
+        {i: sks[i] for i in args.interop_indices},
+        slashing_db_path=args.slashing_db_path,
+        doppelganger=doppelganger,
     )
     blocks = BlockProposalService(store, client)
     atts = AttestationService(store, client)
+    last_epoch = -1
     for slot in range(1, args.slots + 1):
         epoch = slot // _p.SLOTS_PER_EPOCH
+        if doppelganger is not None and epoch != last_epoch:
+            doppelganger.on_epoch(epoch)
+            last_epoch = epoch
         blocks.poll_duties(epoch)
         atts.poll_duties(epoch)
         proposed = blocks.run_block_tasks(epoch, slot)

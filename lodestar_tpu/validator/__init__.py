@@ -9,6 +9,12 @@ duties and produces/signs/submits attestations through the REST client
 """
 
 from .store import SlashingProtection, SlashingError, ValidatorStore  # noqa: F401
+from .doppelganger import (  # noqa: F401
+    DoppelgangerDetected,
+    DoppelgangerService,
+    DoppelgangerStatus,
+    DoppelgangerUnverified,
+)
 from .attestation_service import AttestationService  # noqa: F401
 from .block_service import BlockProposalService  # noqa: F401
 from .sync_committee_service import (  # noqa: F401

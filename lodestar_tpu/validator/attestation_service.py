@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence
 from .. import params
 from ..types import AttestationData
 from ..utils.logger import get_logger
+from .doppelganger import DoppelgangerUnverified
 from .store import SlashingError, ValidatorStore
 
 
@@ -71,6 +72,11 @@ class AttestationService:
             data = produced[ci]
             try:
                 sig = self.store.sign_attestation(duty["validator_index"], data)
+            except DoppelgangerUnverified as e:
+                self.log.info(
+                    "duty delayed: doppelganger watch", reason=str(e)
+                )
+                continue
             except SlashingError as e:
                 self.skipped_slashable += 1
                 self.log.warn(

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..utils.logger import get_logger
+from .doppelganger import DoppelgangerUnverified
 from .store import SlashingError, ValidatorStore
 
 
@@ -48,6 +49,11 @@ class BlockProposalService:
             )
             try:
                 signature = self.store.sign_block(vindex, block)
+            except DoppelgangerUnverified as e:
+                self.log.info(
+                    "duty delayed: doppelganger watch", reason=str(e)
+                )
+                continue
             except SlashingError as e:
                 self.skipped_slashable += 1
                 self.log.warn(

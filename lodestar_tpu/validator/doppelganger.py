@@ -1,0 +1,138 @@
+"""DoppelgangerService — detect a second validator running our keys.
+
+Mirror of the reference (reference:
+packages/validator/src/services/doppelgangerService.ts:1-264): when a
+key is registered, signing is BLOCKED until the network has been
+observed for DEFAULT_REMAINING_EPOCHS full epochs with no liveness
+signal from that validator.  Any liveness hit during the watch window
+means another instance is signing with our key — the only safe move is
+to never sign (the reference triggers process shutdown).
+
+Liveness is an injected probe (epoch, indices) -> {index: bool}; live
+compositions back it with the beacon API's liveness endpoint
+(`/eth/v1/validator/liveness/{epoch}`), which reads epoch participation
+from the head state.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..utils.logger import get_logger
+
+# epochs of observed silence required before a key may sign
+# (reference: doppelgangerService.ts DEFAULT_REMAINING_EPOCHS = 1, plus
+# the registration epoch itself is never checked; we watch 2 full
+# epochs to cover the attestation inclusion tail)
+DEFAULT_REMAINING_EPOCHS = 2
+
+
+class DoppelgangerStatus(str, enum.Enum):
+    UNVERIFIED = "unverified"  # still in the watch window: no signing
+    VERIFIED = "verified"  # silence observed: safe to sign
+    DETECTED = "detected"  # another instance is live: NEVER sign
+
+
+class DoppelgangerDetected(Exception):
+    pass
+
+
+class DoppelgangerUnverified(Exception):
+    pass
+
+
+@dataclass
+class _KeyState:
+    registered_epoch: int
+    remaining_epochs: int
+    status: DoppelgangerStatus
+
+
+class DoppelgangerService:
+    def __init__(
+        self,
+        liveness_fn: Callable[[int, List[int]], Dict[int, bool]],
+        current_epoch_fn: Callable[[], int],
+        remaining_epochs: int = DEFAULT_REMAINING_EPOCHS,
+        on_detected: Optional[Callable[[List[int]], None]] = None,
+    ):
+        self.liveness_fn = liveness_fn
+        self.current_epoch_fn = current_epoch_fn
+        self.remaining_epochs = remaining_epochs
+        self.on_detected = on_detected
+        self.log = get_logger("validator/doppelganger")
+        self._keys: Dict[int, _KeyState] = {}
+
+    def register(self, validator_index: int) -> None:
+        if validator_index in self._keys:
+            return
+        self._keys[validator_index] = _KeyState(
+            registered_epoch=self.current_epoch_fn(),
+            remaining_epochs=self.remaining_epochs,
+            status=(
+                DoppelgangerStatus.UNVERIFIED
+                if self.remaining_epochs > 0
+                else DoppelgangerStatus.VERIFIED
+            ),
+        )
+
+    def status(self, validator_index: int) -> DoppelgangerStatus:
+        st = self._keys.get(validator_index)
+        return st.status if st else DoppelgangerStatus.VERIFIED
+
+    def assert_safe(self, validator_index: int) -> None:
+        st = self.status(validator_index)
+        if st == DoppelgangerStatus.DETECTED:
+            raise DoppelgangerDetected(
+                f"validator {validator_index}: another instance is signing "
+                "with this key — refusing to sign, forever"
+            )
+        if st == DoppelgangerStatus.UNVERIFIED:
+            raise DoppelgangerUnverified(
+                f"validator {validator_index} still in the doppelganger "
+                "watch window"
+            )
+
+    def detected_indices(self) -> List[int]:
+        return [
+            i
+            for i, st in self._keys.items()
+            if st.status == DoppelgangerStatus.DETECTED
+        ]
+
+    def on_epoch(self, epoch: int) -> None:
+        """Run at each epoch boundary: probe liveness of the PREVIOUS
+        epoch for every unverified key (the registration epoch itself
+        never counts — our own pre-shutdown duties could be in it)."""
+        watching = [
+            i
+            for i, st in self._keys.items()
+            if st.status == DoppelgangerStatus.UNVERIFIED
+            # probe only epochs strictly AFTER the registration epoch:
+            # our own pre-restart duties in the registration epoch must
+            # never read as a doppelganger (epoch-1 is what we probe)
+            and epoch - 1 > st.registered_epoch
+        ]
+        if not watching:
+            return
+        live = self.liveness_fn(epoch - 1, watching)
+        if live is None:
+            # probe unavailable: the epoch does NOT count toward the
+            # watch window — silence must be OBSERVED, not assumed
+            return
+        detected = [i for i in watching if live.get(i)]
+        for i in detected:
+            self._keys[i].status = DoppelgangerStatus.DETECTED
+            self.log.warn("DOPPELGANGER DETECTED", validator=i)
+        if detected and self.on_detected is not None:
+            self.on_detected(detected)
+        for i in watching:
+            st = self._keys[i]
+            if st.status != DoppelgangerStatus.UNVERIFIED:
+                continue
+            st.remaining_epochs -= 1
+            if st.remaining_epochs <= 0:
+                st.status = DoppelgangerStatus.VERIFIED
+                self.log.info("doppelganger watch complete", validator=i)

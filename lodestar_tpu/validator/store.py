@@ -36,11 +36,57 @@ class SlashingProtection:
     /block/): target strictly increases, source never decreases
     (prevents double + surround votes under the min/max simplification),
     proposal slots strictly increase.
+
+    DURABILITY: pass `db_path` (or an open KvController via `kv`) and
+    every signed record is written through to the WAL-backed kvstore
+    before the signature is released, so a restarted validator cannot
+    double-sign (the reference's slashing-protection DB is repo-backed
+    the same way — validator/src/slashingProtection/ over @lodestar/db).
     """
 
-    def __init__(self):
+    _ATT_PREFIX = b"sp:att:"
+    _BLK_PREFIX = b"sp:blk:"
+
+    def __init__(self, db_path: Optional[str] = None, kv=None):
         self._atts: Dict[bytes, _AttRecord] = {}
         self._blocks: Dict[bytes, int] = {}
+        self._kv = kv
+        if db_path is not None and kv is None:
+            from ..db.controller import KvController
+
+            self._kv = KvController(db_path)
+        if self._kv is not None:
+            self._load()
+
+    def _load(self) -> None:
+        for key, value in self._kv.entries(
+            gte=self._ATT_PREFIX, lt=self._ATT_PREFIX + b"\xff"
+        ):
+            src, tgt = value.decode().split(",")
+            self._atts[key[len(self._ATT_PREFIX):]] = _AttRecord(
+                int(src), int(tgt)
+            )
+        for key, value in self._kv.entries(
+            gte=self._BLK_PREFIX, lt=self._BLK_PREFIX + b"\xff"
+        ):
+            self._blocks[key[len(self._BLK_PREFIX):]] = int(value)
+
+    def _persist_att(self, pubkey: bytes, rec: "_AttRecord") -> None:
+        if self._kv is not None:
+            self._kv.put(
+                self._ATT_PREFIX + pubkey,
+                f"{rec.source},{rec.target}".encode(),
+            )
+            self._kv.flush()
+
+    def _persist_blk(self, pubkey: bytes, slot: int) -> None:
+        if self._kv is not None:
+            self._kv.put(self._BLK_PREFIX + pubkey, str(slot).encode())
+            self._kv.flush()
+
+    def close(self) -> None:
+        if self._kv is not None:
+            self._kv.close()
 
     def check_attestation(self, pubkey: bytes, source: int, target: int) -> None:
         if source > target:
@@ -55,13 +101,16 @@ class SlashingProtection:
                 raise SlashingError(
                     f"surround vote: source {source} < signed {rec.source}"
                 )
-        self._atts[pubkey] = _AttRecord(source, target)
+        new_rec = _AttRecord(source, target)
+        self._atts[pubkey] = new_rec
+        self._persist_att(pubkey, new_rec)
 
     def check_block(self, pubkey: bytes, slot: int) -> None:
         prev = self._blocks.get(pubkey)
         if prev is not None and slot <= prev:
             raise SlashingError(f"double proposal: slot {slot} <= {prev}")
         self._blocks[pubkey] = slot
+        self._persist_blk(pubkey, slot)
 
     # EIP-3076 interchange (reference: slashingProtection/interchange/)
     def export_interchange(self) -> dict:
@@ -101,27 +150,50 @@ class SlashingProtection:
                 rec = self._atts.get(pk)
                 src, tgt = int(att["source_epoch"]), int(att["target_epoch"])
                 if rec is None or tgt > rec.target:
-                    self._atts[pk] = _AttRecord(
+                    new_rec = _AttRecord(
                         max(src, rec.source if rec else 0), tgt
                     )
+                    self._atts[pk] = new_rec
+                    self._persist_att(pk, new_rec)
             for blk in entry.get("signed_blocks", []):
                 slot = int(blk["slot"])
                 if slot > self._blocks.get(pk, -1):
                     self._blocks[pk] = slot
+                    self._persist_blk(pk, slot)
 
 
 class ValidatorStore:
-    """Signing duties for a set of local keypairs."""
+    """Signing duties for a set of local keypairs.
 
-    def __init__(self, config: ChainConfig, secret_keys: Dict[int, int]):
+    `slashing_db_path` makes the slashing protection durable across
+    restarts; `doppelganger` (a DoppelgangerService) blocks every
+    signing entry point until its keys have proven unique on the
+    network (reference: services/doppelgangerService.ts)."""
+
+    def __init__(
+        self,
+        config: ChainConfig,
+        secret_keys: Dict[int, int],
+        slashing_db_path: Optional[str] = None,
+        doppelganger=None,
+    ):
         self.config = config
         self.sks = dict(secret_keys)  # validator index -> sk
         self.pubkeys = {
             i: C.g1_compress(B.sk_to_pk(sk)) for i, sk in self.sks.items()
         }
-        self.slashing = SlashingProtection()
+        self.slashing = SlashingProtection(db_path=slashing_db_path)
+        self.doppelganger = doppelganger
+        if doppelganger is not None:
+            for i in self.sks:
+                doppelganger.register(i)
+
+    def _check_doppelganger(self, validator_index: int) -> None:
+        if self.doppelganger is not None:
+            self.doppelganger.assert_safe(validator_index)
 
     def sign_attestation(self, validator_index: int, data: dict) -> bytes:
+        self._check_doppelganger(validator_index)
         pk = self.pubkeys[validator_index]
         self.slashing.check_attestation(
             pk, data["source"]["epoch"], data["target"]["epoch"]
@@ -134,6 +206,7 @@ class ValidatorStore:
         return C.g2_compress(B.sign(self.sks[validator_index], root))
 
     def sign_block(self, validator_index: int, block: dict) -> bytes:
+        self._check_doppelganger(validator_index)
         pk = self.pubkeys[validator_index]
         self.slashing.check_block(pk, block["slot"])
         root = self.config.compute_signing_root(

@@ -178,19 +178,34 @@ def test_chain_execution_leg_optimistic_and_invalid():
 
     r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
     payload = el.get_payload(r.payload_id)
-    block = shell(1, payload)
-    chain._verify_execution_payload(block)  # VALID: tracked, not optimistic
-    root = T.BeaconBlockAltair.hash_tree_root(block).hex()
-    assert root in chain._execution_block_hash
-    assert root not in chain.optimistic_roots
+    # VALID payload -> (hash, optimistic=False); the CALLER records the
+    # bookkeeping only after a full successful import
+    assert chain._verify_execution_payload(shell(1, payload)) == (
+        bytes(payload["block_hash"]),
+        False,
+    )
+    assert not chain._execution_block_hash  # no residue pre-import
 
     orphan = dict(payload, parent_hash=b"\xee" * 32)
     orphan["block_hash"] = compute_block_hash(orphan)
-    block2 = shell(2, orphan)
-    chain._verify_execution_payload(block2)  # SYNCING: optimistic import
-    root2 = T.BeaconBlockAltair.hash_tree_root(block2).hex()
-    assert root2 in chain.optimistic_roots
+    # SYNCING -> optimistic=True
+    assert chain._verify_execution_payload(shell(2, orphan)) == (
+        bytes(orphan["block_hash"]),
+        True,
+    )
 
     bad = dict(payload, block_hash=b"\xff" * 32)
     with pytest.raises(ValueError):
         chain._verify_execution_payload(shell(3, bad))
+
+    # EL outage is retryable, never invalidity
+    from lodestar_tpu.execution import ExecutionEngineUnavailable
+
+    el.fail_with = ExecutePayloadStatus.UNAVAILABLE
+    with pytest.raises(ExecutionEngineUnavailable):
+        chain._verify_execution_payload(shell(4, payload))
+    el.fail_with = None
+    # payload-less (altair) blocks are a no-op
+    no_payload = shell(5, payload)
+    del no_payload["body"]["execution_payload"]
+    assert chain._verify_execution_payload(no_payload) is None

@@ -1,0 +1,200 @@
+"""Durable slashing protection + doppelganger protection.
+
+Reference behaviors: packages/validator/src/slashingProtection/
+(repo-backed records, EIP-3076 interchange) and
+services/doppelgangerService.ts (watch-window liveness gate).
+"""
+
+import os
+
+import pytest
+
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.validator import (
+    DoppelgangerDetected,
+    DoppelgangerService,
+    DoppelgangerStatus,
+    DoppelgangerUnverified,
+    SlashingError,
+    SlashingProtection,
+    ValidatorStore,
+)
+
+pytestmark = pytest.mark.smoke
+
+DATA1 = {
+    "slot": 1,
+    "index": 0,
+    "beacon_block_root": b"\x01" * 32,
+    "source": {"epoch": 0, "root": b"\x00" * 32},
+    "target": {"epoch": 1, "root": b"\x02" * 32},
+}
+DATA2 = dict(DATA1, beacon_block_root=b"\x03" * 32)  # same target, new root
+
+
+def test_slashing_protection_survives_restart(tmp_path):
+    """THE restart test: a double-sign attempt after process restart
+    must be blocked by the on-disk records."""
+    db = os.path.join(str(tmp_path), "slashing.db")
+    sks = {0: B.keygen(b"safety-0")}
+
+    store = ValidatorStore(MAINNET_CHAIN_CONFIG, sks, slashing_db_path=db)
+    store.sign_attestation(0, DATA1)
+    store.sign_block(0, {"slot": 5, "proposer_index": 0,
+                         "parent_root": b"\x00" * 32,
+                         "state_root": b"\x00" * 32,
+                         "body": None} | _block_body())
+    store.slashing.close()
+
+    # "restart": a fresh process loads the same DB
+    store2 = ValidatorStore(MAINNET_CHAIN_CONFIG, sks, slashing_db_path=db)
+    with pytest.raises(SlashingError):
+        store2.sign_attestation(0, DATA2)  # double vote at target 1
+    with pytest.raises(SlashingError):
+        store2.sign_block(0, {"slot": 5, "proposer_index": 0,
+                              "parent_root": b"\x00" * 32,
+                              "state_root": b"\x00" * 32} | _block_body())
+    # moving forward is still allowed
+    store2.sign_attestation(
+        0, dict(DATA1, target={"epoch": 2, "root": b"\x04" * 32})
+    )
+    store2.slashing.close()
+
+
+def _block_body():
+    return {
+        "body": {
+            "randao_reveal": b"\x00" * 96,
+            "eth1_data": {
+                "deposit_root": b"\x00" * 32,
+                "deposit_count": 0,
+                "block_hash": b"\x00" * 32,
+            },
+            "graffiti": b"\x00" * 32,
+            "proposer_slashings": [],
+            "attester_slashings": [],
+            "attestations": [],
+            "deposits": [],
+            "voluntary_exits": [],
+            "sync_aggregate": {
+                "sync_committee_bits": [False] * 512,
+                "sync_committee_signature": b"\x00" * 96,
+            },
+        }
+    }
+
+
+def test_interchange_roundtrip_persists(tmp_path):
+    db1 = os.path.join(str(tmp_path), "a.db")
+    db2 = os.path.join(str(tmp_path), "b.db")
+    sp1 = SlashingProtection(db_path=db1)
+    sp1.check_attestation(b"\xaa" * 48, 3, 7)
+    sp1.check_block(b"\xaa" * 48, 42)
+    exported = sp1.export_interchange()
+    sp1.close()
+
+    sp2 = SlashingProtection(db_path=db2)
+    sp2.import_interchange(exported)
+    sp2.close()
+    sp3 = SlashingProtection(db_path=db2)  # reload from disk
+    with pytest.raises(SlashingError):
+        sp3.check_attestation(b"\xaa" * 48, 3, 7)  # same target
+    with pytest.raises(SlashingError):
+        sp3.check_block(b"\xaa" * 48, 42)
+    sp3.close()
+
+
+def test_doppelganger_state_machine():
+    live: dict = {}
+    epoch = [10]
+    detected_cb = []
+    svc = DoppelgangerService(
+        liveness_fn=lambda ep, idx: {i: live.get((ep, i), False) for i in idx},
+        current_epoch_fn=lambda: epoch[0],
+        on_detected=detected_cb.append,
+    )
+    svc.register(1)
+    assert svc.status(1) == DoppelgangerStatus.UNVERIFIED
+    with pytest.raises(DoppelgangerUnverified):
+        svc.assert_safe(1)
+    # the registration epoch itself never counts (our own pre-restart
+    # duties live there); then two observed-silent epochs -> verified
+    svc.on_epoch(11)  # would probe epoch 10 = registration: skipped
+    assert svc.status(1) == DoppelgangerStatus.UNVERIFIED
+    svc.on_epoch(12)  # probes epoch 11: silent
+    assert svc.status(1) == DoppelgangerStatus.UNVERIFIED
+    svc.on_epoch(13)  # probes epoch 12: silent -> verified
+    assert svc.status(1) == DoppelgangerStatus.VERIFIED
+    svc.assert_safe(1)  # no raise
+    # a probe outage must NOT count as observed silence
+    svc2 = DoppelgangerService(
+        liveness_fn=lambda ep, idx: None,
+        current_epoch_fn=lambda: 0,
+    )
+    svc2.register(9)
+    svc2.on_epoch(2)
+    svc2.on_epoch(3)
+    svc2.on_epoch(4)
+    assert svc2.status(9) == DoppelgangerStatus.UNVERIFIED
+
+    # a second key sees liveness -> DETECTED forever
+    svc.register(2)
+    live[(11, 2)] = True  # our key attested at epoch 11 (not by us!)
+    svc.on_epoch(12)  # probes epoch 11 (> registration epoch 10)
+    assert svc.status(2) == DoppelgangerStatus.DETECTED
+    assert detected_cb == [[2]]
+    with pytest.raises(DoppelgangerDetected):
+        svc.assert_safe(2)
+    # detection is permanent, no matter how many silent epochs follow
+    svc.on_epoch(13)
+    svc.on_epoch(14)
+    with pytest.raises(DoppelgangerDetected):
+        svc.assert_safe(2)
+
+
+def test_doppelganger_blocks_store_signing():
+    svc = DoppelgangerService(
+        liveness_fn=lambda ep, idx: {},
+        current_epoch_fn=lambda: 0,
+    )
+    store = ValidatorStore(
+        MAINNET_CHAIN_CONFIG, {0: B.keygen(b"dopp-0")}, doppelganger=svc
+    )
+    with pytest.raises(DoppelgangerUnverified):
+        store.sign_attestation(0, DATA1)
+    svc.on_epoch(1)  # registration epoch: skipped
+    svc.on_epoch(2)
+    svc.on_epoch(3)
+    store.sign_attestation(0, DATA1)  # verified now
+
+
+def test_liveness_endpoint_and_client():
+    """The doppelganger probe over the real REST wire."""
+    from lodestar_tpu import params
+    from lodestar_tpu.api.client import ApiClient
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import create_chain_config
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"lv-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    chain = BeaconChain(cfg, genesis)
+    chain.head_state.current_epoch_participation[1] = 0b111  # index 1 live
+    server = BeaconApiServer(
+        DefaultHandlers(genesis_time=2, chain=chain), port=0
+    )
+    server.listen()
+    try:
+        client = ApiClient([f"http://127.0.0.1:{server.port}"], timeout=30)
+        live = client.get_liveness(0, [0, 1, 2])
+        assert live == {0: False, 1: True, 2: False}
+    finally:
+        server.close()
