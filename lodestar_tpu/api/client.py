@@ -112,6 +112,13 @@ class ApiClient:
             for d in data
         ]
 
+    def get_debug_state(self, state_id: str = "finalized") -> bytes:
+        """Full SSZ state bytes (the checkpoint-sync source)."""
+        reply = self._request(
+            "GET", f"/eth/v2/debug/beacon/states/{state_id}"
+        )
+        return bytes.fromhex(reply["data"][2:])
+
     def get_liveness(self, epoch: int, indices: list) -> dict:
         """{validator index -> live?} (the doppelganger probe)."""
         data = self._request(

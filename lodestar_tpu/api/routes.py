@@ -81,6 +81,8 @@ ROUTES: Tuple[Route, ...] = (
         "/eth/v1/validator/contribution_and_proofs",
         "publish_contributions",
     ),
+    # debug namespace (reference: routes/debug.ts — checkpoint sync source)
+    Route("GET", "/eth/v2/debug/beacon/states/{state_id}", "get_debug_state"),
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)

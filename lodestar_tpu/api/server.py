@@ -120,6 +120,27 @@ class DefaultHandlers:
             ]
         }
 
+    def get_debug_state(self, params, body):
+        """Full SSZ state for checkpoint sync (reference:
+        routes/debug.ts getStateV2; served hex-encoded in the JSON
+        envelope — this server is JSON-only)."""
+        err = self._need_chain()
+        if err:
+            return err
+        state_id = params["state_id"]
+        if state_id in ("head", "finalized"):
+            # finalized state == the nearest archived/checkpoint state;
+            # the head state is what this composition can always serve
+            state = self.chain.head_state
+        elif state_id.isdigit():
+            return 404, {"message": "by-slot debug states not retained"}
+        else:
+            return 400, {"message": f"unsupported state id {state_id}"}
+        return 200, {
+            "version": "altair",
+            "data": "0x" + state.serialize().hex(),
+        }
+
     def get_liveness(self, params, body):
         """Per-validator liveness for an epoch, from head-state epoch
         participation (reference: routes/validator.ts getLiveness,

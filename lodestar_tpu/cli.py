@@ -37,6 +37,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "--slots", type=int, default=0,
         help="dev mode: self-propose this many slots then exit (0 = serve forever)",
     )
+    beacon.add_argument(
+        "--checkpoint-sync-url", default=None,
+        help="bootstrap from a trusted node's debug state endpoint "
+        "(weak-subjectivity checkpoint sync)",
+    )
+    beacon.add_argument(
+        "--checkpoint-state", default=None,
+        help="bootstrap from an SSZ state file",
+    )
 
     validator = sub.add_parser("validator", help="run a validator client")
     validator.add_argument("--beacon-urls", nargs="+", required=True)
@@ -98,6 +107,7 @@ def _dev_config(genesis_time=0):
 
 def _dev_chain(args):
     from .chain.chain import BeaconChain
+    from .chain.init_state import init_beacon_state
     from .db import BeaconDb
     from .state_transition import create_genesis_state
 
@@ -107,10 +117,31 @@ def _dev_chain(args):
         else int(time.time())
     )
     sks, pks = _interop_keys(args.validators)
-    genesis = create_genesis_state(
-        cfg, pks, genesis_time=cfg.genesis_time
+    db = BeaconDb(args.db_path)
+    ckpt_bytes = None
+    ckpt_file = getattr(args, "checkpoint_state", None)
+    if ckpt_file:
+        with open(ckpt_file, "rb") as f:
+            ckpt_bytes = f.read()
+    anchor, source = init_beacon_state(
+        cfg,
+        db=db if args.db_path else None,  # in-memory db has no archive
+        checkpoint_state_bytes=ckpt_bytes,
+        checkpoint_sync_url=getattr(args, "checkpoint_sync_url", None),
+        genesis_fn=lambda: create_genesis_state(
+            cfg, pks, genesis_time=cfg.genesis_time
+        ),
     )
-    chain = BeaconChain(cfg, genesis, db=BeaconDb(args.db_path))
+    if source != "genesis" and int(anchor.genesis_time) != cfg.genesis_time:
+        # a resumed/checkpoint chain OWNS its genesis time — the wall
+        # clock must not reinvent slot 0 (slot clock + doppelganger +
+        # /beacon/genesis all derive from it)
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, genesis_time=int(anchor.genesis_time))
+        anchor.config = cfg
+    print(json.dumps({"anchor_source": source, "anchor_slot": anchor.slot}))
+    chain = BeaconChain(cfg, anchor, db=db)
     return cfg, sks, pks, chain
 
 
@@ -219,12 +250,16 @@ def cmd_validator(args) -> int:
     )
     blocks = BlockProposalService(store, client)
     atts = AttestationService(store, client)
-    last_epoch = -1
+    last_wall_epoch = -1
     for slot in range(1, args.slots + 1):
         epoch = slot // _p.SLOTS_PER_EPOCH
-        if doppelganger is not None and epoch != last_epoch:
-            doppelganger.on_epoch(epoch)
-            last_epoch = epoch
+        if doppelganger is not None:
+            # the watch window lives in WALL-CLOCK epochs (the same
+            # domain keys were registered in) — never the loop counter
+            we = doppelganger.current_epoch_fn()
+            if we != last_wall_epoch:
+                doppelganger.on_epoch(we)
+                last_wall_epoch = we
         blocks.poll_duties(epoch)
         atts.poll_duties(epoch)
         proposed = blocks.run_block_tasks(epoch, slot)

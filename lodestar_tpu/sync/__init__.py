@@ -8,6 +8,7 @@ P9); block sources are injected callables with the reqresp shapes
 (get_blocks_by_range(start_slot, count), get_blocks_by_root(roots)).
 """
 
+from .backfill import ApiBlockSource, BackfillError, BackfillSync  # noqa: F401
 from .range_sync import (  # noqa: F401
     BlockSource,
     RangeSync,

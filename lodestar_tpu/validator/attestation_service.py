@@ -117,7 +117,10 @@ class AttestationService:
             data = self._produced_data.get((slot, duty["committee_index"]))
             if data is None:
                 continue
-            proof = self.store.sign_selection_proof(vindex, slot)
+            try:
+                proof = self.store.sign_selection_proof(vindex, slot)
+            except DoppelgangerUnverified:
+                continue  # no duty publishes during the watch window
             if not is_aggregator(duty.get("committee_length", 1), proof):
                 continue
             aggregate = self.api.get_aggregate_attestation(

@@ -58,16 +58,22 @@ class SlashingProtection:
         if self._kv is not None:
             self._load()
 
+    @staticmethod
+    def _prefix_end(prefix: bytes) -> bytes:
+        """Exclusive upper bound covering EVERY key under prefix —
+        `prefix + b"\\xff"` would exclude pubkeys starting with 0xff."""
+        return prefix[:-1] + bytes([prefix[-1] + 1])
+
     def _load(self) -> None:
         for key, value in self._kv.entries(
-            gte=self._ATT_PREFIX, lt=self._ATT_PREFIX + b"\xff"
+            gte=self._ATT_PREFIX, lt=self._prefix_end(self._ATT_PREFIX)
         ):
             src, tgt = value.decode().split(",")
             self._atts[key[len(self._ATT_PREFIX):]] = _AttRecord(
                 int(src), int(tgt)
             )
         for key, value in self._kv.entries(
-            gte=self._BLK_PREFIX, lt=self._BLK_PREFIX + b"\xff"
+            gte=self._BLK_PREFIX, lt=self._prefix_end(self._BLK_PREFIX)
         ):
             self._blocks[key[len(self._BLK_PREFIX):]] = int(value)
 
@@ -222,6 +228,7 @@ class ValidatorStore:
     def _sign_root(self, validator_index: int, object_root, domain_type, slot):
         from ..ssz import uint64
 
+        self._check_doppelganger(validator_index)
         root = self.config.compute_signing_root(
             object_root, self.config.get_domain(slot, domain_type, slot)
         )

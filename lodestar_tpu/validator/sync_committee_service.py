@@ -15,6 +15,7 @@ from typing import Dict, List
 
 from .. import params
 from ..utils.logger import get_logger
+from .doppelganger import DoppelgangerUnverified
 from .store import ValidatorStore
 
 TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
@@ -67,9 +68,12 @@ class SyncCommitteeService:
         n = 0
         for duty in duties:
             vindex = duty["validator_index"]
-            message = self.store.sign_sync_committee_message(
-                vindex, slot, head_root
-            )
+            try:
+                message = self.store.sign_sync_committee_message(
+                    vindex, slot, head_root
+                )
+            except DoppelgangerUnverified:
+                continue  # no duty publishes during the watch window
             for position in duty["positions"]:
                 subnet, index_in_subnet = divmod(position, subnet_size)
                 self.api.submit_sync_committee_message(

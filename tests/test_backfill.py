@@ -1,0 +1,251 @@
+"""BackfillSync + checkpoint-sync bootstrap + resume-from-archive.
+
+Reference behaviors: packages/beacon-node/src/sync/backfill/
+{backfill.ts,verify.ts}, cli/src/cmds/beacon/initBeaconState.ts:85-131.
+
+World: node A grows a real chain (self-proposed signed blocks).  Node B
+bootstraps from A's checkpoint state over the REST debug endpoint, then
+backfills A's history backward with linkage + batched proposer-signature
+verification; a restarted composition resumes from its state archive.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.init_state import (
+    init_beacon_state,
+    state_from_checkpoint_bytes,
+)
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.db.beacon_db import BeaconDb
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state, process_slots
+from lodestar_tpu.state_transition.accessors import get_beacon_proposer_index
+from lodestar_tpu.ssz import uint64
+from lodestar_tpu.sync import BackfillError, BackfillSync
+from lodestar_tpu.validator import ValidatorStore
+
+pytestmark = pytest.mark.smoke
+
+N_KEYS = 16
+N_SLOTS = 5
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"bf-%d" % i) for i in range(N_KEYS)]
+    pk_points = [B.sk_to_pk(sk) for sk in sks]
+    pks = [C.g1_compress(p) for p in pk_points]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    chain_a = BeaconChain(cfg, genesis)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+
+    blocks = {}  # root -> signed block
+    for slot in range(1, N_SLOTS + 1):
+        reveal = store.sign_randao(
+            get_beacon_proposer_index(_advance(genesis, slot)), slot
+        )
+        block = chain_a.produce_block(slot, reveal)
+        signed = {
+            "message": block,
+            "signature": store.sign_block(block["proposer_index"], block),
+        }
+        root = chain_a.process_block(signed)
+        blocks[bytes(root)] = signed
+    return {
+        "cfg": cfg,
+        "sks": sks,
+        "pk_points": pk_points,
+        "chain_a": chain_a,
+        "blocks": blocks,
+    }
+
+
+def _advance(genesis, slot):
+    st = genesis.clone()
+    process_slots(st, slot)
+    return st
+
+
+class DictSource:
+    """BlockSource over node A's block map."""
+
+    def __init__(self, blocks):
+        self.blocks = blocks
+
+    def get_blocks_by_root(self, roots):
+        return [self.blocks[bytes(r)] for r in roots if bytes(r) in self.blocks]
+
+    def get_blocks_by_range(self, start_slot, count):
+        out = [
+            s
+            for s in self.blocks.values()
+            if start_slot <= s["message"]["slot"] < start_slot + count
+        ]
+        return sorted(out, key=lambda s: s["message"]["slot"])
+
+
+def test_checkpoint_bootstrap_and_backfill(world, tmp_path):
+    w = world
+    chain_a = w["chain_a"]
+    # -- checkpoint state via serialization (the wire shape) --------------
+    ckpt_bytes = chain_a.head_state.serialize()
+    state_b = state_from_checkpoint_bytes(w["cfg"], ckpt_bytes)
+    assert state_b.slot == chain_a.head_state.slot
+
+    chain_b = BeaconChain(w["cfg"], state_b)
+    # B has no history: the anchor header declares the parent chain
+    anchor_parent = bytes(state_b.latest_block_header["parent_root"])
+    anchor_slot = int(state_b.latest_block_header["slot"])
+
+    db = BeaconDb(str(tmp_path / "b.db"))
+    backfill = BackfillSync(
+        w["cfg"], db, CpuBlsVerifier(pubkeys=w["pk_points"]), batch_size=2
+    )
+    n = backfill.backfill(
+        DictSource(w["blocks"]), anchor_parent, anchor_slot, target_slot=1
+    )
+    # every historical block before the anchor was verified + archived
+    assert n == N_SLOTS - 1
+    assert backfill.lowest_backfilled_slot == 1
+    for root, signed in w["blocks"].items():
+        if signed["message"]["slot"] == anchor_slot:
+            continue  # the anchor itself is not backfilled
+        stored = db.get_block_anywhere(root)
+        assert stored is not None
+        assert T.SignedBeaconBlockAltair.serialize(stored) == (
+            T.SignedBeaconBlockAltair.serialize(signed)
+        )
+    # the completed range is recorded for restart resume
+    assert db.backfilled_ranges.get(anchor_slot.to_bytes(8, "big")) == (
+        (1).to_bytes(8, "big")
+    )
+    db.close()
+
+
+def test_backfill_rejects_tampered_history(world, tmp_path):
+    w = world
+    state_b = state_from_checkpoint_bytes(
+        w["cfg"], w["chain_a"].head_state.serialize()
+    )
+    anchor_parent = bytes(state_b.latest_block_header["parent_root"])
+    anchor_slot = int(state_b.latest_block_header["slot"])
+
+    # tamper: swap in a block whose content does not match its root
+    blocks = dict(w["blocks"])
+    victim = anchor_parent
+    forged = {
+        "message": dict(
+            blocks[victim]["message"], state_root=b"\x66" * 32
+        ),
+        "signature": blocks[victim]["signature"],
+    }
+    blocks[victim] = forged
+    db = BeaconDb(str(tmp_path / "t.db"))
+    backfill = BackfillSync(
+        w["cfg"], db, CpuBlsVerifier(pubkeys=w["pk_points"])
+    )
+    with pytest.raises(BackfillError, match="linkage"):
+        backfill.backfill(
+            DictSource(blocks), anchor_parent, anchor_slot, target_slot=1
+        )
+    db.close()
+
+
+def test_backfill_rejects_bad_signature(world, tmp_path):
+    w = world
+    state_b = state_from_checkpoint_bytes(
+        w["cfg"], w["chain_a"].head_state.serialize()
+    )
+    anchor_parent = bytes(state_b.latest_block_header["parent_root"])
+    anchor_slot = int(state_b.latest_block_header["slot"])
+
+    # keep content (so linkage holds) but corrupt a proposer signature
+    blocks = dict(w["blocks"])
+    victim = anchor_parent
+    sig = bytearray(blocks[victim]["signature"])
+    sig[-1] ^= 1
+    blocks[victim] = {
+        "message": blocks[victim]["message"],
+        "signature": bytes(sig),
+    }
+    db = BeaconDb(str(tmp_path / "s.db"))
+    backfill = BackfillSync(
+        w["cfg"], db, CpuBlsVerifier(pubkeys=w["pk_points"])
+    )
+    with pytest.raises(BackfillError, match="signature"):
+        backfill.backfill(
+            DictSource(blocks), anchor_parent, anchor_slot, target_slot=1
+        )
+    db.close()
+
+
+def test_checkpoint_sync_over_rest_wire(world):
+    """fetchWeakSubjectivityState over the real REST debug endpoint."""
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.chain.init_state import fetch_checkpoint_state
+
+    w = world
+    server = BeaconApiServer(
+        DefaultHandlers(genesis_time=2, chain=w["chain_a"]), port=0
+    )
+    server.listen()
+    try:
+        state = fetch_checkpoint_state(
+            w["cfg"], f"http://127.0.0.1:{server.port}"
+        )
+        assert state.slot == w["chain_a"].head_state.slot
+        assert state.hash_tree_root() == (
+            w["chain_a"].head_state.hash_tree_root()
+        )
+    finally:
+        server.close()
+
+
+def test_resume_from_state_archive(world, tmp_path):
+    """Restart path: the db's archived state wins over checkpoint and
+    genesis (initBeaconState.ts:85-100), and the node keeps importing."""
+    w = world
+    db = BeaconDb(str(tmp_path / "r.db"))
+    mid_state = None
+    # archive the state as of slot 3 (mid-chain)
+    chain_tmp = BeaconChain(
+        w["cfg"],
+        state_from_checkpoint_bytes(
+            w["cfg"],
+            w["chain_a"].regen._get_post_state(
+                _root_at_slot(w, 3).hex()
+            ).serialize(),
+        ),
+    )
+    db.archive_state(3, chain_tmp.head_state.serialize())
+
+    state, source = init_beacon_state(
+        w["cfg"], db=db, genesis_fn=lambda: (_ for _ in ()).throw(
+            AssertionError("genesis must not be used")
+        )
+    )
+    assert source == "resume" and state.slot == 3
+    # the resumed chain range-syncs forward to A's head
+    from lodestar_tpu.sync import RangeSync
+
+    chain_b = BeaconChain(w["cfg"], state)
+    rs = RangeSync(chain_b)
+    rs.sync_to(DictSource(w["blocks"]), N_SLOTS)
+    assert chain_b.head_root_hex == w["chain_a"].head_root_hex
+    db.close()
+
+
+def _root_at_slot(w, slot):
+    for root, signed in w["blocks"].items():
+        if signed["message"]["slot"] == slot:
+            return root
+    raise KeyError(slot)
