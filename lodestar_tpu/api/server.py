@@ -27,6 +27,7 @@ class DefaultHandlers:
         genesis_validators_root: bytes = b"\x00" * 32,
         processor=None,
         bls_metrics=None,
+        bls_service=None,
         spec: Optional[dict] = None,
         chain=None,
     ):
@@ -35,6 +36,7 @@ class DefaultHandlers:
         self.genesis_validators_root = genesis_validators_root
         self.processor = processor
         self.bls_metrics = bls_metrics
+        self.bls_service = bls_service  # recent ns job timings
         self.spec = spec or {}
         self.chain = chain  # BeaconChain for the stateful endpoints
 
@@ -88,12 +90,18 @@ class DefaultHandlers:
         if self.bls_metrics is None:
             return 501, {"message": "no bls metrics attached"}
         m = self.bls_metrics
+        timings = []
+        if self.bls_service is not None:
+            timings = list(self.bls_service.recent_job_timings)
         return 200, {
             "data": {
                 "queue_length": m.queue_length.value,
                 "success_jobs": m.success_jobs.value,
                 "batch_retries": m.batch_retries.value,
                 "invalid_sets": m.invalid_sets.value,
+                "worker_time_seconds": m.jobs_worker_time.get("0"),
+                # BlsWorkResult-parity ns records (multithread/types.ts)
+                "recent_job_timings": timings,
             }
         }
 

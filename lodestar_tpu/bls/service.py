@@ -43,13 +43,14 @@ MAX_INFLIGHT_JOBS = 4
 
 
 class _Job:
-    __slots__ = ("sets", "opts", "future", "t_submit")
+    __slots__ = ("sets", "opts", "future", "t_submit", "t_submit_ns")
 
     def __init__(self, sets, opts):
         self.sets = sets
         self.opts = opts
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        self.t_submit_ns = time.time_ns()
 
 
 class BlsVerifierService:
@@ -63,6 +64,9 @@ class BlsVerifierService:
     ):
         self.verifier = verifier
         self.metrics = verifier.metrics
+        if hasattr(verifier, "observe_single_thread"):
+            # pooled worker, not single-thread mode (see CpuBlsVerifier)
+            verifier.observe_single_thread = False
         self._max_pending = max_pending_jobs
         self._max_buffered = max_buffered_sigs
         self._buffer_wait = buffer_wait_ms / 1000.0
@@ -76,6 +80,12 @@ class BlsVerifierService:
         # The bounded in-flight queue pipelines dispatch latency.
         self._inflight: "SimpleQueue" = SimpleQueue()
         self._inflight_slots = threading.Semaphore(max_inflight_jobs)
+        # BlsWorkResult-parity records of recent device jobs (reference:
+        # multithread/types.ts:26-38 — workerId, batchRetries,
+        # batchSigsSuccess, workerStartNs, workerEndNs)
+        from collections import deque
+
+        self.recent_job_timings: "deque" = deque(maxlen=64)
         self._thread = threading.Thread(
             target=self._run, name="bls-verifier-dispatch", daemon=True
         )
@@ -97,12 +107,14 @@ class BlsVerifierService:
         opts = opts or VerifyOptions()
         if opts.verify_on_main_thread:
             fut: Future = Future()
+            t0 = time.perf_counter()
             try:
                 fut.set_result(
                     self.verifier.verify_signature_sets(list(sets), opts)
                 )
             except Exception as e:  # pragma: no cover
                 fut.set_exception(e)
+            self.metrics.main_thread_time.observe(time.perf_counter() - t0)
             return fut
         job = _Job(list(sets), opts)
         with self._lock:
@@ -162,8 +174,18 @@ class BlsVerifierService:
 
     def _dispatch(self, group: List[_Job]) -> None:
         t0 = time.perf_counter()
+        dispatch_start_ns = time.time_ns()
         for j in group:
             self.metrics.job_wait_time.observe(t0 - j.t_submit)
+            # submit -> device dispatch (reference latencyToWorker)
+            self.metrics.latency_to_worker.observe(
+                max(dispatch_start_ns - j.t_submit_ns, 0) / 1e9
+            )
+        self.metrics.total_job_groups_started.inc()
+        self.metrics.total_jobs_started.inc(len(group))
+        self.metrics.total_sig_sets_started.inc(
+            sum(len(j.sets) for j in group)
+        )
         try:
             if len(group) == 1 and not group[0].opts.batchable:
                 batchable = False
@@ -204,7 +226,7 @@ class BlsVerifierService:
                 self._lock.notify_all()
             return
         self._inflight_slots.acquire()  # backpressure: bounded in-flight
-        self._inflight.put((group, handles, t0))
+        self._inflight.put((group, handles, t0, dispatch_start_ns))
 
     def _resolve_loop(self) -> None:
         """Resolver: sync begun jobs in dispatch order, settle futures."""
@@ -212,9 +234,12 @@ class BlsVerifierService:
             item = self._inflight.get()
             if item is None:
                 return
-            group, handles, t0 = item
+            group, handles, t0, worker_start_ns = item
             self._inflight_slots.release()
             self.metrics.workers_busy.set(1)
+            retries_before = self.metrics.batch_retries.value
+            batch_ok_before = self.metrics.batch_sigs_success.value
+            worker_end_ns = None
             try:
                 if isinstance(handles, tuple):
                     merged, batchable = handles
@@ -225,6 +250,7 @@ class BlsVerifierService:
                     ok = True
                     for h in handles:
                         ok &= self.verifier.finish_job(h)
+                worker_end_ns = time.time_ns()
                 if ok:
                     for j in group:
                         j.future.set_result(True)
@@ -278,6 +304,33 @@ class BlsVerifierService:
                 self.metrics.error_jobs.inc(len(group))
             finally:
                 self.metrics.workers_busy.set(0)
+                settled_ns = time.time_ns()
+                if worker_end_ns is not None:
+                    # device result ready -> futures settled (reference
+                    # latencyFromWorker), device-bracket ns timestamps
+                    # (reference workerStartNs/workerEndNs)
+                    self.metrics.latency_from_worker.observe(
+                        max(settled_ns - worker_end_ns, 0) / 1e9
+                    )
+                    self.metrics.jobs_worker_time.inc(
+                        "0", (worker_end_ns - worker_start_ns) / 1e9
+                    )
+                    self.recent_job_timings.append(
+                        {
+                            "worker_id": 0,
+                            "batch_retries": int(
+                                self.metrics.batch_retries.value
+                                - retries_before
+                            ),
+                            "batch_sigs_success": int(
+                                self.metrics.batch_sigs_success.value
+                                - batch_ok_before
+                            ),
+                            "worker_start_ns": worker_start_ns,
+                            "worker_end_ns": worker_end_ns,
+                            "sig_sets": sum(len(j.sets) for j in group),
+                        }
+                    )
                 # verify_signature_sets observes job_time itself; only the
                 # begin/finish handle path accounts here (no double count)
                 if not isinstance(handles, tuple):

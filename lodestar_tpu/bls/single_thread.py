@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-from ..utils.metrics import BlsPoolMetrics
+from ..utils.metrics import BlsPoolMetrics, BlsSingleThreadMetrics
 from .signature_set import SignatureSet, WireSignatureSet
 
 
@@ -34,6 +34,14 @@ class CpuBlsVerifier:
         self._pubkeys = pubkeys
         self._table = table
         self.metrics = metrics or BlsPoolMetrics()
+        self.single_thread_metrics = BlsSingleThreadMetrics(
+            self.metrics.registry
+        )
+        # True when this verifier IS the single-thread mode (the
+        # reference's blsSingleThread family measures the pool-BYPASS
+        # path only); BlsVerifierService clears it when pooling this
+        # verifier as its worker so pool jobs don't double-count
+        self.observe_single_thread = True
         self.max_job_sets = 128
 
     def _pubkey(self, index: int):
@@ -45,7 +53,17 @@ class CpuBlsVerifier:
         return True
 
     def verify_signature_sets(self, sets, opts=None) -> bool:
+        import time as _time
+
+        t0 = _time.perf_counter()
         verdicts = [self._verify_one(s) for s in sets]
+        dt = _time.perf_counter() - t0
+        if self.observe_single_thread:
+            self.single_thread_metrics.duration.observe(dt)
+            if sets:
+                self.single_thread_metrics.time_per_sig_set.observe(
+                    dt / len(sets)
+                )
         good = sum(verdicts)
         self.metrics.success_jobs.inc(good)
         self.metrics.invalid_sets.inc(len(sets) - good)

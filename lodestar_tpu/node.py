@@ -86,6 +86,7 @@ class BeaconNode:
                     genesis_validators_root=config.genesis_validators_root,
                     processor=self.processor,
                     bls_metrics=self.metrics,
+                    bls_service=self.bls,
                     spec={"SECONDS_PER_SLOT": params.SECONDS_PER_SLOT},
                 ),
                 port=opts.api_port,

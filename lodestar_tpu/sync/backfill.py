@@ -125,7 +125,11 @@ class BackfillSync:
                 self._verify_and_archive(batch)
                 batch = []
             expected = bytes(block["parent_root"])
-            if int(block["slot"]) <= target_slot:
+            # slot 1 is the lowest possible SIGNED block — its parent is
+            # the genesis block header, which exists as a root but never
+            # as a fetchable signed block, so the walk must stop here
+            # even with target_slot=0 ("verify everything")
+            if int(block["slot"]) <= max(target_slot, 1):
                 break
         self._verify_and_archive(batch)
         # record the completed range (reference: backfilledRanges repo —
@@ -153,13 +157,21 @@ class ApiBlockSource:
     def __init__(self, client):
         self.client = client
 
+    @staticmethod
+    def _absent(e: Exception) -> bool:
+        """Only a definitive 404 means 'no such block'; transient
+        transport/server errors must propagate so the caller can retry
+        instead of mis-reading them as missing history."""
+        return getattr(e, "status", None) == 404
+
     def get_blocks_by_root(self, roots) -> List[dict]:
         out = []
         for root in roots:
             try:
                 out.append(self.client.get_block("0x" + bytes(root).hex()))
-            except Exception:  # noqa: BLE001 - absent block = empty reply
-                pass
+            except Exception as e:  # noqa: BLE001 - classify absent vs outage
+                if not self._absent(e):
+                    raise
         return out
 
     def get_blocks_by_range(self, start_slot: int, count: int) -> List[dict]:
@@ -167,6 +179,7 @@ class ApiBlockSource:
         for slot in range(start_slot, start_slot + count):
             try:
                 out.append(self.client.get_block(str(slot)))
-            except Exception:  # noqa: BLE001 - skip slots are empty
-                pass
+            except Exception as e:  # noqa: BLE001 - skip slots are empty
+                if not self._absent(e):
+                    raise
         return out

@@ -63,6 +63,32 @@ class Gauge:
         ]
 
 
+class LabeledGauge:
+    """Gauge with one label dimension (the reference's per-worker
+    jobsWorkerTime gauge, labelNames: ["workerId"])."""
+
+    def __init__(self, name: str, help_: str, label: str):
+        self.name, self.help, self.label = name, help_, label
+        self._v: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, label_value: str, amount: float) -> None:
+        with self._lock:
+            self._v[label_value] = self._v.get(label_value, 0.0) + amount
+
+    def get(self, label_value: str) -> float:
+        return self._v.get(label_value, 0.0)
+
+    def expose(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+        ]
+        for lv, v in sorted(self._v.items()):
+            out.append(f'{self.name}{{{self.label}="{lv}"}} {v}')
+        return out
+
+
 class Histogram:
     def __init__(self, name: str, help_: str, buckets: Sequence[float]):
         self.name, self.help = name, help_
@@ -119,6 +145,9 @@ class Registry:
     def histogram(self, name: str, help_: str, buckets) -> Histogram:
         return self._get(name, lambda: Histogram(name, help_, buckets))
 
+    def labeled_gauge(self, name: str, help_: str, label: str) -> LabeledGauge:
+        return self._get(name, lambda: LabeledGauge(name, help_, label))
+
     def _get(self, name, factory):
         if name not in self._metrics:
             self._metrics[name] = factory()
@@ -152,24 +181,77 @@ class BlsPoolMetrics:
         self.job_time = r.histogram(
             p + "job_time_seconds", "Device time per job", _SECONDS
         )
+        # reference name: lodestar_bls_worker_thread_time_per_sigset_seconds
         self.time_per_sig_set = r.histogram(
-            p + "time_per_sig_set_seconds",
-            "Device time per signature set",
-            [1e-5, 1e-4, 1e-3, 1e-2],
+            "lodestar_bls_worker_thread_time_per_sigset_seconds",
+            "Time to verify each sigset on the device path",
+            [1e-5, 1e-4, 0.5e-3, 1e-3, 2e-3, 5e-3, 1e-2],
+        )
+        # main thread <-> device boundary latencies + per-worker time
+        # (reference: lodestar.ts:407-424, multithread/types.ts:26-38)
+        self.latency_to_worker = r.histogram(
+            p + "latency_to_worker",
+            "Time from submitting the job to the device dispatch starting",
+            [0.001, 0.003, 0.01, 0.03, 0.1],
+        )
+        self.latency_from_worker = r.histogram(
+            p + "latency_from_worker",
+            "Time from the device result being ready to futures settling",
+            [0.001, 0.003, 0.01, 0.03, 0.1],
+        )
+        self.jobs_worker_time = r.labeled_gauge(
+            p + "time_seconds_sum",
+            "Total time spent verifying signature sets on the device",
+            "workerId",
+        )
+        self.main_thread_time = r.histogram(
+            p + "main_thread_time_seconds",
+            "Time to verify signatures on the main thread (fast path)",
+            [0],
+        )
+        self.total_job_groups_started = r.counter(
+            p + "job_groups_started_total", "Job groups started"
+        )
+        self.total_jobs_started = r.counter(
+            p + "jobs_started_total", "Jobs started"
+        )
+        self.total_sig_sets_started = r.counter(
+            p + "sig_sets_started_total", "Signature sets started"
         )
         self.success_jobs = r.counter(
             p + "success_jobs_signature_sets_count", "Sig sets verified OK"
         )
-        self.error_jobs = r.counter(p + "error_jobs_count", "Failed jobs")
+        self.error_jobs = r.counter(
+            p + "error_jobs_signature_sets_count", "Error-ed signature sets"
+        )
         self.batch_retries = r.counter(
-            p + "batch_retries_count", "Batches re-verified set-by-set"
+            p + "batch_retries_total", "Batches re-verified set-by-set"
         )
         self.batch_sigs_success = r.counter(
-            p + "batch_sigs_success_count", "Sig sets verified in a batch"
+            p + "batch_sigs_success_total", "Sig sets verified in a batch"
         )
         self.batchable_sigs = r.counter(
             p + "batchable_sigs_count", "Sig sets submitted as batchable"
         )
         self.invalid_sets = r.counter(
             p + "invalid_sig_sets_count", "Sig sets that failed verification"
+        )
+
+
+class BlsSingleThreadMetrics:
+    """The lodestar_bls_single_thread_* family (reference:
+    lodestar.ts:433-446) — the CPU fallback verifier's timings."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.duration = r.histogram(
+            "lodestar_bls_single_thread_time_seconds",
+            "Time to verify signatures with single thread mode",
+            [0],
+        )
+        self.time_per_sig_set = r.histogram(
+            "lodestar_bls_single_thread_time_per_sigset_seconds",
+            "Time to verify each sigset with single thread mode",
+            [0.5e-3, 0.75e-3, 1e-3, 1.5e-3, 2e-3, 5e-3],
         )

@@ -43,7 +43,13 @@ class BlockProposalService:
         published = 0
         for duty in self.duties_at_slot(epoch, slot):
             vindex = duty["validator_index"]
-            randao_reveal = self.store.sign_randao(vindex, slot)
+            try:
+                randao_reveal = self.store.sign_randao(vindex, slot)
+            except DoppelgangerUnverified as e:
+                self.log.info(
+                    "duty delayed: doppelganger watch", reason=str(e)
+                )
+                continue
             block = self.api.produce_block_v2(
                 slot, randao_reveal, self.graffiti
             )

@@ -1,0 +1,149 @@
+"""Observability parity: ns job timing + the full bls_thread_pool family.
+
+Reference: packages/beacon-node/src/metrics/metrics/lodestar.ts:357-446
+(every blsThreadPool + blsSingleThread instrument) and
+chain/bls/multithread/types.ts:26-38 (BlsWorkResult ns fields).
+"""
+
+import pytest
+
+from lodestar_tpu.bls.service import BlsVerifierService
+from lodestar_tpu.bls.signature_set import WireSignatureSet
+from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+from lodestar_tpu.bls.verifier import VerifyOptions
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.utils.metrics import BlsPoolMetrics, Registry
+
+pytestmark = pytest.mark.smoke
+
+# every metric name the reference defines for the pool + single thread
+REFERENCE_METRIC_NAMES = (
+    "lodestar_bls_thread_pool_time_seconds_sum",
+    "lodestar_bls_thread_pool_success_jobs_signature_sets_count",
+    "lodestar_bls_thread_pool_error_jobs_signature_sets_count",
+    "lodestar_bls_thread_pool_queue_job_wait_time_seconds",
+    "lodestar_bls_thread_pool_queue_length",
+    "lodestar_bls_thread_pool_workers_busy",
+    "lodestar_bls_thread_pool_job_groups_started_total",
+    "lodestar_bls_thread_pool_jobs_started_total",
+    "lodestar_bls_thread_pool_sig_sets_started_total",
+    "lodestar_bls_thread_pool_batch_retries_total",
+    "lodestar_bls_thread_pool_batch_sigs_success_total",
+    "lodestar_bls_thread_pool_latency_to_worker",
+    "lodestar_bls_thread_pool_latency_from_worker",
+    "lodestar_bls_thread_pool_main_thread_time_seconds",
+    "lodestar_bls_worker_thread_time_per_sigset_seconds",
+    "lodestar_bls_single_thread_time_seconds",
+    "lodestar_bls_single_thread_time_per_sigset_seconds",
+)
+
+
+@pytest.fixture(scope="module")
+def world():
+    sks = [B.keygen(b"obs-%d" % i) for i in range(4)]
+    pks = [B.sk_to_pk(sk) for sk in sks]
+    root = b"\x07" * 32
+    sets = [
+        WireSignatureSet.single(
+            i, root, C.g2_compress(B.sign(sks[i], root))
+        )
+        for i in range(4)
+    ]
+    return sks, pks, sets
+
+
+def test_exposition_covers_every_reference_instrument(world):
+    sks, pks, sets = world
+    registry = Registry()
+    verifier = CpuBlsVerifier(pubkeys=pks, metrics=BlsPoolMetrics(registry))
+    service = BlsVerifierService(verifier)
+    try:
+        assert service.verify_signature_sets(
+            sets, VerifyOptions(batchable=True)
+        )
+        assert service.verify_signature_sets(
+            sets[:1], VerifyOptions(verify_on_main_thread=True)
+        )
+    finally:
+        service.close()
+    text = registry.expose()
+    missing = [n for n in REFERENCE_METRIC_NAMES if n not in text]
+    assert not missing, f"missing reference instruments: {missing}"
+    # the per-worker time gauge carries its label
+    assert 'workerId="0"' in text
+
+
+def test_ns_job_timing_records(world):
+    sks, pks, sets = world
+    verifier = CpuBlsVerifier(pubkeys=pks)
+    service = BlsVerifierService(verifier)
+    try:
+        assert service.verify_signature_sets(
+            sets, VerifyOptions(batchable=True)
+        )
+        timings = list(service.recent_job_timings)
+        assert timings, "no BlsWorkResult-parity records"
+        rec = timings[-1]
+        # the exact BlsWorkResult field set (multithread/types.ts:26-38)
+        for field in (
+            "worker_id",
+            "batch_retries",
+            "batch_sigs_success",
+            "worker_start_ns",
+            "worker_end_ns",
+        ):
+            assert field in rec, field
+        assert rec["worker_end_ns"] >= rec["worker_start_ns"] > 0
+        assert rec["sig_sets"] == len(sets)
+        m = verifier.metrics
+        assert m.latency_to_worker.count >= 1
+        assert m.latency_from_worker.count >= 1
+        assert m.jobs_worker_time.get("0") > 0
+        assert m.total_job_groups_started.value >= 1
+        assert m.total_sig_sets_started.value >= len(sets)
+    finally:
+        service.close()
+
+
+def test_single_thread_family_observed(world):
+    sks, pks, sets = world
+    verifier = CpuBlsVerifier(pubkeys=pks)
+    assert verifier.verify_signature_sets(sets)
+    st = verifier.single_thread_metrics
+    assert st.duration.count == 1
+    assert st.time_per_sig_set.count == 1
+
+
+def test_timings_visible_over_rest(world):
+    """The ns records reach the lodestar introspection endpoint."""
+    import json
+    import urllib.request
+
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+
+    sks, pks, sets = world
+    verifier = CpuBlsVerifier(pubkeys=pks)
+    service = BlsVerifierService(verifier)
+    server = BeaconApiServer(
+        DefaultHandlers(
+            bls_metrics=verifier.metrics, bls_service=service
+        ),
+        port=0,
+    )
+    server.listen()
+    try:
+        assert service.verify_signature_sets(
+            sets, VerifyOptions(batchable=True)
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/eth/v1/lodestar/bls-metrics",
+            timeout=30,
+        ) as resp:
+            data = json.loads(resp.read())["data"]
+        assert data["recent_job_timings"], data
+        assert data["worker_time_seconds"] > 0
+        assert data["recent_job_timings"][-1]["worker_end_ns"] > 0
+    finally:
+        server.close()
+        service.close()
