@@ -92,7 +92,7 @@ class DefaultHandlers:
         m = self.bls_metrics
         timings = []
         if self.bls_service is not None:
-            timings = list(self.bls_service.recent_job_timings)
+            timings = self.bls_service.job_timings()
         return 200, {
             "data": {
                 "queue_length": m.queue_length.value,

@@ -86,6 +86,7 @@ class BlsVerifierService:
         from collections import deque
 
         self.recent_job_timings: "deque" = deque(maxlen=64)
+        self._timings_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name="bls-verifier-dispatch", daemon=True
         )
@@ -94,6 +95,11 @@ class BlsVerifierService:
         )
         self._thread.start()
         self._resolver.start()
+
+    def job_timings(self) -> List[dict]:
+        """Thread-safe snapshot of the BlsWorkResult-parity records."""
+        with self._timings_lock:
+            return list(self.recent_job_timings)
 
     # -- submission -------------------------------------------------------
 
@@ -175,6 +181,11 @@ class BlsVerifierService:
     def _dispatch(self, group: List[_Job]) -> None:
         t0 = time.perf_counter()
         dispatch_start_ns = time.time_ns()
+        # counter snapshots BEFORE begin_job runs (it can increment
+        # batch_retries for undecodable signatures); the BlsWorkResult
+        # record's deltas belong to THIS group
+        retries_before = self.metrics.batch_retries.value
+        batch_ok_before = self.metrics.batch_sigs_success.value
         for j in group:
             self.metrics.job_wait_time.observe(t0 - j.t_submit)
             # submit -> device dispatch (reference latencyToWorker)
@@ -226,7 +237,10 @@ class BlsVerifierService:
                 self._lock.notify_all()
             return
         self._inflight_slots.acquire()  # backpressure: bounded in-flight
-        self._inflight.put((group, handles, t0, dispatch_start_ns))
+        self._inflight.put(
+            (group, handles, t0, dispatch_start_ns,
+             retries_before, batch_ok_before)
+        )
 
     def _resolve_loop(self) -> None:
         """Resolver: sync begun jobs in dispatch order, settle futures."""
@@ -234,11 +248,10 @@ class BlsVerifierService:
             item = self._inflight.get()
             if item is None:
                 return
-            group, handles, t0, worker_start_ns = item
+            (group, handles, t0, worker_start_ns,
+             retries_before, batch_ok_before) = item
             self._inflight_slots.release()
             self.metrics.workers_busy.set(1)
-            retries_before = self.metrics.batch_retries.value
-            batch_ok_before = self.metrics.batch_sigs_success.value
             worker_end_ns = None
             try:
                 if isinstance(handles, tuple):
@@ -315,22 +328,23 @@ class BlsVerifierService:
                     self.metrics.jobs_worker_time.inc(
                         "0", (worker_end_ns - worker_start_ns) / 1e9
                     )
-                    self.recent_job_timings.append(
-                        {
-                            "worker_id": 0,
-                            "batch_retries": int(
-                                self.metrics.batch_retries.value
-                                - retries_before
-                            ),
-                            "batch_sigs_success": int(
-                                self.metrics.batch_sigs_success.value
-                                - batch_ok_before
-                            ),
-                            "worker_start_ns": worker_start_ns,
-                            "worker_end_ns": worker_end_ns,
-                            "sig_sets": sum(len(j.sets) for j in group),
-                        }
-                    )
+                    with self._timings_lock:
+                        self.recent_job_timings.append(
+                            {
+                                "worker_id": 0,
+                                "batch_retries": int(
+                                    self.metrics.batch_retries.value
+                                    - retries_before
+                                ),
+                                "batch_sigs_success": int(
+                                    self.metrics.batch_sigs_success.value
+                                    - batch_ok_before
+                                ),
+                                "worker_start_ns": worker_start_ns,
+                                "worker_end_ns": worker_end_ns,
+                                "sig_sets": sum(len(j.sets) for j in group),
+                            }
+                        )
                 # verify_signature_sets observes job_time itself; only the
                 # begin/finish handle path accounts here (no double count)
                 if not isinstance(handles, tuple):

@@ -471,14 +471,17 @@ def _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid):
     return batch_ok, sub_ok
 
 
-def _batch_core(
+def _batch_local(
     table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid
 ):
-    """Shared batch pipeline from Montgomery planes onward.
+    """The per-shard slice of the batch pipeline: everything DATA-
+    PARALLEL over the sets axis.  Returns lane-replicated partials ready
+    for cross-device combination:
 
-    msgM/sigM: affine G2 planes in Montgomery form; sig_bad: bool[N]
-    lanes whose signature cannot participate (infinity or undecodable) —
-    they fail the batch and are excluded from the aggregate.
+        fprod — 12 Fp12 planes, the product of this shard's set pairs,
+        jsum  — (6 planes + inf row) jacobian sum of r_i*sig_i,
+        sub   — per-set subgroup-check row [1, n_local],
+        live / pk_inf — per-set masks.
     """
     n = valid.shape[0]
     msg_x0, msg_x1, msg_y0, msg_y1 = msgM
@@ -518,17 +521,7 @@ def _batch_core(
         sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, excl, n
     )
     # cross-lane butterfly in plain XLA: 128 partials -> total in every lane
-    jx0, jx1, jy0, jy1, jz0, jz1, jinf = _j_sum_lanes(
-        px0, px1, py0, py1, pz0, pz1, pinf
-    )
-    # [NL, BT] planes: every lane holds the aggregate point
-    ax0, ax1, ay0, ay1, ainf = _tiled(
-        _k_affine_g2,
-        (jx0, jx1, jy0, jy1, jz0, jz1, jinf),
-        [NL] * 6 + [1],
-        [NL] * 4 + [1],
-        BT,
-    )
+    jsum = _j_sum_lanes(px0, px1, py0, py1, pz0, pz1, pinf)
 
     # Miller: N set pairs
     fN = _tiled(
@@ -538,7 +531,25 @@ def _batch_core(
         [NL] * 12,
         n,
     )
+    fpartial = _prod(fN, live_i, n)
+    fprod = _j_product12(tuple(fpartial), jnp.ones((BT,), bool))
+    return fprod, jsum, sub, live, pk_inf
 
+
+def _batch_tail(fprod, jsum):
+    """The per-batch tail: one affine conversion, ONE aggregate Miller
+    pair (-G1, A), final exponentiation -> is-one row [1, BT].  In the
+    sharded path this runs replicated on every device over the combined
+    partials (it is one pair's worth of work)."""
+    jx0, jx1, jy0, jy1, jz0, jz1, jinf = jsum
+    # [NL, BT] planes: every lane holds the aggregate point
+    ax0, ax1, ay0, ay1, ainf = _tiled(
+        _k_affine_g2,
+        (jx0, jx1, jy0, jy1, jz0, jz1, jinf),
+        [NL] * 6 + [1],
+        [NL] * 4 + [1],
+        BT,
+    )
     # Miller: the aggregate pair (-G1, A) — full-width lanes all carry A,
     # so the same compiled tile kernel serves it
     fA = _tiled(
@@ -551,9 +562,6 @@ def _batch_core(
         [NL] * 12,
         BT,
     )
-
-    fpartial = _prod(fN, live_i, n)
-    fprod = _j_product12(tuple(fpartial), jnp.ones((BT,), bool))
     ok2 = _tiled(
         _k_final_one,
         (ainf, *fprod, *fA),
@@ -561,7 +569,22 @@ def _batch_core(
         [1],
         BT,
     )[0]
+    return ok2
 
+
+def _batch_core(
+    table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid
+):
+    """Shared batch pipeline from Montgomery planes onward.
+
+    msgM/sigM: affine G2 planes in Montgomery form; sig_bad: bool[N]
+    lanes whose signature cannot participate (infinity or undecodable) —
+    they fail the batch and are excluded from the aggregate.
+    """
+    fprod, jsum, sub, live, pk_inf = _batch_local(
+        table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid
+    )
+    ok2 = _batch_tail(fprod, jsum)
     return _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid)
 
 
@@ -633,6 +656,132 @@ def verify_each_device_wire(
         (msg_x0, msg_x1, msg_y0, msg_y1),
         (sx0, sx1, sy0, sy1),
         sig_bad, valid,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip sharding (SURVEY §2.4 P1: data parallelism over signature
+# sets; the device pubkey table is REPLICATED — 1M keys in limb planes is
+# ~260 MB, well under per-chip HBM, so gathers stay local and the only
+# cross-device traffic is one all_gather of the Fp12 partial products +
+# the aggregate-signature jacobian + violation counts per job)
+# ---------------------------------------------------------------------------
+
+
+def wire_shard_specs(axis: str = "sets"):
+    """The PartitionSpec layout for make_sharded_wire_verifier's 13
+    positional args — exported so device_put call sites (graft dryrun,
+    tests) cannot drift from the verifier's in_specs."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        P(), P(),                      # table planes replicated
+        P(axis), P(axis),              # idx [N, K], kmask
+        P(None, axis), P(None, axis),  # msg planes [NL, N] x4
+        P(None, axis), P(None, axis),
+        P(None, axis), P(None, axis),  # sig_x0, sig_x1
+        P(None, axis),                 # sig_flags [2, N]
+        P(None, axis),                 # rwords [2, N]
+        P(axis),                       # valid [N]
+    )
+
+
+def make_sharded_wire_verifier(mesh, axis: str = "sets"):
+    """Build the sharded wire-path batch verifier over `mesh`.
+
+    Returns fn(table_x, table_y, idx, kmask, m0..m3, sig_x0, sig_x1,
+    sig_flags, rwords, valid) -> (batch_ok, sig_sub_ok) where the
+    per-set operands are sharded over `axis` (each shard a multiple of
+    the lane tile) and the table is replicated.  Each device runs the
+    FULL local pipeline (ingest -> gather -> RLC scalar muls -> Miller
+    -> partial product); the cross-device combine is one all_gather,
+    then the one-pair tail (affine + aggregate Miller + final exp) runs
+    replicated.  Wrap in jax.jit to compile over the mesh.
+    """
+    import jax.lax as lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:  # jax >= 0.8 module move
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+
+    def _combine_f12(gathered):
+        """[D, NL, bt] x12 leaves -> product over D (plain XLA ops)."""
+        acc = _unflatten_f12([g[0] for g in gathered])
+        for d in range(1, n_dev):
+            acc = TW.mul12(acc, _unflatten_f12([g[d] for g in gathered]))
+        return acc
+
+    def _combine_jsum(gathered, inf_g):
+        """[D, NL, bt] x6 + [D, 1, bt] inf -> jacobian sum over D."""
+        acc = (
+            (gathered[0][0], gathered[1][0]),
+            (gathered[2][0], gathered[3][0]),
+            (gathered[4][0], gathered[5][0]),
+        )
+        acc_inf = inf_g[0][0] != 0
+        for d in range(1, n_dev):
+            pt = (
+                (gathered[0][d], gathered[1][d]),
+                (gathered[2][d], gathered[3][d]),
+                (gathered[4][d], gathered[5][d]),
+            )
+            acc, acc_inf = CV.jac_add_full(
+                CV.FP2_OPS, acc, acc_inf, pt, inf_g[d][0] != 0
+            )
+        return (
+            acc[0][0], acc[0][1], acc[1][0], acc[1][1], acc[2][0], acc[2][1],
+            acc_inf[None, :].astype(jnp.int32),
+        )
+
+    def body(
+        table_x, table_y, idx, kmask,
+        m0, m1, m2, m3, sig_x0, sig_x1, sig_flags,
+        rwords, valid,
+    ):
+        n = valid.shape[0]  # LOCAL shard size
+        m0, m1, m2, m3 = _tiled(
+            _k_mont4, (m0, m1, m2, m3), [NL] * 4, [NL] * 4, n
+        )
+        (s0, s1, s2, s3), dec_ok = _decompress_sig(
+            sig_x0, sig_x1, sig_flags, n
+        )
+        sig_bad = (sig_flags[1] != 0) | ~dec_ok
+        fprod, jsum, sub, live, pk_inf = _batch_local(
+            table_x, table_y, idx, kmask,
+            (m0, m1, m2, m3), (s0, s1, s2, s3),
+            sig_bad, rwords, valid,
+        )
+        # -- cross-device combine (the only collectives in the job) ----
+        f_g = [lax.all_gather(leaf, axis) for leaf in fprod]
+        j_g = [lax.all_gather(p, axis) for p in jsum[:6]]
+        inf_g = lax.all_gather(jsum[6], axis)
+        fprod_all = tuple(
+            jax.tree_util.tree_leaves(_combine_f12(f_g))
+        )
+        jsum_all = _combine_jsum(j_g, inf_g)
+        # local violation counts -> global via psum
+        sub_ok = (sub[0] != 0) | ~live
+        viol = (
+            jnp.sum(~sub_ok)
+            + jnp.sum(pk_inf & (valid != 0))
+            + jnp.sum(sig_bad & (valid != 0))
+        )
+        viol_total = lax.psum(viol, axis)
+        # -- replicated one-pair tail ----------------------------------
+        ok2 = _batch_tail(fprod_all, jsum_all)
+        batch_ok = (ok2[0, 0] != 0) & (viol_total == 0)
+        return batch_ok, sub_ok
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=wire_shard_specs(axis),
+        out_specs=(P(), P(axis)),
+        check_vma=False,
     )
 
 

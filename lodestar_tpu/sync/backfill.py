@@ -93,16 +93,21 @@ class BackfillSync:
         anchor_parent_root: bytes,
         anchor_slot: int,
         target_slot: int = 0,
+        genesis_root: bytes = None,
     ) -> int:
         """Fetch-verify-archive backward until target_slot (or the
-        pre-genesis zero root).  `anchor_parent_root` is the parent root
-        declared by the TRUSTED anchor block (from the checkpoint
-        state's latest block header)."""
+        pre-genesis zero root / the genesis block root, which exists as
+        a parent reference but never as a fetchable signed block).
+        `anchor_parent_root` is the parent root declared by the TRUSTED
+        anchor block (from the checkpoint state's latest block
+        header); pass `genesis_root` when known so a chain with an
+        empty slot 1 still terminates cleanly."""
         imported_before = self.verified_blocks
         expected = bytes(anchor_parent_root)
         batch: List[dict] = []
         prev_slot = anchor_slot
-        while expected != ZERO_ROOT:
+        genesis = bytes(genesis_root) if genesis_root is not None else None
+        while expected != ZERO_ROOT and expected != genesis:
             blocks = source.get_blocks_by_root([expected])
             if not blocks:
                 raise BackfillError(
