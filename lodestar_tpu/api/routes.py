@@ -88,6 +88,11 @@ ROUTES: Tuple[Route, ...] = (
     # lodestar namespace (reference: api/impl/lodestar/index.ts)
     Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),
     Route("GET", "/eth/v1/lodestar/bls-metrics", "get_bls_metrics"),
+    Route(
+        "GET",
+        "/eth/v1/lodestar/validator-monitor/{epoch}",
+        "get_validator_monitor",
+    ),
 )
 
 

@@ -105,6 +105,22 @@ class DefaultHandlers:
             }
         }
 
+    def get_validator_monitor(self, params, body):
+        """Per-tracked-validator epoch summaries (reference:
+        validatorMonitor.ts via the lodestar namespace)."""
+        err = self._need_chain()
+        if err:
+            return err
+        mon = getattr(self.chain, "monitor", None)
+        if mon is None:
+            return 501, {"message": "no validator monitor attached"}
+        epoch = int(params["epoch"])
+        return 200, {
+            "data": [
+                mon.summary_dict(i, epoch) for i in sorted(mon.tracked_indices)
+            ]
+        }
+
     # -- chain-backed endpoints (reference: api/impl/{beacon,validator}) ---
 
     def _need_chain(self):

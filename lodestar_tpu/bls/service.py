@@ -181,11 +181,6 @@ class BlsVerifierService:
     def _dispatch(self, group: List[_Job]) -> None:
         t0 = time.perf_counter()
         dispatch_start_ns = time.time_ns()
-        # counter snapshots BEFORE begin_job runs (it can increment
-        # batch_retries for undecodable signatures); the BlsWorkResult
-        # record's deltas belong to THIS group
-        retries_before = self.metrics.batch_retries.value
-        batch_ok_before = self.metrics.batch_sigs_success.value
         for j in group:
             self.metrics.job_wait_time.observe(t0 - j.t_submit)
             # submit -> device dispatch (reference latencyToWorker)
@@ -237,10 +232,7 @@ class BlsVerifierService:
                 self._lock.notify_all()
             return
         self._inflight_slots.acquire()  # backpressure: bounded in-flight
-        self._inflight.put(
-            (group, handles, t0, dispatch_start_ns,
-             retries_before, batch_ok_before)
-        )
+        self._inflight.put((group, handles, t0, dispatch_start_ns))
 
     def _resolve_loop(self) -> None:
         """Resolver: sync begun jobs in dispatch order, settle futures."""
@@ -248,8 +240,7 @@ class BlsVerifierService:
             item = self._inflight.get()
             if item is None:
                 return
-            (group, handles, t0, worker_start_ns,
-             retries_before, batch_ok_before) = item
+            group, handles, t0, worker_start_ns = item
             self._inflight_slots.release()
             self.metrics.workers_busy.set(1)
             worker_end_ns = None
@@ -332,13 +323,30 @@ class BlsVerifierService:
                         self.recent_job_timings.append(
                             {
                                 "worker_id": 0,
-                                "batch_retries": int(
-                                    self.metrics.batch_retries.value
-                                    - retries_before
+                                # per-job fields carried on the device
+                                # handles themselves (no racy global
+                                # counter diffs).  KNOWN GAP: the
+                                # no-begin_job tuple path and the
+                                # misaligned re-verify fallback create
+                                # internal jobs whose counters are not
+                                # attributed here (global counters stay
+                                # correct; only the per-job record
+                                # underreports on those rare paths)
+                                "batch_retries": sum(
+                                    getattr(h, "batch_retries", 0)
+                                    for h in (
+                                        handles
+                                        if not isinstance(handles, tuple)
+                                        else ()
+                                    )
                                 ),
-                                "batch_sigs_success": int(
-                                    self.metrics.batch_sigs_success.value
-                                    - batch_ok_before
+                                "batch_sigs_success": sum(
+                                    getattr(h, "batch_sigs_success", 0)
+                                    for h in (
+                                        handles
+                                        if not isinstance(handles, tuple)
+                                        else ()
+                                    )
                                 ),
                                 "worker_start_ns": worker_start_ns,
                                 "worker_end_ns": worker_end_ns,

@@ -64,7 +64,8 @@ class _DeviceJob:
     """An in-flight device job: lazy result handles + host-side context."""
 
     __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
-                 "batch_ok", "per_set", "wire", "verdicts")
+                 "batch_ok", "per_set", "wire", "verdicts",
+                 "batch_retries", "batch_sigs_success")
 
     def __init__(self, sets, batchable, ok_big, wire=False):
         self.sets = sets
@@ -77,6 +78,10 @@ class _DeviceJob:
         self.batch_ok = None  # lazy device scalar (RLC batch verdict)
         self.per_set = None  # lazy device vector (per-set verdicts)
         self.verdicts = None  # host per-set bools, set by finish_job retry
+        # per-job accounting (BlsWorkResult parity without racing the
+        # process-global counters — the service reads these)
+        self.batch_retries = 0
+        self.batch_sigs_success = 0
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -300,6 +305,7 @@ class TpuBlsVerifier:
                 # as a batch retry and go straight to per-set verdicts
                 self.metrics.batchable_sigs.inc(len(sets))
                 self.metrics.batch_retries.inc()
+                job.batch_retries += 1
             job.per_set = self._each_fn(job)(*job.args, job.valid)
         return job
 
@@ -342,12 +348,14 @@ class TpuBlsVerifier:
         if job.batch_ok is not None:
             if bool(job.batch_ok):  # device sync point
                 self.metrics.batch_sigs_success.inc(len(sets))
+                job.batch_sigs_success += len(sets)
                 self.metrics.success_jobs.inc(len(sets))
                 return job.ok_big
             # batch failed (or contained an undecodable signature): retry
             # each set individually so one bad signature cannot poison the
             # verdict of honest sets (reference: multithread/worker.ts:74-96)
             self.metrics.batch_retries.inc()
+            job.batch_retries += 1
             job.per_set = self._each_fn(job)(*job.args, job.valid)
         per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
         job.verdicts = per_set  # callers can slice per-set results

@@ -58,6 +58,7 @@ class BeaconChain:
         bls_verifier=None,
         eth1=None,
         execution=None,
+        monitor=None,
         emitter: Optional[ChainEventEmitter] = None,
     ):
         self.config = config
@@ -67,6 +68,7 @@ class BeaconChain:
         self.bls = bls_verifier  # optional batched signature service
         self.eth1 = eth1  # optional Eth1DepositDataTracker
         self.execution = execution  # optional IExecutionEngine
+        self.monitor = monitor  # optional ValidatorMonitor
         # beacon root -> execution block hash (payload-carrying blocks)
         self._execution_block_hash: Dict[str, bytes] = {}
         # roots imported optimistically (EL said SYNCING/ACCEPTED)
@@ -135,8 +137,17 @@ class BeaconChain:
         # grow the maps.
         exec_result = self._verify_execution_payload(block)
 
+        view = None
+        if self.bls is not None or (
+            self.monitor is not None and self.monitor.tracked_indices
+        ):
+            # ONE view serves both signature extraction and monitoring
+            # (the two-epoch committee shuffling is the expensive part)
+            from ..state_transition.signature_sets import BeaconStateView
+
+            view = BeaconStateView.from_state(pre_state)
         if self.bls is not None:
-            ok = self._verify_signatures_batched(pre_state, signed_block)
+            ok = self._verify_signatures_batched(view, signed_block)
             if not ok:
                 raise ValueError("block signature verification failed")
             post = state_transition(
@@ -221,7 +232,66 @@ class BeaconChain:
             ChainEvent.head, bytes.fromhex(self.head_root_hex), block["slot"]
         )
         self._notify_forkchoice()
+        if self.monitor is not None and self.monitor.tracked_indices:
+            self._monitor_imported_block(view, post, signed_block)
         return root
+
+    def _monitor_imported_block(self, view, post, signed_block) -> None:
+        """Feed the ValidatorMonitor from IMPORTED data (reference:
+        validatorMonitor.ts — the chain, not the validator client, is
+        the ground truth for duty performance)."""
+        from ..state_transition.accessors import get_block_root_at_slot
+
+        block = signed_block["message"]
+        mon = self.monitor
+        mon.register_beacon_block(
+            int(block["proposer_index"]), int(block["slot"])
+        )
+        parent_idx = self.fork_choice.proto.indices.get(
+            block["parent_root"].hex()
+        )
+        parent_slot = (
+            self.fork_choice.proto.nodes[parent_idx].slot
+            if parent_idx is not None
+            else int(block["slot"]) - 1
+        )
+        for att in block["body"].get("attestations", []):
+            try:
+                indexed = view.get_indexed_attestation(att)
+            except Exception:
+                continue
+            if not mon.tracked_indices.intersection(
+                int(v) for v in indexed["attesting_indices"]
+            ):
+                continue
+            data = att["data"]
+            try:
+                actual = get_block_root_at_slot(post, int(data["slot"]))
+                correct_head = bytes(data["beacon_block_root"]) == bytes(actual)
+            except Exception:
+                correct_head = False
+            mon.register_attestation_in_block(indexed, parent_slot, correct_head)
+        sync_agg = block["body"].get("sync_aggregate")
+        if sync_agg is not None:
+            epoch = int(block["slot"]) // P.SLOTS_PER_EPOCH
+            participants = view.epoch_cache.get_sync_committee_participant_indices(
+                sync_agg["sync_committee_bits"]
+            )
+            tracked = [
+                int(v) for v in participants if int(v) in mon.tracked_indices
+            ]
+            if tracked:
+                mon.register_sync_aggregate_in_block(epoch, tracked)
+        # epoch close: when the chain enters epoch E, the summaries of
+        # E-2 are final (reference subtracts two for the inclusion
+        # tail).  The PARENT's epoch is the last one already entered —
+        # pre_state is advanced to the block slot, so comparing pre/post
+        # would never fire; skipped epochs each close in turn.
+        parent_epoch = compute_epoch_at_slot(parent_slot)
+        block_epoch = compute_epoch_at_slot(int(block["slot"]))
+        for entered in range(parent_epoch + 1, block_epoch + 1):
+            if entered >= 2:
+                mon.on_epoch_close(entered - 2)
 
     def _verify_execution_payload(self, block: dict):
         """The third verification leg (reference: verifyBlock.ts
@@ -295,16 +365,14 @@ class BeaconChain:
         except Exception as e:  # noqa: BLE001 - EL outage must not kill import
             self.log.warn("engine forkchoiceUpdated failed", error=str(e))
 
-    def _verify_signatures_batched(self, pre_state, signed_block) -> bool:
+    def _verify_signatures_batched(self, view, signed_block) -> bool:
         """One batched job through the injected verifier service using the
         wire signature-set extractors (reference
         verifyBlocksSignatures.ts)."""
         from ..state_transition.signature_sets import (
-            BeaconStateView,
             get_block_signature_sets,
         )
 
-        view = BeaconStateView.from_state(pre_state)
         sets = get_block_signature_sets(view, signed_block)
         if hasattr(self.bls, "verify_signature_sets_async"):
             fut = self.bls.verify_signature_sets_async(sets)

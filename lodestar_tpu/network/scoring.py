@@ -1,0 +1,359 @@
+"""Gossipsub peer-scoring parameters — the policy layer.
+
+Mirror of the reference's scoring parameter derivation (reference:
+packages/beacon-node/src/network/gossip/scoringParameters.ts:1-333,
+itself following Lighthouse's gossipsub_scoring_parameters.rs): per-topic
+TopicScoreParams derived from the chain config and the active validator
+count, plus the global PeerScoreParams and thresholds.  The wire mesh
+(libp2p gossipsub) is off the TPU path (SURVEY §2.4 P9), so these
+parameters drive the in-process PeerScoreBook: an invalid message on a
+topic applies that topic's invalidMessageDeliveries penalty.
+
+All formulas follow the gossipsub v1.1 scoring spec:
+https://github.com/libp2p/specs/blob/master/pubsub/gossipsub/
+gossipsub-v1.1.md#peer-scoring
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .. import params
+from .gossip import GossipTopicName, topic_string
+
+GOSSIP_D = 8
+GOSSIP_D_LOW = 6
+GOSSIP_D_HIGH = 12
+
+MAX_IN_MESH_SCORE = 10.0
+MAX_FIRST_MESSAGE_DELIVERIES_SCORE = 40.0
+BEACON_BLOCK_WEIGHT = 0.5
+BEACON_AGGREGATE_PROOF_WEIGHT = 0.5
+VOLUNTARY_EXIT_WEIGHT = 0.05
+PROPOSER_SLASHING_WEIGHT = 0.05
+ATTESTER_SLASHING_WEIGHT = 0.05
+BLS_TO_EXECUTION_CHANGE_WEIGHT = 0.05
+
+_ATT_SUBNET_WEIGHT = 1 / params.ATTESTATION_SUBNET_COUNT
+MAX_POSITIVE_SCORE = (
+    MAX_IN_MESH_SCORE + MAX_FIRST_MESSAGE_DELIVERIES_SCORE
+) * (
+    BEACON_BLOCK_WEIGHT
+    + BEACON_AGGREGATE_PROOF_WEIGHT
+    + _ATT_SUBNET_WEIGHT * params.ATTESTATION_SUBNET_COUNT
+    + VOLUNTARY_EXIT_WEIGHT
+    + PROPOSER_SLASHING_WEIGHT
+    + ATTESTER_SLASHING_WEIGHT
+    + BLS_TO_EXECUTION_CHANGE_WEIGHT
+)
+
+
+@dataclass(frozen=True)
+class PeerScoreThresholds:
+    """reference: scoringParameters.ts gossipScoreThresholds."""
+
+    gossip_threshold: float = -4000.0
+    publish_threshold: float = -8000.0
+    graylist_threshold: float = -16000.0
+    accept_px_threshold: float = 100.0
+    opportunistic_graft_threshold: float = 5.0
+
+
+GOSSIP_SCORE_THRESHOLDS = PeerScoreThresholds()
+NEGATIVE_GOSSIP_SCORE_IGNORE_THRESHOLD = -1000.0
+
+
+@dataclass
+class TopicScoreParams:
+    topic_weight: float = 0.0
+    time_in_mesh_quantum_ms: float = 0.0
+    time_in_mesh_cap: float = 0.0
+    time_in_mesh_weight: float = 0.0
+    first_message_deliveries_decay: float = 0.0
+    first_message_deliveries_cap: float = 0.0
+    first_message_deliveries_weight: float = 0.0
+    mesh_message_deliveries_decay: float = 0.0
+    mesh_message_deliveries_threshold: float = 0.0
+    mesh_message_deliveries_cap: float = 0.0
+    mesh_message_deliveries_activation_ms: float = 0.0
+    mesh_message_deliveries_window_ms: float = 0.0
+    mesh_message_deliveries_weight: float = 0.0
+    mesh_failure_penalty_decay: float = 0.0
+    mesh_failure_penalty_weight: float = 0.0
+    invalid_message_deliveries_weight: float = 0.0
+    invalid_message_deliveries_decay: float = 0.0
+
+
+@dataclass
+class PeerScoreParams:
+    topics: Dict[str, TopicScoreParams] = field(default_factory=dict)
+    decay_interval_ms: float = 12_000.0
+    decay_to_zero: float = 0.01
+    retain_score_ms: float = 0.0
+    app_specific_weight: float = 1.0
+    ip_colocation_factor_threshold: int = 3
+    ip_colocation_factor_weight: float = 0.0
+    behaviour_penalty_decay: float = 0.0
+    behaviour_penalty_weight: float = 0.0
+    behaviour_penalty_threshold: float = 6.0
+    topic_score_cap: float = 0.0
+
+
+# -- decay math (gossipsub v1.1 spec) ---------------------------------------
+
+
+def score_parameter_decay_with_base(
+    decay_time_ms: float, decay_interval_ms: float, decay_to_zero: float
+) -> float:
+    ticks = decay_time_ms / decay_interval_ms
+    return decay_to_zero ** (1 / ticks)
+
+
+def decay_convergence(decay: float, rate: float) -> float:
+    return rate / (1 - decay)
+
+
+def threshold(decay: float, rate: float) -> float:
+    return decay_convergence(decay, rate) * decay
+
+
+# -- validator-count-dependent rates (scoringParameters.ts:306-329) ---------
+
+
+def expected_aggregator_count_per_slot(active_validator_count: int):
+    """-> (aggregators_per_slot, committees_per_slot)."""
+    spe = params.SLOTS_PER_EPOCH
+    committees_per_slot = max(
+        1,
+        min(
+            params.ACTIVE_PRESET.MAX_COMMITTEES_PER_SLOT,
+            active_validator_count
+            // spe
+            // params.ACTIVE_PRESET.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+    committees_per_epoch = committees_per_slot * spe
+    smaller = active_validator_count // committees_per_epoch
+    larger = smaller + 1
+    large_per_epoch = active_validator_count - smaller * committees_per_epoch
+    small_per_epoch = committees_per_epoch - large_per_epoch
+    mod_small = max(1, smaller // params.TARGET_AGGREGATORS_PER_COMMITTEE)
+    mod_large = max(1, larger // params.TARGET_AGGREGATORS_PER_COMMITTEE)
+    small_aggs = (smaller / mod_small) * small_per_epoch
+    large_aggs = (larger / mod_large) * large_per_epoch
+    return (
+        max(1, int((small_aggs + large_aggs) // spe)),
+        committees_per_slot,
+    )
+
+
+# -- the derivation (scoringParameters.ts computeGossipPeerScoreParams) -----
+
+
+def _topic_params(
+    pre: dict,
+    topic_weight: float,
+    expected_message_rate: float,
+    first_message_decay_time_ms: float,
+    mesh_info: Optional[dict] = None,
+) -> TopicScoreParams:
+    decay_fn = pre["decay_fn"]
+    p = TopicScoreParams()
+    p.topic_weight = topic_weight
+    p.time_in_mesh_quantum_ms = pre["slot_ms"]
+    p.time_in_mesh_cap = 3600 / (p.time_in_mesh_quantum_ms / 1000)
+    p.time_in_mesh_weight = MAX_IN_MESH_SCORE / p.time_in_mesh_cap
+    p.first_message_deliveries_decay = decay_fn(first_message_decay_time_ms)
+    p.first_message_deliveries_cap = decay_convergence(
+        p.first_message_deliveries_decay, 2 * expected_message_rate / GOSSIP_D
+    )
+    p.first_message_deliveries_weight = (
+        MAX_FIRST_MESSAGE_DELIVERIES_SCORE / p.first_message_deliveries_cap
+    )
+    if mesh_info is not None:
+        decay_time_ms = pre["slot_ms"] * mesh_info["decay_slots"]
+        p.mesh_message_deliveries_decay = decay_fn(decay_time_ms)
+        p.mesh_message_deliveries_threshold = threshold(
+            p.mesh_message_deliveries_decay, expected_message_rate / 50
+        )
+        p.mesh_message_deliveries_cap = max(
+            mesh_info["cap_factor"] * p.mesh_message_deliveries_threshold, 2
+        )
+        p.mesh_message_deliveries_activation_ms = mesh_info["activation_ms"]
+        p.mesh_message_deliveries_window_ms = 12_000
+        p.mesh_failure_penalty_decay = p.mesh_message_deliveries_decay
+        p.mesh_message_deliveries_weight = (
+            -MAX_POSITIVE_SCORE
+            / (p.topic_weight * p.mesh_message_deliveries_threshold ** 2)
+        )
+        p.mesh_failure_penalty_weight = p.mesh_message_deliveries_weight
+        if mesh_info["decay_slots"] >= mesh_info["current_slot"]:
+            # young chain: do not punish mesh under-delivery yet
+            p.mesh_message_deliveries_threshold = 0
+            p.mesh_message_deliveries_weight = 0
+    p.invalid_message_deliveries_weight = -MAX_POSITIVE_SCORE / p.topic_weight
+    p.invalid_message_deliveries_decay = decay_fn(pre["epoch_ms"] * 50)
+    return p
+
+
+def compute_gossip_peer_score_params(
+    config,
+    active_validator_count: int,
+    current_slot: int,
+    fork_digest: Optional[bytes] = None,
+) -> PeerScoreParams:
+    """The full parameter set for one fork's topics (reference computes
+    per active fork; compositions call once per fork digest)."""
+    if active_validator_count <= 0:
+        raise ValueError("active_validator_count must be positive")
+    spe = params.SLOTS_PER_EPOCH
+    slot_ms = (
+        getattr(config, "SECONDS_PER_SLOT", params.SECONDS_PER_SLOT) * 1000
+    )
+    epoch_ms = slot_ms * spe
+    decay_interval_ms = slot_ms
+    decay_to_zero = 0.01
+
+    def decay_fn(decay_time_ms: float) -> float:
+        return score_parameter_decay_with_base(
+            decay_time_ms, decay_interval_ms, decay_to_zero
+        )
+
+    pre = {"decay_fn": decay_fn, "slot_ms": slot_ms, "epoch_ms": epoch_ms}
+    digest = fork_digest if fork_digest is not None else config.fork_digest(
+        current_slot
+    )
+
+    def t(name, subnet=None):
+        return topic_string(digest, name, subnet=subnet)
+
+    topics: Dict[str, TopicScoreParams] = {}
+    for name, weight, rate in (
+        (GossipTopicName.voluntary_exit, VOLUNTARY_EXIT_WEIGHT, 4 / spe),
+        (
+            GossipTopicName.proposer_slashing,
+            PROPOSER_SLASHING_WEIGHT,
+            1 / 5 / spe,
+        ),
+        (
+            GossipTopicName.attester_slashing,
+            ATTESTER_SLASHING_WEIGHT,
+            1 / 5 / spe,
+        ),
+    ):
+        topics[t(name)] = _topic_params(
+            pre, weight, rate, first_message_decay_time_ms=epoch_ms * 100
+        )
+
+    topics[t(GossipTopicName.beacon_block)] = _topic_params(
+        pre,
+        BEACON_BLOCK_WEIGHT,
+        expected_message_rate=1,
+        first_message_decay_time_ms=epoch_ms * 20,
+        mesh_info={
+            "decay_slots": spe * 5,
+            "cap_factor": 3,
+            "activation_ms": epoch_ms,
+            "current_slot": current_slot,
+        },
+    )
+
+    aggregators_per_slot, committees_per_slot = (
+        expected_aggregator_count_per_slot(active_validator_count)
+    )
+    topics[t(GossipTopicName.beacon_aggregate_and_proof)] = _topic_params(
+        pre,
+        BEACON_AGGREGATE_PROOF_WEIGHT,
+        expected_message_rate=aggregators_per_slot,
+        first_message_decay_time_ms=epoch_ms,
+        mesh_info={
+            "decay_slots": spe * 2,
+            "cap_factor": 4,
+            "activation_ms": epoch_ms,
+            "current_slot": current_slot,
+        },
+    )
+
+    multiple_bursts = committees_per_slot >= (
+        2 * params.ATTESTATION_SUBNET_COUNT
+    ) / spe
+    att_params = _topic_params(
+        pre,
+        _ATT_SUBNET_WEIGHT,
+        expected_message_rate=(
+            active_validator_count / params.ATTESTATION_SUBNET_COUNT / spe
+        ),
+        first_message_decay_time_ms=(
+            epoch_ms if multiple_bursts else epoch_ms * 4
+        ),
+        mesh_info={
+            "decay_slots": spe * 4 if multiple_bursts else spe * 16,
+            "cap_factor": 16,
+            "activation_ms": (
+                slot_ms * (spe / 2 + 1) if multiple_bursts else epoch_ms
+            ),
+            "current_slot": current_slot,
+        },
+    )
+    for subnet in range(params.ATTESTATION_SUBNET_COUNT):
+        topics[t(GossipTopicName.beacon_attestation, subnet)] = att_params
+
+    behaviour_penalty_decay = decay_fn(epoch_ms * 10)
+    target_value = (
+        decay_convergence(behaviour_penalty_decay, 10 / spe) - 6
+    )
+    topic_score_cap = MAX_POSITIVE_SCORE * 0.5
+    return PeerScoreParams(
+        topics=topics,
+        decay_interval_ms=decay_interval_ms,
+        decay_to_zero=decay_to_zero,
+        retain_score_ms=epoch_ms * 100,
+        app_specific_weight=1,
+        ip_colocation_factor_threshold=3,
+        ip_colocation_factor_weight=-topic_score_cap,
+        behaviour_penalty_decay=behaviour_penalty_decay,
+        behaviour_penalty_weight=(
+            GOSSIP_SCORE_THRESHOLDS.gossip_threshold / target_value ** 2
+        ),
+        behaviour_penalty_threshold=6,
+        topic_score_cap=topic_score_cap,
+    )
+
+
+class GossipPeerScorer:
+    """Applies topic-aware penalties to the PeerScoreBook — the policy
+    consumer that makes the derived parameters real in this composition
+    (the reference hands them to libp2p-gossipsub)."""
+
+    def __init__(self, score_params: PeerScoreParams, score_book):
+        self.params = score_params
+        self.book = score_book
+        # (peer, topic) -> first-delivery counter (caps earned score)
+        self._first_deliveries: Dict[tuple, float] = {}
+
+    def on_invalid_message(self, peer_id: str, topic: str) -> float:
+        tp = self.params.topics.get(topic)
+        weight = (
+            tp.invalid_message_deliveries_weight * tp.topic_weight
+            if tp is not None
+            else -MAX_POSITIVE_SCORE
+        )
+        return self.book.add(peer_id, weight)
+
+    def on_first_delivery(self, peer_id: str, topic: str) -> float:
+        """Credits one first-seen delivery, bounded by the topic's
+        cumulative first_message_deliveries_cap (gossipsub spec: the
+        counter, and therefore the earned score, saturates at the cap)."""
+        tp = self.params.topics.get(topic)
+        if tp is None:
+            return self.book.score(peer_id)
+        key = (peer_id, topic)
+        count = self._first_deliveries.get(key, 0.0)
+        if count >= tp.first_message_deliveries_cap:
+            return self.book.score(peer_id)
+        self._first_deliveries[key] = count + 1
+        return self.book.add(
+            peer_id, tp.first_message_deliveries_weight * tp.topic_weight
+        )

@@ -410,3 +410,17 @@ ExecutionPayloadHeader = Container(
     ),
     name="ExecutionPayloadHeader",
 )
+
+
+BeaconBlockBodyBellatrix = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload", ExecutionPayload),
+    ),
+    name="BeaconBlockBodyBellatrix",
+)
+
+BeaconBlockBellatrix, SignedBeaconBlockBellatrix = _block_types(
+    BeaconBlockBodyBellatrix, "Bellatrix"
+)

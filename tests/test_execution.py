@@ -209,3 +209,45 @@ def test_chain_execution_leg_optimistic_and_invalid():
     no_payload = shell(5, payload)
     del no_payload["body"]["execution_payload"]
     assert chain._verify_execution_payload(no_payload) is None
+
+
+def test_bellatrix_block_types_roundtrip():
+    """Bellatrix SSZ block family (body carries the execution payload);
+    the STF consuming it is the next fork milestone — the engine layer,
+    payload types, and verification leg are ready (see COVERAGE.md)."""
+    el = ExecutionEngineMock()
+    r = el.notify_forkchoice_update(ZERO_HASH, ZERO_HASH, ZERO_HASH, ATTRS)
+    payload = el.get_payload(r.payload_id)
+    body = {
+        "randao_reveal": b"\x00" * 96,
+        "eth1_data": {
+            "deposit_root": b"\x00" * 32,
+            "deposit_count": 0,
+            "block_hash": b"\x00" * 32,
+        },
+        "graffiti": b"\x00" * 32,
+        "proposer_slashings": [],
+        "attester_slashings": [],
+        "attestations": [],
+        "deposits": [],
+        "voluntary_exits": [],
+        "sync_aggregate": {
+            "sync_committee_bits": [False] * 512,
+            "sync_committee_signature": b"\x00" * 96,
+        },
+        "execution_payload": payload,
+    }
+    block = {
+        "slot": 1,
+        "proposer_index": 0,
+        "parent_root": b"\x01" * 32,
+        "state_root": b"\x02" * 32,
+        "body": body,
+    }
+    signed = {"message": block, "signature": b"\x00" * 96}
+    data = T.SignedBeaconBlockBellatrix.serialize(signed)
+    back = T.SignedBeaconBlockBellatrix.deserialize(data)
+    assert T.SignedBeaconBlockBellatrix.serialize(back) == data
+    assert bytes(
+        back["message"]["body"]["execution_payload"]["block_hash"]
+    ) == bytes(payload["block_hash"])

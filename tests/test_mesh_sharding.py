@@ -12,6 +12,8 @@ blocks against the CPU ground truth (SURVEY.md §2.4 P1/P8):
 """
 
 import numpy as np
+import os
+
 import pytest
 
 import jax
@@ -145,10 +147,16 @@ def test_sharded_wire_verifier_builds(mesh):
     assert callable(fn)
 
 
+@pytest.mark.skipif(
+    os.environ.get("LODESTAR_TPU_RUN_SHARDED_KERNELS") != "1",
+    reason="XLA:CPU cannot compile the monolithic interpret-mode pipeline "
+    "(round-4 measurement: algebraic-simplifier loop, >42 min without "
+    "terminating — dev/NOTES.md 'CPU-host costs'); opt in on capable "
+    "hosts / real multi-chip with LODESTAR_TPU_RUN_SHARDED_KERNELS=1",
+)
 def test_sharded_wire_verifier_runs(mesh):
-    """SLOW (default-tier deselected): one sharded wire-path job over
-    the mesh — per-device local pipelines + one all_gather/psum combine
-    + replicated tail.  Budget: tens of minutes on a 1-core host."""
+    """One sharded wire-path job over the mesh — per-device local
+    pipelines + one all_gather/psum combine + replicated tail."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -160,17 +168,9 @@ def test_sharded_wire_verifier_runs(mesh):
     fn_args = G._wire_example(n, distinct=8, seed=b"mesh-kernels")
     _fn, args = fn_args
     sharded = KV.make_sharded_wire_verifier(mesh)
-    specs = [
-        P(), P(),
-        P("sets"), P("sets"),
-        P(None, "sets"), P(None, "sets"), P(None, "sets"), P(None, "sets"),
-        P(None, "sets"), P(None, "sets"), P(None, "sets"),
-        P(None, "sets"),
-        P("sets"),
-    ]
     placed = [
         jax.device_put(a, NamedSharding(mesh, s))
-        for a, s in zip(args, specs)
+        for a, s in zip(args, KV.wire_shard_specs())
     ]
     ok, sub_ok = jax.jit(sharded)(*placed)
     assert bool(ok)

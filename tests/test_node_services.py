@@ -211,8 +211,10 @@ def test_cli_beacon_dev_mode(capsys):
         json.loads(line)
         for line in capsys.readouterr().out.strip().splitlines()
     ]
-    assert lines[0]["msg"] == "beacon node up"
-    proposed = [l for l in lines[1:] if "slot" in l]
+    # the anchor-source line precedes the banner since checkpoint sync
+    assert any(l.get("anchor_source") == "genesis" for l in lines)
+    assert any(l.get("msg") == "beacon node up" for l in lines)
+    proposed = [l for l in lines if "slot" in l and "proposed" in l]
     assert len(proposed) == 2
     assert all(p["proposed"] == 1 for p in proposed)
 

@@ -1,0 +1,133 @@
+"""Gossipsub peer-scoring parameter derivation + score-book consumption.
+
+Reference behaviors: packages/beacon-node/src/network/gossip/
+scoringParameters.ts:1-333 (formulas follow the gossipsub v1.1 scoring
+spec and Lighthouse's parameterization).
+"""
+
+import math
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.network.gossip import GossipTopicName, topic_string
+from lodestar_tpu.network.peers import PeerScoreBook, ScoreState
+from lodestar_tpu.network.scoring import (
+    GOSSIP_SCORE_THRESHOLDS,
+    MAX_POSITIVE_SCORE,
+    GossipPeerScorer,
+    compute_gossip_peer_score_params,
+    decay_convergence,
+    expected_aggregator_count_per_slot,
+    score_parameter_decay_with_base,
+)
+
+pytestmark = pytest.mark.smoke
+
+CFG = MAINNET_CHAIN_CONFIG
+DIGEST = b"\x01\x02\x03\x04"
+
+
+@pytest.fixture(scope="module")
+def score_params():
+    return compute_gossip_peer_score_params(
+        CFG, active_validator_count=500_000, current_slot=10_000,
+        fork_digest=DIGEST,
+    )
+
+
+def test_decay_math():
+    # decaying over N intervals reaches decay_to_zero exactly
+    d = score_parameter_decay_with_base(120_000, 12_000, 0.01)
+    assert math.isclose(d ** 10, 0.01, rel_tol=1e-9)
+    # convergence: c = rate / (1 - decay) is the fixed point of
+    # c' = c * decay + rate
+    c = decay_convergence(d, 5.0)
+    assert math.isclose(c * d + 5.0, c, rel_tol=1e-9)
+
+
+def test_topic_coverage_and_shape(score_params):
+    p = score_params
+    # every scored topic family present; all attestation subnets share params
+    names = [
+        topic_string(DIGEST, GossipTopicName.beacon_block),
+        topic_string(DIGEST, GossipTopicName.beacon_aggregate_and_proof),
+        topic_string(DIGEST, GossipTopicName.voluntary_exit),
+        topic_string(DIGEST, GossipTopicName.proposer_slashing),
+        topic_string(DIGEST, GossipTopicName.attester_slashing),
+    ]
+    for t in names:
+        assert t in p.topics, t
+    subnets = [
+        topic_string(DIGEST, GossipTopicName.beacon_attestation, subnet=s)
+        for s in range(params.ATTESTATION_SUBNET_COUNT)
+    ]
+    for t in subnets:
+        assert t in p.topics
+    assert len({id(p.topics[t]) for t in subnets}) == 1  # shared object
+    assert len(p.topics) == 5 + params.ATTESTATION_SUBNET_COUNT
+
+
+def test_invariants_gossipsub_spec(score_params):
+    """The validity conditions libp2p-gossipsub enforces on params."""
+    p = score_params
+    assert p.topic_score_cap == pytest.approx(MAX_POSITIVE_SCORE * 0.5)
+    assert p.ip_colocation_factor_weight == pytest.approx(-p.topic_score_cap)
+    assert p.behaviour_penalty_weight < 0
+    assert 0 < p.behaviour_penalty_decay < 1
+    for name, tp in p.topics.items():
+        assert tp.topic_weight > 0, name
+        assert tp.first_message_deliveries_cap > 0, name
+        assert tp.first_message_deliveries_weight > 0, name
+        assert 0 < tp.first_message_deliveries_decay < 1, name
+        assert tp.invalid_message_deliveries_weight < 0, name
+        # invalid penalty saturates the max positive score
+        assert (
+            tp.invalid_message_deliveries_weight * tp.topic_weight
+            == pytest.approx(-MAX_POSITIVE_SCORE)
+        ), name
+        if tp.mesh_message_deliveries_weight:
+            assert tp.mesh_message_deliveries_weight < 0, name
+            assert tp.mesh_message_deliveries_cap >= (
+                tp.mesh_message_deliveries_threshold
+            ), name
+
+
+def test_young_chain_disables_mesh_penalty():
+    p = compute_gossip_peer_score_params(
+        CFG, active_validator_count=1000, current_slot=3, fork_digest=DIGEST
+    )
+    tp = p.topics[topic_string(DIGEST, GossipTopicName.beacon_block)]
+    # decay_slots >= current_slot -> no under-delivery punishment yet
+    assert tp.mesh_message_deliveries_weight == 0
+    assert tp.mesh_message_deliveries_threshold == 0
+
+
+def test_aggregator_count_scales():
+    a_small, c_small = expected_aggregator_count_per_slot(2_048)
+    a_big, c_big = expected_aggregator_count_per_slot(1_000_000)
+    assert a_small >= 1 and c_small >= 1
+    assert c_big == params.ACTIVE_PRESET.MAX_COMMITTEES_PER_SLOT
+    assert a_big > a_small
+
+
+def test_zero_validators_rejected():
+    with pytest.raises(ValueError):
+        compute_gossip_peer_score_params(
+            CFG, active_validator_count=0, current_slot=1, fork_digest=DIGEST
+        )
+
+
+def test_scorer_banishes_invalid_spammer(score_params):
+    book = PeerScoreBook()
+    scorer = GossipPeerScorer(score_params, book)
+    topic = topic_string(DIGEST, GossipTopicName.beacon_block)
+    # one invalid block costs the whole positive budget (the book clamps
+    # at its MIN_SCORE floor, like the reference's score bounds)
+    s = scorer.on_invalid_message("peer-x", topic)
+    assert s <= -100.0
+    assert book.state("peer-x") == ScoreState.banned
+    # honest first deliveries stay bounded and positive
+    s2 = scorer.on_first_delivery("peer-y", topic)
+    assert 0 < s2 <= 10.0

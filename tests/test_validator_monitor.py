@@ -1,0 +1,162 @@
+"""ValidatorMonitor: tracked-validator performance from imported blocks.
+
+Reference behaviors: packages/beacon-node/src/metrics/
+validatorMonitor.ts:1-558 (registration, attestation-in-block
+accounting, proposals, sync participation, historic-window pruning,
+missed-duty accounting at epoch close).
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.utils.validator_monitor import (
+    HISTORIC_EPOCHS,
+    ValidatorMonitor,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def _indexed(indices, slot=1, root=b"\x01" * 32):
+    return {
+        "attesting_indices": list(indices),
+        "data": {
+            "slot": slot,
+            "index": 0,
+            "beacon_block_root": root,
+            "source": {"epoch": 0, "root": b"\x00" * 32},
+            "target": {"epoch": slot // params.SLOTS_PER_EPOCH, "root": root},
+        },
+        "signature": b"\x00" * 96,
+    }
+
+
+def test_attestation_accounting_tracked_only():
+    mon = ValidatorMonitor()
+    mon.register_local_validator(3)
+    mon.register_local_validator(5)
+    # indices 3 (tracked) and 9 (untracked) attest at slot 1, included at 2
+    mon.register_attestation_in_block(
+        _indexed([3, 9], slot=1), parent_slot=1, correct_head=True
+    )
+    s = mon.summary_dict(3, 0)
+    assert s["attestations_included"] == 1
+    assert s["attestation_min_delay_slots"] == 1
+    assert s["attestation_correct_head"] == 1
+    assert mon.summary_dict(9, 0)["attestations_included"] == 0  # untracked
+    assert mon.summary_dict(5, 0)["attestations_included"] == 0
+    assert mon.m_attestations.value == 1
+    # a later, worse inclusion does not overwrite the best delay
+    mon.register_attestation_in_block(
+        _indexed([3], slot=1), parent_slot=4, correct_head=False
+    )
+    assert mon.summary_dict(3, 0)["attestation_min_delay_slots"] == 1
+    assert mon.summary_dict(3, 0)["attestations_included"] == 2
+
+
+def test_blocks_and_sync_signals():
+    mon = ValidatorMonitor()
+    mon.register_local_validator(7)
+    mon.register_beacon_block(7, slot=5)
+    mon.register_beacon_block(8, slot=5)  # untracked
+    assert mon.summary_dict(7, 0)["blocks_proposed"] == 1
+    assert mon.m_blocks.value == 1
+    mon.register_local_validator_in_sync_committee(7, until_epoch=10)
+    mon.register_sync_aggregate_in_block(0, [7, 8])
+    assert mon.summary_dict(7, 0)["sync_signals_included"] == 1
+    assert mon.m_sync_signals.value == 1
+
+
+def test_epoch_close_accounts_missed():
+    mon = ValidatorMonitor()
+    mon.register_local_validator(1)
+    mon.register_local_validator(2)
+    mon.register_attestation_in_block(
+        _indexed([1], slot=1), parent_slot=1, correct_head=True
+    )
+    summaries = mon.on_epoch_close(0)
+    assert {s["index"]: s["attestations_included"] for s in summaries} == {
+        1: 1,
+        2: 0,
+    }
+    assert mon.m_missed.value == 1  # validator 2 missed epoch 0
+
+
+def test_historic_window_pruned():
+    mon = ValidatorMonitor()
+    mon.register_local_validator(1)
+    for epoch in range(HISTORIC_EPOCHS + 3):
+        mon.register_attestation_in_block(
+            _indexed([1], slot=epoch * params.SLOTS_PER_EPOCH + 1),
+            parent_slot=epoch * params.SLOTS_PER_EPOCH + 1,
+            correct_head=True,
+        )
+    v = mon._validators[1]
+    assert len(v.summaries) <= HISTORIC_EPOCHS
+
+
+def test_chain_feeds_monitor_on_import():
+    """End-to-end: a real imported block with attestations + sync
+    aggregate lands in the monitor (reference: imported data, not the
+    validator client's submissions)."""
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_committee,
+        get_beacon_proposer_index,
+    )
+    from lodestar_tpu.state_transition.slot import process_slots
+    from lodestar_tpu.validator import ValidatorStore
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"vm-%d" % i) for i in range(32)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    mon = ValidatorMonitor()
+    for i in range(32):
+        mon.register_local_validator(i)
+    chain = BeaconChain(cfg, genesis, monitor=mon)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+
+    def propose(slot):
+        st = genesis.clone()
+        process_slots(st, slot)
+        proposer = get_beacon_proposer_index(st)
+        block = chain.produce_block(slot, store.sign_randao(proposer, slot))
+        signed = {
+            "message": block,
+            "signature": store.sign_block(proposer, block),
+        }
+        chain.process_block(signed)
+        return proposer
+
+    p1 = propose(1)
+    assert mon.summary_dict(p1, 0)["blocks_proposed"] >= 1
+
+    # attest at slot 1 (full-committee aggregate into the block pool),
+    # then import a slot-2 block carrying it
+    committee = get_beacon_committee(chain.head_state, 1, 0)
+    data = chain.produce_attestation_data(0, 1)
+    sigs = [
+        C.g2_decompress(store.sign_attestation(int(v), data))
+        for v in committee
+    ]
+    chain.add_aggregate(
+        {
+            "aggregation_bits": [True] * len(committee),
+            "data": data,
+            "signature": C.g2_compress(B.aggregate_signatures(sigs)),
+        }
+    )
+    propose(2)
+    attester = int(committee[0])
+    s = mon.summary_dict(attester, 0)
+    assert s["attestations_included"] >= 1
+    assert s["attestation_min_delay_slots"] == 1
+    assert s["attestation_correct_head"] >= 1

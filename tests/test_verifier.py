@@ -8,11 +8,20 @@ maybeBatch.ts, multithread/worker.ts:52-96}.
 """
 
 import numpy as np
+import pytest
 
 from lodestar_tpu.bls import PubkeyTable, SignatureSet, TpuBlsVerifier, VerifyOptions
 from lodestar_tpu.crypto import bls as GTB
 from lodestar_tpu.crypto import curves as C
 from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+
+# SLOW TIER: these drive the REAL device pipeline (eager interpret mode
+# on CPU hosts — pathological per-op dispatch, dev/NOTES.md "CPU-host
+# costs"; round-4 measurement: >400 s on the 1-core driver host).  The
+# IBlsVerifier CONTRACT stays covered in the default tier by
+# test_service/test_validation over CpuBlsVerifier; the wire-path device
+# tests (test_verifier_wire) were always slow-tier for the same reason.
+pytestmark = pytest.mark.slow
 
 N_KEYS = 6
 
