@@ -74,15 +74,27 @@ class ApiClient:
             [to_json(Attestation, a) for a in attestations],
         )
 
+    @staticmethod
+    def _signed_block_type(body: dict):
+        """Fork dispatch by content: a bellatrix body carries the
+        execution payload (clients have no ChainConfig)."""
+        from ..types import SignedBeaconBlockAltair, SignedBeaconBlockBellatrix
+
+        if "execution_payload" in body:
+            return SignedBeaconBlockBellatrix
+        return SignedBeaconBlockAltair
+
     def publish_block(self, signed_block: dict):
         """signed_block is an SSZ value; encoded to API JSON here."""
-        from ..types import SignedBeaconBlockAltair
         from .encoding import to_json
 
         return self._request(
             "POST",
             "/eth/v1/beacon/blocks",
-            to_json(SignedBeaconBlockAltair, signed_block),
+            to_json(
+                self._signed_block_type(signed_block["message"]["body"]),
+                signed_block,
+            ),
         )
 
     def get_finality_checkpoints(self, state_id: str = "head") -> dict:
@@ -91,11 +103,16 @@ class ApiClient:
         )["data"]
 
     def get_block(self, block_id: str = "head") -> dict:
-        from ..types import SignedBeaconBlockAltair
+        from ..types import SignedBeaconBlockAltair, SignedBeaconBlockBellatrix
         from .encoding import from_json
 
         payload = self._request("GET", f"/eth/v2/beacon/blocks/{block_id}")
-        return from_json(SignedBeaconBlockAltair, payload["data"])
+        typ = (
+            SignedBeaconBlockBellatrix
+            if payload.get("version") == "bellatrix"
+            else SignedBeaconBlockAltair
+        )
+        return from_json(typ, payload["data"])
 
     # -- validator ---------------------------------------------------------
 
@@ -157,7 +174,7 @@ class ApiClient:
     def produce_block_v2(
         self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
     ) -> dict:
-        from ..types import BeaconBlockAltair
+        from ..types import BeaconBlockAltair, BeaconBlockBellatrix
         from .encoding import from_json
 
         payload = self._request(
@@ -166,7 +183,12 @@ class ApiClient:
             f"?randao_reveal=0x{randao_reveal.hex()}"
             f"&graffiti=0x{graffiti.hex()}",
         )
-        return from_json(BeaconBlockAltair, payload["data"])
+        typ = (
+            BeaconBlockBellatrix
+            if payload.get("version") == "bellatrix"
+            else BeaconBlockAltair
+        )
+        return from_json(typ, payload["data"])
 
     def submit_proposer_slashing(self, slashing: dict):
         from ..types import ProposerSlashing

@@ -229,7 +229,6 @@ class DefaultHandlers:
         err = self._need_chain()
         if err:
             return err
-        from ..types import BeaconBlockAltair
         from .encoding import to_json
 
         reveal = bytes.fromhex(params["randao_reveal"][2:])
@@ -238,20 +237,26 @@ class DefaultHandlers:
             if "graffiti" in params
             else b"\x00" * 32
         )
-        block = self.chain.produce_block(int(params["slot"]), reveal, graffiti)
+        slot = int(params["slot"])
+        block = self.chain.produce_block(slot, reveal, graffiti)
+        block_type = self.chain.config.get_fork_types(slot)[0]
         return 200, {
-            "version": "altair",
-            "data": to_json(BeaconBlockAltair, block),
+            "version": self.chain.config.get_fork_name(slot).value,
+            "data": to_json(block_type, block),
         }
 
     def publish_block(self, params, body):
         err = self._need_chain()
         if err:
             return err
-        from ..types import SignedBeaconBlockAltair
         from .encoding import from_json
 
-        signed = from_json(SignedBeaconBlockAltair, body)
+        # fork dispatch by content: bellatrix bodies carry the payload
+        # (the JSON wire has no version envelope on POST)
+        signed_type = self.chain.config.get_fork_types(
+            int(body["message"]["slot"])
+        )[1]
+        signed = from_json(signed_type, body)
         # proposer boost: timely iff the block arrives before 1/3 slot
         # (reference: forkChoice.ts onBlock blockDelaySec vs
         # SECONDS_PER_SLOT / INTERVALS_PER_SLOT)
@@ -546,12 +551,13 @@ class DefaultHandlers:
         if err:
             return err
         _root, signed = found
-        from ..types import SignedBeaconBlockAltair
         from .encoding import to_json
 
+        slot = int(signed["message"]["slot"])
+        signed_type = self.chain.config.get_fork_types(slot)[1]
         return 200, {
-            "version": "altair",
-            "data": to_json(SignedBeaconBlockAltair, signed),
+            "version": self.chain.config.get_fork_name(slot).value,
+            "data": to_json(signed_type, signed),
         }
 
     def get_block_header(self, params, body):

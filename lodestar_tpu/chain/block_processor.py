@@ -86,7 +86,9 @@ class BlockProcessor:
                 )
                 extracted.append(sets)
                 block = signed["message"]
-                root = BeaconBlockAltair.hash_tree_root(block)
+                root = self.state.config.get_fork_types(
+                    block["slot"]
+                )[0].hash_tree_root(block)
                 segment_roots.append(root)
                 slot = block["slot"]
                 if slot not in prior:

@@ -30,6 +30,7 @@ from ..state_transition.util import (
     compute_epoch_at_slot,
     compute_start_slot_at_epoch,
 )
+from .. import types as T
 from ..types import BeaconBlockAltair, BeaconBlockHeader
 from ..utils.logger import get_logger
 from .emitter import ChainEvent, ChainEventEmitter
@@ -106,6 +107,10 @@ class BeaconChain:
 
         self.imported_blocks = 0
 
+    def _block_type(self, slot: int):
+        """Fork-aware block container (reference: config.getForkTypes)."""
+        return self.config.get_fork_types(slot)[0]
+
     # -- head --------------------------------------------------------------
 
     @property
@@ -122,7 +127,7 @@ class BeaconChain:
         arrived before 1/3 slot — it receives the proposer score boost
         (reference: forkChoice.ts onBlock blockDelaySec gate)."""
         block = signed_block["message"]
-        root = BeaconBlockAltair.hash_tree_root(block)
+        root = self._block_type(int(block["slot"])).hash_tree_root(block)
         if self.fork_choice.has_block(root.hex()):
             return root  # already imported
 
@@ -398,6 +403,7 @@ class BeaconChain:
             head_root=self.get_head_root(),
             graffiti=graffiti,
             eth1=self.eth1,
+            execution=self.execution,
         )
         return block
 

@@ -42,8 +42,10 @@ def produce_block_body(
     voluntary_exits: Optional[List[Dict]] = None,
     sync_aggregate: Optional[Dict] = None,
     eth1_data: Optional[Dict] = None,
+    execution_payload: Optional[Dict] = None,
 ) -> Dict:
-    """Assemble an altair block body (reference produceBlockBody.ts)."""
+    """Assemble an altair/bellatrix block body (reference
+    produceBlockBody.ts; the payload slot activates with the fork)."""
     body = {
         "randao_reveal": randao_reveal,
         "eth1_data": dict(eth1_data or state.eth1_data),
@@ -55,6 +57,8 @@ def produce_block_body(
         "voluntary_exits": list(voluntary_exits or []),
         "sync_aggregate": dict(sync_aggregate or default_sync_aggregate()),
     }
+    if execution_payload is not None:
+        body["execution_payload"] = dict(execution_payload)
     return body
 
 
@@ -71,6 +75,7 @@ def produce_block_from_pools(
     eth1_data: Optional[Dict] = None,
     deposits: Optional[List[Dict]] = None,
     eth1=None,
+    execution=None,
 ) -> Tuple[Dict, object]:
     """produceBlockBody from the op pools (reference
     produceBlockBody.ts:66-118): attestations ranked by participation,
@@ -107,6 +112,7 @@ def produce_block_from_pools(
         pre,
         slot,
         randao_reveal,
+        execution=execution,
         graffiti=graffiti,
         eth1_data=eth1_data,
         deposits=deposits,
@@ -118,10 +124,43 @@ def produce_block_from_pools(
     )
 
 
+def _fetch_payload(execution, pre) -> Dict:
+    """engine_forkchoiceUpdated(attributes) + engine_getPayload against
+    the state's latest header (reference: produceBlockBody.ts
+    prepareExecutionPayload)."""
+    from ..execution import PayloadAttributes
+    from ..state_transition.accessors import get_randao_mix
+
+    from ..state_transition.block import is_merge_transition_complete
+
+    parent_hash = (
+        bytes(pre.latest_execution_payload_header["block_hash"])
+        if is_merge_transition_complete(pre)
+        else b"\x00" * 32
+    )
+    r = execution.notify_forkchoice_update(
+        parent_hash,
+        parent_hash,
+        b"\x00" * 32,
+        PayloadAttributes(
+            timestamp=int(pre.genesis_time)
+            + pre.slot * params.SECONDS_PER_SLOT,
+            prev_randao=get_randao_mix(
+                pre, pre.slot // P.SLOTS_PER_EPOCH
+            ),
+            suggested_fee_recipient=b"\x00" * 20,
+        ),
+    )
+    if r.payload_id is None:
+        raise ValueError(f"EL did not prepare a payload ({r.status})")
+    return execution.get_payload(r.payload_id)
+
+
 def produce_block(
     state,
     slot: int,
     randao_reveal: bytes,
+    execution=None,
     **body_kwargs,
 ) -> Tuple[Dict, object]:
     """Build an unsigned block at `slot` on top of `state`.
@@ -133,6 +172,17 @@ def produce_block(
         process_slots(pre, slot)
     proposer_index = get_beacon_proposer_index(pre)
     parent_root = BeaconBlockHeader.hash_tree_root(pre.latest_block_header)
+    if (
+        pre.latest_execution_payload_header is not None
+        and body_kwargs.get("execution_payload") is None
+    ):
+        # bellatrix proposal: fetch the payload from the EL (reference:
+        # produceBlockBody.ts engine getPayload leg)
+        if execution is None:
+            raise ValueError(
+                "post-bellatrix proposal requires an execution engine"
+            )
+        body_kwargs["execution_payload"] = _fetch_payload(execution, pre)
     body = produce_block_body(pre, randao_reveal, **body_kwargs)
     block = {
         "slot": slot,

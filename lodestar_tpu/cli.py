@@ -352,7 +352,7 @@ def cmd_lightclient(args) -> int:
             head, slot, reveal, sync_aggregate=sync_aggregate
         )
         broot = cfg.compute_signing_root(
-            T.BeaconBlockAltair.hash_tree_root(block),
+            cfg.get_fork_types(slot)[0].hash_tree_root(block),
             cfg.get_domain(slot, _p.DOMAIN_BEACON_PROPOSER, slot),
         )
         chain.process_block(

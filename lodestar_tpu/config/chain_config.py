@@ -74,6 +74,27 @@ class ChainConfig:
                 active = f
         return active
 
+    def get_fork_types(self, slot: int):
+        """(block, signed_block, body) SSZ containers for the fork at
+        `slot` (reference: config.getForkTypes — the ONE fork->type
+        dispatch every serializer/signer/hasher must use)."""
+        from .. import types as T
+
+        name = self.get_fork_name(slot)
+        if name == ForkName.phase0:
+            return T.BeaconBlock, T.SignedBeaconBlock, T.BeaconBlockBody
+        if name == ForkName.altair:
+            return (
+                T.BeaconBlockAltair,
+                T.SignedBeaconBlockAltair,
+                T.BeaconBlockBodyAltair,
+            )
+        return (
+            T.BeaconBlockBellatrix,
+            T.SignedBeaconBlockBellatrix,
+            T.BeaconBlockBodyBellatrix,
+        )
+
     def get_fork_seq(self, slot: int) -> int:
         return params.FORK_SEQ[self.get_fork_name(slot)]
 

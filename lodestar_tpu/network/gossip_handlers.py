@@ -64,12 +64,27 @@ class GossipHandlers:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _signed_block_type_for_digest(self, digest: bytes):
+        """Fork dispatch from the topic's fork digest (gossip topics are
+        per-fork; reference: gossip/topic.ts sszType selection)."""
+        from .. import params as _p
+
+        cfg = self.chain.config
+        for fork, epoch in cfg.fork_epochs.items():
+            slot = epoch * _p.SLOTS_PER_EPOCH
+            try:
+                if cfg.fork_digest(slot) == digest:
+                    return cfg.get_fork_types(slot)[1]
+            except Exception:  # unscheduled fork (FAR_FUTURE overflow)
+                continue
+        return T.SignedBeaconBlockAltair
+
     def handle(self, topic: str, data: bytes) -> GossipAction | None:
         """Returns None on ACCEPT, else the failure action."""
-        _digest, name = parse_topic(topic)
+        digest, name = parse_topic(topic)
         try:
             payload = decode_message(data)
-            action = self._dispatch(name, payload)
+            action = self._dispatch(name, payload, digest)
         except GossipValidationError as e:
             self._count(name, e.action.value)
             self.log.debug("gossip rejected", topic=name, reason=e.reason)
@@ -97,12 +112,14 @@ class GossipHandlers:
         clock-less compositions."""
         self._prune(slot)
 
-    def _dispatch(self, name: str, payload: bytes) -> None:
+    def _dispatch(self, name: str, payload: bytes, digest: bytes) -> None:
         v = self.validators
         if name == "beacon_block":
             from ..execution import ExecutionEngineUnavailable
 
-            signed = T.SignedBeaconBlockAltair.deserialize(payload)
+            signed = self._signed_block_type_for_digest(digest).deserialize(
+                payload
+            )
             slot = int(signed["message"]["slot"])
             proposer = int(signed["message"]["proposer_index"])
             # one block per proposer per slot at the gossip layer

@@ -97,14 +97,7 @@ def process_block_header(state, block: Dict) -> None:
 
 
 def _body_type(state, slot: int):
-    from ..types import BeaconBlockBody, BeaconBlockBodyAltair
-
-    name = state.config.get_fork_name(slot)
-    return (
-        BeaconBlockBody
-        if name == params.ForkName.phase0
-        else BeaconBlockBodyAltair
-    )
+    return state.config.get_fork_types(slot)[2]
 
 
 # -- randao -----------------------------------------------------------------
@@ -671,10 +664,100 @@ def process_operations(state, body: Dict, verify_signatures: bool) -> None:
         process_voluntary_exit(state, op, verify_signatures)
 
 
+def is_merge_transition_complete(state) -> bool:
+    """The payload header differs from the default (spec
+    is_merge_transition_complete)."""
+    from ..types import ExecutionPayloadHeader
+
+    header = state.latest_execution_payload_header
+    return header is not None and ExecutionPayloadHeader.hash_tree_root(
+        header
+    ) != ExecutionPayloadHeader.hash_tree_root(ExecutionPayloadHeader.default())
+
+
+def payload_to_header(payload: Dict) -> Dict:
+    """ExecutionPayload -> ExecutionPayloadHeader (transactions list ->
+    transactions_root)."""
+    from ..types import Transaction
+    from ..ssz import List as SszList
+
+    txs_root = SszList(Transaction, 1_048_576).hash_tree_root(
+        payload["transactions"]
+    )
+    header = {
+        k: payload[k]
+        for k in (
+            "parent_hash", "fee_recipient", "state_root", "receipts_root",
+            "logs_bloom", "prev_randao", "block_number", "gas_limit",
+            "gas_used", "timestamp", "extra_data", "base_fee_per_gas",
+            "block_hash",
+        )
+    }
+    header["transactions_root"] = txs_root
+    return header
+
+
+def _is_nondefault_payload(payload: Dict) -> bool:
+    """spec is_merge_transition_block's payload != ExecutionPayload()
+    test (a default payload means execution is not yet enabled)."""
+    from ..types import ExecutionPayload
+
+    return ExecutionPayload.hash_tree_root(
+        payload
+    ) != ExecutionPayload.hash_tree_root(ExecutionPayload.default())
+
+
+def process_execution_payload(state, payload: Dict) -> None:
+    """Consensus-side payload checks + header update (reference:
+    bellatrix block/processExecutionPayload.ts).  EL-side validity
+    (engine_newPayload) runs at the chain layer as the parallel
+    verification leg — NOT here."""
+    from .accessors import get_randao_mix
+
+    _require(
+        state.latest_execution_payload_header is not None,
+        "pre-bellatrix state cannot process an execution payload",
+    )
+    if is_merge_transition_complete(state):
+        _require(
+            bytes(payload["parent_hash"])
+            == bytes(state.latest_execution_payload_header["block_hash"]),
+            "payload parent hash does not extend the latest header",
+        )
+    epoch = compute_epoch_at_slot(state.slot)
+    _require(
+        bytes(payload["prev_randao"]) == bytes(get_randao_mix(state, epoch)),
+        "payload prev_randao mismatch",
+    )
+    expected_time = (
+        state.genesis_time + state.slot * params.SECONDS_PER_SLOT
+    )
+    _require(
+        int(payload["timestamp"]) == expected_time,
+        f"payload timestamp {payload['timestamp']} != slot time {expected_time}",
+    )
+    state.latest_execution_payload_header = payload_to_header(payload)
+
+
 def process_block(state, block: Dict, verify_signatures: bool = False) -> None:
-    """Full altair block processing (reference block/index.ts order)."""
+    """Full altair/bellatrix block processing (reference block/index.ts
+    order; the payload step activates once the state carries a header)."""
     process_block_header(state, block)
     body = block["body"]
+    if state.latest_execution_payload_header is not None:
+        _require(
+            "execution_payload" in body,
+            "bellatrix block must carry an execution payload",
+        )
+        # spec is_execution_enabled: process the payload once the merge
+        # transition is complete OR this block IS the transition block
+        # (non-default payload); a pre-merge default payload is skipped.
+        if is_merge_transition_complete(state) or _is_nondefault_payload(
+            body["execution_payload"]
+        ):
+            # spec order: the payload step precedes randao — its
+            # prev_randao check reads the PRE-block mix
+            process_execution_payload(state, body["execution_payload"])
     process_randao(state, body, verify_signatures)
     process_eth1_data(state, body)
     process_operations(state, body, verify_signatures)

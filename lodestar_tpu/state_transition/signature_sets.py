@@ -90,10 +90,8 @@ class BeaconStateView:
 
 
 def _block_types(config: ChainConfig, slot: int):
-    fork = config.get_fork_name(slot)
-    if fork == ForkName.phase0:
-        return T.BeaconBlock, T.BeaconBlockBody
-    return T.BeaconBlockAltair, T.BeaconBlockBodyAltair
+    block, _signed, body = config.get_fork_types(slot)
+    return block, body
 
 
 def _signing_root(config: ChainConfig, state_slot, domain_type, msg_slot, obj_root):

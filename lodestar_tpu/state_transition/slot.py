@@ -3,9 +3,11 @@
 Reference: packages/state-transition/src/slot/index.ts (processSlot),
 stateTransition.ts (stateTransition / processSlots; the
 eth2fastspec-style "cache roots then maybe epoch-transition" loop).
-Fork upgrades are a no-op here because the TPU build's canonical state
-IS the altair family (minimal config activates altair at epoch 0);
-phase0 pre-states are out of the replay window this framework targets.
+The canonical working state is the altair family (phase0 pre-states are
+out of the replay window); the BELLATRIX fork upgrade runs at its
+scheduled epoch boundary (reference: slot/upgradeStateToBellatrix.ts),
+attaching the execution-payload header that process_execution_payload
+maintains thereafter.
 """
 
 from __future__ import annotations
@@ -13,7 +15,8 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from .. import params
-from ..types import BeaconBlockHeader
+from ..params import ForkName
+from ..types import BeaconBlockHeader, ExecutionPayloadHeader
 from .epoch import process_epoch
 
 P = params.ACTIVE_PRESET
@@ -43,3 +46,32 @@ def process_slots(state, slot: int, metrics: Optional[Dict] = None) -> None:
         if (state.slot + 1) % P.SLOTS_PER_EPOCH == 0:
             process_epoch(state)
         state.slot += 1
+        maybe_upgrade_state(state)
+
+
+def maybe_upgrade_state(state) -> None:
+    """Run the scheduled fork upgrade when the state enters the fork's
+    first slot (reference: stateTransition.ts processSlotsWithTransientCache
+    -> upgradeStateToX at epoch boundaries)."""
+    if state.slot % P.SLOTS_PER_EPOCH != 0:
+        return
+    epoch = state.slot // P.SLOTS_PER_EPOCH
+    bellatrix_epoch = state.config.fork_epochs.get(ForkName.bellatrix)
+    if (
+        bellatrix_epoch is not None
+        and epoch == bellatrix_epoch
+        and state.latest_execution_payload_header is None
+    ):
+        upgrade_to_bellatrix(state)
+
+
+def upgrade_to_bellatrix(state) -> None:
+    """reference: slot/upgradeStateToBellatrix.ts — bump the fork record
+    and attach the default (pre-merge) execution payload header."""
+    epoch = state.slot // P.SLOTS_PER_EPOCH
+    state.fork = {
+        "previous_version": state.fork["current_version"],
+        "current_version": state.config.fork_versions[ForkName.bellatrix],
+        "epoch": epoch,
+    }
+    state.latest_execution_payload_header = ExecutionPayloadHeader.default()

@@ -38,8 +38,7 @@ from ..types import (
 P = params.ACTIVE_PRESET
 
 # Full altair BeaconState SSZ type (reference: types/src/altair/sszTypes.ts)
-BeaconStateAltair = Container(
-    (
+_altair_state_fields = (
         ("genesis_time", uint64),
         ("genesis_validators_root", Bytes32),
         ("slot", uint64),
@@ -75,8 +74,18 @@ BeaconStateAltair = Container(
         ("inactivity_scores", SszList(uint64, P.VALIDATOR_REGISTRY_LIMIT)),
         ("current_sync_committee", SyncCommittee),
         ("next_sync_committee", SyncCommittee),
-    ),
-    name="BeaconStateAltair",
+)
+
+BeaconStateAltair = Container(_altair_state_fields, name="BeaconStateAltair")
+
+# bellatrix appends the execution-payload header
+# (reference: types/src/bellatrix/sszTypes.ts BeaconState)
+from ..types import ExecutionPayloadHeader as _ExecutionPayloadHeader  # noqa: E402
+
+BeaconStateBellatrix = Container(
+    _altair_state_fields
+    + (("latest_execution_payload_header", _ExecutionPayloadHeader),),
+    name="BeaconStateBellatrix",
 )
 
 _U64 = np.uint64
@@ -159,6 +168,8 @@ class BeaconState:
     next_sync_committee: Dict = field(
         default_factory=lambda: SyncCommittee.default()
     )
+    # None = pre-bellatrix state; set by upgrade_to_bellatrix
+    latest_execution_payload_header: Optional[Dict] = None
 
     # -- registry ----------------------------------------------------------
 
@@ -275,6 +286,9 @@ class BeaconState:
         out.finalized_checkpoint = dict(self.finalized_checkpoint)
         out.current_sync_committee = copy.deepcopy(self.current_sync_committee)
         out.next_sync_committee = copy.deepcopy(self.next_sync_committee)
+        out.latest_execution_payload_header = copy.deepcopy(
+            self.latest_execution_payload_header
+        )
         return out
 
     def validators_value(self) -> List[Dict]:
@@ -296,7 +310,7 @@ class BeaconState:
 
     def to_value(self) -> Dict:
         """Materialize the SSZ container value."""
-        return {
+        out = {
             "genesis_time": self.genesis_time,
             "genesis_validators_root": self.genesis_validators_root,
             "slot": self.slot,
@@ -326,6 +340,11 @@ class BeaconState:
             "current_sync_committee": self.current_sync_committee,
             "next_sync_committee": self.next_sync_committee,
         }
+        if self.latest_execution_payload_header is not None:
+            out["latest_execution_payload_header"] = (
+                self.latest_execution_payload_header
+            )
+        return out
 
     @classmethod
     def from_value(cls, value: Dict, config: ChainConfig) -> "BeaconState":
@@ -380,14 +399,43 @@ class BeaconState:
         st.inactivity_scores = np.asarray(value["inactivity_scores"], _U64)
         st.current_sync_committee = dict(value["current_sync_committee"])
         st.next_sync_committee = dict(value["next_sync_committee"])
+        if "latest_execution_payload_header" in value:
+            st.latest_execution_payload_header = dict(
+                value["latest_execution_payload_header"]
+            )
         return st
 
+    # -- fork-aware container selection ------------------------------------
+
+    def _container(self):
+        return (
+            BeaconStateBellatrix
+            if self.latest_execution_payload_header is not None
+            else BeaconStateAltair
+        )
+
+    @staticmethod
+    def _container_for_bytes(data: bytes, config: ChainConfig):
+        """Pick the SSZ container from the fork version embedded in the
+        serialized state (Fork.current_version at fixed offset 52:56 —
+        genesis_time 8 + genesis_validators_root 32 + slot 8 +
+        previous_version 4)."""
+        version = bytes(data[52:56])
+        for name, v in config.fork_versions.items():
+            if v == version:
+                order = list(params.ForkName)
+                if order.index(name) >= order.index(params.ForkName.bellatrix):
+                    return BeaconStateBellatrix
+                return BeaconStateAltair
+        return BeaconStateAltair
+
     def hash_tree_root(self) -> bytes:
-        return BeaconStateAltair.hash_tree_root(self.to_value())
+        return self._container().hash_tree_root(self.to_value())
 
     def serialize(self) -> bytes:
-        return BeaconStateAltair.serialize(self.to_value())
+        return self._container().serialize(self.to_value())
 
     @classmethod
     def deserialize(cls, data: bytes, config: ChainConfig) -> "BeaconState":
-        return cls.from_value(BeaconStateAltair.deserialize(data), config)
+        container = cls._container_for_bytes(data, config)
+        return cls.from_value(container.deserialize(data), config)

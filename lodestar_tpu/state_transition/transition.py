@@ -23,11 +23,7 @@ P = params.ACTIVE_PRESET
 
 
 def _block_type(config, slot: int):
-    return (
-        BeaconBlock
-        if config.get_fork_name(slot) == params.ForkName.phase0
-        else BeaconBlockAltair
-    )
+    return config.get_fork_types(slot)[0]
 
 
 def verify_proposer_signature(state, signed_block: Dict) -> bool:

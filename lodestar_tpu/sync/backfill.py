@@ -57,8 +57,9 @@ class BackfillSync:
         domain = self.config.get_domain(
             block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
         )
+        block_type = self.config.get_fork_types(block["slot"])[0]
         root = self.config.compute_signing_root(
-            BeaconBlockAltair.hash_tree_root(block), domain
+            block_type.hash_tree_root(block), domain
         )
         return WireSignatureSet.single(
             int(block["proposer_index"]), root, signed["signature"]
@@ -79,7 +80,9 @@ class BackfillSync:
             )
         for signed in batch:
             block = signed["message"]
-            root = BeaconBlockAltair.hash_tree_root(block)
+            root = self.config.get_fork_types(block["slot"])[0].hash_tree_root(
+                block
+            )
             self.db.archive_block(int(block["slot"]), signed, root=root)
             self.verified_blocks += 1
             self.lowest_backfilled_slot = int(block["slot"])
@@ -116,7 +119,9 @@ class BackfillSync:
                 )
             signed = blocks[0]
             block = signed["message"]
-            root = BeaconBlockAltair.hash_tree_root(block)
+            root = self.config.get_fork_types(block["slot"])[0].hash_tree_root(
+                block
+            )
             if root != expected:
                 raise BackfillError(
                     f"linkage broken: fetched block roots to "

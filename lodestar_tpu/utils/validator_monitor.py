@@ -53,6 +53,7 @@ class ValidatorMonitor:
         self.registry = r
         self.log = get_logger("validator-monitor")
         self._validators: Dict[int, _Tracked] = {}
+        self._last_closed_epoch = -1
         p = "validator_monitor_"
         self.m_validators = r.gauge(
             p + "validators_total", "Count of tracked validators"
@@ -170,7 +171,15 @@ class ValidatorMonitor:
 
     def on_epoch_close(self, closed_epoch: int) -> List[dict]:
         """Account missed attestation duties for `closed_epoch` and
-        return the per-validator summaries (the REST surface)."""
+        return the per-validator summaries (the REST surface).
+        Idempotent per epoch: competing imported branches both crossing
+        the same boundary must not double-count misses."""
+        if closed_epoch <= self._last_closed_epoch:
+            return [
+                self.summary_dict(i, closed_epoch)
+                for i in sorted(self._validators)
+            ]
+        self._last_closed_epoch = closed_epoch
         out = []
         for v in self._validators.values():
             s = v.summaries.get(closed_epoch)

@@ -215,8 +215,9 @@ class ValidatorStore:
         self._check_doppelganger(validator_index)
         pk = self.pubkeys[validator_index]
         self.slashing.check_block(pk, block["slot"])
+        block_type = self.config.get_fork_types(block["slot"])[0]
         root = self.config.compute_signing_root(
-            T.BeaconBlockAltair.hash_tree_root(block),
+            block_type.hash_tree_root(block),
             self.config.get_domain(
                 block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
             ),

@@ -10,7 +10,15 @@ import pytest
 
 from lodestar_tpu import params
 from lodestar_tpu import types as T
-from lodestar_tpu.config import MAINNET_CHAIN_CONFIG
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.params import ForkName
+
+# altair-activated schedule: this framework's produced bodies are the
+# altair family, and signing containers are fork-dispatched (the raw
+# mainnet schedule would put early slots in phase0)
+CFG = create_chain_config(
+    MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+)
 from lodestar_tpu.crypto import bls as B
 from lodestar_tpu.crypto import curves as C
 from lodestar_tpu.validator import (
@@ -26,7 +34,7 @@ P = params.ACTIVE_PRESET
 @pytest.fixture()
 def store():
     sks = {i: B.keygen(b"vsvc-%d" % i) for i in range(2)}
-    return ValidatorStore(MAINNET_CHAIN_CONFIG, sks)
+    return ValidatorStore(CFG, sks)
 
 
 class FakeBlockApi:
