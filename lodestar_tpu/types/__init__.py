@@ -424,3 +424,98 @@ BeaconBlockBodyBellatrix = Container(
 BeaconBlockBellatrix, SignedBeaconBlockBellatrix = _block_types(
     BeaconBlockBodyBellatrix, "Bellatrix"
 )
+
+
+# -- capella / deneb type layer (reference: types/src/{capella,deneb}/
+# sszTypes.ts) — the containers the later forks add; their STF variants
+# are future work (withdrawals + blobs are off the BLS path, BASELINE) --
+
+Withdrawal = Container(
+    (
+        ("index", uint64),
+        ("validator_index", ValidatorIndex),
+        ("address", ByteVector(20)),
+        ("amount", Gwei),
+    ),
+    name="Withdrawal",
+)
+
+MAX_WITHDRAWALS_PER_PAYLOAD = 16
+
+ExecutionPayloadCapella = Container(
+    _payload_header_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions", List(Transaction, 1_048_576)),
+        ("withdrawals", List(Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD)),
+    ),
+    name="ExecutionPayloadCapella",
+)
+
+ExecutionPayloadHeaderCapella = Container(
+    _payload_header_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions_root", Bytes32),
+        ("withdrawals_root", Bytes32),
+    ),
+    name="ExecutionPayloadHeaderCapella",
+)
+
+BeaconBlockBodyCapella = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload", ExecutionPayloadCapella),
+        (
+            "bls_to_execution_changes",
+            List(SignedBLSToExecutionChange, 16),
+        ),
+    ),
+    name="BeaconBlockBodyCapella",
+)
+
+BeaconBlockCapella, SignedBeaconBlockCapella = _block_types(
+    BeaconBlockBodyCapella, "Capella"
+)
+
+# deneb: blob KZG commitments ride the block body (KZG verification is
+# out of scope per BASELINE; the type layer carries the commitments)
+KZGCommitment = Bytes48
+MAX_BLOB_COMMITMENTS_PER_BLOCK = 4096
+
+_deneb_payload_fields = _payload_header_fields + (
+    ("blob_gas_used", uint64),
+    ("excess_blob_gas", uint64),
+)
+
+ExecutionPayloadDeneb = Container(
+    _deneb_payload_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions", List(Transaction, 1_048_576)),
+        ("withdrawals", List(Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD)),
+    ),
+    name="ExecutionPayloadDeneb",
+)
+
+BeaconBlockBodyDeneb = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload", ExecutionPayloadDeneb),
+        (
+            "bls_to_execution_changes",
+            List(SignedBLSToExecutionChange, 16),
+        ),
+        (
+            "blob_kzg_commitments",
+            List(KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK),
+        ),
+    ),
+    name="BeaconBlockBodyDeneb",
+)
+
+BeaconBlockDeneb, SignedBeaconBlockDeneb = _block_types(
+    BeaconBlockBodyDeneb, "Deneb"
+)

@@ -182,3 +182,45 @@ def test_signed_block_round_trip():
     assert T.SignedBeaconBlockAltair.hash_tree_root(signed) == (
         T.SignedBeaconBlockAltair.hash_tree_root(back)
     )
+
+
+def test_capella_deneb_block_families_roundtrip():
+    """The later-fork containers (reference: types/src/{capella,deneb}/
+    sszTypes.ts) serialize + hash; their STF variants are future forks
+    (COVERAGE.md descope)."""
+    from lodestar_tpu import types as T
+
+    payload = {
+        "parent_hash": b"\x01" * 32,
+        "fee_recipient": b"\x02" * 20,
+        "state_root": b"\x03" * 32,
+        "receipts_root": b"\x04" * 32,
+        "logs_bloom": b"\x00" * 256,
+        "prev_randao": b"\x05" * 32,
+        "block_number": 9,
+        "gas_limit": 30_000_000,
+        "gas_used": 21_000,
+        "timestamp": 12,
+        "extra_data": b"cap",
+        "base_fee_per_gas": 7,
+        "block_hash": b"\x06" * 32,
+        "transactions": [b"\xaa\xbb"],
+        "withdrawals": [
+            {
+                "index": 0,
+                "validator_index": 3,
+                "address": b"\x07" * 20,
+                "amount": 64,
+            }
+        ],
+    }
+    data = T.ExecutionPayloadCapella.serialize(payload)
+    back = T.ExecutionPayloadCapella.deserialize(data)
+    assert T.ExecutionPayloadCapella.serialize(back) == data
+    assert T.ExecutionPayloadCapella.hash_tree_root(payload)
+
+    deneb_payload = dict(payload, blob_gas_used=1, excess_blob_gas=2)
+    d2 = T.ExecutionPayloadDeneb.serialize(deneb_payload)
+    assert T.ExecutionPayloadDeneb.serialize(
+        T.ExecutionPayloadDeneb.deserialize(d2)
+    ) == d2
