@@ -115,13 +115,15 @@ class InMemoryGossipBus:
         from collections import deque
 
         self.seen_cap = seen_cap
-        self._subs: Dict[str, List[Tuple[str, Callable]]] = defaultdict(list)
+        # topic -> [(node_id, handler, scorer-or-None)]
+        self._subs: Dict[str, List[Tuple[str, Callable, object]]] = defaultdict(list)
         self._seen: Dict[str, set] = defaultdict(set)
         self._seen_order: Dict[str, "deque"] = defaultdict(deque)
         self.log = get_logger("network/gossip")
         self.published = 0
         self.delivered = 0
         self.duplicates = 0
+        self.graylisted = 0
 
     def _mark_seen(self, node_id: str, msg_id: bytes) -> None:
         seen = self._seen[node_id]
@@ -133,33 +135,45 @@ class InMemoryGossipBus:
         while len(order) > self.seen_cap:
             seen.discard(order.popleft())
 
-    def subscribe(self, node_id: str, topic: str, handler: Callable) -> None:
-        self._subs[topic].append((node_id, handler))
+    def subscribe(
+        self, node_id: str, topic: str, handler: Callable, scorer=None
+    ) -> None:
+        self._subs[topic].append((node_id, handler, scorer))
 
     def unsubscribe(self, node_id: str, topic: str) -> None:
         self._subs[topic] = [
-            (nid, h) for nid, h in self._subs[topic] if nid != node_id
+            entry for entry in self._subs[topic] if entry[0] != node_id
         ]
 
     def publish(self, from_node: str, topic: str, data: bytes) -> int:
-        """Deliver to every OTHER subscriber that has not seen the id."""
+        """Deliver to every OTHER subscriber that has not seen the id.
+
+        A subscriber registered with `scorer=` has the sender judged on
+        every delivery: handler verdicts feed the gossipsub scoring
+        policy, and messages from banned senders are dropped at the
+        mesh edge (gossipsub graylisting)."""
         msg_id = compute_message_id(topic, data)
         self.published += 1
         # the publisher has seen its own message: a relayed copy must
         # not echo back (gossipsub inserts published ids into seenCache)
         self._mark_seen(from_node, msg_id)
         delivered = 0
-        for node_id, handler in list(self._subs[topic]):
+        for node_id, handler, scorer in list(self._subs[topic]):
             if node_id == from_node:
+                continue
+            if scorer is not None and scorer.is_banned(from_node):
+                self.graylisted += 1
                 continue
             if msg_id in self._seen[node_id]:
                 self.duplicates += 1
                 continue
             self._mark_seen(node_id, msg_id)
             try:
-                handler(topic, data)
+                verdict = handler(topic, data)
                 delivered += 1
                 self.delivered += 1
+                if scorer is not None:
+                    scorer.on_verdict(from_node, topic, verdict)
             except Exception as e:  # noqa: BLE001 - subscriber isolation
                 self.log.warn(
                     "gossip handler failed", topic=topic, error=str(e)

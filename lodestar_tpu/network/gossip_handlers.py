@@ -193,6 +193,7 @@ class GossipHandlers:
         fork_digest: bytes,
         attnets: Tuple[int, ...] = (0,),
         syncnets: Tuple[int, ...] = (0,),
+        scorer=None,
     ) -> None:
         topics = [
             topic_string(fork_digest, GossipTopicName.beacon_block),
@@ -218,4 +219,4 @@ class GossipHandlers:
             for s in syncnets
         ]
         for t in topics:
-            bus.subscribe(node_id, t, self.handle)
+            bus.subscribe(node_id, t, self.handle, scorer=scorer)

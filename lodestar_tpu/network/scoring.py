@@ -323,24 +323,83 @@ def compute_gossip_peer_score_params(
 
 
 class GossipPeerScorer:
-    """Applies topic-aware penalties to the PeerScoreBook — the policy
-    consumer that makes the derived parameters real in this composition
-    (the reference hands them to libp2p-gossipsub)."""
+    """The gossipsub score consumer: realizes the derived parameters as
+    an actual per-peer GOSSIP score (the reference hands them to
+    libp2p-gossipsub; this composition keeps the same two-tier split —
+    the wide-scale gossip score with its own thresholds here, the
+    +/-100 app-level PeerScoreBook observing a scaled summary).
 
-    def __init__(self, score_params: PeerScoreParams, score_book):
+    Per the gossipsub v1.1 spec, the invalid-message counter's
+    contribution is QUADRATIC (P4: w4 * counter^2), so one corrupt
+    relay costs ~one topic budget while graylisting (-16000) takes on
+    the order of a dozen invalid messages."""
+
+    def __init__(self, score_params: PeerScoreParams, score_book=None):
         self.params = score_params
-        self.book = score_book
+        self.book = score_book  # optional app-level observer
         # (peer, topic) -> first-delivery counter (caps earned score)
         self._first_deliveries: Dict[tuple, float] = {}
+        # (peer, topic) -> invalid-message counter (P4, squared)
+        self._invalid_counts: Dict[tuple, float] = {}
+        # peer -> positive deliveries score component
+        self._positive: Dict[str, float] = {}
+
+    def gossip_score(self, peer_id: str) -> float:
+        """The peer's gossipsub score: capped positive deliveries plus
+        the squared invalid-message penalties."""
+        score = min(
+            self._positive.get(peer_id, 0.0), self.params.topic_score_cap
+        )
+        for (pid, topic), count in self._invalid_counts.items():
+            if pid != peer_id:
+                continue
+            tp = self.params.topics.get(topic)
+            if tp is None:
+                continue
+            score += (
+                tp.topic_weight
+                * tp.invalid_message_deliveries_weight
+                * count
+                * count
+            )
+        return score
 
     def on_invalid_message(self, peer_id: str, topic: str) -> float:
-        tp = self.params.topics.get(topic)
-        weight = (
-            tp.invalid_message_deliveries_weight * tp.topic_weight
-            if tp is not None
-            else -MAX_POSITIVE_SCORE
+        key = (peer_id, topic)
+        self._invalid_counts[key] = self._invalid_counts.get(key, 0.0) + 1
+        score = self.gossip_score(peer_id)
+        if self.book is not None:
+            # app-level observer: one clamped unit per invalid message
+            tp = self.params.topics.get(topic)
+            self.book.add(
+                peer_id,
+                (
+                    tp.invalid_message_deliveries_weight * tp.topic_weight
+                    if tp is not None
+                    else -MAX_POSITIVE_SCORE
+                ),
+            )
+        return score
+
+    def is_banned(self, peer_id: str) -> bool:
+        """Graylist check at the mesh edge: the GOSSIP score against the
+        derived graylist threshold (gossipsub drops messages from peers
+        below it)."""
+        return (
+            self.gossip_score(peer_id)
+            <= GOSSIP_SCORE_THRESHOLDS.graylist_threshold
         )
-        return self.book.add(peer_id, weight)
+
+    def on_verdict(self, peer_id: str, topic: str, verdict) -> None:
+        """Score one handler verdict (GossipHandlers.handle returns
+        None on ACCEPT, else the GossipAction)."""
+        from ..chain.validation import GossipAction
+
+        if verdict is None:
+            self.on_first_delivery(peer_id, topic)
+        elif verdict == GossipAction.REJECT:
+            self.on_invalid_message(peer_id, topic)
+        # IGNORE: no score movement (gossipsub does not punish ignores)
 
     def on_first_delivery(self, peer_id: str, topic: str) -> float:
         """Credits one first-seen delivery, bounded by the topic's
@@ -348,12 +407,18 @@ class GossipPeerScorer:
         counter, and therefore the earned score, saturates at the cap)."""
         tp = self.params.topics.get(topic)
         if tp is None:
-            return self.book.score(peer_id)
+            return self.gossip_score(peer_id)
         key = (peer_id, topic)
         count = self._first_deliveries.get(key, 0.0)
         if count >= tp.first_message_deliveries_cap:
-            return self.book.score(peer_id)
+            return self.gossip_score(peer_id)
         self._first_deliveries[key] = count + 1
-        return self.book.add(
-            peer_id, tp.first_message_deliveries_weight * tp.topic_weight
+        self._positive[peer_id] = self._positive.get(peer_id, 0.0) + (
+            tp.first_message_deliveries_weight * tp.topic_weight
         )
+        if self.book is not None:
+            self.book.add(
+                peer_id,
+                min(tp.first_message_deliveries_weight * tp.topic_weight, 1.0),
+            )
+        return self.gossip_score(peer_id)

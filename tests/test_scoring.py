@@ -120,14 +120,112 @@ def test_zero_validators_rejected():
 
 
 def test_scorer_banishes_invalid_spammer(score_params):
-    book = PeerScoreBook()
-    scorer = GossipPeerScorer(score_params, book)
+    scorer = GossipPeerScorer(score_params, PeerScoreBook())
     topic = topic_string(DIGEST, GossipTopicName.beacon_block)
-    # one invalid block costs the whole positive budget (the book clamps
-    # at its MIN_SCORE floor, like the reference's score bounds)
+    # ONE corrupt relay costs ~a topic budget but must NOT graylist
     s = scorer.on_invalid_message("peer-x", topic)
-    assert s <= -100.0
-    assert book.state("peer-x") == ScoreState.banned
+    assert -MAX_POSITIVE_SCORE * 1.5 < s < 0
+    assert not scorer.is_banned("peer-x")
+    # the P4 counter is squared: ~a dozen invalids reach the graylist
+    n = 1
+    while not scorer.is_banned("peer-x"):
+        scorer.on_invalid_message("peer-x", topic)
+        n += 1
+        assert n < 40, "graylist never reached"
+    assert 5 <= n <= 20  # gossipsub-plausible band
     # honest first deliveries stay bounded and positive
     s2 = scorer.on_first_delivery("peer-y", topic)
-    assert 0 < s2 <= 10.0
+    assert 0 < s2 <= score_params.topic_score_cap
+
+
+def test_bus_graylists_invalid_spammer_end_to_end(score_params):
+    """The full loop over the bus: a peer publishing invalid blocks is
+    scored down by handler verdicts and then graylisted at the mesh
+    edge — its later messages never reach the handler (gossipsub
+    behavior realized over the in-process bus)."""
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+    from lodestar_tpu.network.gossip import InMemoryGossipBus, encode_message
+    from lodestar_tpu.network.gossip_handlers import GossipHandlers
+    from lodestar_tpu.network.scoring import GossipPeerScorer
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu import types as T
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"spam-%d" % i) for i in range(4)]
+    pkp = [B.sk_to_pk(sk) for sk in sks]
+    pks = [C.g1_compress(p) for p in pkp]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    chain = BeaconChain(cfg, genesis)
+    handlers = GossipHandlers(chain, CpuBlsVerifier(pubkeys=pkp))
+    bus = InMemoryGossipBus()
+    digest = cfg.fork_digest(0)
+    book = PeerScoreBook()
+    scorer = GossipPeerScorer(
+        compute_gossip_peer_score_params(
+            cfg, active_validator_count=4, current_slot=100,
+            fork_digest=digest,
+        ),
+        book,
+    )
+    handlers.subscribe_all(bus, "b", digest, scorer=scorer)
+    topic = topic_string(digest, GossipTopicName.beacon_block)
+
+    def bad_block(n):
+        return {
+            "message": {
+                "slot": 1,
+                "proposer_index": 0,
+                "parent_root": bytes([n]) * 32,
+                "state_root": b"\x00" * 32,
+                "body": {
+                    "randao_reveal": b"\x11" * 96,
+                    "eth1_data": {
+                        "deposit_root": b"\x00" * 32,
+                        "deposit_count": 0,
+                        "block_hash": b"\x00" * 32,
+                    },
+                    "graffiti": b"\x00" * 32,
+                    "proposer_slashings": [],
+                    "attester_slashings": [],
+                    "attestations": [],
+                    "deposits": [],
+                    "voluntary_exits": [],
+                    "sync_aggregate": {
+                        "sync_committee_bits": [False] * 512,
+                        "sync_committee_signature": b"\x00" * 96,
+                    },
+                },
+            },
+            "signature": b"\x22" * 96,
+        }
+
+    # REJECT verdicts accumulate on the squared P4 counter until the
+    # spammer crosses the graylist threshold
+    i = 0
+    while not scorer.is_banned("spammer"):
+        bus.publish(
+            "spammer", topic, encode_message(bytes([0xF0 + (i % 8)]) * (40 + i))
+        )
+        i += 1
+        assert i < 40, "spammer never graylisted"
+    assert book.score("spammer") < 0  # app book observed the abuse
+    before = dict(handlers.results.get("beacon_block", {}))
+    n = bus.publish(
+        "spammer",
+        topic,
+        encode_message(T.SignedBeaconBlockAltair.serialize(bad_block(3))),
+    )
+    assert n == 0 and bus.graylisted >= 1  # dropped at the mesh edge
+    assert handlers.results.get("beacon_block", {}) == before
+    # an honest peer still DELIVERS (also invalid content, but it must
+    # reach the handler and be judged there, not at the mesh edge)
+    ok = bus.publish("honest", topic, encode_message(b"\xfe" * 40))
+    assert ok == 1
+    assert handlers.results["beacon_block"]["reject"] == before["reject"] + 1
