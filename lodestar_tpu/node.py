@@ -39,6 +39,11 @@ class NodeOptions:
     api_port: int = 0
     serve_api: bool = True
     verifier: Optional[object] = None  # injected IBlsVerifier (tests/CPU)
+    execution: Optional[object] = None  # injected IExecutionEngine
+    track_validators: tuple = ()  # local indices for the ValidatorMonitor
+    gossip_bus: Optional[object] = None  # InMemoryGossipBus to join
+    node_id: str = "node"  # bus identity
+    active_validator_count_hint: int = 0  # for the scoring params
 
 
 class BeaconNode:
@@ -162,6 +167,145 @@ class BeaconNode:
                 accepted += 1
         self._futures = []
         return accepted
+
+    def close(self) -> None:
+        if self.api:
+            self.api.close()
+        self.bls.close()
+        self.db.close()
+
+
+class FullBeaconNode:
+    """The ONE init path (reference: BeaconNode.init, nodejs.ts:134-307):
+    metrics -> db -> chain (clock, fork choice, regen, pools, verifier,
+    execution, monitor) -> light-client server + archiver -> gossip
+    handlers + peer scoring (+ bus subscription) -> network processor ->
+    sync drivers -> REST API.  `close()` tears down in reverse."""
+
+    @classmethod
+    def init(cls, config, anchor_state, opts: Optional[NodeOptions] = None):
+        from .chain.archiver import Archiver
+        from .chain.chain import BeaconChain
+        from .chain.light_client_server import LightClientServer
+        from .network.gossip_handlers import GossipHandlers
+        from .network.peers import PeerScoreBook
+        from .network.scoring import (
+            GossipPeerScorer,
+            compute_gossip_peer_score_params,
+        )
+        from .sync import BackfillSync, RangeSync, UnknownBlockSync
+        from .utils.validator_monitor import ValidatorMonitor
+
+        opts = opts or NodeOptions()
+        self = cls()
+        self.config = config
+        self.log = get_logger("node")
+        self.registry = Registry()
+        self.metrics = BlsPoolMetrics(self.registry)
+
+        # db + clock
+        self.db = BeaconDb(opts.db_path)
+        self.clock = Clock(genesis_time=config.genesis_time)
+
+        # verifier service (the TPU boundary) — reference chain.ts:196-198
+        verifier = opts.verifier
+        if verifier is None:
+            from .bls.pubkey_table import PubkeyTable
+
+            table = PubkeyTable(capacity=max(anchor_state.num_validators, 1))
+            table.register_compressed(list(anchor_state.pubkeys))
+            verifier = TpuBlsVerifier(table, metrics=self.metrics)
+        self.bls = BlsVerifierService(verifier)
+
+        # monitor (optional)
+        self.monitor = None
+        if opts.track_validators:
+            self.monitor = ValidatorMonitor(self.registry)
+            for i in opts.track_validators:
+                self.monitor.register_local_validator(int(i))
+
+        # the chain composition
+        self.chain = BeaconChain(
+            config,
+            anchor_state,
+            db=self.db,
+            bls_verifier=self.bls,
+            execution=opts.execution,
+            monitor=self.monitor,
+        )
+        self.fork_choice = self.chain.fork_choice
+        self.light_client_server = LightClientServer(self.chain)
+        self.archiver = Archiver(self.chain)
+
+        # gossip handlers + peer scoring, joined to a bus when provided
+        self.score_book = PeerScoreBook()
+        self.handlers = GossipHandlers(
+            self.chain,
+            verifier,
+            current_slot_fn=lambda: self.clock.current_slot,
+        )
+        self.scorer = None
+        n_val = opts.active_validator_count_hint or anchor_state.num_validators
+        if n_val > 0:
+            digest = config.fork_digest(self.chain.head_state.slot)
+            self.scorer = GossipPeerScorer(
+                compute_gossip_peer_score_params(
+                    config,
+                    active_validator_count=n_val,
+                    current_slot=max(self.chain.head_state.slot, 1),
+                    fork_digest=digest,
+                ),
+                self.score_book,
+            )
+            if opts.gossip_bus is not None:
+                self.handlers.subscribe_all(
+                    opts.gossip_bus, opts.node_id, digest, scorer=self.scorer
+                )
+
+        # network processor over the validators' backpressure
+        self.processor = NetworkProcessor(
+            self._process_gossip_message,
+            [self.bls.can_accept_work],
+            has_block_root=self.fork_choice.has_block,
+        )
+
+        # sync drivers (sources injected per peer/transport)
+        self.range_sync = RangeSync(self.chain)
+        self.unknown_block_sync = UnknownBlockSync(self.chain)
+        self.backfill = BackfillSync(config, self.db, verifier)
+
+        # clock wiring: processor ticks, boost lifecycle, cache pruning
+        self.clock.on_slot(self.processor.on_clock_slot)
+        self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
+        self.clock.on_slot(self.handlers.on_clock_slot)
+
+        # REST API over everything
+        self.api = None
+        if opts.serve_api:
+            self.api = BeaconApiServer(
+                DefaultHandlers(
+                    genesis_time=config.genesis_time,
+                    genesis_validators_root=config.genesis_validators_root,
+                    processor=self.processor,
+                    bls_metrics=self.metrics,
+                    bls_service=self.bls,
+                    chain=self.chain,
+                    spec={"SECONDS_PER_SLOT": params.SECONDS_PER_SLOT},
+                ),
+                port=opts.api_port,
+            )
+        return self
+
+    def _process_gossip_message(self, msg) -> None:
+        """Processor worker: full SSZ gossip messages dispatch through
+        the per-topic handlers (msg.topic is a topic string; msg.data
+        the raw wire bytes)."""
+        self.handlers.handle(msg.topic, msg.data)
+
+    def start(self) -> None:
+        if self.api:
+            self.api.listen()
+            self.log.info("rest api listening", port=self.api.port)
 
     def close(self) -> None:
         if self.api:
