@@ -61,6 +61,11 @@ ROUTES: Tuple[Route, ...] = (
     ),
     Route("POST", "/eth/v1/validator/duties/sync/{epoch}", "get_sync_duties"),
     Route("POST", "/eth/v1/validator/liveness/{epoch}", "get_liveness"),
+    Route(
+        "POST",
+        "/eth/v1/validator/beacon_committee_subscriptions",
+        "prepare_beacon_committee_subnet",
+    ),
     Route("GET", "/eth/v1/validator/attestation_data", "produce_attestation_data"),
     Route(
         "GET", "/eth/v1/validator/aggregate_attestation", "get_aggregate_attestation"

@@ -30,6 +30,7 @@ class DefaultHandlers:
         bls_service=None,
         spec: Optional[dict] = None,
         chain=None,
+        attnets=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -39,6 +40,7 @@ class DefaultHandlers:
         self.bls_service = bls_service  # recent ns job timings
         self.spec = spec or {}
         self.chain = chain  # BeaconChain for the stateful endpoints
+        self.attnets = attnets  # AttnetsService for duty subscriptions
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -104,6 +106,24 @@ class DefaultHandlers:
                 "recent_job_timings": timings,
             }
         }
+
+    def prepare_beacon_committee_subnet(self, params, body):
+        """Validator duty subnet announcements (reference:
+        routes/validator.ts prepareBeaconCommitteeSubnet ->
+        attnetsService short-lived subscriptions)."""
+        if self.attnets is None:
+            return 501, {"message": "no attnets service attached"}
+        subnets = []
+        for sub in body or []:
+            subnets.append(
+                self.attnets.prepare_committee_subscription(
+                    committees_per_slot=int(sub["committees_at_slot"]),
+                    slot=int(sub["slot"]),
+                    committee_index=int(sub["committee_index"]),
+                    is_aggregator=bool(sub["is_aggregator"]),
+                )
+            )
+        return 200, {"data": [str(s) for s in subnets]}
 
     def get_validator_monitor(self, params, body):
         """Per-tracked-validator epoch summaries (reference:

@@ -1,0 +1,144 @@
+"""Attestation/sync-committee subnet services — subscription policy.
+
+Mirror of the reference's subnet services (reference:
+packages/beacon-node/src/network/subnets/{attnetsService,
+syncnetsService}.ts): which gossip subnets a node subscribes to and
+when.  The policy layer is transport-independent — the wire mesh is off
+the TPU path (SURVEY §2.4 P9) — and is consumed by the gossip bus
+subscriptions and the REST beacon_committee_subscriptions endpoint.
+
+Long-lived attestation subnets follow the p2p spec's deterministic
+node-id schedule (compute_subscribed_subnets): every node serves
+SUBNETS_PER_NODE subnets derived from its node-id prefix, rotating
+every EPOCHS_PER_SUBNET_SUBSCRIPTION epochs, so subnet backbones stay
+populated without coordination.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Set, Tuple
+
+from .. import params
+from ..state_transition.util import compute_shuffled_index
+
+# p2p spec constants (phase0/p2p-interface.md)
+SUBNETS_PER_NODE = 2
+ATTESTATION_SUBNET_PREFIX_BITS = 6
+EPOCHS_PER_SUBNET_SUBSCRIPTION = 256
+# short-lived duty subscriptions linger a few slots past the duty
+SUBSCRIPTION_EXPIRY_SLOTS = 2
+
+
+def compute_subscribed_subnet(node_id: int, epoch: int, index: int) -> int:
+    """p2p spec compute_subscribed_subnet: the node-id prefix shuffled
+    by the subscription period's seed, offset by the subnet index."""
+    node_id_prefix = node_id >> (256 - ATTESTATION_SUBNET_PREFIX_BITS)
+    period = epoch // EPOCHS_PER_SUBNET_SUBSCRIPTION
+    seed = hashlib.sha256(period.to_bytes(8, "little")).digest()
+    permutated = compute_shuffled_index(
+        node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS, seed
+    )
+    return (permutated + index) % params.ATTESTATION_SUBNET_COUNT
+
+
+def compute_subscribed_subnets(node_id: int, epoch: int) -> List[int]:
+    return [
+        compute_subscribed_subnet(node_id, epoch, i)
+        for i in range(SUBNETS_PER_NODE)
+    ]
+
+
+def compute_subnet_for_attestation(
+    committees_per_slot: int, slot: int, committee_index: int
+) -> int:
+    """p2p spec compute_subnet_for_attestation (the publish side of the
+    wrong-subnet REJECT check in chain/validation.py)."""
+    slots_since_epoch_start = slot % params.SLOTS_PER_EPOCH
+    committees_since = committees_per_slot * slots_since_epoch_start
+    return (
+        committees_since + committee_index
+    ) % params.ATTESTATION_SUBNET_COUNT
+
+
+class AttnetsService:
+    """Long-lived node-id subnets + short-lived committee-duty
+    subscriptions (reference: attnetsService.ts)."""
+
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+        # (slot, subnet) -> expiry slot for duty subscriptions
+        self._short_lived: Dict[int, int] = {}
+
+    def long_lived_subnets(self, epoch: int) -> List[int]:
+        return compute_subscribed_subnets(self.node_id, epoch)
+
+    def prepare_committee_subscription(
+        self,
+        committees_per_slot: int,
+        slot: int,
+        committee_index: int,
+        is_aggregator: bool,
+    ) -> int:
+        """A validator duty announces itself (the REST
+        beacon_committee_subscriptions flow); aggregators must join the
+        subnet to collect attestations."""
+        subnet = compute_subnet_for_attestation(
+            committees_per_slot, slot, committee_index
+        )
+        if is_aggregator:
+            expiry = slot + SUBSCRIPTION_EXPIRY_SLOTS
+            self._short_lived[subnet] = max(
+                self._short_lived.get(subnet, 0), expiry
+            )
+        return subnet
+
+    def active_subnets(self, epoch: int, current_slot: int) -> Set[int]:
+        self.prune(current_slot)
+        return set(self.long_lived_subnets(epoch)) | set(self._short_lived)
+
+    def prune(self, current_slot: int) -> None:
+        for subnet in [
+            s for s, exp in self._short_lived.items() if exp < current_slot
+        ]:
+            del self._short_lived[subnet]
+
+    def metadata_attnets(self, epoch: int, current_slot: int) -> List[bool]:
+        """The ENR/metadata attnets bitvector."""
+        active = self.active_subnets(epoch, current_slot)
+        return [
+            s in active for s in range(params.ATTESTATION_SUBNET_COUNT)
+        ]
+
+
+class SyncnetsService:
+    """Sync-committee subnets from duty windows (reference:
+    syncnetsService.ts: subscribe while any local validator serves the
+    committee period)."""
+
+    def __init__(self):
+        # subnet -> until_epoch
+        self._subscriptions: Dict[int, int] = {}
+
+    def subscribe_for_duty(self, subnet: int, until_epoch: int) -> None:
+        if not 0 <= subnet < params.SYNC_COMMITTEE_SUBNET_COUNT:
+            raise ValueError(f"invalid sync subnet {subnet}")
+        self._subscriptions[subnet] = max(
+            self._subscriptions.get(subnet, 0), until_epoch
+        )
+
+    def active_subnets(self, epoch: int) -> Set[int]:
+        self.prune(epoch)
+        return set(self._subscriptions)
+
+    def prune(self, epoch: int) -> None:
+        for subnet in [
+            s for s, until in self._subscriptions.items() if until < epoch
+        ]:
+            del self._subscriptions[subnet]
+
+    def metadata_syncnets(self, epoch: int) -> List[bool]:
+        active = self.active_subnets(epoch)
+        return [
+            s in active for s in range(params.SYNC_COMMITTEE_SUBNET_COUNT)
+        ]
