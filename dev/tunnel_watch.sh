@@ -1,0 +1,25 @@
+#!/bin/bash
+# Poll the axon remote-compile endpoint; when it accepts a trivial pallas
+# compile, run the remaining verify-pipeline stage probes (resumable dev
+# tool for the flaky tunnel — execution can be up while compiles are not).
+LOG=/tmp/tunnel_watch.log
+PROBE_LOG=/tmp/probe_r4b.log
+while true; do
+  ts=$(date +%H:%M:%S)
+  timeout 120 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+def k(x, o): o[...] = x[...] + 1
+f = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
+assert int(f(jnp.zeros((8, 128), jnp.int32))[0, 0]) == 1
+EOF
+  if [ $? -eq 0 ]; then
+    echo "$ts COMPILE OK — running stage probes" >> "$LOG"
+    # full stage list: finished stages replay from the persistent cache
+    python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
+    echo "$ts probes done rc=$?" >> "$LOG"
+    break
+  fi
+  echo "$ts compile unavailable" >> "$LOG"
+  sleep 120
+done

@@ -324,7 +324,20 @@ class BeaconChain:
         )
 
         try:
-            st = self.execution.notify_new_payload(payload)
+            if "blob_gas_used" in payload:
+                # deneb (engine V3): commitment versioned hashes + the
+                # parent beacon block root ride along for EL-side checks
+                import hashlib as _hl
+
+                hashes = [
+                    b"\x01" + _hl.sha256(bytes(c)).digest()[1:]
+                    for c in body.get("blob_kzg_commitments", ())
+                ]
+                st = self.execution.notify_new_payload(
+                    payload, hashes, bytes(block["parent_root"])
+                )
+            else:
+                st = self.execution.notify_new_payload(payload)
         except ExecutionEngineUnavailable:
             raise
         except Exception as e:  # transport failure = outage, retryable

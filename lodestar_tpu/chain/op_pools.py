@@ -176,6 +176,23 @@ class OpPool:
         self._proposer_slashings: Dict[int, dict] = {}
         self._attester_slashings: Dict[Tuple[int, ...], dict] = {}
         self._voluntary_exits: Dict[int, dict] = {}
+        self._bls_to_execution_changes: Dict[int, dict] = {}
+
+    def insert_bls_to_execution_change(self, signed_change: dict) -> None:
+        self._bls_to_execution_changes.setdefault(
+            signed_change["message"]["validator_index"], signed_change
+        )
+
+    def get_bls_to_execution_changes(self, state):
+        """Changes still applicable: the validator's credentials must
+        still carry the 0x00 BLS prefix."""
+        return [
+            c
+            for idx, c in self._bls_to_execution_changes.items()
+            if idx < state.num_validators
+            and bytes(state.withdrawal_credentials[idx][:1])
+            == params.BLS_WITHDRAWAL_PREFIX
+        ][: P.MAX_BLS_TO_EXECUTION_CHANGES]
 
     def insert_proposer_slashing(self, slashing: dict) -> None:
         index = slashing["signed_header_1"]["message"]["proposer_index"]
@@ -262,6 +279,14 @@ class OpPool:
             and int(finalized_state.exit_epoch[i]) != params.FAR_FUTURE_EPOCH
         ]:
             del self._voluntary_exits[idx]
+        for idx in [
+            i
+            for i in self._bls_to_execution_changes
+            if i < finalized_state.num_validators
+            and bytes(finalized_state.withdrawal_credentials[i][:1])
+            != params.BLS_WITHDRAWAL_PREFIX
+        ]:
+            del self._bls_to_execution_changes[idx]
 
 
 class SyncCommitteeMessagePool:

@@ -43,9 +43,12 @@ def produce_block_body(
     sync_aggregate: Optional[Dict] = None,
     eth1_data: Optional[Dict] = None,
     execution_payload: Optional[Dict] = None,
+    bls_to_execution_changes: Optional[List[Dict]] = None,
+    blob_kzg_commitments: Optional[List[bytes]] = None,
 ) -> Dict:
-    """Assemble an altair/bellatrix block body (reference
-    produceBlockBody.ts; the payload slot activates with the fork)."""
+    """Assemble a fork-appropriate block body (reference
+    produceBlockBody.ts; the payload/withdrawal/blob slots activate with
+    their forks)."""
     body = {
         "randao_reveal": randao_reveal,
         "eth1_data": dict(eth1_data or state.eth1_data),
@@ -59,6 +62,10 @@ def produce_block_body(
     }
     if execution_payload is not None:
         body["execution_payload"] = dict(execution_payload)
+    if state.fork_at_least(params.ForkName.capella):
+        body["bls_to_execution_changes"] = list(bls_to_execution_changes or [])
+    if state.fork_at_least(params.ForkName.deneb):
+        body["blob_kzg_commitments"] = list(blob_kzg_commitments or [])
     return body
 
 
@@ -101,6 +108,11 @@ def produce_block_from_pools(
         if op_pool is not None
         else ([], [], [])
     )
+    bls_changes = (
+        op_pool.get_bls_to_execution_changes(pre)
+        if op_pool is not None and pre.fork_at_least(params.ForkName.capella)
+        else []
+    )
     sync_aggregate = None
     if contribution_pool is not None and head_root is not None:
         sync_aggregate = contribution_pool.produce_sync_aggregate(
@@ -121,6 +133,7 @@ def produce_block_from_pools(
         attester_slashings=attester_slashings,
         voluntary_exits=voluntary_exits,
         sync_aggregate=sync_aggregate,
+        bls_to_execution_changes=bls_changes,
     )
 
 
@@ -131,12 +144,22 @@ def _fetch_payload(execution, pre) -> Dict:
     from ..execution import PayloadAttributes
     from ..state_transition.accessors import get_randao_mix
 
-    from ..state_transition.block import is_merge_transition_complete
+    from ..state_transition.block import (
+        get_expected_withdrawals,
+        is_merge_transition_complete,
+    )
 
     parent_hash = (
         bytes(pre.latest_execution_payload_header["block_hash"])
         if is_merge_transition_complete(pre)
         else b"\x00" * 32
+    )
+    # capella onward (engine API v2): ship the protocol-expected
+    # withdrawals so the built payload passes process_withdrawals
+    withdrawals = (
+        get_expected_withdrawals(pre)
+        if pre.next_withdrawal_index is not None
+        else None
     )
     r = execution.notify_forkchoice_update(
         parent_hash,
@@ -149,11 +172,24 @@ def _fetch_payload(execution, pre) -> Dict:
                 pre, pre.slot // P.SLOTS_PER_EPOCH
             ),
             suggested_fee_recipient=b"\x00" * 20,
+            withdrawals=withdrawals,
         ),
     )
     if r.payload_id is None:
         raise ValueError(f"EL did not prepare a payload ({r.status})")
-    return execution.get_payload(r.payload_id)
+    # engine API version follows the proposal fork (deneb requires
+    # getPayloadV3 on real ELs; V1 for pre-capella)
+    if pre.fork_at_least(params.ForkName.deneb):
+        version = 3
+    elif pre.fork_at_least(params.ForkName.capella):
+        version = 2
+    else:
+        version = 1
+    payload = execution.get_payload(r.payload_id, version)
+    if pre.fork_at_least(params.ForkName.deneb) and "blob_gas_used" not in payload:
+        # a mock/dev EL without blob support: default the blob gas fields
+        payload = {**payload, "blob_gas_used": 0, "excess_blob_gas": 0}
+    return payload
 
 
 def produce_block(

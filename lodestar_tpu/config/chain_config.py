@@ -89,10 +89,22 @@ class ChainConfig:
                 T.SignedBeaconBlockAltair,
                 T.BeaconBlockBodyAltair,
             )
+        if name == ForkName.bellatrix:
+            return (
+                T.BeaconBlockBellatrix,
+                T.SignedBeaconBlockBellatrix,
+                T.BeaconBlockBodyBellatrix,
+            )
+        if name == ForkName.capella:
+            return (
+                T.BeaconBlockCapella,
+                T.SignedBeaconBlockCapella,
+                T.BeaconBlockBodyCapella,
+            )
         return (
-            T.BeaconBlockBellatrix,
-            T.SignedBeaconBlockBellatrix,
-            T.BeaconBlockBodyBellatrix,
+            T.BeaconBlockDeneb,
+            T.SignedBeaconBlockDeneb,
+            T.BeaconBlockBodyDeneb,
         )
 
     def get_fork_seq(self, slot: int) -> int:
@@ -131,6 +143,20 @@ class ChainConfig:
             d = domain_type + self.fork_data_root(version)[:28]
             self._domain_cache[key] = d
         return d
+
+    def compute_domain(
+        self,
+        domain_type: bytes,
+        fork_version: bytes,
+        genesis_validators_root: bytes = None,
+    ) -> bytes:
+        """Domain pinned to an explicit fork version (spec compute_domain;
+        used by fork-agnostic signatures: deposits, BLS-to-execution
+        changes, and post-EIP-7044 voluntary exits)."""
+        return (
+            domain_type
+            + self.fork_data_root(fork_version, genesis_validators_root)[:28]
+        )
 
     def compute_signing_root(self, object_root: bytes, domain: bytes) -> bytes:
         """hash_tree_root(SigningData(object_root, domain)) — the 32-byte

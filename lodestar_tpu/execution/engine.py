@@ -47,15 +47,24 @@ class ForkchoiceUpdateResult:
 
 @dataclass
 class PayloadAttributes:
-    """engine_forkchoiceUpdated payload-build request (interface.ts)."""
+    """engine_forkchoiceUpdated payload-build request (interface.ts).
+
+    `withdrawals` (engine API v2 / capella) carries the protocol-computed
+    expected withdrawals the built payload must include; None = v1."""
 
     timestamp: int
     prev_randao: bytes
     suggested_fee_recipient: bytes
+    withdrawals: Optional[list] = None
 
 
 class IExecutionEngine(Protocol):
-    def notify_new_payload(self, payload: dict) -> ExecutionPayloadStatus: ...
+    def notify_new_payload(
+        self,
+        payload: dict,
+        versioned_hashes: Optional[list] = None,
+        parent_beacon_block_root: Optional[bytes] = None,
+    ) -> ExecutionPayloadStatus: ...
 
     def notify_forkchoice_update(
         self,
@@ -65,4 +74,4 @@ class IExecutionEngine(Protocol):
         payload_attributes: Optional[PayloadAttributes] = None,
     ) -> ForkchoiceUpdateResult: ...
 
-    def get_payload(self, payload_id: str) -> dict: ...
+    def get_payload(self, payload_id: str, version: int = 2) -> dict: ...

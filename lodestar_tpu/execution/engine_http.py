@@ -74,6 +74,24 @@ _INT_FIELDS = ("block_number", "gas_limit", "gas_used", "timestamp",
                "base_fee_per_gas")
 
 
+def withdrawal_to_json(w: dict) -> dict:
+    return {
+        "index": hex(int(w["index"])),
+        "validatorIndex": hex(int(w["validator_index"])),
+        "address": "0x" + bytes(w["address"]).hex(),
+        "amount": hex(int(w["amount"])),
+    }
+
+
+def withdrawal_from_json(w: dict) -> dict:
+    return {
+        "index": int(w["index"], 16),
+        "validator_index": int(w["validatorIndex"], 16),
+        "address": bytes.fromhex(w["address"][2:]),
+        "amount": int(w["amount"], 16),
+    }
+
+
 def payload_to_json(payload: dict) -> dict:
     out = {}
     for k in _BYTES_FIELDS:
@@ -83,6 +101,13 @@ def payload_to_json(payload: dict) -> dict:
     out["transactions"] = [
         "0x" + bytes(tx).hex() for tx in payload.get("transactions", [])
     ]
+    if "withdrawals" in payload:  # capella (V2 shapes)
+        out["withdrawals"] = [
+            withdrawal_to_json(w) for w in payload["withdrawals"]
+        ]
+    if "blob_gas_used" in payload:  # deneb (V3 shapes)
+        out["blobGasUsed"] = hex(int(payload["blob_gas_used"]))
+        out["excessBlobGas"] = hex(int(payload["excess_blob_gas"]))
     return out
 
 
@@ -95,6 +120,13 @@ def payload_from_json(obj: dict) -> dict:
     out["transactions"] = [
         bytes.fromhex(tx[2:]) for tx in obj.get("transactions", [])
     ]
+    if "withdrawals" in obj:
+        out["withdrawals"] = [
+            withdrawal_from_json(w) for w in obj["withdrawals"]
+        ]
+    if "blobGasUsed" in obj:
+        out["blob_gas_used"] = int(obj["blobGasUsed"], 16)
+        out["excess_blob_gas"] = int(obj["excessBlobGas"], 16)
     return out
 
 
@@ -132,8 +164,30 @@ class ExecutionEngineHttp:
             raise EngineHttpError(str(reply["error"]))
         return reply["result"]
 
-    def notify_new_payload(self, payload: dict) -> ExecutionPayloadStatus:
-        r = self._call("engine_newPayloadV1", [payload_to_json(payload)])
+    def notify_new_payload(
+        self,
+        payload: dict,
+        versioned_hashes=None,
+        parent_beacon_block_root=None,
+    ) -> ExecutionPayloadStatus:
+        # method version follows the payload's fork shape (engine API:
+        # newPayloadV1 bellatrix, V2 capella, V3 deneb)
+        if "blob_gas_used" in payload:
+            # V3 REQUIRES the 3-param form: [payload, versionedHashes,
+            # parentBeaconBlockRoot]
+            params = [
+                payload_to_json(payload),
+                ["0x" + bytes(h).hex() for h in (versioned_hashes or [])],
+                "0x" + bytes(parent_beacon_block_root or b"\x00" * 32).hex(),
+            ]
+            method = "engine_newPayloadV3"
+        elif "withdrawals" in payload:
+            params = [payload_to_json(payload)]
+            method = "engine_newPayloadV2"
+        else:
+            params = [payload_to_json(payload)]
+            method = "engine_newPayloadV1"
+        r = self._call(method, params)
         return ExecutionPayloadStatus(
             ExecutePayloadStatus(r["status"]),
             latest_valid_hash=r.get("latestValidHash"),
@@ -153,6 +207,7 @@ class ExecutionEngineHttp:
             "finalizedBlockHash": "0x" + bytes(finalized_block_hash).hex(),
         }
         attrs = None
+        method = "engine_forkchoiceUpdatedV1"
         if payload_attributes is not None:
             attrs = {
                 "timestamp": hex(payload_attributes.timestamp),
@@ -160,7 +215,13 @@ class ExecutionEngineHttp:
                 "suggestedFeeRecipient": "0x"
                 + bytes(payload_attributes.suggested_fee_recipient).hex(),
             }
-        r = self._call("engine_forkchoiceUpdatedV1", [state, attrs])
+            if payload_attributes.withdrawals is not None:
+                method = "engine_forkchoiceUpdatedV2"
+                attrs["withdrawals"] = [
+                    withdrawal_to_json(w)
+                    for w in payload_attributes.withdrawals
+                ]
+        r = self._call(method, [state, attrs])
         ps = r["payloadStatus"]
         return ForkchoiceUpdateResult(
             ExecutePayloadStatus(ps["status"]),
@@ -168,8 +229,15 @@ class ExecutionEngineHttp:
             payload_id=r.get("payloadId"),
         )
 
-    def get_payload(self, payload_id: str) -> dict:
-        return payload_from_json(self._call("engine_getPayloadV1", [payload_id]))
+    def get_payload(self, payload_id: str, version: int = 2) -> dict:
+        # deneb payload_ids require getPayloadV3 on real ELs ("Unsupported
+        # fork" otherwise); the caller passes the fork-appropriate version.
+        # V2/V3 responses wrap the payload ({executionPayload, ...});
+        # V1 returns it bare — accept both.
+        r = self._call(f"engine_getPayloadV{version}", [payload_id])
+        if "executionPayload" in r:
+            r = r["executionPayload"]
+        return payload_from_json(r)
 
 
 class EngineApiServer:
@@ -222,23 +290,47 @@ class EngineApiServer:
         )
 
     def _dispatch(self, method: str, params: list):
-        if method == "engine_newPayloadV1":
-            st = self.engine.notify_new_payload(payload_from_json(params[0]))
+        if method in (
+            "engine_newPayloadV1",
+            "engine_newPayloadV2",
+            "engine_newPayloadV3",
+        ):
+            if method == "engine_newPayloadV3":
+                if len(params) < 3:
+                    raise ValueError("newPayloadV3 requires 3 params")
+                hashes = [bytes.fromhex(h[2:]) for h in params[1]]
+                parent_root = bytes.fromhex(params[2][2:])
+                st = self.engine.notify_new_payload(
+                    payload_from_json(params[0]), hashes, parent_root
+                )
+            else:
+                st = self.engine.notify_new_payload(
+                    payload_from_json(params[0])
+                )
             return {
                 "status": st.status.value,
                 "latestValidHash": st.latest_valid_hash,
                 "validationError": st.validation_error,
             }
-        if method == "engine_forkchoiceUpdatedV1":
+        if method in (
+            "engine_forkchoiceUpdatedV1",
+            "engine_forkchoiceUpdatedV2",
+        ):
             state, attrs = params
             pa = None
             if attrs:
+                withdrawals = None
+                if attrs.get("withdrawals") is not None:
+                    withdrawals = [
+                        withdrawal_from_json(w) for w in attrs["withdrawals"]
+                    ]
                 pa = PayloadAttributes(
                     timestamp=int(attrs["timestamp"], 16),
                     prev_randao=bytes.fromhex(attrs["prevRandao"][2:]),
                     suggested_fee_recipient=bytes.fromhex(
                         attrs["suggestedFeeRecipient"][2:]
                     ),
+                    withdrawals=withdrawals,
                 )
             r = self.engine.notify_forkchoice_update(
                 bytes.fromhex(state["headBlockHash"][2:]),
@@ -256,6 +348,13 @@ class EngineApiServer:
             }
         if method == "engine_getPayloadV1":
             return payload_to_json(self.engine.get_payload(params[0]))
+        if method in ("engine_getPayloadV2", "engine_getPayloadV3"):
+            return {
+                "executionPayload": payload_to_json(
+                    self.engine.get_payload(params[0])
+                ),
+                "blockValue": "0x0",
+            }
         raise ValueError(f"unknown method {method}")
 
     def listen(self) -> None:

@@ -67,7 +67,12 @@ class ExecutionEngineMock:
 
     # -- engine_newPayload -------------------------------------------------
 
-    def notify_new_payload(self, payload: dict) -> ExecutionPayloadStatus:
+    def notify_new_payload(
+        self,
+        payload: dict,
+        versioned_hashes=None,
+        parent_beacon_block_root=None,
+    ) -> ExecutionPayloadStatus:
         if self.fail_with is not None:
             return ExecutionPayloadStatus(self.fail_with)
         declared = bytes(payload["block_hash"])
@@ -130,6 +135,12 @@ class ExecutionEngineMock:
                 "base_fee_per_gas": 7,
                 "transactions": [],
             }
+            if payload_attributes.withdrawals is not None:
+                # engine API v2 (capella): the built payload includes the
+                # protocol-computed withdrawal list verbatim
+                payload["withdrawals"] = [
+                    dict(w) for w in payload_attributes.withdrawals
+                ]
             payload["block_hash"] = compute_block_hash(payload)
             self.preparing[payload_id] = payload
         return ForkchoiceUpdateResult(
@@ -148,7 +159,7 @@ class ExecutionEngineMock:
 
     # -- engine_getPayload -------------------------------------------------
 
-    def get_payload(self, payload_id: str) -> dict:
+    def get_payload(self, payload_id: str, version: int = 2) -> dict:
         payload = self.preparing.pop(payload_id, None)
         if payload is None:
             raise ValueError(f"unknown payload id {payload_id}")

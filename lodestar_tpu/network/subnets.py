@@ -32,9 +32,14 @@ SUBSCRIPTION_EXPIRY_SLOTS = 2
 
 def compute_subscribed_subnet(node_id: int, epoch: int, index: int) -> int:
     """p2p spec compute_subscribed_subnet: the node-id prefix shuffled
-    by the subscription period's seed, offset by the subnet index."""
+    by the subscription period's seed, offset by the subnet index.
+
+    The per-node offset (node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION) enters
+    the period so rotations are STAGGERED across nodes — without it every
+    backbone would churn at the same epoch boundary."""
     node_id_prefix = node_id >> (256 - ATTESTATION_SUBNET_PREFIX_BITS)
-    period = epoch // EPOCHS_PER_SUBNET_SUBSCRIPTION
+    node_offset = node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION
+    period = (epoch + node_offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION
     seed = hashlib.sha256(period.to_bytes(8, "little")).digest()
     permutated = compute_shuffled_index(
         node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS, seed

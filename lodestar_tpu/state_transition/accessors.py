@@ -73,6 +73,17 @@ def get_validator_churn_limit(state) -> int:
     )
 
 
+def get_validator_activation_churn_limit(state) -> int:
+    """deneb (EIP-7514): activations are additionally capped at
+    MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT; exits keep the plain limit."""
+    from .. import params as _params
+
+    limit = get_validator_churn_limit(state)
+    if state.fork_at_least(_params.ForkName.deneb):
+        return min(_params.ACTIVE_PRESET.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT, limit)
+    return limit
+
+
 # -- randao / seeds ---------------------------------------------------------
 
 

@@ -540,11 +540,20 @@ def process_voluntary_exit(
         "validator too young to exit",
     )
     if verify_signatures:
-        domain = state.config.get_domain(
-            state.slot,
-            params.DOMAIN_VOLUNTARY_EXIT,
-            exit_msg["epoch"] * P.SLOTS_PER_EPOCH,
-        )
+        if state.fork_at_least(params.ForkName.deneb):
+            # EIP-7044 (deneb): exits are signed against the CAPELLA fork
+            # domain permanently, so old pre-signed exits stay valid
+            domain = state.config.compute_domain(
+                params.DOMAIN_VOLUNTARY_EXIT,
+                state.config.fork_versions[params.ForkName.capella],
+                state.genesis_validators_root,
+            )
+        else:
+            domain = state.config.get_domain(
+                state.slot,
+                params.DOMAIN_VOLUNTARY_EXIT,
+                exit_msg["epoch"] * P.SLOTS_PER_EPOCH,
+            )
         root = state.config.compute_signing_root(
             VoluntaryExit.hash_tree_root(exit_msg), domain
         )
@@ -553,6 +562,154 @@ def process_voluntary_exit(
             "invalid exit signature",
         )
     initiate_validator_exit(state, index)
+
+
+# -- capella: withdrawals + BLS-to-execution changes ------------------------
+
+
+def has_eth1_withdrawal_credential(cred: bytes) -> bool:
+    return bytes(cred[:1]) == params.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def _is_fully_withdrawable(state, index: int, epoch: int) -> bool:
+    """spec is_fully_withdrawable_validator"""
+    return (
+        has_eth1_withdrawal_credential(state.withdrawal_credentials[index])
+        and int(state.withdrawable_epoch[index]) <= epoch
+        and int(state.balances[index]) > 0
+    )
+
+
+def _is_partially_withdrawable(state, index: int) -> bool:
+    """spec is_partially_withdrawable_validator: effective balance pinned
+    at max AND an excess balance above it."""
+    return (
+        has_eth1_withdrawal_credential(state.withdrawal_credentials[index])
+        and int(state.effective_balance[index]) == P.MAX_EFFECTIVE_BALANCE
+        and int(state.balances[index]) > P.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def get_expected_withdrawals(state) -> List[Dict]:
+    """spec get_expected_withdrawals (capella): sweep up to
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP validators from the rotating
+    cursor, emitting full withdrawals for withdrawable validators and
+    excess-balance skims for max-effective ones, capped at
+    MAX_WITHDRAWALS_PER_PAYLOAD (reference:
+    state-transition/src/block/processWithdrawals.ts)."""
+    epoch = compute_epoch_at_slot(state.slot)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    n = state.num_validators
+    withdrawals: List[Dict] = []
+    for _ in range(min(P.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP, n)):
+        if len(withdrawals) == P.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        balance = int(state.balances[validator_index])
+        address = bytes(
+            state.withdrawal_credentials[validator_index][12:]
+        )
+        if _is_fully_withdrawable(state, validator_index, epoch):
+            withdrawals.append(
+                {
+                    "index": withdrawal_index,
+                    "validator_index": validator_index,
+                    "address": address,
+                    "amount": balance,
+                }
+            )
+            withdrawal_index += 1
+        elif _is_partially_withdrawable(state, validator_index):
+            withdrawals.append(
+                {
+                    "index": withdrawal_index,
+                    "validator_index": validator_index,
+                    "address": address,
+                    "amount": balance - P.MAX_EFFECTIVE_BALANCE,
+                }
+            )
+            withdrawal_index += 1
+        validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(state, payload: Dict) -> None:
+    """spec process_withdrawals: the payload's withdrawal list must equal
+    the protocol-computed expectation; balances are debited and both
+    cursors advance."""
+    from ..types import Withdrawal
+
+    expected = get_expected_withdrawals(state)
+    got = list(payload["withdrawals"])
+    _require(
+        len(got) == len(expected)
+        and all(
+            Withdrawal.hash_tree_root(a) == Withdrawal.hash_tree_root(e)
+            for a, e in zip(got, expected)
+        ),
+        "payload withdrawals do not match protocol expectation",
+    )
+    for w in expected:
+        state.decrease_balance(w["validator_index"], w["amount"])
+    if expected:
+        state.next_withdrawal_index = expected[-1]["index"] + 1
+    n = state.num_validators
+    if len(expected) == P.MAX_WITHDRAWALS_PER_PAYLOAD:
+        # full payload: resume after the last withdrawn validator
+        state.next_withdrawal_validator_index = (
+            expected[-1]["validator_index"] + 1
+        ) % n
+    else:
+        # partial sweep: jump the cursor by the UNCLAMPED sweep bound
+        # before the modulo (spec get_expected_withdrawals epilogue —
+        # clamping changes the post-state cursor when n < sweep bound)
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + P.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % n
+
+
+def process_bls_to_execution_change(
+    state, signed_change: Dict, verify_signatures: bool
+) -> None:
+    """spec process_bls_to_execution_change: rotate 0x00 BLS withdrawal
+    credentials to a 0x01 execution address; signed against the GENESIS
+    fork domain so pre-signed changes outlive forks."""
+    change = signed_change["message"]
+    index = change["validator_index"]
+    _require(index < state.num_validators, "unknown validator")
+    cred = bytes(state.withdrawal_credentials[index])
+    _require(
+        cred[:1] == params.BLS_WITHDRAWAL_PREFIX,
+        "credentials already rotated",
+    )
+    pk_hash = hashlib.sha256(bytes(change["from_bls_pubkey"])).digest()
+    _require(cred[1:] == pk_hash[1:], "from_bls_pubkey does not match credentials")
+    if verify_signatures:
+        from ..crypto import bls as _bls
+        from ..crypto import curves as _curves
+        from ..types import BLSToExecutionChange
+
+        domain = state.config.compute_domain(
+            params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            state.config.fork_versions[params.ForkName.phase0],
+            state.genesis_validators_root,
+        )
+        root = state.config.compute_signing_root(
+            BLSToExecutionChange.hash_tree_root(change), domain
+        )
+        try:
+            pk = _curves.g1_decompress(bytes(change["from_bls_pubkey"]))
+            sig = _curves.g2_decompress(bytes(signed_change["signature"]))
+            ok = _bls.verify(pk, root, sig)
+        except Exception:
+            ok = False
+        _require(ok, "invalid BLS-to-execution-change signature")
+    state.withdrawal_credentials[index] = (
+        params.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        + b"\x00" * 11
+        + bytes(change["to_execution_address"])
+    )
 
 
 # -- sync aggregate ---------------------------------------------------------
@@ -662,6 +819,12 @@ def process_operations(state, body: Dict, verify_signatures: bool) -> None:
         process_deposit(state, op)
     for op in body["voluntary_exits"]:
         process_voluntary_exit(state, op, verify_signatures)
+    for op in body.get("bls_to_execution_changes", ()):
+        _require(
+            state.fork_at_least(params.ForkName.capella),
+            "bls_to_execution_changes before capella",
+        )
+        process_bls_to_execution_change(state, op, verify_signatures)
 
 
 def is_merge_transition_complete(state) -> bool:
@@ -677,8 +840,9 @@ def is_merge_transition_complete(state) -> bool:
 
 def payload_to_header(payload: Dict) -> Dict:
     """ExecutionPayload -> ExecutionPayloadHeader (transactions list ->
-    transactions_root)."""
-    from ..types import Transaction
+    transactions_root; capella also roots the withdrawal list, deneb
+    copies the blob gas fields)."""
+    from ..types import Transaction, Withdrawal
     from ..ssz import List as SszList
 
     txs_root = SszList(Transaction, 1_048_576).hash_tree_root(
@@ -694,6 +858,13 @@ def payload_to_header(payload: Dict) -> Dict:
         )
     }
     header["transactions_root"] = txs_root
+    if "withdrawals" in payload:
+        header["withdrawals_root"] = SszList(
+            Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD
+        ).hash_tree_root(payload["withdrawals"])
+    if "blob_gas_used" in payload:
+        header["blob_gas_used"] = payload["blob_gas_used"]
+        header["excess_blob_gas"] = payload["excess_blob_gas"]
     return header
 
 
@@ -749,12 +920,23 @@ def process_block(state, block: Dict, verify_signatures: bool = False) -> None:
             "execution_payload" in body,
             "bellatrix block must carry an execution payload",
         )
+        if state.fork_at_least(params.ForkName.deneb):
+            _require(
+                len(body.get("blob_kzg_commitments", ()))
+                <= P.MAX_BLOBS_PER_BLOCK,
+                "too many blob commitments",
+            )
         # spec is_execution_enabled: process the payload once the merge
         # transition is complete OR this block IS the transition block
         # (non-default payload); a pre-merge default payload is skipped.
         if is_merge_transition_complete(state) or _is_nondefault_payload(
             body["execution_payload"]
         ):
+            # capella order: withdrawals precede the payload header update
+            # (spec capella process_block: process_withdrawals(payload)
+            # then process_execution_payload)
+            if state.next_withdrawal_index is not None:
+                process_withdrawals(state, body["execution_payload"])
             # spec order: the payload step precedes randao — its
             # prev_randao check reads the PRE-block mix
             process_execution_payload(state, body["execution_payload"])

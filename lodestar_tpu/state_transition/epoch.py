@@ -302,7 +302,9 @@ def process_registry_updates(state, cache: EpochTransitionCache) -> None:
         order = np.lexsort(
             (queue, state.activation_eligibility_epoch[queue])
         )
-        churn = get_validator_churn_limit(state)
+        from .accessors import get_validator_activation_churn_limit
+
+        churn = get_validator_activation_churn_limit(state)
         dequeued = queue[order][:churn]
         state.activation_epoch[dequeued] = compute_activation_exit_epoch(
             current_epoch
@@ -379,14 +381,32 @@ def process_historical_roots_update(
 ) -> None:
     next_epoch = cache.current_epoch + 1
     if next_epoch % (P.SLOTS_PER_HISTORICAL_ROOT // P.SLOTS_PER_EPOCH) == 0:
-        state.historical_roots.append(
-            HistoricalBatch.hash_tree_root(
+        if state.historical_summaries is not None:
+            # capella (process_historical_summaries_update): summarize the
+            # two root vectors separately so light proofs need no batch
+            from ..ssz import Vector as _Vec
+            from ..types import Root as _Root
+
+            vec = _Vec(_Root, P.SLOTS_PER_HISTORICAL_ROOT)
+            state.historical_summaries.append(
                 {
-                    "block_roots": list(state.block_roots),
-                    "state_roots": list(state.state_roots),
+                    "block_summary_root": vec.hash_tree_root(
+                        list(state.block_roots)
+                    ),
+                    "state_summary_root": vec.hash_tree_root(
+                        list(state.state_roots)
+                    ),
                 }
             )
-        )
+        else:
+            state.historical_roots.append(
+                HistoricalBatch.hash_tree_root(
+                    {
+                        "block_roots": list(state.block_roots),
+                        "state_roots": list(state.state_roots),
+                    }
+                )
+            )
 
 
 def process_participation_flag_updates(

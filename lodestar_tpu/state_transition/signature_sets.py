@@ -39,6 +39,16 @@ class BeaconStateView:
     block_roots: Dict[int, bytes] = field(default_factory=dict)
     # previous-epoch committees (blocks carry prev-epoch attestations)
     prev_epoch_cache: Optional[EpochCache] = None
+    # the STATE's genesis validators root — fork-agnostic domains
+    # (deposits, BLS changes, EIP-7044 exits) must use the live chain's
+    # value, not whatever the ChainConfig preset was built with
+    _genesis_validators_root: Optional[bytes] = None
+
+    @property
+    def genesis_validators_root(self) -> bytes:
+        if self._genesis_validators_root is not None:
+            return self._genesis_validators_root
+        return self.config.genesis_validators_root
 
     def get_block_root_at_slot(self, slot: int) -> bytes:
         return self.block_roots.get(slot, b"\x00" * 32)
@@ -86,6 +96,7 @@ class BeaconStateView:
             epoch_cache=_cache(epoch),
             block_roots=window,
             prev_epoch_cache=_cache(epoch - 1) if epoch > 0 else None,
+            _genesis_validators_root=state.genesis_validators_root,
         )
 
 
@@ -217,19 +228,67 @@ def get_attester_slashings_signature_sets(
 def get_voluntary_exits_signature_sets(
     state: BeaconStateView, signed_block: dict
 ) -> List[WireSignatureSet]:
+    # EIP-7044 (deneb): exits verify against the CAPELLA fork domain
+    # permanently — must match process_voluntary_exit's rule exactly
+    deneb = (
+        state.config.get_fork_seq(state.slot)
+        >= params.FORK_SEQ[ForkName.deneb]
+    )
     out = []
     for signed_exit in signed_block["message"]["body"]["voluntary_exits"]:
         exit_msg = signed_exit["message"]
-        root = _signing_root(
-            state.config,
-            state.slot,
-            params.DOMAIN_VOLUNTARY_EXIT,
-            compute_start_slot_at_epoch(exit_msg["epoch"]),
-            T.VoluntaryExit.hash_tree_root(exit_msg),
-        )
+        if deneb:
+            domain = state.config.compute_domain(
+                params.DOMAIN_VOLUNTARY_EXIT,
+                state.config.fork_versions[ForkName.capella],
+                state.genesis_validators_root,
+            )
+            root = state.config.compute_signing_root(
+                T.VoluntaryExit.hash_tree_root(exit_msg), domain
+            )
+        else:
+            root = _signing_root(
+                state.config,
+                state.slot,
+                params.DOMAIN_VOLUNTARY_EXIT,
+                compute_start_slot_at_epoch(exit_msg["epoch"]),
+                T.VoluntaryExit.hash_tree_root(exit_msg),
+            )
         out.append(
             WireSignatureSet.single(
                 exit_msg["validator_index"], root, signed_exit["signature"]
+            )
+        )
+    return out
+
+
+# -- capella BLS-to-execution changes (reference: signatureSets/
+# blsToExecutionChange.ts) — signed by the WITHDRAWAL key, which lives
+# outside the validator signing-key registry, against the genesis fork
+# domain so pre-signed changes survive forks ---------------------------------
+
+
+def get_bls_to_execution_change_signature_sets(
+    state: BeaconStateView, signed_block: dict
+) -> List[WireSignatureSet]:
+    out = []
+    for signed_change in signed_block["message"]["body"].get(
+        "bls_to_execution_changes", ()
+    ):
+        change = signed_change["message"]
+        domain = state.config.compute_domain(
+            params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            state.config.fork_versions[ForkName.phase0],
+            state.genesis_validators_root,
+        )
+        root = state.config.compute_signing_root(
+            T.BLSToExecutionChange.hash_tree_root(change), domain
+        )
+        out.append(
+            WireSignatureSet.external(
+                [bytes(change["from_bls_pubkey"])],
+                root,
+                signed_change["signature"],
             )
         )
     return out
@@ -394,6 +453,9 @@ def get_block_signature_sets(
     sets.extend(get_attester_slashings_signature_sets(state, signed_block))
     sets.extend(get_attestation_signature_sets(state, signed_block))
     sets.extend(get_voluntary_exits_signature_sets(state, signed_block))
+    sets.extend(
+        get_bls_to_execution_change_signature_sets(state, signed_block)
+    )
     if not skip_proposer_signature:
         sets.append(get_proposer_signature_set(state, signed_block))
     if state.config.get_fork_seq(block["slot"]) >= params.FORK_SEQ[ForkName.altair]:

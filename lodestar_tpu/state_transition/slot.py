@@ -63,15 +63,57 @@ def maybe_upgrade_state(state) -> None:
         and state.latest_execution_payload_header is None
     ):
         upgrade_to_bellatrix(state)
+    capella_epoch = state.config.fork_epochs.get(ForkName.capella)
+    if (
+        capella_epoch is not None
+        and epoch == capella_epoch
+        and state.next_withdrawal_index is None
+    ):
+        upgrade_to_capella(state)
+    deneb_epoch = state.config.fork_epochs.get(ForkName.deneb)
+    if (
+        deneb_epoch is not None
+        and epoch == deneb_epoch
+        and state.next_withdrawal_index is not None
+        and "blob_gas_used" not in (state.latest_execution_payload_header or {})
+    ):
+        upgrade_to_deneb(state)
+
+
+def _bump_fork(state, fork: ForkName) -> None:
+    state.fork = {
+        "previous_version": state.fork["current_version"],
+        "current_version": state.config.fork_versions[fork],
+        "epoch": state.slot // P.SLOTS_PER_EPOCH,
+    }
 
 
 def upgrade_to_bellatrix(state) -> None:
     """reference: slot/upgradeStateToBellatrix.ts — bump the fork record
     and attach the default (pre-merge) execution payload header."""
-    epoch = state.slot // P.SLOTS_PER_EPOCH
-    state.fork = {
-        "previous_version": state.fork["current_version"],
-        "current_version": state.config.fork_versions[ForkName.bellatrix],
-        "epoch": epoch,
-    }
+    _bump_fork(state, ForkName.bellatrix)
     state.latest_execution_payload_header = ExecutionPayloadHeader.default()
+
+
+def upgrade_to_capella(state) -> None:
+    """reference: slot/upgradeStateToCapella.ts — the payload header gains
+    withdrawals_root; withdrawal bookkeeping + historical summaries start."""
+    _bump_fork(state, ForkName.capella)
+    header = dict(state.latest_execution_payload_header or {})
+    if not header:
+        header = ExecutionPayloadHeader.default()
+    header["withdrawals_root"] = ZERO_ROOT
+    state.latest_execution_payload_header = header
+    state.next_withdrawal_index = 0
+    state.next_withdrawal_validator_index = 0
+    state.historical_summaries = []
+
+
+def upgrade_to_deneb(state) -> None:
+    """reference: slot/upgradeStateToDeneb.ts — the payload header gains
+    the blob gas fields."""
+    _bump_fork(state, ForkName.deneb)
+    header = dict(state.latest_execution_payload_header)
+    header["blob_gas_used"] = 0
+    header["excess_blob_gas"] = 0
+    state.latest_execution_payload_header = header

@@ -88,6 +88,39 @@ BeaconStateBellatrix = Container(
     name="BeaconStateBellatrix",
 )
 
+# capella appends withdrawal bookkeeping + historical summaries, and the
+# payload header gains withdrawals_root
+# (reference: types/src/capella/sszTypes.ts BeaconState)
+from ..types import (  # noqa: E402
+    ExecutionPayloadHeaderCapella as _HeaderCapella,
+    ExecutionPayloadHeaderDeneb as _HeaderDeneb,
+    HistoricalSummary as _HistoricalSummary,
+)
+
+_capella_extra_fields = (
+    ("next_withdrawal_index", uint64),
+    ("next_withdrawal_validator_index", uint64),
+    (
+        "historical_summaries",
+        SszList(_HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT),
+    ),
+)
+
+BeaconStateCapella = Container(
+    _altair_state_fields
+    + (("latest_execution_payload_header", _HeaderCapella),)
+    + _capella_extra_fields,
+    name="BeaconStateCapella",
+)
+
+# deneb only swaps the payload header type (blob gas fields)
+BeaconStateDeneb = Container(
+    _altair_state_fields
+    + (("latest_execution_payload_header", _HeaderDeneb),)
+    + _capella_extra_fields,
+    name="BeaconStateDeneb",
+)
+
 _U64 = np.uint64
 FAR_FUTURE = params.FAR_FUTURE_EPOCH
 
@@ -170,6 +203,25 @@ class BeaconState:
     )
     # None = pre-bellatrix state; set by upgrade_to_bellatrix
     latest_execution_payload_header: Optional[Dict] = None
+    # None = pre-capella state; set by upgrade_to_capella
+    next_withdrawal_index: Optional[int] = None
+    next_withdrawal_validator_index: Optional[int] = None
+    historical_summaries: Optional[List[Dict]] = None
+
+    # -- fork identity ------------------------------------------------------
+
+    @property
+    def fork_name(self) -> params.ForkName:
+        """The fork this state is in, from Fork.current_version (the
+        reference's config.getForkName(state.slot) equivalent)."""
+        version = bytes(self.fork["current_version"])
+        for name, v in self.config.fork_versions.items():
+            if bytes(v) == version:
+                return name
+        return params.ForkName.altair
+
+    def fork_at_least(self, fork: params.ForkName) -> bool:
+        return params.FORK_SEQ[self.fork_name] >= params.FORK_SEQ[fork]
 
     # -- registry ----------------------------------------------------------
 
@@ -289,6 +341,15 @@ class BeaconState:
         out.latest_execution_payload_header = copy.deepcopy(
             self.latest_execution_payload_header
         )
+        out.next_withdrawal_index = self.next_withdrawal_index
+        out.next_withdrawal_validator_index = (
+            self.next_withdrawal_validator_index
+        )
+        out.historical_summaries = (
+            [dict(h) for h in self.historical_summaries]
+            if self.historical_summaries is not None
+            else None
+        )
         return out
 
     def validators_value(self) -> List[Dict]:
@@ -344,6 +405,12 @@ class BeaconState:
             out["latest_execution_payload_header"] = (
                 self.latest_execution_payload_header
             )
+        if self.next_withdrawal_index is not None:
+            out["next_withdrawal_index"] = self.next_withdrawal_index
+            out["next_withdrawal_validator_index"] = (
+                self.next_withdrawal_validator_index
+            )
+            out["historical_summaries"] = list(self.historical_summaries)
         return out
 
     @classmethod
@@ -403,16 +470,39 @@ class BeaconState:
             st.latest_execution_payload_header = dict(
                 value["latest_execution_payload_header"]
             )
+        if "next_withdrawal_index" in value:
+            st.next_withdrawal_index = value["next_withdrawal_index"]
+            st.next_withdrawal_validator_index = value[
+                "next_withdrawal_validator_index"
+            ]
+            st.historical_summaries = [
+                dict(h) for h in value["historical_summaries"]
+            ]
         return st
 
     # -- fork-aware container selection ------------------------------------
 
+    @staticmethod
+    def _container_for_fork(name: params.ForkName):
+        seq = params.FORK_SEQ[name]
+        if seq >= params.FORK_SEQ[params.ForkName.deneb]:
+            return BeaconStateDeneb
+        if seq >= params.FORK_SEQ[params.ForkName.capella]:
+            return BeaconStateCapella
+        if seq >= params.FORK_SEQ[params.ForkName.bellatrix]:
+            return BeaconStateBellatrix
+        return BeaconStateAltair
+
     def _container(self):
-        return (
-            BeaconStateBellatrix
-            if self.latest_execution_payload_header is not None
-            else BeaconStateAltair
-        )
+        # Prefer the schema implied by the materialized fields over the
+        # fork version: tests build altair-shaped states with arbitrary
+        # fork records, and a capella state always carries the fields.
+        if self.next_withdrawal_index is not None:
+            c = self._container_for_fork(self.fork_name)
+            return c if c in (BeaconStateCapella, BeaconStateDeneb) else BeaconStateCapella
+        if self.latest_execution_payload_header is not None:
+            return BeaconStateBellatrix
+        return BeaconStateAltair
 
     @staticmethod
     def _container_for_bytes(data: bytes, config: ChainConfig):
@@ -423,10 +513,7 @@ class BeaconState:
         version = bytes(data[52:56])
         for name, v in config.fork_versions.items():
             if v == version:
-                order = list(params.ForkName)
-                if order.index(name) >= order.index(params.ForkName.bellatrix):
-                    return BeaconStateBellatrix
-                return BeaconStateAltair
+                return BeaconState._container_for_fork(name)
         return BeaconStateAltair
 
     def hash_tree_root(self) -> bytes:

@@ -440,7 +440,7 @@ Withdrawal = Container(
     name="Withdrawal",
 )
 
-MAX_WITHDRAWALS_PER_PAYLOAD = 16
+MAX_WITHDRAWALS_PER_PAYLOAD = P.MAX_WITHDRAWALS_PER_PAYLOAD
 
 ExecutionPayloadCapella = Container(
     _payload_header_fields
@@ -479,24 +479,45 @@ BeaconBlockCapella, SignedBeaconBlockCapella = _block_types(
     BeaconBlockBodyCapella, "Capella"
 )
 
+# capella replaces the historical-roots accumulator entries
+# (reference: types/src/capella/sszTypes.ts HistoricalSummary)
+HistoricalSummary = Container(
+    (
+        ("block_summary_root", Bytes32),
+        ("state_summary_root", Bytes32),
+    ),
+    name="HistoricalSummary",
+)
+
 # deneb: blob KZG commitments ride the block body (KZG verification is
-# out of scope per BASELINE; the type layer carries the commitments)
+# out of scope per BASELINE; the type layer carries the commitments).
+# Spec field order appends blob_gas_used/excess_blob_gas AFTER the
+# capella fields (consensus-specs deneb/beacon-chain.md ExecutionPayload).
 KZGCommitment = Bytes48
 MAX_BLOB_COMMITMENTS_PER_BLOCK = 4096
 
-_deneb_payload_fields = _payload_header_fields + (
-    ("blob_gas_used", uint64),
-    ("excess_blob_gas", uint64),
-)
-
 ExecutionPayloadDeneb = Container(
-    _deneb_payload_fields
+    _payload_header_fields
     + (
         ("block_hash", Bytes32),
         ("transactions", List(Transaction, 1_048_576)),
         ("withdrawals", List(Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD)),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
     ),
     name="ExecutionPayloadDeneb",
+)
+
+ExecutionPayloadHeaderDeneb = Container(
+    _payload_header_fields
+    + (
+        ("block_hash", Bytes32),
+        ("transactions_root", Bytes32),
+        ("withdrawals_root", Bytes32),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
+    ),
+    name="ExecutionPayloadHeaderDeneb",
 )
 
 BeaconBlockBodyDeneb = Container(
@@ -518,4 +539,33 @@ BeaconBlockBodyDeneb = Container(
 
 BeaconBlockDeneb, SignedBeaconBlockDeneb = _block_types(
     BeaconBlockBodyDeneb, "Deneb"
+)
+
+# Per-fork namespaces for the later forks (reference: types/src/sszTypes.ts
+# `ssz.bellatrix` / `ssz.capella` / `ssz.deneb`)
+ssz.bellatrix = SimpleNamespace(
+    ExecutionPayload=ExecutionPayload,
+    ExecutionPayloadHeader=ExecutionPayloadHeader,
+    BeaconBlock=BeaconBlockBellatrix,
+    SignedBeaconBlock=SignedBeaconBlockBellatrix,
+    BeaconBlockBody=BeaconBlockBodyBellatrix,
+)
+ssz.capella = SimpleNamespace(
+    Withdrawal=Withdrawal,
+    HistoricalSummary=HistoricalSummary,
+    BLSToExecutionChange=BLSToExecutionChange,
+    SignedBLSToExecutionChange=SignedBLSToExecutionChange,
+    ExecutionPayload=ExecutionPayloadCapella,
+    ExecutionPayloadHeader=ExecutionPayloadHeaderCapella,
+    BeaconBlock=BeaconBlockCapella,
+    SignedBeaconBlock=SignedBeaconBlockCapella,
+    BeaconBlockBody=BeaconBlockBodyCapella,
+)
+ssz.deneb = SimpleNamespace(
+    KZGCommitment=KZGCommitment,
+    ExecutionPayload=ExecutionPayloadDeneb,
+    ExecutionPayloadHeader=ExecutionPayloadHeaderDeneb,
+    BeaconBlock=BeaconBlockDeneb,
+    SignedBeaconBlock=SignedBeaconBlockDeneb,
+    BeaconBlockBody=BeaconBlockBodyDeneb,
 )

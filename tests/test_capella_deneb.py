@@ -1,0 +1,341 @@
+"""Capella/deneb slice: withdrawals, credential rotation, historical
+summaries, blob-era block shapes.
+
+Reference behaviors: packages/state-transition capella processing
+(processWithdrawals, processBlsToExecutionChange,
+upgradeStateToCapella/Deneb — the reference spreads these across
+block/ and slot/), engine API v2/v3 payload shapes
+(packages/beacon-node/src/execution/engine/http.ts), and the capella
+signature-set extractor (signatureSets/blsToExecutionChange.ts).
+"""
+
+import hashlib
+from types import SimpleNamespace
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.execution import ExecutionEngineMock, PayloadAttributes
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_proposer_index,
+)
+from lodestar_tpu.state_transition.block import (
+    BlockProcessError,
+    get_expected_withdrawals,
+    process_bls_to_execution_change,
+    process_withdrawals,
+)
+from lodestar_tpu.state_transition.epoch import (
+    process_historical_roots_update,
+)
+from lodestar_tpu.state_transition.slot import process_slots
+from lodestar_tpu.state_transition.state import BeaconState
+from lodestar_tpu.validator import ValidatorStore
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+N_KEYS = 8
+
+
+def make_cfg(bellatrix=1, capella=2, deneb=3):
+    return create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={
+            ForkName.altair: 0,
+            ForkName.bellatrix: bellatrix,
+            ForkName.capella: capella,
+            ForkName.deneb: deneb,
+        },
+    )
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = make_cfg()
+    sks = [B.keygen(b"cap-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    # validator 0 carries a 1-ETH excess balance: once its credentials
+    # rotate to 0x01 it becomes partially withdrawable (effective stays
+    # MAX; the excess out-lives a few epochs of missed-duty penalties)
+    balances = [
+        P.MAX_EFFECTIVE_BALANCE + 10**9
+    ] + [P.MAX_EFFECTIVE_BALANCE] * (N_KEYS - 1)
+    genesis = create_genesis_state(cfg, pks, genesis_time=2, balances=balances)
+    return cfg, sks, pks, genesis
+
+
+def _eth1_creds(address: bytes) -> bytes:
+    return params.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+
+
+def test_fork_upgrades_capella_then_deneb(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)  # epoch 2 = capella
+    assert st.next_withdrawal_index == 0
+    assert st.next_withdrawal_validator_index == 0
+    assert st.historical_summaries == []
+    assert "withdrawals_root" in st.latest_execution_payload_header
+    assert st.fork["current_version"] == cfg.fork_versions[ForkName.capella]
+    process_slots(st, 3 * P.SLOTS_PER_EPOCH)  # epoch 3 = deneb
+    assert st.latest_execution_payload_header["blob_gas_used"] == 0
+    assert st.fork["current_version"] == cfg.fork_versions[ForkName.deneb]
+    assert st.fork_name == ForkName.deneb
+
+
+def test_state_ssz_roundtrip_capella_and_deneb(world):
+    cfg, sks, pks, genesis = world
+    for slot in (2 * P.SLOTS_PER_EPOCH + 1, 3 * P.SLOTS_PER_EPOCH + 1):
+        st = genesis.clone()
+        process_slots(st, slot)
+        data = st.serialize()
+        back = BeaconState.deserialize(data, cfg)
+        assert back.next_withdrawal_index == st.next_withdrawal_index
+        assert back.hash_tree_root() == st.hash_tree_root()
+        assert back.serialize() == data
+
+
+def test_expected_withdrawals_sweep(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)
+    # nobody withdrawable yet: all creds still 0x00 BLS
+    assert get_expected_withdrawals(st) == []
+    # validator 3: rotated creds + excess balance -> partial withdrawal
+    st.withdrawal_credentials[3] = _eth1_creds(b"\x33" * 20)
+    st.balances[3] = P.MAX_EFFECTIVE_BALANCE + 5
+    # validator 5: rotated creds + withdrawable epoch passed -> full
+    st.withdrawal_credentials[5] = _eth1_creds(b"\x55" * 20)
+    st.withdrawable_epoch[5] = 0
+    ws = get_expected_withdrawals(st)
+    assert [w["validator_index"] for w in ws] == [3, 5]
+    assert ws[0]["amount"] == 5
+    assert ws[0]["address"] == b"\x33" * 20
+    assert ws[1]["amount"] == int(st.balances[5])
+    assert [w["index"] for w in ws] == [0, 1]
+
+
+def test_process_withdrawals_debits_and_advances(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)
+    st.withdrawal_credentials[2] = _eth1_creds(b"\x22" * 20)
+    st.balances[2] = P.MAX_EFFECTIVE_BALANCE + 9
+    expected = get_expected_withdrawals(st)
+    payload = {"withdrawals": expected}
+    process_withdrawals(st, payload)
+    assert int(st.balances[2]) == P.MAX_EFFECTIVE_BALANCE
+    assert st.next_withdrawal_index == 1
+    # partial sweep: cursor jumps past the whole (8-validator) window
+    assert st.next_withdrawal_validator_index == 0  # 0+8 % 8
+    # mismatching payload list REJECTS
+    st2 = genesis.clone()
+    process_slots(st2, 2 * P.SLOTS_PER_EPOCH)
+    st2.withdrawal_credentials[2] = _eth1_creds(b"\x22" * 20)
+    st2.balances[2] = P.MAX_EFFECTIVE_BALANCE + 9
+    bad = [dict(w, amount=w["amount"] + 1) for w in get_expected_withdrawals(st2)]
+    with pytest.raises(BlockProcessError, match="withdrawals"):
+        process_withdrawals(st2, {"withdrawals": bad})
+
+
+def test_bls_to_execution_change(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)
+    index = 4
+    change = {
+        "validator_index": index,
+        "from_bls_pubkey": pks[index],  # genesis creds hash this key
+        "to_execution_address": b"\x44" * 20,
+    }
+    domain = cfg.compute_domain(
+        params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg.fork_versions[ForkName.phase0],
+        st.genesis_validators_root,
+    )
+    root = cfg.compute_signing_root(
+        T.BLSToExecutionChange.hash_tree_root(change), domain
+    )
+    signed = {
+        "message": change,
+        "signature": C.g2_compress(B.sign(sks[index], root)),
+    }
+    process_bls_to_execution_change(st, signed, verify_signatures=True)
+    assert st.withdrawal_credentials[index] == _eth1_creds(b"\x44" * 20)
+    # second application REJECTS (already rotated)
+    with pytest.raises(BlockProcessError, match="rotated"):
+        process_bls_to_execution_change(st, signed, verify_signatures=True)
+    # wrong withdrawal key REJECTS
+    st2 = genesis.clone()
+    process_slots(st2, 2 * P.SLOTS_PER_EPOCH)
+    bad = dict(change, from_bls_pubkey=pks[(index + 1) % N_KEYS])
+    with pytest.raises(BlockProcessError, match="credentials"):
+        process_bls_to_execution_change(
+            st2, {"message": bad, "signature": signed["signature"]}, True
+        )
+
+
+def test_historical_summaries_replace_roots(world):
+    cfg, sks, pks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)
+    period = P.SLOTS_PER_HISTORICAL_ROOT // P.SLOTS_PER_EPOCH
+    cache = SimpleNamespace(current_epoch=period - 1)  # next_epoch hits it
+    n_roots = len(st.historical_roots)
+    process_historical_roots_update(st, cache)
+    assert len(st.historical_summaries) == 1
+    assert len(st.historical_roots) == n_roots  # frozen after capella
+    s = st.historical_summaries[0]
+    assert T.HistoricalSummary.hash_tree_root(s)  # well-formed
+
+
+def test_deneb_exit_domain_is_pinned_to_capella(world):
+    """EIP-7044: a deneb-era exit verifies against the capella fork
+    domain, independent of the current fork."""
+    import dataclasses
+
+    cfg, sks, pks, genesis = world
+    cfg0 = dataclasses.replace(cfg, SHARD_COMMITTEE_PERIOD=0)
+    st = genesis.clone()
+    st.config = cfg0
+    process_slots(st, 3 * P.SLOTS_PER_EPOCH + 1)
+    assert st.fork_name == ForkName.deneb
+    index = 1
+    exit_msg = {"epoch": 0, "validator_index": index}
+    # signed against the CAPELLA domain although the state is in deneb
+    domain = cfg0.compute_domain(
+        params.DOMAIN_VOLUNTARY_EXIT,
+        cfg0.fork_versions[ForkName.capella],
+        st.genesis_validators_root,
+    )
+    root = cfg0.compute_signing_root(
+        T.VoluntaryExit.hash_tree_root(exit_msg), domain
+    )
+    from lodestar_tpu.state_transition.block import process_voluntary_exit
+
+    signed = {
+        "message": exit_msg,
+        "signature": C.g2_compress(B.sign(sks[index], root)),
+    }
+    process_voluntary_exit(st, signed, verify_signatures=True)
+    assert int(st.exit_epoch[index]) != params.FAR_FUTURE_EPOCH
+    # the deneb-fork domain (pre-7044 rule) must NOT verify
+    st2 = genesis.clone()
+    st2.config = cfg0
+    process_slots(st2, 3 * P.SLOTS_PER_EPOCH + 1)
+    bad_domain = cfg0.compute_domain(
+        params.DOMAIN_VOLUNTARY_EXIT,
+        cfg0.fork_versions[ForkName.deneb],
+        st2.genesis_validators_root,
+    )
+    bad_root = cfg0.compute_signing_root(
+        T.VoluntaryExit.hash_tree_root(exit_msg), bad_domain
+    )
+    bad = {
+        "message": exit_msg,
+        "signature": C.g2_compress(B.sign(sks[index], bad_root)),
+    }
+    with pytest.raises(BlockProcessError, match="signature"):
+        process_voluntary_exit(st2, bad, verify_signatures=True)
+
+
+def test_chain_crosses_merge_capella_deneb_end_to_end(world):
+    """Produce+import real signed blocks across bellatrix -> capella ->
+    deneb; capella payloads carry protocol-expected withdrawals built by
+    the mock EL from engine-v2 attributes; a bls-to-execution change
+    rides a capella block from the op pool."""
+    cfg, sks, pks, genesis = world
+    el = ExecutionEngineMock()
+    chain = BeaconChain(cfg, genesis, execution=el)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+
+    def propose(slot):
+        # proposer from the REAL head chain (randao mixes diverge from an
+        # empty-chain replay once imported reveals land)
+        st = chain.head_state.clone()
+        if st.slot < slot:
+            process_slots(st, slot)
+        proposer = get_beacon_proposer_index(st)
+        block = chain.produce_block(slot, store.sign_randao(proposer, slot))
+        block_type, _signed_t, _body_t = cfg.get_fork_types(slot)
+        root = cfg.compute_signing_root(
+            block_type.hash_tree_root(block),
+            cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
+        )
+        signed = {
+            "message": block,
+            "signature": C.g2_compress(B.sign(sks[proposer], root)),
+        }
+        return chain.process_block(signed)
+
+
+    # bellatrix: the merge block
+    propose(P.SLOTS_PER_EPOCH + 1)
+    # capella: rotate validator 0's creds in-block, then withdraw
+    index = 0
+    change = {
+        "validator_index": index,
+        "from_bls_pubkey": pks[index],
+        "to_execution_address": b"\xaa" * 20,
+    }
+    domain = cfg.compute_domain(
+        params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg.fork_versions[ForkName.phase0],
+        genesis.genesis_validators_root,
+    )
+    change_root = cfg.compute_signing_root(
+        T.BLSToExecutionChange.hash_tree_root(change), domain
+    )
+    signed_change = {
+        "message": change,
+        "signature": C.g2_compress(B.sign(sks[index], change_root)),
+    }
+    # the change rides the op pool into the next produced block
+    chain.op_pool.insert_bls_to_execution_change(signed_change)
+    slot_cap = 2 * P.SLOTS_PER_EPOCH + 1
+    root_cap = propose(slot_cap)
+    assert chain.head_root_hex == bytes(root_cap).hex()
+    head = chain.head_state
+    assert bytes(head.withdrawal_credentials[index][:1]) == (
+        params.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    )
+    # validator 0 has carried an excess balance since genesis; with the
+    # credentials rotated the NEXT payload must skim it
+
+    # deneb block: body carries (empty) blob commitments and the payload
+    # the blob gas fields; the withdrawal executes
+    slot_deneb = 3 * P.SLOTS_PER_EPOCH + 1
+    st = chain.head_state.clone()
+    if st.slot < slot_deneb:
+        process_slots(st, slot_deneb)
+    proposer = get_beacon_proposer_index(st)
+    block = chain.produce_block(slot_deneb, store.sign_randao(proposer, slot_deneb))
+    assert "blob_kzg_commitments" in block["body"]
+    payload = block["body"]["execution_payload"]
+    assert "blob_gas_used" in payload
+    assert [w["validator_index"] for w in payload["withdrawals"]] == [index]
+    assert payload["withdrawals"][0]["amount"] > 0  # the excess skim
+    block_type, _s, _b = cfg.get_fork_types(slot_deneb)
+    root = cfg.compute_signing_root(
+        block_type.hash_tree_root(block),
+        cfg.get_domain(slot_deneb, params.DOMAIN_BEACON_PROPOSER, slot_deneb),
+    )
+    signed = {
+        "message": block,
+        "signature": C.g2_compress(B.sign(sks[proposer], root)),
+    }
+    root_deneb = chain.process_block(signed)
+    assert chain.head_root_hex == bytes(root_deneb).hex()
+    # the skim leaves exactly MAX, minus the same-block empty-sync-
+    # aggregate penalties (every validator sits in the tiny committee)
+    final = int(chain.head_state.balances[index])
+    assert P.MAX_EFFECTIVE_BALANCE - 10**7 < final <= P.MAX_EFFECTIVE_BALANCE
+    assert not chain.optimistic_roots

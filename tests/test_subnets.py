@@ -28,13 +28,30 @@ def test_long_lived_subnets_deterministic_and_rotating():
     assert all(0 <= s < params.ATTESTATION_SUBNET_COUNT for s in subs)
     # stable within a subscription period
     assert compute_subscribed_subnets(node_id, 11) == subs
-    assert (
+    # real rotation: across several periods at least one change occurs
+    # (the permutation seed changes every period; a node keeping the
+    # same two subnets through 4 consecutive periods means the period
+    # stopped entering the seed)
+    horizon = [
         compute_subscribed_subnets(
-            node_id, 10 + EPOCHS_PER_SUBNET_SUBSCRIPTION
+            node_id, 10 + k * EPOCHS_PER_SUBNET_SUBSCRIPTION
         )
-        != subs
-        or True  # rotation is seed-dependent; at minimum it must not crash
-    )
+        for k in range(5)
+    ]
+    assert any(h != subs for h in horizon[1:])
+    # staggered rotation (p2p spec node_offset): nodes with different
+    # offsets must NOT all flip at the same epoch boundary
+    flip_epochs = set()
+    for i in (1, 7, 42, 99):
+        nid = int.from_bytes(bytes([i]) * 32, "big")
+        prev = compute_subscribed_subnets(nid, 0)
+        for e in range(1, 2 * EPOCHS_PER_SUBNET_SUBSCRIPTION):
+            cur = compute_subscribed_subnets(nid, e)
+            if cur != prev:
+                flip_epochs.add(e % EPOCHS_PER_SUBNET_SUBSCRIPTION)
+                break
+            prev = cur
+    assert len(flip_epochs) > 1, "rotations must be staggered across nodes"
     # different nodes spread over different subnets (backbone coverage)
     others = {
         tuple(
