@@ -65,7 +65,7 @@ class _DeviceJob:
 
     __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
                  "batch_ok", "per_set", "wire", "verdicts",
-                 "batch_retries", "batch_sigs_success")
+                 "batch_retries", "batch_sigs_success", "unsort")
 
     def __init__(self, sets, batchable, ok_big, wire=False):
         self.sets = sets
@@ -78,6 +78,9 @@ class _DeviceJob:
         self.batch_ok = None  # lazy device scalar (RLC batch verdict)
         self.per_set = None  # lazy device vector (per-set verdicts)
         self.verdicts = None  # host per-set bools, set by finish_job retry
+        # device planes may be SORTED by signing root (message grouping);
+        # unsort[i] = plane lane of original set i (None = identity)
+        self.unsort = None
         # per-job accounting (BlsWorkResult parity without racing the
         # process-global counters — the service reads these)
         self.batch_retries = 0
@@ -286,6 +289,18 @@ class TpuBlsVerifier:
             return job
 
         if wire:
+            # SORT by signing root: lane-contiguous message groups let
+            # the batch path run ONE Miller tile per distinct root
+            # (kernels/verify.py grouping rationale) instead of one per
+            # set.  Verdict order is restored through job.unsort.
+            order = sorted(
+                range(len(sets)), key=lambda i: sets[i].signing_root
+            )
+            if order != list(range(len(sets))):
+                sets = [sets[i] for i in order]
+                job.sets = sets
+                job.unsort = np.empty(len(order), np.int64)
+                job.unsort[np.asarray(order)] = np.arange(len(order))
             job.args, job.valid, n, host_bad = self._prepare_wire(sets)
             job.decodable = ~host_bad[: len(sets)]
         else:
@@ -295,10 +310,19 @@ class TpuBlsVerifier:
             # reference: maybeBatch.ts:16 (batch iff >= 2 sets)
             self.metrics.batchable_sigs.inc(len(sets))
             rand = jnp.asarray(BK.make_rand_words(n, self.rng))
-            batch_fn = (
-                KV.verify_batch_device_wire if wire else KV.verify_batch_device
-            )
-            job.batch_ok, _sub = batch_fn(*job.args, rand, job.valid)
+            grouping = self._grouping(sets, n) if wire else None
+            if grouping is not None:
+                group, head_lanes, glive = grouping
+                job.batch_ok, _sub = KV.verify_batch_device_wire_grouped(
+                    *job.args, group, head_lanes, glive, rand, job.valid
+                )
+            else:
+                batch_fn = (
+                    KV.verify_batch_device_wire
+                    if wire
+                    else KV.verify_batch_device
+                )
+                job.batch_ok, _sub = batch_fn(*job.args, rand, job.valid)
         else:
             if batchable and len(sets) >= 2:
                 # an undecodable signature voids the merged batch: count it
@@ -311,6 +335,42 @@ class TpuBlsVerifier:
 
     def _each_fn(self, job):
         return KV.verify_each_device_wire if job.wire else KV.verify_each_device
+
+    def _grouping(self, sets, n):
+        """Distinct-message group arrays for the grouped batch path
+        (kernels/verify.py verify_batch_device_wire_grouped), or None
+        when grouping does not apply: more distinct roots than one lane
+        tile, or no duplicate roots at all (nothing to collapse).
+
+        `sets` MUST be sorted by signing_root (begin_job does)."""
+        roots = [s.signing_root for s in sets]
+        group = np.zeros(n, np.int32)
+        heads = []
+        g = 0
+        for i in range(1, len(sets)):
+            if roots[i] != roots[i - 1]:
+                heads.append(i - 1)
+                g += 1
+            group[i] = g
+        heads.append(len(sets) - 1)
+        n_groups = g + 1
+        if n_groups > KV.BT or n_groups == len(sets):
+            return None
+        # padding lanes: fresh ids so they cannot merge into the last
+        # real group (they are dead either way; this keeps it explicit)
+        if n > len(sets):
+            group[len(sets):] = np.arange(
+                n_groups, n_groups + n - len(sets), dtype=np.int32
+            )
+        head_lanes = np.zeros(KV.BT, np.int32)
+        head_lanes[:n_groups] = heads
+        glive = np.zeros(KV.BT, np.int32)
+        glive[:n_groups] = 1
+        return (
+            jnp.asarray(group),
+            jnp.asarray(head_lanes),
+            jnp.asarray(glive),
+        )
 
     def _prepare_wire(self, sets: List[WireSignatureSet]):
         """Wire sets -> device planes: hashed messages from the device
@@ -358,6 +418,10 @@ class TpuBlsVerifier:
             job.batch_retries += 1
             job.per_set = self._each_fn(job)(*job.args, job.valid)
         per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+        if job.unsort is not None:
+            # planes were sorted by signing root: restore the caller's
+            # submission order (the service maps verdicts positionally)
+            per_set = per_set[job.unsort]
         job.verdicts = per_set  # callers can slice per-set results
         good = int(per_set.sum())
         self.metrics.success_jobs.inc(good)

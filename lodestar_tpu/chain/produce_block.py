@@ -161,6 +161,12 @@ def _fetch_payload(execution, pre) -> Dict:
         if pre.next_withdrawal_index is not None
         else None
     )
+    # deneb (v3): the parent beacon block root must ride the attributes
+    parent_beacon_root = None
+    if pre.fork_at_least(params.ForkName.deneb):
+        parent_beacon_root = BeaconBlockHeader.hash_tree_root(
+            pre.latest_block_header
+        )
     r = execution.notify_forkchoice_update(
         parent_hash,
         parent_hash,
@@ -173,6 +179,7 @@ def _fetch_payload(execution, pre) -> Dict:
             ),
             suggested_fee_recipient=b"\x00" * 20,
             withdrawals=withdrawals,
+            parent_beacon_block_root=parent_beacon_root,
         ),
     )
     if r.payload_id is None:

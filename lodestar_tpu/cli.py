@@ -117,7 +117,7 @@ def _dev_chain(args):
         else int(time.time())
     )
     sks, pks = _interop_keys(args.validators)
-    db = BeaconDb(args.db_path)
+    db = BeaconDb(args.db_path, config=cfg)
     ckpt_bytes = None
     ckpt_file = getattr(args, "checkpoint_state", None)
     if ckpt_file:

@@ -17,13 +17,44 @@ def _slot_key(slot: int) -> bytes:
     return slot.to_bytes(8, "big")  # big-endian keeps slot order == byte order
 
 
+class ForkAwareSignedBlockCodec:
+    """serialize/deserialize signed blocks with the container of the
+    block's OWN fork (reference: db repositories use
+    config.getForkTypes(slot) — db/repositories/block.ts).
+
+    An altair-typed repository silently DROPS execution payloads on put;
+    this codec reads the slot straight out of the value/bytes and
+    dispatches.  Serialized layout of every SignedBeaconBlock fork:
+    [message offset u32 | signature 96B | message...], and slot is the
+    message's first (fixed) field."""
+
+    def __init__(self, config):
+        self.config = config
+
+    def serialize(self, signed: dict) -> bytes:
+        slot = int(signed["message"]["slot"])
+        return self.config.get_fork_types(slot)[1].serialize(signed)
+
+    def deserialize(self, data: bytes) -> dict:
+        offset = int.from_bytes(data[0:4], "little")
+        slot = int.from_bytes(data[offset : offset + 8], "little")
+        return self.config.get_fork_types(slot)[1].deserialize(data)
+
+
 class BeaconDb:
-    def __init__(self, path=None):
+    def __init__(self, path=None, config=None):
         self.controller = KvController(path)
         db = self.controller
-        self.block = Repository(db, Bucket.block, T.SignedBeaconBlockAltair)
+        # fork-aware block codec when a config is wired; the altair
+        # container otherwise (legacy tests)
+        block_codec = (
+            ForkAwareSignedBlockCodec(config)
+            if config is not None
+            else T.SignedBeaconBlockAltair
+        )
+        self.block = Repository(db, Bucket.block, block_codec)
         self.block_archive = Repository(
-            db, Bucket.block_archive, T.SignedBeaconBlockAltair
+            db, Bucket.block_archive, block_codec
         )
         # root -> slot key for archived blocks (reference:
         # blockArchiveRootIndex in db/repositories/blockArchive.ts)

@@ -50,12 +50,15 @@ class PayloadAttributes:
     """engine_forkchoiceUpdated payload-build request (interface.ts).
 
     `withdrawals` (engine API v2 / capella) carries the protocol-computed
-    expected withdrawals the built payload must include; None = v1."""
+    expected withdrawals the built payload must include; None = v1.
+    `parent_beacon_block_root` (v3 / deneb) is required by post-Cancun
+    ELs — forkchoiceUpdatedV3 rejects attributes without it."""
 
     timestamp: int
     prev_randao: bytes
     suggested_fee_recipient: bytes
     withdrawals: Optional[list] = None
+    parent_beacon_block_root: Optional[bytes] = None
 
 
 class IExecutionEngine(Protocol):

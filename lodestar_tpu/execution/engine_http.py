@@ -221,6 +221,15 @@ class ExecutionEngineHttp:
                     withdrawal_to_json(w)
                     for w in payload_attributes.withdrawals
                 ]
+            if payload_attributes.parent_beacon_block_root is not None:
+                # deneb: post-Cancun ELs require fcuV3 + the parent root
+                method = "engine_forkchoiceUpdatedV3"
+                attrs["parentBeaconBlockRoot"] = (
+                    "0x"
+                    + bytes(
+                        payload_attributes.parent_beacon_block_root
+                    ).hex()
+                )
         r = self._call(method, [state, attrs])
         ps = r["payloadStatus"]
         return ForkchoiceUpdateResult(
@@ -315,6 +324,7 @@ class EngineApiServer:
         if method in (
             "engine_forkchoiceUpdatedV1",
             "engine_forkchoiceUpdatedV2",
+            "engine_forkchoiceUpdatedV3",
         ):
             state, attrs = params
             pa = None
@@ -324,6 +334,17 @@ class EngineApiServer:
                     withdrawals = [
                         withdrawal_from_json(w) for w in attrs["withdrawals"]
                     ]
+                if method == "engine_forkchoiceUpdatedV3" and not attrs.get(
+                    "parentBeaconBlockRoot"
+                ):
+                    raise ValueError(
+                        "forkchoiceUpdatedV3 requires parentBeaconBlockRoot"
+                    )
+                parent_root = (
+                    bytes.fromhex(attrs["parentBeaconBlockRoot"][2:])
+                    if attrs.get("parentBeaconBlockRoot")
+                    else None
+                )
                 pa = PayloadAttributes(
                     timestamp=int(attrs["timestamp"], 16),
                     prev_randao=bytes.fromhex(attrs["prevRandao"][2:]),
@@ -331,6 +352,7 @@ class EngineApiServer:
                         attrs["suggestedFeeRecipient"][2:]
                     ),
                     withdrawals=withdrawals,
+                    parent_beacon_block_root=parent_root,
                 )
             r = self.engine.notify_forkchoice_update(
                 bytes.fromhex(state["headBlockHash"][2:]),

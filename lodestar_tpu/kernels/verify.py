@@ -615,6 +615,168 @@ def _prod(fN, live_i, n):
     )(live_i, *fN)
 
 
+# ---------------------------------------------------------------------------
+# Distinct-message grouping (the SeenAttestationDatas cadence on device)
+#
+# Gossip attestation sets massively share signing roots: mainnet sees
+# ~64 distinct AttestationDatas per slot amortized over ~15k single sets
+# (reference: seenCache/seenAttestationData.ts caches committee indices +
+# signing roots per distinct data for the same reason).  Batch
+# verification with per-set randomizers factors through bilinearity:
+#
+#   prod_i e(r_i pk_i, H(m_i)) = prod_m e( SUM_{i: m_i=m} r_i pk_i, H(m) )
+#
+# so the N per-set Miller loops collapse to G per-DISTINCT-message Miller
+# loops (G <= 128 -> ONE lane tile) after a cheap segmented jacobian sum
+# of the randomized pubkeys.  The G2 side (r_i sig_i sum, subgroup
+# checks) is unchanged.  Sets must arrive SORTED by message so groups
+# are lane-contiguous (the host sorts; it already owns job assembly).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _j_seg_sum_g1(px, py, pz, dead, group):
+    """Segmented inclusive jacobian prefix-scan over the lane axis.
+
+    `group` is int32[n], nondecreasing (lane-contiguous groups); `dead`
+    lanes count as infinity (excluded from their group's sum).  Runs in
+    plain XLA (log2(n) full-width jac_add_full rounds) — the scan is
+    ~1% of one scalar-mul stage, not worth a Mosaic kernel.  Returns
+    (planes, inf) where the LAST lane of each segment holds the total.
+    """
+    n = group.shape[0]
+    pts = (px, py, pz)
+    inf = dead
+    lane = jnp.arange(n)
+    s = 1
+    while s < n:
+        prev = jax.tree_util.tree_map(
+            lambda a: jnp.roll(a, s, axis=-1), pts
+        )
+        prev_inf = jnp.roll(inf, s)
+        prev_group = jnp.roll(group, s)
+        ok = (lane >= s) & (prev_group == group)
+        pts, inf = CV.jac_add_full(
+            CV.FP_OPS, pts, inf, prev, jnp.where(ok, prev_inf, True)
+        )
+        s *= 2
+    return pts, inf
+
+
+@jax.jit
+def _j_group_heads(
+    pts, seg_inf, msg_x0, msg_x1, msg_y0, msg_y1, head_lanes, glive
+):
+    """Gather each group's total (its last lane) + that group's hashed
+    message onto one BT-lane tile; dead group lanes get generator pairs
+    (excluded from the Fp12 product by the live row)."""
+    gx, gy, gz = (jnp.take(a, head_lanes, axis=-1) for a in pts)
+    g_inf = jnp.take(seg_inf, head_lanes) | (glive == 0)
+    live = ~g_inf
+    gx = C.select(live, gx, _bcast(_G1X, BT))
+    gy = C.select(live, gy, _bcast(_G1Y, BT))
+    gz = C.select(live, gz, _bcast(_ONE, BT))
+    q = [
+        jnp.take(m, head_lanes, axis=-1)
+        for m in (msg_x0, msg_x1, msg_y0, msg_y1)
+    ]
+    qx = F2.select2(live, (q[0], q[1]), (_bcast(_G2X[0], BT), _bcast(_G2X[1], BT)))
+    qy = F2.select2(live, (q[2], q[3]), (_bcast(_G2Y[0], BT), _bcast(_G2Y[1], BT)))
+    # a live group whose pk-sum IS infinity contributes e(O, Q) = 1 —
+    # excluding it from the product is the exact value, not a fallback
+    live_row = live[None, :].astype(jnp.int32)
+    return gx, gy, gz, qx[0], qx[1], qy[0], qy[1], live_row
+
+
+def _batch_local_grouped(
+    table_x, table_y, idx, kmask, msgM, sigM, sig_bad, rwords, valid,
+    group, head_lanes, glive,
+):
+    """_batch_local with the G1/Miller side grouped by distinct message.
+
+    group: int32[n] nondecreasing ids; head_lanes: int32[BT] lane index
+    of each group's LAST member (padding entries arbitrary); glive:
+    int32[BT] 1 for real groups.  Requires distinct messages <= BT.
+    """
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = msgM
+    sig_x0, sig_x1, sig_y0, sig_y1 = sigM
+    (pk, pk_inf) = _gather_pk(table_x, table_y, idx, kmask)
+    live = (valid != 0) & ~pk_inf & ~sig_bad
+
+    px, py, pz, sx, sy = _j_substitute(
+        live, pk[0], pk[1], pk[2], sig_x0, sig_x1, sig_y0, sig_y1
+    )
+    live_i = live[None, :].astype(jnp.int32)
+    zero_row = jnp.zeros((1, n), jnp.int32)
+
+    rx, ry, rz, rinf = _tiled(
+        _k_g1_rpk,
+        (px, py, pz, zero_row, rwords),
+        [NL, NL, NL, 1, 2],
+        [NL, NL, NL, 1],
+        n,
+    )
+
+    sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, rsinf, sub = _tiled(
+        _k_g2_rsig_sub,
+        (sx[0], sx[1], sy[0], sy[1], zero_row, rwords),
+        [NL, NL, NL, NL, 1, 2],
+        [NL] * 6 + [1, 1],
+        n,
+    )
+
+    excl = (~live)[None, :].astype(jnp.int32) | rsinf
+    px0, px1, py0, py1, pz0, pz1, pinf = _sum_g2(
+        sx0r, sx1r, sy0r, sy1r, sz0r, sz1r, excl, n
+    )
+    jsum = _j_sum_lanes(px0, px1, py0, py1, pz0, pz1, pinf)
+
+    # grouped G1 side: segmented sum -> G group pairs -> ONE Miller tile
+    dead = (~live) | (rinf[0] != 0)
+    pts, seg_inf = _j_seg_sum_g1(rx, ry, rz, dead, group)
+    gx, gy, gz, qx0, qx1, qy0, qy1, live_row = _j_group_heads(
+        pts, seg_inf, msg_x0, msg_x1, msg_y0, msg_y1, head_lanes, glive
+    )
+    fG = _tiled(
+        _k_miller,
+        (gx, gy, gz, qx0, qx1, qy0, qy1),
+        [NL] * 7,
+        [NL] * 12,
+        BT,
+    )
+    fpartial = _prod(fG, live_row, BT)
+    fprod = _j_product12(tuple(fpartial), jnp.ones((BT,), bool))
+    return fprod, jsum, sub, live, pk_inf
+
+
+def verify_batch_device_wire_grouped(
+    table_x, table_y, idx, kmask,
+    msg_x0, msg_x1, msg_y0, msg_y1,
+    sig_x0, sig_x1, sig_flags,
+    group, head_lanes, glive,
+    rwords, valid,
+):
+    """verify_batch_device_wire with distinct-message grouping: the
+    Miller stage runs per distinct signing root (<= BT of them) instead
+    of per set.  Same verdict semantics as the ungrouped path."""
+    n = valid.shape[0]
+    msg_x0, msg_x1, msg_y0, msg_y1 = _tiled(
+        _k_mont4, (msg_x0, msg_x1, msg_y0, msg_y1), [NL] * 4, [NL] * 4, n
+    )
+    (sx0, sx1, sy0, sy1), dec_ok = _decompress_sig(sig_x0, sig_x1, sig_flags, n)
+    sig_bad = (sig_flags[1] != 0) | ~dec_ok
+    fprod, jsum, sub, live, pk_inf = _batch_local_grouped(
+        table_x, table_y, idx, kmask,
+        (msg_x0, msg_x1, msg_y0, msg_y1),
+        (sx0, sx1, sy0, sy1),
+        sig_bad, rwords, valid,
+        group, head_lanes, glive,
+    )
+    ok2 = _batch_tail(fprod, jsum)
+    return _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid)
+
+
 def verify_each_device(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,

@@ -1,0 +1,347 @@
+"""Req/resp protocol layer: protocol registry, chunked ssz_snappy
+streams, GCRA rate limiting, node-side handlers.
+
+Mirror of the reference's reqresp stack (reference:
+packages/reqresp/src/ReqResp.ts, rate_limiter/rateLimiterGRCA.ts,
+encodingStrategies/sszSnappy/, and the beacon-node bindings
+packages/beacon-node/src/network/reqresp/{protocols,types,rateLimit,
+handlers}.ts).  The ssz_snappy chunk codec lives in network/snappy.py;
+this module adds everything above it:
+
+  - protocol identifiers `/eth2/beacon_chain/req/<method>/<version>/ssz_snappy`
+  - response chunk streams `<result:u8>[<context:4>]<ssz_snappy payload>`
+    with fork-digest context bytes on v2 protocols
+  - per-peer + total GCRA rate limiting with per-request token counts
+  - a transport-agnostic `ReqResp` node: the libp2p wire itself is off
+    the TPU path (SURVEY §2.4 P9); tests and the in-process stack
+    connect two nodes with `connect_inmemory`.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import params
+from . import snappy as SN
+
+MAX_REQUEST_BLOCKS = 1024
+MAX_REQUEST_LIGHT_CLIENT_UPDATES = 128
+
+
+class ReqRespMethod(str, enum.Enum):
+    """reference: network/reqresp/types.ts ReqRespMethod."""
+
+    status = "status"
+    goodbye = "goodbye"
+    ping = "ping"
+    metadata = "metadata"
+    beacon_blocks_by_range = "beacon_blocks_by_range"
+    beacon_blocks_by_root = "beacon_blocks_by_root"
+    light_client_bootstrap = "light_client_bootstrap"
+    light_client_updates_by_range = "light_client_updates_by_range"
+    light_client_finality_update = "light_client_finality_update"
+    light_client_optimistic_update = "light_client_optimistic_update"
+
+
+class RespCode(enum.IntEnum):
+    """p2p spec response result byte."""
+
+    SUCCESS = 0
+    INVALID_REQUEST = 1
+    SERVER_ERROR = 2
+    RESOURCE_UNAVAILABLE = 3
+    RATE_LIMITED = 139  # reference: RespStatus.RATE_LIMITED
+
+
+class ContextBytes(str, enum.Enum):
+    empty = "empty"
+    fork_digest = "fork_digest"
+
+
+class ReqRespError(Exception):
+    def __init__(self, code: RespCode, message: str = ""):
+        super().__init__(f"{code.name}: {message}")
+        self.code = code
+        self.message = message
+
+
+@dataclass(frozen=True)
+class Protocol:
+    """One protocol version (reference: protocols.ts toProtocol)."""
+
+    method: ReqRespMethod
+    version: int
+    context_bytes: ContextBytes
+    # ssz codecs as plain callables so dict-shaped bodies stay the
+    # repo-wide currency: encode(body) -> bytes, decode(bytes) -> body.
+    # None = no request body (metadata, light client head updates).
+    encode_request: Optional[Callable] = None
+    decode_request: Optional[Callable] = None
+    # response codecs keyed by fork (context dispatch); for empty
+    # context bytes only the `None` key is used
+    encode_response: Callable = None
+    decode_response: Callable = None
+
+    @property
+    def protocol_id(self) -> str:
+        return (
+            f"/eth2/beacon_chain/req/{self.method.value}/{self.version}/"
+            "ssz_snappy"
+        )
+
+
+# -- GCRA rate limiter (reference: rate_limiter/rateLimiterGRCA.ts) ---------
+
+
+@dataclass
+class RateLimiterQuota:
+    quota: float
+    quota_time_ms: float
+
+
+class RateLimiterGRCA:
+    """Generic Cell Rate Algorithm: one stored value (the theoretical
+    arrival time) per key; allows bursts up to `quota` while enforcing
+    the long-run rate quota/quota_time_ms."""
+
+    def __init__(self, quota: RateLimiterQuota, clock=time.monotonic):
+        assert quota.quota > 0 and quota.quota_time_ms > 0
+        self.ms_per_bucket = quota.quota_time_ms
+        self.ms_per_token = quota.quota_time_ms / quota.quota
+        self._tat: Dict[object, float] = {}
+        self._clock = clock
+
+    def allows(self, key, tokens: float = 1.0) -> bool:
+        now_ms = self._clock() * 1000.0
+        tat = self._tat.get(key, now_ms)
+        # earliest time the bucket could accept `tokens` more
+        new_tat = max(now_ms, tat) + tokens * self.ms_per_token
+        if new_tat - now_ms > self.ms_per_bucket:
+            return False
+        self._tat[key] = new_tat
+        return True
+
+    def prune(self, older_than_ms: float = 60_000.0) -> None:
+        now_ms = self._clock() * 1000.0
+        for k in [k for k, t in self._tat.items() if now_ms - t > older_than_ms]:
+            del self._tat[k]
+
+
+@dataclass
+class InboundRateLimitQuota:
+    """reference: network/reqresp/rateLimit.ts rateLimitQuotas."""
+
+    by_peer: RateLimiterQuota
+    total: Optional[RateLimiterQuota] = None
+    # request bytes -> token count (blocks_by_range counts `count` etc.)
+    get_request_count: Optional[Callable[[dict], float]] = None
+
+
+def default_rate_limits() -> Dict[ReqRespMethod, InboundRateLimitQuota]:
+    """The reference's quota table (rateLimit.ts:6-66)."""
+    M = ReqRespMethod
+    return {
+        M.status: InboundRateLimitQuota(RateLimiterQuota(5, 15_000)),
+        M.goodbye: InboundRateLimitQuota(RateLimiterQuota(1, 10_000)),
+        M.ping: InboundRateLimitQuota(RateLimiterQuota(2, 10_000)),
+        M.metadata: InboundRateLimitQuota(RateLimiterQuota(2, 5_000)),
+        M.beacon_blocks_by_range: InboundRateLimitQuota(
+            RateLimiterQuota(MAX_REQUEST_BLOCKS, 10_000),
+            get_request_count=lambda req: max(1, int(req.get("count", 1))),
+        ),
+        M.beacon_blocks_by_root: InboundRateLimitQuota(
+            RateLimiterQuota(128, 10_000),
+            get_request_count=lambda req: max(1, len(req)),
+        ),
+        M.light_client_bootstrap: InboundRateLimitQuota(
+            RateLimiterQuota(5, 15_000)
+        ),
+        M.light_client_updates_by_range: InboundRateLimitQuota(
+            RateLimiterQuota(MAX_REQUEST_LIGHT_CLIENT_UPDATES, 10_000),
+            get_request_count=lambda req: max(1, int(req.get("count", 1))),
+        ),
+        M.light_client_finality_update: InboundRateLimitQuota(
+            RateLimiterQuota(2, 12_000)
+        ),
+        M.light_client_optimistic_update: InboundRateLimitQuota(
+            RateLimiterQuota(2, 12_000)
+        ),
+    }
+
+
+# -- chunk stream codec -----------------------------------------------------
+
+
+def encode_response_chunks(
+    chunks: List[Tuple[bytes, Optional[bytes]]]
+) -> bytes:
+    """[(ssz_bytes, context_bytes|None), ...] -> response stream."""
+    out = bytearray()
+    for ssz_bytes, ctx in chunks:
+        out.append(RespCode.SUCCESS)
+        if ctx is not None:
+            assert len(ctx) == 4
+            out += ctx
+        out += SN.encode_reqresp_chunk(ssz_bytes)
+    return bytes(out)
+
+
+def encode_error_chunk(code: RespCode, message: str) -> bytes:
+    payload = message.encode()[:256]
+    return bytes([code]) + SN.encode_reqresp_chunk(payload)
+
+
+def decode_response_chunks(
+    data: bytes, context_bytes: ContextBytes
+) -> List[Tuple[bytes, Optional[bytes]]]:
+    """Response stream -> [(ssz_bytes, context|None)].  Raises
+    ReqRespError on an error chunk (error terminates the stream)."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        code = data[pos]
+        pos += 1
+        ctx = None
+        if code == RespCode.SUCCESS and context_bytes is ContextBytes.fork_digest:
+            ctx = bytes(data[pos : pos + 4])
+            pos += 4
+        ssz_bytes, pos = SN.decode_reqresp_chunk_at(data, pos)
+        if code != RespCode.SUCCESS:
+            try:
+                msg = ssz_bytes.decode()
+            except UnicodeDecodeError:
+                msg = ssz_bytes.hex()
+            raise ReqRespError(RespCode(code), msg)
+        out.append((ssz_bytes, ctx))
+    return out
+
+
+# -- the ReqResp node -------------------------------------------------------
+
+
+Handler = Callable[[str, object], List[Tuple[bytes, Optional[bytes]]]]
+
+
+class ReqResp:
+    """Transport-agnostic req/resp node (reference: ReqResp.ts).
+
+    Server side: `handle_request(peer, protocol_id, req_bytes)` returns
+    the encoded response stream (rate-limited, error chunks on failure).
+    Client side: `send_request(peer, protocol, body)` resolves the
+    peer's transport (a callable set by `connect`), sends, and decodes.
+    """
+
+    def __init__(
+        self,
+        rate_limits: Optional[Dict[ReqRespMethod, InboundRateLimitQuota]] = None,
+        clock=time.monotonic,
+        on_rate_limit: Optional[Callable[[str, str], None]] = None,
+    ):
+        self._protocols: Dict[str, Protocol] = {}
+        self._handlers: Dict[str, Handler] = {}
+        self._transports: Dict[str, Callable[[str, bytes], bytes]] = {}
+        self._rate_limits = (
+            default_rate_limits() if rate_limits is None else rate_limits
+        )
+        self._by_peer: Dict[ReqRespMethod, RateLimiterGRCA] = {}
+        self._total: Dict[ReqRespMethod, RateLimiterGRCA] = {}
+        for m, q in self._rate_limits.items():
+            self._by_peer[m] = RateLimiterGRCA(q.by_peer, clock)
+            if q.total is not None:
+                self._total[m] = RateLimiterGRCA(q.total, clock)
+        self._on_rate_limit = on_rate_limit
+
+    # -- registration ------------------------------------------------------
+
+    def register_protocol(self, protocol: Protocol, handler: Handler) -> None:
+        self._protocols[protocol.protocol_id] = protocol
+        self._handlers[protocol.protocol_id] = handler
+
+    def prune_limiters(self, older_than_ms: float = 60_000.0) -> None:
+        """Drop stale per-peer limiter state (call on a slow tick —
+        peers churn, their TAT entries must not accumulate forever)."""
+        for limiter in self._by_peer.values():
+            limiter.prune(older_than_ms)
+        for limiter in self._total.values():
+            limiter.prune(older_than_ms)
+
+    def supported_protocols(self) -> List[str]:
+        return list(self._protocols)
+
+    # -- transport wiring --------------------------------------------------
+
+    def connect(self, peer_id: str, send: Callable[[str, bytes], bytes]) -> None:
+        """`send(protocol_id, request_bytes) -> response_bytes`."""
+        self._transports[peer_id] = send
+
+    def disconnect(self, peer_id: str) -> None:
+        self._transports.pop(peer_id, None)
+
+    # -- server side -------------------------------------------------------
+
+    def handle_request(
+        self, peer_id: str, protocol_id: str, req_bytes: bytes
+    ) -> bytes:
+        protocol = self._protocols.get(protocol_id)
+        if protocol is None:
+            return encode_error_chunk(
+                RespCode.INVALID_REQUEST, f"unsupported protocol {protocol_id}"
+            )
+        try:
+            body = None
+            if protocol.decode_request is not None:
+                body = protocol.decode_request(
+                    SN.decode_reqresp_chunk(req_bytes)
+                )
+        except Exception as e:  # noqa: BLE001 — malformed wire input
+            return encode_error_chunk(RespCode.INVALID_REQUEST, str(e))
+        quota = self._rate_limits.get(protocol.method)
+        if quota is not None:
+            tokens = 1.0
+            if quota.get_request_count is not None and body is not None:
+                try:
+                    tokens = float(quota.get_request_count(body))
+                except Exception:  # noqa: BLE001
+                    tokens = 1.0
+            limiter = self._by_peer[protocol.method]
+            total = self._total.get(protocol.method)
+            if not limiter.allows(peer_id, tokens) or (
+                total is not None and not total.allows("total", tokens)
+            ):
+                if self._on_rate_limit is not None:
+                    self._on_rate_limit(peer_id, protocol_id)
+                return encode_error_chunk(
+                    RespCode.RATE_LIMITED, "rate limited"
+                )
+        try:
+            chunks = self._handlers[protocol_id](peer_id, body)
+            return encode_response_chunks(chunks)
+        except ReqRespError as e:
+            return encode_error_chunk(e.code, e.message)
+        except Exception as e:  # noqa: BLE001 — handler crash = server error
+            return encode_error_chunk(RespCode.SERVER_ERROR, str(e))
+
+    # -- client side -------------------------------------------------------
+
+    def send_request(
+        self, peer_id: str, protocol: Protocol, body=None
+    ) -> List[Tuple[bytes, Optional[bytes]]]:
+        send = self._transports.get(peer_id)
+        if send is None:
+            raise ReqRespError(
+                RespCode.SERVER_ERROR, f"no transport for peer {peer_id}"
+            )
+        req = b""
+        if protocol.encode_request is not None:
+            req = SN.encode_reqresp_chunk(protocol.encode_request(body))
+        resp = send(protocol.protocol_id, req)
+        return decode_response_chunks(resp, protocol.context_bytes)
+
+
+def connect_inmemory(a: ReqResp, a_id: str, b: ReqResp, b_id: str) -> None:
+    """Wire two nodes directly (the test/in-process transport)."""
+    a.connect(b_id, lambda pid, req: b.handle_request(a_id, pid, req))
+    b.connect(a_id, lambda pid, req: a.handle_request(b_id, pid, req))

@@ -1,0 +1,481 @@
+"""Beacon-node req/resp protocol bindings: wire types + handlers.
+
+Mirror of the reference's protocol definitions and handler wiring
+(reference: packages/beacon-node/src/network/reqresp/{types.ts,
+protocols.ts:8-87, handlers/*.ts}): the SSZ request/response containers,
+fork-digest context dispatch for v2 protocols, and handlers backed by
+chain + db + light-client server.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from .. import params
+from .. import types as T
+from ..ssz import Bytes32, Container, List as SszList, uint64
+from .reqresp import (
+    ContextBytes,
+    MAX_REQUEST_BLOCKS,
+    MAX_REQUEST_LIGHT_CLIENT_UPDATES,
+    Protocol,
+    ReqResp,
+    ReqRespError,
+    ReqRespMethod,
+    RespCode,
+)
+
+# -- wire containers (reference: network/reqresp/types.ts) ------------------
+
+StatusType = Container(
+    (
+        ("fork_digest", T.Version),
+        ("finalized_root", T.Root),
+        ("finalized_epoch", T.Epoch),
+        ("head_root", T.Root),
+        ("head_slot", T.Slot),
+    ),
+    name="Status",
+)
+
+GoodbyeType = uint64
+PingType = uint64
+
+BeaconBlocksByRangeRequest = Container(
+    (
+        ("start_slot", T.Slot),
+        ("count", uint64),
+        ("step", uint64),
+    ),
+    name="BeaconBlocksByRangeRequest",
+)
+
+BlockRootsRequest = SszList(Bytes32, MAX_REQUEST_BLOCKS)
+
+LightClientUpdatesByRangeRequest = Container(
+    (
+        ("start_period", uint64),
+        ("count", uint64),
+    ),
+    name="LightClientUpdatesByRangeRequest",
+)
+
+# altair light-client wire containers (reference: types/src/altair/
+# sszTypes.ts LightClientUpdate/LightClientBootstrap); absent optional
+# parts travel zero-filled, as in the spec containers
+from ..light_client.lightclient import (  # noqa: E402
+    FINALIZED_ROOT_DEPTH,
+    NEXT_SYNC_COMMITTEE_DEPTH,
+)
+from ..ssz import Vector  # noqa: E402
+
+LightClientUpdateType = Container(
+    (
+        ("attested_header", T.BeaconBlockHeader),
+        ("next_sync_committee", T.SyncCommittee),
+        (
+            "next_sync_committee_branch",
+            Vector(Bytes32, NEXT_SYNC_COMMITTEE_DEPTH),
+        ),
+        ("finalized_header", T.BeaconBlockHeader),
+        ("finality_branch", Vector(Bytes32, FINALIZED_ROOT_DEPTH)),
+        ("sync_aggregate", T.SyncAggregate),
+        ("signature_slot", T.Slot),
+    ),
+    name="LightClientUpdate",
+)
+
+LightClientBootstrapType = Container(
+    (
+        ("header", T.BeaconBlockHeader),
+        ("current_sync_committee", T.SyncCommittee),
+        (
+            "current_sync_committee_branch",
+            Vector(Bytes32, NEXT_SYNC_COMMITTEE_DEPTH),
+        ),
+    ),
+    name="LightClientBootstrap",
+)
+
+_ZERO_BRANCH5 = [b"\x00" * 32] * NEXT_SYNC_COMMITTEE_DEPTH
+_ZERO_BRANCH6 = [b"\x00" * 32] * FINALIZED_ROOT_DEPTH
+
+
+def light_client_update_to_value(upd) -> dict:
+    """LightClientUpdate dataclass -> spec-shaped container value."""
+    empty_committee = T.SyncCommittee.default()
+    return {
+        "attested_header": dict(upd.attested_header),
+        "next_sync_committee": dict(
+            upd.next_sync_committee or empty_committee
+        ),
+        "next_sync_committee_branch": list(
+            upd.next_sync_committee_branch or _ZERO_BRANCH5
+        ),
+        "finalized_header": dict(
+            upd.finalized_header or T.BeaconBlockHeader.default()
+        ),
+        "finality_branch": list(upd.finality_branch or _ZERO_BRANCH6),
+        "sync_aggregate": {
+            "sync_committee_bits": list(upd.sync_committee_bits),
+            "sync_committee_signature": bytes(upd.sync_committee_signature),
+        },
+        "signature_slot": int(upd.signature_slot),
+    }
+
+
+def light_client_update_from_value(value: dict):
+    """Container value -> LightClientUpdate dataclass (zero-filled parts
+    become None)."""
+    from ..light_client.lightclient import LightClientUpdate
+
+    branch5 = [bytes(b) for b in value["next_sync_committee_branch"]]
+    branch6 = [bytes(b) for b in value["finality_branch"]]
+    has_committee = branch5 != _ZERO_BRANCH5
+    has_finality = branch6 != _ZERO_BRANCH6
+    agg = value["sync_aggregate"]
+    return LightClientUpdate(
+        attested_header=dict(value["attested_header"]),
+        sync_committee_bits=list(agg["sync_committee_bits"]),
+        sync_committee_signature=bytes(agg["sync_committee_signature"]),
+        signature_slot=int(value["signature_slot"]),
+        finalized_header=(
+            dict(value["finalized_header"]) if has_finality else None
+        ),
+        finality_branch=branch6 if has_finality else None,
+        next_sync_committee=(
+            dict(value["next_sync_committee"]) if has_committee else None
+        ),
+        next_sync_committee_branch=branch5 if has_committee else None,
+    )
+
+
+def _metadata_type():
+    """Metadata container built against the live bitvector types (the
+    subnet services own the attnets/syncnets shapes)."""
+    from ..ssz import Bitvector
+
+    return Container(
+        (
+            ("seq_number", uint64),
+            ("attnets", Bitvector(params.ATTESTATION_SUBNET_COUNT)),
+            ("syncnets", Bitvector(params.SYNC_COMMITTEE_SUBNET_COUNT)),
+        ),
+        name="Metadata",
+    )
+
+
+METADATA_TYPE = _metadata_type()
+
+
+# -- protocol constructors --------------------------------------------------
+
+
+def _enc(t):
+    return lambda body: t.serialize(body)
+
+
+def _dec(t):
+    return lambda data: t.deserialize(data)
+
+
+def status_protocol() -> Protocol:
+    return Protocol(
+        method=ReqRespMethod.status,
+        version=1,
+        context_bytes=ContextBytes.empty,
+        encode_request=_enc(StatusType),
+        decode_request=_dec(StatusType),
+        encode_response=_enc(StatusType),
+        decode_response=_dec(StatusType),
+    )
+
+
+def goodbye_protocol() -> Protocol:
+    return Protocol(
+        method=ReqRespMethod.goodbye,
+        version=1,
+        context_bytes=ContextBytes.empty,
+        encode_request=_enc(GoodbyeType),
+        decode_request=_dec(GoodbyeType),
+        encode_response=_enc(GoodbyeType),
+        decode_response=_dec(GoodbyeType),
+    )
+
+
+def ping_protocol() -> Protocol:
+    return Protocol(
+        method=ReqRespMethod.ping,
+        version=1,
+        context_bytes=ContextBytes.empty,
+        encode_request=_enc(PingType),
+        decode_request=_dec(PingType),
+        encode_response=_enc(PingType),
+        decode_response=_dec(PingType),
+    )
+
+
+def metadata_protocol(version: int = 2) -> Protocol:
+    return Protocol(
+        method=ReqRespMethod.metadata,
+        version=version,
+        context_bytes=ContextBytes.empty,
+        encode_request=None,  # metadata requests carry no body
+        decode_request=None,
+        encode_response=_enc(METADATA_TYPE),
+        decode_response=_dec(METADATA_TYPE),
+    )
+
+
+def blocks_by_range_protocol(config, version: int = 2) -> Protocol:
+    """v2 prefixes each block chunk with the block fork's digest."""
+    return Protocol(
+        method=ReqRespMethod.beacon_blocks_by_range,
+        version=version,
+        context_bytes=(
+            ContextBytes.fork_digest if version >= 2 else ContextBytes.empty
+        ),
+        encode_request=_enc(BeaconBlocksByRangeRequest),
+        decode_request=_dec(BeaconBlocksByRangeRequest),
+        encode_response=None,  # handlers emit pre-encoded chunks
+        decode_response=lambda data, ctx=None: _decode_signed_block(
+            config, data, ctx
+        ),
+    )
+
+
+def blocks_by_root_protocol(config, version: int = 2) -> Protocol:
+    return Protocol(
+        method=ReqRespMethod.beacon_blocks_by_root,
+        version=version,
+        context_bytes=(
+            ContextBytes.fork_digest if version >= 2 else ContextBytes.empty
+        ),
+        encode_request=_enc(BlockRootsRequest),
+        decode_request=_dec(BlockRootsRequest),
+        encode_response=None,
+        decode_response=lambda data, ctx=None: _decode_signed_block(
+            config, data, ctx
+        ),
+    )
+
+
+def _decode_signed_block(config, data: bytes, ctx: Optional[bytes]):
+    """Pick the signed-block container from the chunk's fork digest
+    (v2 context bytes).  An unknown digest is a protocol violation —
+    decoding it as some other fork would yield structurally-valid
+    garbage that fails far from the cause."""
+    if ctx is None:  # v1: no context bytes -> pre-bellatrix container
+        return T.SignedBeaconBlockAltair.deserialize(data)
+    for fork in config.fork_schedule():
+        epoch = config.fork_epochs[fork]
+        slot = epoch * params.SLOTS_PER_EPOCH
+        if config.fork_digest(slot) == ctx:
+            return config.get_fork_types(slot)[1].deserialize(data)
+    raise ReqRespError(
+        RespCode.INVALID_REQUEST, f"unknown fork digest {ctx.hex()}"
+    )
+
+
+def decode_block_chunks(config, chunks: List[Tuple[bytes, Optional[bytes]]]):
+    return [_decode_signed_block(config, d, ctx) for d, ctx in chunks]
+
+
+# -- node-side handlers (reference: network/reqresp/handlers/) --------------
+
+
+class ReqRespBeaconNode:
+    """Registers the full beacon protocol set on a ReqResp node and
+    serves them from chain + db (reference: ReqRespBeaconNode.ts).
+
+    `metadata_fn() -> {seq_number, attnets, syncnets}` comes from the
+    subnet services; `on_goodbye(peer, reason)` feeds the peer manager.
+    """
+
+    def __init__(
+        self,
+        reqresp: ReqResp,
+        config,
+        chain=None,
+        db=None,
+        light_client_server=None,
+        metadata_fn: Optional[Callable[[], dict]] = None,
+        on_goodbye: Optional[Callable[[str, int], None]] = None,
+        on_status: Optional[Callable[[str, dict], None]] = None,
+    ):
+        self.reqresp = reqresp
+        self.config = config
+        self.chain = chain
+        self.db = db
+        self.lc = light_client_server
+        self.metadata_fn = metadata_fn
+        self.on_goodbye = on_goodbye
+        self.on_status = on_status
+        self.protocols = {}
+        self._register()
+
+    def _register(self) -> None:
+        r = self.reqresp
+        p = self.protocols
+        p["status"] = status_protocol()
+        r.register_protocol(p["status"], self._handle_status)
+        p["goodbye"] = goodbye_protocol()
+        r.register_protocol(p["goodbye"], self._handle_goodbye)
+        p["ping"] = ping_protocol()
+        r.register_protocol(p["ping"], self._handle_ping)
+        p["metadata"] = metadata_protocol()
+        r.register_protocol(p["metadata"], self._handle_metadata)
+        p["blocks_by_range"] = blocks_by_range_protocol(self.config)
+        r.register_protocol(p["blocks_by_range"], self._handle_blocks_by_range)
+        p["blocks_by_root"] = blocks_by_root_protocol(self.config)
+        r.register_protocol(p["blocks_by_root"], self._handle_blocks_by_root)
+        if self.lc is not None:
+            self._register_light_client(r, p)
+
+    def _register_light_client(self, r, p) -> None:
+        p["lc_bootstrap"] = Protocol(
+            method=ReqRespMethod.light_client_bootstrap,
+            version=1,
+            context_bytes=ContextBytes.fork_digest,
+            encode_request=lambda root: bytes(root),
+            decode_request=lambda data: bytes(data),
+            encode_response=_enc(LightClientBootstrapType),
+            decode_response=_dec(LightClientBootstrapType),
+        )
+        r.register_protocol(p["lc_bootstrap"], self._handle_lc_bootstrap)
+        p["lc_updates"] = Protocol(
+            method=ReqRespMethod.light_client_updates_by_range,
+            version=1,
+            context_bytes=ContextBytes.fork_digest,
+            encode_request=_enc(LightClientUpdatesByRangeRequest),
+            decode_request=_dec(LightClientUpdatesByRangeRequest),
+            encode_response=_enc(LightClientUpdateType),
+            decode_response=_dec(LightClientUpdateType),
+        )
+        r.register_protocol(p["lc_updates"], self._handle_lc_updates)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _ctx(self, slot: int) -> bytes:
+        return self.config.fork_digest(slot)
+
+    def _handle_status(self, peer_id: str, req: dict):
+        if self.on_status is not None:
+            self.on_status(peer_id, req)
+        st = self._local_status()
+        return [(StatusType.serialize(st), None)]
+
+    def _local_status(self) -> dict:
+        chain = self.chain
+        if chain is None:
+            raise ReqRespError(RespCode.SERVER_ERROR, "no chain wired")
+        head = chain.head_state
+        fin = head.finalized_checkpoint
+        return {
+            "fork_digest": self.config.fork_digest(head.slot),
+            "finalized_root": bytes(fin["root"]),
+            "finalized_epoch": int(fin["epoch"]),
+            "head_root": chain.get_head_root(),
+            "head_slot": int(head.slot),
+        }
+
+    def _handle_goodbye(self, peer_id: str, reason: int):
+        if self.on_goodbye is not None:
+            self.on_goodbye(peer_id, int(reason))
+        return [(GoodbyeType.serialize(0), None)]
+
+    def _handle_ping(self, peer_id: str, seq: int):
+        md = self.metadata_fn() if self.metadata_fn is not None else None
+        seq_number = int(md["seq_number"]) if md else 0
+        return [(PingType.serialize(seq_number), None)]
+
+    def _handle_metadata(self, peer_id: str, _req):
+        if self.metadata_fn is None:
+            raise ReqRespError(RespCode.SERVER_ERROR, "no metadata source")
+        return [(METADATA_TYPE.serialize(self.metadata_fn()), None)]
+
+    def _handle_blocks_by_range(self, peer_id: str, req: dict):
+        """Slot-ordered canonical blocks from the archive + hot chain
+        (reference: handlers/beaconBlocksByRange.ts)."""
+        start = int(req["start_slot"])
+        count = min(int(req["count"]), MAX_REQUEST_BLOCKS)
+        step = max(1, int(req.get("step", 1)))  # deprecated; 1 in practice
+        if count < 1 or start < 0:
+            raise ReqRespError(RespCode.INVALID_REQUEST, "bad range")
+        out = []
+        for slot in range(start, start + count * step, step):
+            signed = self._canonical_block_at_slot(slot)
+            if signed is None:
+                continue
+            slot_ = int(signed["message"]["slot"])
+            signed_type = self.config.get_fork_types(slot_)[1]
+            out.append((signed_type.serialize(signed), self._ctx(slot_)))
+        return out
+
+    def _canonical_block_at_slot(self, slot: int):
+        if self.db is not None:
+            key = slot.to_bytes(8, "big")
+            data = self.db.block_archive.get(key)
+            if data is not None:
+                return data
+        if self.chain is not None:
+            root = self.chain.fork_choice.canonical_root_at_slot(slot) if (
+                hasattr(self.chain, "fork_choice")
+                and hasattr(self.chain.fork_choice, "canonical_root_at_slot")
+            ) else None
+            if root is not None:
+                blk = self._block_by_root(root)
+                if blk is not None:
+                    return blk
+            # fallback: scan hot blocks for an exact slot match on the
+            # canonical chain
+            getter = getattr(self.chain, "get_block_by_slot", None)
+            if getter is not None:
+                return getter(slot)
+        return None
+
+    def _block_by_root(self, root: bytes):
+        if self.db is not None:
+            blk = self.db.get_block_anywhere(bytes(root))
+            if blk is not None:
+                return blk
+        if self.chain is not None:
+            getter = getattr(self.chain, "get_block", None)
+            if getter is not None:
+                return getter(bytes(root))
+        return None
+
+    def _handle_blocks_by_root(self, peer_id: str, roots):
+        out = []
+        for root in roots[:MAX_REQUEST_BLOCKS]:
+            signed = self._block_by_root(bytes(root))
+            if signed is None:
+                continue
+            slot = int(signed["message"]["slot"])
+            signed_type = self.config.get_fork_types(slot)[1]
+            out.append((signed_type.serialize(signed), self._ctx(slot)))
+        return out
+
+    def _handle_lc_bootstrap(self, peer_id: str, root: bytes):
+        boot = self.lc.get_bootstrap(bytes(root))
+        if boot is None:
+            raise ReqRespError(
+                RespCode.RESOURCE_UNAVAILABLE, "no bootstrap for root"
+            )
+        slot = int(boot["header"]["slot"])
+        return [(LightClientBootstrapType.serialize(boot), self._ctx(slot))]
+
+    def _handle_lc_updates(self, peer_id: str, req: dict):
+        start = int(req["start_period"])
+        count = min(int(req["count"]), MAX_REQUEST_LIGHT_CLIENT_UPDATES)
+        out = []
+        for period in range(start, start + count):
+            upd = self.lc.get_update(period)
+            if upd is None:
+                continue
+            value = light_client_update_to_value(upd)
+            slot = int(value["attested_header"]["slot"])
+            out.append(
+                (LightClientUpdateType.serialize(value), self._ctx(slot))
+            )
+        return out

@@ -198,3 +198,61 @@ def decode_reqresp_chunk(data: bytes, max_len: int = 1 << 27) -> bytes:
             f"length mismatch: declared {declared}, got {len(payload)}"
         )
     return payload
+
+
+def decode_reqresp_chunk_at(
+    data: bytes, start: int, max_len: int = 1 << 27
+) -> Tuple[bytes, int]:
+    """Decode ONE ssz_snappy chunk out of a concatenated response stream
+    (reference: response/responseDecode.ts reads chunk-by-chunk).
+
+    Decompresses snappy frames until the declared ssz length is reached;
+    returns (payload, next_position)."""
+    declared, pos = _read_uvarint(data, start)
+    if declared > max_len:
+        raise SnappyError("declared length over limit")
+    if data[pos : pos + len(_STREAM_ID)] != _STREAM_ID:
+        raise SnappyError("missing snappy stream identifier")
+    pos += len(_STREAM_ID)
+    out = bytearray()
+    data_frames = 0
+    # declared == 0 still carries ONE (empty) DATA frame — consume it so
+    # the stream position stays aligned for the next chunk (padding and
+    # repeated stream-identifier frames do not count)
+    while len(out) < declared or (declared == 0 and data_frames == 0):
+        if pos + 4 > len(data):
+            raise SnappyError("truncated chunk header")
+        ctype = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        pos += 4
+        if pos + length > len(data):
+            raise SnappyError("truncated chunk body")
+        body = data[pos : pos + length]
+        pos += length
+        if ctype in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+            data_frames += 1
+            if length < 4:
+                raise SnappyError("chunk too short for checksum")
+            (crc,) = struct.unpack("<I", body[:4])
+            payload = body[4:]
+            chunk = (
+                decompress(payload)
+                if ctype == _CHUNK_COMPRESSED
+                else payload
+            )
+            if _masked_crc(chunk) != crc:
+                raise SnappyError("chunk checksum mismatch")
+            out += chunk
+        elif ctype == 0xFF:
+            # repeated stream identifier: legal anywhere in a stream
+            if body != _STREAM_ID[4:]:
+                raise SnappyError("bad repeated stream identifier")
+        elif 0x80 <= ctype <= 0xFE:
+            continue
+        else:
+            raise SnappyError(f"unknown chunk type {ctype:#x}")
+    if len(out) != declared:
+        raise SnappyError(
+            f"length mismatch: declared {declared}, got {len(out)}"
+        )
+    return bytes(out), pos

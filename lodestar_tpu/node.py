@@ -13,6 +13,7 @@ verification -> fork choice, mirroring SURVEY.md §3.2's hot loop.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -63,7 +64,7 @@ class BeaconNode:
         self.registry = Registry()
         self.metrics = BlsPoolMetrics(self.registry)
 
-        self.db = BeaconDb(opts.db_path)
+        self.db = BeaconDb(opts.db_path, config=config)
         self.clock = Clock(genesis_time=config.genesis_time)
         self.fork_choice = ForkChoice(ProtoArray(genesis_root), genesis_root)
 
@@ -204,7 +205,7 @@ class FullBeaconNode:
         self.metrics = BlsPoolMetrics(self.registry)
 
         # db + clock
-        self.db = BeaconDb(opts.db_path)
+        self.db = BeaconDb(opts.db_path, config=config)
         self.clock = Clock(genesis_time=config.genesis_time)
 
         # verifier service (the TPU boundary) — reference chain.ts:196-198
@@ -274,10 +275,76 @@ class FullBeaconNode:
         self.unknown_block_sync = UnknownBlockSync(self.chain)
         self.backfill = BackfillSync(config, self.db, verifier)
 
+        # req/resp: subnet-policy metadata + the full protocol set over
+        # the transport-agnostic node (reference: ReqRespBeaconNode.ts;
+        # the in-process transport stands in for libp2p streams, P9)
+        from .network.peers import PeerStatus
+        from .network.reqresp import ReqResp
+        from .network.reqresp_protocols import ReqRespBeaconNode
+        from .network.subnets import AttnetsService, SyncnetsService
+
+        # subnet policy wants the 256-bit discovery node-id; derive it
+        # from the bus identity string (a real discv5 integration would
+        # use the ENR node-id)
+        node_id_int = int.from_bytes(
+            hashlib.sha256((opts.node_id or "node").encode()).digest(), "big"
+        )
+        self.attnets = AttnetsService(node_id_int)
+        self.syncnets = SyncnetsService()
+        # the p2p spec requires seq_number to BUMP whenever the metadata
+        # content changes — peers re-fetch metadata only on a new seq
+        self._metadata_seq = 0
+        self._metadata_fingerprint = None
+
+        def _metadata():
+            slot = self.clock.current_slot
+            epoch = slot // params.SLOTS_PER_EPOCH
+            attnets = self.attnets.metadata_attnets(epoch, slot)
+            syncnets = self.syncnets.metadata_syncnets(epoch)
+            fp = (tuple(attnets), tuple(syncnets))
+            if fp != self._metadata_fingerprint:
+                if self._metadata_fingerprint is not None:
+                    self._metadata_seq += 1
+                self._metadata_fingerprint = fp
+            return {
+                "seq_number": self._metadata_seq,
+                "attnets": attnets,
+                "syncnets": syncnets,
+            }
+
+        self.reqresp = ReqResp()
+        self.reqresp_node = ReqRespBeaconNode(
+            self.reqresp,
+            config,
+            chain=self.chain,
+            db=self.db,
+            light_client_server=self.light_client_server,
+            metadata_fn=_metadata,
+            on_goodbye=lambda peer, reason: self.log.info(
+                "peer goodbye", peer=peer, reason=reason
+            ),
+            on_status=lambda peer, st: self.score_book.on_status(
+                peer,
+                PeerStatus(
+                    fork_digest=bytes(st["fork_digest"]),
+                    finalized_root=bytes(st["finalized_root"]),
+                    finalized_epoch=int(st["finalized_epoch"]),
+                    head_root=bytes(st["head_root"]),
+                    head_slot=int(st["head_slot"]),
+                ),
+            ),
+        )
+
         # clock wiring: processor ticks, boost lifecycle, cache pruning
         self.clock.on_slot(self.processor.on_clock_slot)
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
+        # rate-limiter TAT entries for churned peers must not pile up
+        self.clock.on_slot(
+            lambda s: self.reqresp.prune_limiters()
+            if s % params.SLOTS_PER_EPOCH == 0
+            else None
+        )
 
         # REST API over everything
         self.api = None

@@ -540,22 +540,14 @@ def process_voluntary_exit(
         "validator too young to exit",
     )
     if verify_signatures:
-        if state.fork_at_least(params.ForkName.deneb):
-            # EIP-7044 (deneb): exits are signed against the CAPELLA fork
-            # domain permanently, so old pre-signed exits stay valid
-            domain = state.config.compute_domain(
-                params.DOMAIN_VOLUNTARY_EXIT,
-                state.config.fork_versions[params.ForkName.capella],
-                state.genesis_validators_root,
-            )
-        else:
-            domain = state.config.get_domain(
-                state.slot,
-                params.DOMAIN_VOLUNTARY_EXIT,
-                exit_msg["epoch"] * P.SLOTS_PER_EPOCH,
-            )
-        root = state.config.compute_signing_root(
-            VoluntaryExit.hash_tree_root(exit_msg), domain
+        from .signature_sets import voluntary_exit_signing_root
+
+        root = voluntary_exit_signing_root(
+            state.config,
+            state.genesis_validators_root,
+            state.fork_at_least(params.ForkName.deneb),
+            state.slot,
+            exit_msg,
         )
         _require(
             _verify_sig(state, index, root, signed_exit["signature"]),

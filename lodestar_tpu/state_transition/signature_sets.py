@@ -225,11 +225,37 @@ def get_attester_slashings_signature_sets(
 # -- exits (reference: signatureSets/voluntaryExits.ts) ---------------------
 
 
+def voluntary_exit_signing_root(
+    config: ChainConfig,
+    genesis_validators_root: bytes,
+    in_deneb: bool,
+    state_slot: int,
+    exit_msg: dict,
+) -> bytes:
+    """THE exit signing root — shared by the STF's per-op check
+    (block.py process_voluntary_exit) and the wire extractor so the two
+    verification paths cannot diverge.  EIP-7044 (deneb): exits verify
+    against the CAPELLA fork domain permanently."""
+    if in_deneb:
+        domain = config.compute_domain(
+            params.DOMAIN_VOLUNTARY_EXIT,
+            config.fork_versions[ForkName.capella],
+            genesis_validators_root,
+        )
+    else:
+        domain = config.get_domain(
+            state_slot,
+            params.DOMAIN_VOLUNTARY_EXIT,
+            compute_start_slot_at_epoch(exit_msg["epoch"]),
+        )
+    return config.compute_signing_root(
+        T.VoluntaryExit.hash_tree_root(exit_msg), domain
+    )
+
+
 def get_voluntary_exits_signature_sets(
     state: BeaconStateView, signed_block: dict
 ) -> List[WireSignatureSet]:
-    # EIP-7044 (deneb): exits verify against the CAPELLA fork domain
-    # permanently — must match process_voluntary_exit's rule exactly
     deneb = (
         state.config.get_fork_seq(state.slot)
         >= params.FORK_SEQ[ForkName.deneb]
@@ -237,23 +263,13 @@ def get_voluntary_exits_signature_sets(
     out = []
     for signed_exit in signed_block["message"]["body"]["voluntary_exits"]:
         exit_msg = signed_exit["message"]
-        if deneb:
-            domain = state.config.compute_domain(
-                params.DOMAIN_VOLUNTARY_EXIT,
-                state.config.fork_versions[ForkName.capella],
-                state.genesis_validators_root,
-            )
-            root = state.config.compute_signing_root(
-                T.VoluntaryExit.hash_tree_root(exit_msg), domain
-            )
-        else:
-            root = _signing_root(
-                state.config,
-                state.slot,
-                params.DOMAIN_VOLUNTARY_EXIT,
-                compute_start_slot_at_epoch(exit_msg["epoch"]),
-                T.VoluntaryExit.hash_tree_root(exit_msg),
-            )
+        root = voluntary_exit_signing_root(
+            state.config,
+            state.genesis_validators_root,
+            deneb,
+            state.slot,
+            exit_msg,
+        )
         out.append(
             WireSignatureSet.single(
                 exit_msg["validator_index"], root, signed_exit["signature"]

@@ -318,5 +318,43 @@ def test_full_beacon_node_single_init_path(tmp_path):
         ) as resp:
             data = _json.loads(resp.read())
         assert data["data"]["message"]["slot"] == "1"
+        # req/resp surface: a peer performs the status handshake and
+        # fetches the imported block by root over the protocol layer
+        from lodestar_tpu.network.reqresp import ReqResp, connect_inmemory
+        from lodestar_tpu.network.reqresp_protocols import (
+            METADATA_TYPE,
+            StatusType,
+            decode_block_chunks,
+        )
+
+        peer = ReqResp()
+        connect_inmemory(peer, "peer-b", node.reqresp, "full-node")
+        chunks = peer.send_request(
+            "full-node",
+            node.reqresp_node.protocols["status"],
+            {
+                "fork_digest": cfg.fork_digest(0),
+                "finalized_root": b"\x00" * 32,
+                "finalized_epoch": 0,
+                "head_root": b"\x00" * 32,
+                "head_slot": 0,
+            },
+        )
+        st_resp = StatusType.deserialize(chunks[0][0])
+        assert st_resp["head_root"] == bytes(root)
+        assert node.score_book.status_of("peer-b").head_slot == 0
+        chunks = peer.send_request(
+            "full-node",
+            node.reqresp_node.protocols["blocks_by_root"],
+            [bytes(root)],
+        )
+        got = decode_block_chunks(cfg, chunks)
+        assert got and got[0]["message"]["slot"] == 1
+        chunks = peer.send_request(
+            "full-node", node.reqresp_node.protocols["metadata"]
+        )
+        md = METADATA_TYPE.deserialize(chunks[0][0])
+        assert len(md["attnets"]) == _p.ATTESTATION_SUBNET_COUNT
+        assert sum(md["attnets"]) >= 2  # long-lived subnet policy active
     finally:
         node.close()

@@ -1,0 +1,340 @@
+"""Req/resp protocol layer: chunk streams, rate limiting, handlers.
+
+Reference behaviors: packages/reqresp/src/ReqResp.ts (request/response
+flow), rate_limiter/rateLimiterGRCA.ts (GCRA), encodingStrategies
+(ssz_snappy chunks), and the beacon-node bindings protocols.ts:8-87 +
+rateLimit.ts + handlers/.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.network import snappy as SN
+from lodestar_tpu.network.reqresp import (
+    ContextBytes,
+    InboundRateLimitQuota,
+    Protocol,
+    RateLimiterGRCA,
+    RateLimiterQuota,
+    ReqResp,
+    ReqRespError,
+    ReqRespMethod,
+    RespCode,
+    connect_inmemory,
+    decode_response_chunks,
+    encode_error_chunk,
+    encode_response_chunks,
+)
+from lodestar_tpu.network.reqresp_protocols import (
+    BeaconBlocksByRangeRequest,
+    LightClientUpdateType,
+    METADATA_TYPE,
+    ReqRespBeaconNode,
+    StatusType,
+    decode_block_chunks,
+    light_client_update_from_value,
+    light_client_update_to_value,
+    ping_protocol,
+    status_protocol,
+)
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+
+
+# -- chunk stream codec -----------------------------------------------------
+
+
+def test_response_chunk_stream_roundtrip():
+    chunks = [(b"a" * 40, None), (b"", None), (b"b" * 100_000, None)]
+    stream = encode_response_chunks(chunks)
+    back = decode_response_chunks(stream, ContextBytes.empty)
+    assert [c[0] for c in back] == [c[0] for c in chunks]
+
+
+def test_response_chunk_stream_with_context_bytes():
+    chunks = [(b"x" * 10, b"\x01\x02\x03\x04"), (b"y" * 20, b"\xaa\xbb\xcc\xdd")]
+    stream = encode_response_chunks(chunks)
+    back = decode_response_chunks(stream, ContextBytes.fork_digest)
+    assert back == chunks
+
+
+def test_error_chunk_raises():
+    stream = encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "nope")
+    with pytest.raises(ReqRespError, match="nope"):
+        decode_response_chunks(stream, ContextBytes.empty)
+
+
+def test_chunk_at_decodes_concatenation():
+    a = SN.encode_reqresp_chunk(b"first")
+    b = SN.encode_reqresp_chunk(b"")
+    c = SN.encode_reqresp_chunk(b"third" * 1000)
+    data = a + b + c
+    p0, pos = SN.decode_reqresp_chunk_at(data, 0)
+    p1, pos = SN.decode_reqresp_chunk_at(data, pos)
+    p2, pos = SN.decode_reqresp_chunk_at(data, pos)
+    assert (p0, p1, p2) == (b"first", b"", b"third" * 1000)
+    assert pos == len(data)
+
+
+# -- GCRA rate limiter ------------------------------------------------------
+
+
+def test_gcra_allows_burst_then_limits():
+    t = [0.0]
+    rl = RateLimiterGRCA(RateLimiterQuota(5, 15_000), clock=lambda: t[0])
+    for _ in range(5):
+        assert rl.allows("peer-a")
+    assert not rl.allows("peer-a")
+    assert rl.allows("peer-b")  # per-key isolation
+    t[0] += 3.0  # one token replenished (15s / 5)
+    assert rl.allows("peer-a")
+    assert not rl.allows("peer-a")
+
+
+def test_gcra_token_counts():
+    t = [0.0]
+    rl = RateLimiterGRCA(RateLimiterQuota(100, 10_000), clock=lambda: t[0])
+    assert rl.allows("p", 80)
+    assert not rl.allows("p", 40)  # 80 + 40 > 100
+    assert rl.allows("p", 20)
+
+
+# -- node-to-node flows -----------------------------------------------------
+
+
+def _two_nodes(clock=None):
+    kwargs = {"clock": clock} if clock is not None else {}
+    a, b = ReqResp(**kwargs), ReqResp(**kwargs)
+    connect_inmemory(a, "A", b, "B")
+    return a, b
+
+
+def test_status_handshake_between_nodes():
+    a, b = _two_nodes()
+    seen = {}
+    status_b = {
+        "fork_digest": b"\x01\x00\x00\x00",
+        "finalized_root": b"\x11" * 32,
+        "finalized_epoch": 7,
+        "head_root": b"\x22" * 32,
+        "head_slot": 321,
+    }
+    proto = status_protocol()
+
+    def handler(peer, req):
+        seen[peer] = req
+        return [(StatusType.serialize(status_b), None)]
+
+    b.register_protocol(proto, handler)
+    my_status = dict(status_b, head_slot=99)
+    chunks = a.send_request("B", proto, my_status)
+    got = StatusType.deserialize(chunks[0][0])
+    assert got["head_slot"] == 321
+    assert seen["A"]["head_slot"] == 99
+
+
+def test_rate_limited_peer_gets_error_chunk():
+    t = [0.0]
+    a, b = _two_nodes(clock=lambda: t[0])
+    proto = ping_protocol()
+    b.register_protocol(proto, lambda peer, seq: [(b"\x00" * 8, None)])
+    # quota: 2 per 10s
+    a.send_request("B", proto, 1)
+    a.send_request("B", proto, 2)
+    with pytest.raises(ReqRespError, match="rate limited"):
+        a.send_request("B", proto, 3)
+    t[0] += 5.0
+    a.send_request("B", proto, 4)  # replenished
+
+
+def test_unknown_protocol_and_handler_crash():
+    a, b = _two_nodes()
+    bogus = Protocol(
+        method=ReqRespMethod.ping, version=9,
+        context_bytes=ContextBytes.empty,
+        encode_request=lambda x: b"\x00" * 8,
+        decode_request=lambda d: d,
+    )
+    with pytest.raises(ReqRespError, match="unsupported"):
+        a.send_request("B", bogus, 0)
+    crash = ping_protocol()
+
+    def boom(peer, req):
+        raise RuntimeError("kaboom")
+
+    b.register_protocol(crash, boom)
+    with pytest.raises(ReqRespError, match="kaboom"):
+        a.send_request("B", crash, 1)
+
+
+# -- beacon-node bindings ---------------------------------------------------
+
+
+class _FakeChain:
+    def __init__(self, cfg, head_state, head_root, blocks):
+        self.config = cfg
+        self._head_state = head_state
+        self._head_root = head_root
+        self._blocks = blocks  # root -> signed block
+
+    @property
+    def head_state(self):
+        return self._head_state
+
+    def get_head_root(self):
+        return self._head_root
+
+    def get_block(self, root):
+        return self._blocks.get(bytes(root))
+
+
+def _mini_world():
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.params import ForkName
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+
+    class _St:
+        slot = 5
+        finalized_checkpoint = {"epoch": 0, "root": b"\x00" * 32}
+
+    def mk_block(slot):
+        blk = T.BeaconBlockAltair.default()
+        blk["slot"] = slot
+        return {
+            "message": blk,
+            "signature": b"\x00" * 96,
+        }
+
+    blocks = {bytes([i]) * 32: mk_block(i) for i in range(1, 4)}
+    chain = _FakeChain(cfg, _St(), b"\x03" * 32, blocks)
+    return cfg, chain, blocks
+
+
+def test_beacon_node_bindings_end_to_end():
+    cfg, chain, blocks = _mini_world()
+    server = ReqResp()
+    client = ReqResp()
+    connect_inmemory(client, "C", server, "S")
+    md = {"seq_number": 3, "attnets": [False] * 64, "syncnets": [True] * 4}
+    node = ReqRespBeaconNode(
+        server, cfg, chain=chain, metadata_fn=lambda: md
+    )
+    # status
+    chunks = client.send_request("S", node.protocols["status"], {
+        "fork_digest": cfg.fork_digest(0),
+        "finalized_root": b"\x00" * 32,
+        "finalized_epoch": 0,
+        "head_root": b"\x01" * 32,
+        "head_slot": 1,
+    })
+    st = StatusType.deserialize(chunks[0][0])
+    assert st["head_slot"] == 5
+    # ping answers the metadata seq number
+    chunks = client.send_request("S", node.protocols["ping"], 0)
+    assert int.from_bytes(chunks[0][0], "little") == 3
+    # metadata (no request body)
+    chunks = client.send_request("S", node.protocols["metadata"])
+    got = METADATA_TYPE.deserialize(chunks[0][0])
+    assert got["seq_number"] == 3 and got["syncnets"] == [True] * 4
+    # blocks by root (fork digest context bytes attached)
+    chunks = client.send_request(
+        "S", node.protocols["blocks_by_root"], [bytes([2]) * 32, b"\x99" * 32]
+    )
+    assert len(chunks) == 1  # unknown root skipped
+    decoded = decode_block_chunks(cfg, chunks)
+    assert decoded[0]["message"]["slot"] == 2
+    assert chunks[0][1] == cfg.fork_digest(2)
+
+
+def test_blocks_by_range_from_archive(tmp_path):
+    from lodestar_tpu.db.beacon_db import BeaconDb
+
+    cfg, chain, blocks = _mini_world()
+    db = BeaconDb(str(tmp_path / "db"))
+    for root, signed in blocks.items():
+        db.archive_block(int(signed["message"]["slot"]), signed, root)
+    server = ReqResp()
+    client = ReqResp()
+    connect_inmemory(client, "C", server, "S")
+    node = ReqRespBeaconNode(server, cfg, chain=chain, db=db)
+    chunks = client.send_request(
+        "S",
+        node.protocols["blocks_by_range"],
+        {"start_slot": 1, "count": 10, "step": 1},
+    )
+    decoded = decode_block_chunks(cfg, chunks)
+    assert [b["message"]["slot"] for b in decoded] == [1, 2, 3]
+    # count-weighted rate limiting: a huge request burns the quota
+    client.send_request(
+        "S",
+        node.protocols["blocks_by_range"],
+        {"start_slot": 0, "count": 1000, "step": 1},
+    )
+    with pytest.raises(ReqRespError, match="rate limited"):
+        client.send_request(
+            "S",
+            node.protocols["blocks_by_range"],
+            {"start_slot": 0, "count": 100, "step": 1},
+        )
+    db.close()
+
+
+def test_light_client_update_wire_roundtrip():
+    from lodestar_tpu.light_client.lightclient import LightClientUpdate
+
+    upd = LightClientUpdate(
+        attested_header={
+            "slot": 40, "proposer_index": 2, "parent_root": b"\x01" * 32,
+            "state_root": b"\x02" * 32, "body_root": b"\x03" * 32,
+        },
+        sync_committee_bits=[True] * P.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=b"\x05" * 96,
+        signature_slot=41,
+        finalized_header={
+            "slot": 8, "proposer_index": 0, "parent_root": b"\x04" * 32,
+            "state_root": b"\x05" * 32, "body_root": b"\x06" * 32,
+        },
+        finality_branch=[bytes([i]) * 32 for i in range(1, 7)],
+    )
+    value = light_client_update_to_value(upd)
+    data = LightClientUpdateType.serialize(value)
+    back = light_client_update_from_value(
+        LightClientUpdateType.deserialize(data)
+    )
+    assert back.attested_header == upd.attested_header
+    assert back.finality_branch == upd.finality_branch
+    assert back.next_sync_committee is None  # zero branch -> absent
+    assert back.signature_slot == 41
+
+
+def test_db_fork_aware_block_codec(tmp_path):
+    """Post-altair blocks keep their execution payload through the db
+    (an altair-typed repository would silently drop it on put)."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.db.beacon_db import BeaconDb
+    from lodestar_tpu.params import ForkName
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0, ForkName.bellatrix: 1},
+    )
+    db = BeaconDb(str(tmp_path / "db"), config=cfg)
+    blk = T.BeaconBlockBellatrix.default()
+    blk["slot"] = P.SLOTS_PER_EPOCH + 2  # a bellatrix-era slot
+    blk["body"]["execution_payload"]["block_number"] = 77
+    signed = {"message": blk, "signature": b"\x01" * 96}
+    root = b"\x42" * 32
+    db.put_block(root, signed)
+    back = db.get_block_anywhere(root)
+    assert back["message"]["body"]["execution_payload"]["block_number"] == 77
+    # archive path too
+    db.archive_block(int(blk["slot"]), signed, root=b"\x43" * 32)
+    arch = db.block_archive.get(int(blk["slot"]).to_bytes(8, "big"))
+    assert arch["message"]["body"]["execution_payload"]["block_number"] == 77
+    db.close()
