@@ -18,6 +18,11 @@ EOF
     # full stage list: finished stages replay from the persistent cache
     python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
     echo "$ts probes done rc=$?" >> "$LOG"
+    # pre-warm the bench's exact compile shapes so the driver-window
+    # bench run hits the persistent cache instead of cold-compiling
+    BENCH_DEADLINE=3300 timeout 3400 python bench.py \
+      > /tmp/bench_warm.json 2>/tmp/bench_warm.log
+    echo "$ts bench warm rc=$? $(cat /tmp/bench_warm.json)" >> "$LOG"
     break
   fi
   echo "$ts compile unavailable" >> "$LOG"

@@ -21,6 +21,7 @@ import hashlib
 from typing import List, Optional, Sequence
 
 from .. import params
+from .. import types as T
 from ..bls.signature_set import WireSignatureSet
 from ..bls.verifier import VerifyOptions
 from ..state_transition.signature_sets import (
@@ -132,6 +133,21 @@ class GossipValidators:
             if cache is not None and cache.epoch == epoch:
                 return cache.get_beacon_committee(slot, index)
         _ignore(f"no committee cache for epoch {epoch}")
+
+    def _expected_proposer(self, slot: int) -> int:
+        """Shuffle-expected proposer for `slot`, cached per epoch (the
+        reference reads EpochContext.proposers)."""
+        epoch = slot // params.SLOTS_PER_EPOCH
+        cache = getattr(self, "_proposer_epoch_cache", None)
+        if cache is None or cache[0] != (epoch, self.chain.head_root_hex):
+            try:
+                duties = self.chain.get_proposer_duties(epoch)
+            except Exception as e:  # noqa: BLE001 — epoch unreachable
+                _ignore(f"no proposer shuffling for epoch {epoch}: {e}")
+            cache = ((epoch, self.chain.head_root_hex), duties)
+            self._proposer_epoch_cache = cache
+        start = epoch * params.SLOTS_PER_EPOCH
+        return int(cache[1][slot - start]["validator_index"])
 
     def _current_slot(self) -> int:
         if self.current_slot_fn is not None:
@@ -426,6 +442,66 @@ class GossipValidators:
         self.chain.op_pool.insert_voluntary_exit(signed_exit)
         return vindex
 
+    # -- blob_sidecar_{subnet} (deneb; reference: validation/
+    # blobsSidecar.ts updated to the per-blob mainnet sidecar shape) -------
+
+    def validate_blob_sidecar(
+        self, sidecar: dict, kzg_setup, body_type=None
+    ) -> bytes:
+        """Returns the block root the sidecar belongs to on ACCEPT."""
+        from ..crypto import kzg as K
+        from . import blobs as BL
+
+        index = int(sidecar["index"])
+        if index >= params.MAX_BLOBS_PER_BLOCK:
+            _reject(f"blob index {index} out of range")
+        header = sidecar["signed_block_header"]["message"]
+        slot = int(header["slot"])
+        self._check_slot_window(slot)
+        block_root = T.BeaconBlockHeader.hash_tree_root(header)
+        if not hasattr(self, "seen_blob_sidecars"):
+            self.seen_blob_sidecars = {}  # (root, index) -> slot
+        if (bytes(block_root), index) in self.seen_blob_sidecars:
+            _ignore("duplicate blob sidecar")
+        # the CLAIMED proposer must be the shuffle-expected proposer for
+        # the slot — otherwise any validator could mint accepted sidecars
+        # with a self-signed header (spec REJECT condition)
+        expected = self._expected_proposer(slot)
+        if int(header["proposer_index"]) != expected:
+            _reject(
+                f"proposer {header['proposer_index']} != expected {expected}"
+            )
+        # proposer signature over the header (REJECT on failure)
+        view = self._view()
+        root = view.config.compute_signing_root(
+            block_root,
+            view.config.get_domain(
+                view.slot, params.DOMAIN_BEACON_PROPOSER, slot
+            ),
+        )
+        self._verify(
+            [
+                WireSignatureSet.single(
+                    int(header["proposer_index"]),
+                    root,
+                    sidecar["signed_block_header"]["signature"],
+                )
+            ]
+        )
+        if body_type is None:
+            body_type = view.config.get_fork_types(slot)[2]
+        if not BL.verify_blob_inclusion(sidecar, body_type):
+            _reject("commitment inclusion proof invalid")
+        if not K.verify_blob_kzg_proof(
+            bytes(sidecar["blob"]),
+            bytes(sidecar["kzg_commitment"]),
+            bytes(sidecar["kzg_proof"]),
+            kzg_setup,
+        ):
+            _reject("blob KZG proof invalid")
+        self.seen_blob_sidecars[(bytes(block_root), index)] = slot
+        return bytes(block_root)
+
     # -- pruning -----------------------------------------------------------
 
     def prune(self, current_slot: int) -> None:
@@ -434,3 +510,9 @@ class GossipValidators:
         self.seen_aggregators.prune(epoch)
         self.seen_sync_messages.prune(current_slot)
         self.seen_contributions.prune(current_slot)
+        # blob-sidecar dedup only matters inside the gossip slot window
+        seen_blobs = getattr(self, "seen_blob_sidecars", None)
+        if seen_blobs:
+            horizon = current_slot - ATTESTATION_PROPAGATION_SLOT_RANGE
+            for key in [k for k, s in seen_blobs.items() if s < horizon]:
+                del seen_blobs[key]

@@ -141,7 +141,10 @@ class InboundRateLimitQuota:
 
 
 def default_rate_limits() -> Dict[ReqRespMethod, InboundRateLimitQuota]:
-    """The reference's quota table (rateLimit.ts:6-66)."""
+    """The reference's per-peer quota table (rateLimit.ts:6-66), plus a
+    node-wide `total` backstop on the expensive serving methods — the
+    reference's table leaves totals unset, which lets N peers each pull
+    a full per-peer quota with no aggregate cap on db reads."""
     M = ReqRespMethod
     return {
         M.status: InboundRateLimitQuota(RateLimiterQuota(5, 15_000)),
@@ -150,10 +153,12 @@ def default_rate_limits() -> Dict[ReqRespMethod, InboundRateLimitQuota]:
         M.metadata: InboundRateLimitQuota(RateLimiterQuota(2, 5_000)),
         M.beacon_blocks_by_range: InboundRateLimitQuota(
             RateLimiterQuota(MAX_REQUEST_BLOCKS, 10_000),
+            total=RateLimiterQuota(4 * MAX_REQUEST_BLOCKS, 10_000),
             get_request_count=lambda req: max(1, int(req.get("count", 1))),
         ),
         M.beacon_blocks_by_root: InboundRateLimitQuota(
             RateLimiterQuota(128, 10_000),
+            total=RateLimiterQuota(4 * 128, 10_000),
             get_request_count=lambda req: max(1, len(req)),
         ),
         M.light_client_bootstrap: InboundRateLimitQuota(
@@ -161,6 +166,9 @@ def default_rate_limits() -> Dict[ReqRespMethod, InboundRateLimitQuota]:
         ),
         M.light_client_updates_by_range: InboundRateLimitQuota(
             RateLimiterQuota(MAX_REQUEST_LIGHT_CLIENT_UPDATES, 10_000),
+            total=RateLimiterQuota(
+                4 * MAX_REQUEST_LIGHT_CLIENT_UPDATES, 10_000
+            ),
             get_request_count=lambda req: max(1, int(req.get("count", 1))),
         ),
         M.light_client_finality_update: InboundRateLimitQuota(
@@ -214,7 +222,15 @@ def decode_response_chunks(
                 msg = ssz_bytes.decode()
             except UnicodeDecodeError:
                 msg = ssz_bytes.hex()
-            raise ReqRespError(RespCode(code), msg)
+            # the p2p spec reserves EVERY nonzero result byte as an
+            # error; map unknown codes to SERVER_ERROR instead of
+            # crashing on the enum lookup
+            try:
+                rc = RespCode(code)
+            except ValueError:
+                rc = RespCode.SERVER_ERROR
+                msg = f"error code {code}: {msg}"
+            raise ReqRespError(rc, msg)
         out.append((ssz_bytes, ctx))
     return out
 

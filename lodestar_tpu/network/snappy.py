@@ -189,14 +189,11 @@ def encode_reqresp_chunk(ssz_bytes: bytes) -> bytes:
 
 
 def decode_reqresp_chunk(data: bytes, max_len: int = 1 << 27) -> bytes:
-    declared, pos = _read_uvarint(data, 0)
-    if declared > max_len:
-        raise SnappyError("declared length over limit")
-    payload = frame_decompress(data[pos:])
-    if len(payload) != declared:
-        raise SnappyError(
-            f"length mismatch: declared {declared}, got {len(payload)}"
-        )
+    """One chunk filling the whole buffer (delegates to the positional
+    decoder so there is exactly ONE frame-parsing state machine)."""
+    payload, pos = decode_reqresp_chunk_at(data, 0, max_len)
+    if pos != len(data):
+        raise SnappyError(f"{len(data) - pos} trailing bytes after chunk")
     return payload
 
 

@@ -569,3 +569,27 @@ ssz.deneb = SimpleNamespace(
     SignedBeaconBlock=SignedBeaconBlockDeneb,
     BeaconBlockBody=BeaconBlockBodyDeneb,
 )
+
+# deneb blob sidecars (reference carried the earlier
+# BeaconBlockAndBlobsSidecar shape, types/src/deneb/sszTypes.ts; this is
+# the per-blob sidecar that shipped on mainnet deneb)
+Blob = ByteVector(32 * P.FIELD_ELEMENTS_PER_BLOB)
+KZGProof = Bytes48
+KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = 17
+
+BlobSidecar = Container(
+    (
+        ("index", uint64),
+        ("blob", Blob),
+        ("kzg_commitment", KZGCommitment),
+        ("kzg_proof", KZGProof),
+        ("signed_block_header", SignedBeaconBlockHeader),
+        (
+            "kzg_commitment_inclusion_proof",
+            Vector(Bytes32, KZG_COMMITMENT_INCLUSION_PROOF_DEPTH),
+        ),
+    ),
+    name="BlobSidecar",
+)
+ssz.deneb.Blob = Blob
+ssz.deneb.BlobSidecar = BlobSidecar

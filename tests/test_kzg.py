@@ -1,0 +1,138 @@
+"""KZG polynomial commitments (the c-kzg replacement).
+
+Reference behaviors: packages/beacon-node/src/util/kzg.ts (the c-kzg
+surface the node consumes) and the deneb polynomial-commitments spec
+(blob_to_kzg_commitment / compute_kzg_proof / verify_blob_kzg_proof).
+Runs at a small domain width — the math is width-independent and the
+dev setup's known tau lets tests cross-check commitments white-box.
+"""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.crypto import kzg as K
+
+pytestmark = pytest.mark.smoke
+
+WIDTH = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return K.insecure_dev_setup(WIDTH)
+
+
+def _blob(seed: bytes) -> bytes:
+    evals = [
+        int.from_bytes(hashlib.sha256(seed + bytes([i])).digest(), "big")
+        % K.R
+        for i in range(WIDTH)
+    ]
+    return K.polynomial_to_blob(evals)
+
+
+def test_roots_of_unity_and_brp():
+    roots = K.compute_roots_of_unity(WIDTH)
+    assert len(set(roots)) == WIDTH
+    assert all(pow(w, WIDTH, K.R) == 1 for w in roots)
+    brp = K.bit_reversal_permutation(list(range(8)))
+    assert brp == [0, 4, 2, 6, 1, 5, 3, 7]
+
+
+def test_commitment_matches_known_tau(setup):
+    """White-box: MSM over the Lagrange setup must equal [p(tau)]G1."""
+    blob = _blob(b"wb")
+    evals = K.blob_to_polynomial(blob, WIDTH)
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    tau = (
+        int.from_bytes(hashlib.sha256(b"lodestar-tpu-dev-kzg").digest(), "big")
+        % K.R
+    )
+    y = K.evaluate_polynomial_in_evaluation_form(evals, tau, setup)
+    direct = C.scalar_mul(C.FP_OPS, C.G1_GEN, y)
+    assert C.g1_compress(direct) == commitment
+
+
+def test_kzg_proof_roundtrip_off_domain(setup):
+    blob = _blob(b"p1")
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    z = (12345).to_bytes(32, "big")
+    proof, y = K.compute_kzg_proof(blob, z, setup)
+    assert K.verify_kzg_proof(commitment, z, y, proof, setup)
+    # wrong y rejects
+    bad_y = ((int.from_bytes(y, "big") + 1) % K.R).to_bytes(32, "big")
+    assert not K.verify_kzg_proof(commitment, z, bad_y, proof, setup)
+    # wrong z rejects
+    z2 = (54321).to_bytes(32, "big")
+    assert not K.verify_kzg_proof(commitment, z2, y, proof, setup)
+
+
+def test_kzg_proof_at_domain_point(setup):
+    """z ON the evaluation domain exercises the quotient's L'Hopital
+    branch; y must equal the blob's stored evaluation."""
+    blob = _blob(b"p2")
+    evals = K.blob_to_polynomial(blob, WIDTH)
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    k = 3
+    z = int(setup.roots_brp[k]).to_bytes(32, "big")
+    proof, y = K.compute_kzg_proof(blob, z, setup)
+    assert int.from_bytes(y, "big") == evals[k]
+    assert K.verify_kzg_proof(commitment, z, y, proof, setup)
+
+
+def test_blob_proof_accept_and_reject(setup):
+    blob = _blob(b"p3")
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    proof = K.compute_blob_kzg_proof(blob, commitment, setup)
+    assert K.verify_blob_kzg_proof(blob, commitment, proof, setup)
+    # tampered blob fails
+    tampered = bytearray(blob)
+    tampered[-1] ^= 1
+    assert not K.verify_blob_kzg_proof(bytes(tampered), commitment, proof, setup)
+    # commitment of a different blob fails
+    other = K.blob_to_kzg_commitment(_blob(b"p4"), setup)
+    assert not K.verify_blob_kzg_proof(blob, other, proof, setup)
+    # garbage proof bytes fail (not a curve point)
+    assert not K.verify_blob_kzg_proof(blob, commitment, b"\x01" * 48, setup)
+
+
+def test_blob_batch_verify(setup):
+    blobs = [_blob(b"b%d" % i) for i in range(3)]
+    commitments = [K.blob_to_kzg_commitment(b, setup) for b in blobs]
+    proofs = [
+        K.compute_blob_kzg_proof(b, c, setup)
+        for b, c in zip(blobs, commitments)
+    ]
+    assert K.verify_blob_kzg_proof_batch(blobs, commitments, proofs, setup)
+    # one bad proof poisons the batch
+    proofs_bad = list(proofs)
+    proofs_bad[1] = proofs[0]
+    assert not K.verify_blob_kzg_proof_batch(
+        blobs, commitments, proofs_bad, setup
+    )
+    # length mismatch rejects
+    assert not K.verify_blob_kzg_proof_batch(blobs[:2], commitments, proofs, setup)
+
+
+def test_constant_polynomial_infinity_proof(setup):
+    """A constant polynomial's quotient is zero — the proof is the
+    point at infinity and must still verify."""
+    evals = [7] * WIDTH
+    blob = K.polynomial_to_blob(evals)
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    z = (99).to_bytes(32, "big")
+    proof, y = K.compute_kzg_proof(blob, z, setup)
+    assert int.from_bytes(y, "big") == 7
+    assert proof == bytes([0xC0]) + b"\x00" * 47
+    assert K.verify_kzg_proof(commitment, z, y, proof, setup)
+
+
+def test_non_canonical_blob_rejected(setup):
+    bad = K.polynomial_to_blob([K.R] + [0] * (WIDTH - 1))  # == modulus
+    with pytest.raises(K.KzgError, match="canonical"):
+        K.blob_to_polynomial(bad, WIDTH)
+    assert not K.verify_blob_kzg_proof(
+        bad, bytes([0xC0]) + b"\x00" * 47, bytes([0xC0]) + b"\x00" * 47, setup
+    )

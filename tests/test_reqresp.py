@@ -338,3 +338,33 @@ def test_db_fork_aware_block_codec(tmp_path):
     arch = db.block_archive.get(int(blk["slot"]).to_bytes(8, "big"))
     assert arch["message"]["body"]["execution_payload"]["block_number"] == 77
     db.close()
+
+
+def test_unknown_error_code_maps_to_server_error():
+    # the p2p spec reserves EVERY nonzero result byte as an error
+    stream = bytes([4]) + SN.encode_reqresp_chunk(b"weird")
+    with pytest.raises(ReqRespError, match="error code 4"):
+        decode_response_chunks(stream, ContextBytes.empty)
+
+
+def test_total_quota_caps_across_peers():
+    t = [0.0]
+    limits = {
+        ReqRespMethod.ping: InboundRateLimitQuota(
+            RateLimiterQuota(2, 10_000), total=RateLimiterQuota(3, 10_000)
+        )
+    }
+    server = ReqResp(rate_limits=limits, clock=lambda: t[0])
+    proto = ping_protocol()
+    server.register_protocol(proto, lambda p, s: [(b"\x00" * 8, None)])
+    clients = []
+    for name in ("p1", "p2"):
+        c = ReqResp(clock=lambda: t[0])
+        c.connect("S", lambda pid, req, n=name: server.handle_request(n, pid, req))
+        clients.append(c)
+    clients[0].send_request("S", proto, 1)
+    clients[0].send_request("S", proto, 2)
+    clients[1].send_request("S", proto, 3)  # third TOTAL token
+    # peer p2 is under its per-peer quota but the node-wide cap trips
+    with pytest.raises(ReqRespError, match="rate limited"):
+        clients[1].send_request("S", proto, 4)

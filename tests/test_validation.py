@@ -446,3 +446,122 @@ def test_voluntary_exit_flow(world):
     with pytest.raises(GossipValidationError) as ei:
         w["handlers"].validators.validate_voluntary_exit_gossip(bad)
     assert ei.value.action == GossipAction.REJECT
+
+
+def test_blob_sidecar_validation(world):
+    """deneb blob sidecar: inclusion proof + KZG proof + proposer sig
+    (reference role: validation/blobsSidecar.ts, modern per-blob shape)."""
+    import hashlib as _hl
+
+    from lodestar_tpu.chain import blobs as BL
+    from lodestar_tpu.chain.validation import (
+        GossipValidationError,
+        GossipValidators,
+    )
+    from lodestar_tpu.crypto import kzg as K
+
+    w = world
+    setup = K.insecure_dev_setup(8)
+    width_bytes = 8 * 32
+    blobs = [
+        K.polynomial_to_blob(
+            [
+                int.from_bytes(_hl.sha256(b"bl-%d-%d" % (j, i)).digest(), "big")
+                % K.R
+                for i in range(8)
+            ]
+        )
+        for j in range(2)
+    ]
+    commitments = [K.blob_to_kzg_commitment(b, setup) for b in blobs]
+    body = T.BeaconBlockBodyDeneb.default()
+    body["blob_kzg_commitments"] = list(commitments)
+    # the claimed proposer must be the shuffle-expected one for the slot
+    duties = w["chain_a"].get_proposer_duties(0)
+    proposer = int(duties[1]["validator_index"])
+    block = {
+        "slot": 1,
+        "proposer_index": proposer,
+        "parent_root": b"\x01" * 32,
+        "state_root": b"\x02" * 32,
+        "body": body,
+    }
+    # proposer signature over the header (the sidecar carries the block's
+    # signature next to the header)
+    header = {
+        "slot": 1,
+        "proposer_index": proposer,
+        "parent_root": b"\x01" * 32,
+        "state_root": b"\x02" * 32,
+        "body_root": T.BeaconBlockBodyDeneb.hash_tree_root(body),
+    }
+    root = w["cfg"].compute_signing_root(
+        T.BeaconBlockHeader.hash_tree_root(header),
+        w["cfg"].get_domain(1, params.DOMAIN_BEACON_PROPOSER, 1),
+    )
+    sig = C.g2_compress(B.sign(w["sks"][proposer], root))
+    signed = {"message": block, "signature": sig}
+    sidecars = BL.make_blob_sidecars(
+        signed, T.BeaconBlockBodyDeneb, blobs, setup
+    )
+    assert len(sidecars) == 2
+    # inclusion proofs verify standalone
+    for sc in sidecars:
+        assert BL.verify_blob_inclusion(sc, T.BeaconBlockBodyDeneb)
+
+    v = GossipValidators(w["chain_a"], w["verifier"])
+    got_root = v.validate_blob_sidecar(
+        sidecars[0], setup, body_type=T.BeaconBlockBodyDeneb
+    )
+    assert got_root == T.BeaconBlockHeader.hash_tree_root(header)
+    # duplicate -> IGNORE
+    with pytest.raises(GossipValidationError, match="duplicate"):
+        v.validate_blob_sidecar(
+            sidecars[0], setup, body_type=T.BeaconBlockBodyDeneb
+        )
+    # wrong blob content -> KZG REJECT
+    bad = dict(sidecars[1])
+    bad["blob"] = blobs[0]
+    with pytest.raises(GossipValidationError, match="KZG"):
+        v.validate_blob_sidecar(bad, setup, body_type=T.BeaconBlockBodyDeneb)
+    # tampered inclusion proof -> REJECT
+    bad2 = dict(sidecars[1])
+    proof = list(bad2["kzg_commitment_inclusion_proof"])
+    proof[0] = b"\x55" * 32
+    bad2["kzg_commitment_inclusion_proof"] = proof
+    with pytest.raises(GossipValidationError, match="inclusion"):
+        v.validate_blob_sidecar(bad2, setup, body_type=T.BeaconBlockBodyDeneb)
+    # out-of-range index -> REJECT
+    bad3 = dict(sidecars[1])
+    bad3["index"] = params.MAX_BLOBS_PER_BLOCK
+    with pytest.raises(GossipValidationError, match="range"):
+        v.validate_blob_sidecar(bad3, setup, body_type=T.BeaconBlockBodyDeneb)
+    # wrong proposer signature -> REJECT
+    bad4 = dict(sidecars[1])
+    bad4["signed_block_header"] = {
+        "message": header,
+        "signature": C.g2_compress(
+            B.sign(w["sks"][(proposer + 1) % N_KEYS], root)  # wrong key
+        ),
+    }
+    with pytest.raises(GossipValidationError, match="signature"):
+        v.validate_blob_sidecar(bad4, setup, body_type=T.BeaconBlockBodyDeneb)
+    # a header naming a NON-expected proposer (self-signed) -> REJECT,
+    # even with a self-consistent signature
+    imposter = (proposer + 1) % N_KEYS
+    fake_header = dict(header, proposer_index=imposter)
+    fake_root = w["cfg"].compute_signing_root(
+        T.BeaconBlockHeader.hash_tree_root(fake_header),
+        w["cfg"].get_domain(1, params.DOMAIN_BEACON_PROPOSER, 1),
+    )
+    bad5 = dict(sidecars[1])
+    bad5["signed_block_header"] = {
+        "message": fake_header,
+        "signature": C.g2_compress(B.sign(w["sks"][imposter], fake_root)),
+    }
+    with pytest.raises(GossipValidationError, match="expected"):
+        v.validate_blob_sidecar(bad5, setup, body_type=T.BeaconBlockBodyDeneb)
+    # the untampered second sidecar still accepts
+    assert v.validate_blob_sidecar(
+        sidecars[1], setup, body_type=T.BeaconBlockBodyDeneb
+    ) == bytes(got_root)
