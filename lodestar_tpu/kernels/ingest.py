@@ -210,15 +210,10 @@ def _sds(shape):
 
 
 def _tiled(kernel, ins, in_rows, out_rows, n):
-    assert n % BT == 0, n
-    return pl.pallas_call(
-        kernel,
-        out_shape=[_sds((r, n)) for r in out_rows],
-        grid=(n // BT,),
-        in_specs=[pl.BlockSpec((r, BT), lambda i: (0, i)) for r in in_rows],
-        out_specs=[pl.BlockSpec((r, BT), lambda i: (0, i)) for r in out_rows],
-        interpret=_interpret(),
-    )(*ins)
+    # cached launch: a per-call pallas_call re-traces the kernel body
+    from . import launch as LA
+
+    return LA.tiled(kernel, ins, in_rows, out_rows, n, BT)
 
 
 _R2_LIMBS = [int(v) for v in LY.MONT_R2]

@@ -38,6 +38,7 @@ from . import core as C
 from . import curve as CV
 from . import fp2 as F2
 from . import ingest as IG
+from . import launch as LA
 from . import layout as LY
 from . import pairing as KP
 from . import tower as TW
@@ -72,16 +73,9 @@ def _sds(shape):
 
 def _tiled(kernel, ins, in_rows, out_rows, n):
     """Lane-tiled pallas_call: each operand is [rows, n], blocked to
-    [rows, BT]; one compile serves every n that is a multiple of BT."""
-    assert n % BT == 0, n
-    return pl.pallas_call(
-        kernel,
-        out_shape=[_sds((r, n)) for r in out_rows],
-        grid=(n // BT,),
-        in_specs=[pl.BlockSpec((r, BT), lambda i: (0, i)) for r in in_rows],
-        out_specs=[pl.BlockSpec((r, BT), lambda i: (0, i)) for r in out_rows],
-        interpret=_interpret(),
-    )(*ins)
+    [rows, BT].  Launches go through the kernels/launch.py cache — a
+    wrapper rebuilt per call re-traces the kernel body every time."""
+    return LA.tiled(kernel, ins, in_rows, out_rows, n, BT)
 
 
 # ---------------------------------------------------------------------------
@@ -329,19 +323,23 @@ def _gather_pk(table_x, table_y, idx, kmask):
     gy = jnp.moveaxis(gy, 2, 0)
     m = jnp.moveaxis(kmask, 1, 0)  # [K, N]
     kc = min(k, 32)
-    ox, oy, oz, oinf = pl.pallas_call(
-        _k_agg_pk,
-        out_shape=[_sds((NL, n))] * 3 + [_sds((1, n))],
-        grid=(n // BT, k // kc),
-        in_specs=[
-            pl.BlockSpec((kc, NL, BT), lambda i, k_: (k_, 0, i)),
-            pl.BlockSpec((kc, NL, BT), lambda i, k_: (k_, 0, i)),
-            pl.BlockSpec((kc, BT), lambda i, k_: (k_, i)),
-        ],
-        out_specs=[pl.BlockSpec((NL, BT), lambda i, k_: (0, i))] * 3
-        + [pl.BlockSpec((1, BT), lambda i, k_: (0, i))],
-        interpret=_interpret(),
-    )(gx, gy, m)
+    fn = LA.cached(
+        ("agg_pk", n, k, kc),
+        lambda: pl.pallas_call(
+            _k_agg_pk,
+            out_shape=[_sds((NL, n))] * 3 + [_sds((1, n))],
+            grid=(n // BT, k // kc),
+            in_specs=[
+                pl.BlockSpec((kc, NL, BT), lambda i, k_: (k_, 0, i)),
+                pl.BlockSpec((kc, NL, BT), lambda i, k_: (k_, 0, i)),
+                pl.BlockSpec((kc, BT), lambda i, k_: (k_, i)),
+            ],
+            out_specs=[pl.BlockSpec((NL, BT), lambda i, k_: (0, i))] * 3
+            + [pl.BlockSpec((1, BT), lambda i, k_: (0, i))],
+            interpret=LA.interpret(),
+        ),
+    )
+    ox, oy, oz, oinf = fn(gx, gy, m)
     return (ox, oy, oz), (oinf[0] != 0)
 
 
@@ -590,29 +588,37 @@ def _batch_core(
 
 def _sum_g2(x0, x1, y0, y1, z0, z1, excl, n):
     """Lane-tiled grid accumulation wrapper for _k_sum_g2 (full width)."""
-    return pl.pallas_call(
-        _k_sum_g2,
-        out_shape=[_sds((NL, BT))] * 6 + [_sds((1, BT))],
-        grid=(n // BT,),
-        in_specs=[pl.BlockSpec((NL, BT), lambda i: (0, i))] * 6
-        + [pl.BlockSpec((1, BT), lambda i: (0, i))],
-        out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 6
-        + [pl.BlockSpec((1, BT), lambda i: (0, 0))],
-        interpret=_interpret(),
-    )(x0, x1, y0, y1, z0, z1, excl)
+    fn = LA.cached(
+        ("sum_g2", n),
+        lambda: pl.pallas_call(
+            _k_sum_g2,
+            out_shape=[_sds((NL, BT))] * 6 + [_sds((1, BT))],
+            grid=(n // BT,),
+            in_specs=[pl.BlockSpec((NL, BT), lambda i: (0, i))] * 6
+            + [pl.BlockSpec((1, BT), lambda i: (0, i))],
+            out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 6
+            + [pl.BlockSpec((1, BT), lambda i: (0, 0))],
+            interpret=LA.interpret(),
+        ),
+    )
+    return fn(x0, x1, y0, y1, z0, z1, excl)
 
 
 def _prod(fN, live_i, n):
     """Lane-tiled grid accumulation wrapper for _k_prod (full width)."""
-    return pl.pallas_call(
-        _k_prod,
-        out_shape=[_sds((NL, BT))] * 12,
-        grid=(n // BT,),
-        in_specs=[pl.BlockSpec((1, BT), lambda i: (0, i))]
-        + [pl.BlockSpec((NL, BT), lambda i: (0, i))] * 12,
-        out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 12,
-        interpret=_interpret(),
-    )(live_i, *fN)
+    fn = LA.cached(
+        ("prod", n),
+        lambda: pl.pallas_call(
+            _k_prod,
+            out_shape=[_sds((NL, BT))] * 12,
+            grid=(n // BT,),
+            in_specs=[pl.BlockSpec((1, BT), lambda i: (0, i))]
+            + [pl.BlockSpec((NL, BT), lambda i: (0, i))] * 12,
+            out_specs=[pl.BlockSpec((NL, BT), lambda i: (0, 0))] * 12,
+            interpret=LA.interpret(),
+        ),
+    )
+    return fn(live_i, *fN)
 
 
 # ---------------------------------------------------------------------------
