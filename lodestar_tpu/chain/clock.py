@@ -8,10 +8,13 @@ it manually (set_time) — a live node would tick it from wall time.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, List
 
 from .. import params
+
+_log = logging.getLogger("clock")
 
 
 class Clock:
@@ -37,13 +40,23 @@ class Clock:
         return self.genesis_time + slot * params.SECONDS_PER_SLOT
 
     def set_time(self, t: float) -> None:
-        """Advance the clock (replay driver); emits slot events."""
+        """Advance the clock (replay driver); emits slot events.
+
+        Listeners are ISOLATED: one misbehaving subsystem (e.g. a peer
+        returning garbage mid-heartbeat) must not starve the listeners
+        registered after it or abort the tick."""
         self._now = t
         slot = self.current_slot
         while self._last_emitted_slot < slot:
             self._last_emitted_slot += 1
             for fn in self._slot_listeners:
-                fn(self._last_emitted_slot)
+                try:
+                    fn(self._last_emitted_slot)
+                except Exception:  # noqa: BLE001 — isolate slot listeners
+                    _log.exception(
+                        "slot listener failed at slot %d",
+                        self._last_emitted_slot,
+                    )
 
     def tick_wall(self) -> None:
         self.set_time(time.time())

@@ -201,14 +201,6 @@ def clear_cofactor_g2_jac(q_jac):
 # -- jitted wrappers (ingest entry points) ----------------------------------
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _sds(shape):
-    return jax.ShapeDtypeStruct(shape, jnp.int32)
-
-
 def _tiled(kernel, ins, in_rows, out_rows, n):
     # cached launch: a per-call pallas_call re-traces the kernel body
     from . import launch as LA

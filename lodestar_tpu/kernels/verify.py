@@ -48,10 +48,6 @@ RAND_BITS = 64
 BT = 128  # lane tile: job sizes must be multiples of this
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
 # Baked constants (host-side numpy, python ints)
 _G1X = LY.const_mont(GC.G1_GEN[0])
 _G1Y = LY.const_mont(GC.G1_GEN[1])

@@ -1,0 +1,282 @@
+"""PeerManager — peer lifecycle: heartbeat, target count, ping/status.
+
+Mirror of the reference's peer manager (reference:
+packages/beacon-node/src/network/peers/peerManager.ts: the 30 s
+heartbeat loop, ping/status timeouts, and utils/prioritizePeers.ts'
+excess-peer pruning that protects subnet-duty peers and drops the
+worst-scored first).  Discovery is an injected candidate source — the
+discv5 UDP transport itself is off the TPU path (SURVEY §2.4 P6/P9);
+anything that can produce (peer_id, connect_fn) pairs plugs in.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .peers import PeerAction, PeerScoreBook, PeerStatus, ScoreState
+from .reqresp import ReqRespError
+
+HEARTBEAT_INTERVAL_S = 30.0  # reference: peerManager.ts HEARTBEAT_INTERVAL_MS
+PING_INTERVAL_INBOUND_S = 15.0  # reference: PING_INTERVAL_INBOUND_MS
+PING_INTERVAL_OUTBOUND_S = 20.0
+STATUS_INTERVAL_S = 300.0  # reference: STATUS_INTER_RELEVANT_PEERS_MS
+
+# goodbye reason codes (p2p spec)
+GOODBYE_CLIENT_SHUTDOWN = 1
+GOODBYE_IRRELEVANT_NETWORK = 2
+GOODBYE_ERROR = 3
+GOODBYE_TOO_MANY_PEERS = 129
+GOODBYE_BANNED = 251
+
+
+@dataclass
+class PeerData:
+    """reference: peers/peersData.ts PeerData."""
+
+    direction: str  # "inbound" | "outbound"
+    connected_at: float
+    last_ping: float = 0.0
+    last_status: float = 0.0
+    metadata: Optional[dict] = None  # {seq_number, attnets, syncnets}
+    agent: str = ""
+
+
+def prioritize_peers(
+    connected: Sequence[Tuple[str, float, Sequence[int]]],
+    active_subnets: Sequence[int],
+    target_peers: int,
+    max_peers: int,
+) -> Tuple[int, List[str]]:
+    """(peers_to_connect, peers_to_disconnect).
+
+    Distills the reference's prioritizePeers.ts: below target -> how
+    many to dial; above target -> drop the excess, worst score first,
+    PROTECTING peers that serve subnets we actively need.
+    `connected`: (peer_id, score, subnets_served)."""
+    n = len(connected)
+    if n < target_peers:
+        return target_peers - n, []
+    if n == target_peers:
+        return 0, []
+    needed = set(active_subnets)
+    protected = set()
+    # keep the best-scored provider per needed subnet
+    for subnet in needed:
+        best = None
+        for pid, score, subnets in connected:
+            if subnet in subnets and (best is None or score > best[1]):
+                best = (pid, score)
+        if best is not None:
+            protected.add(best[0])
+    excess = n - target_peers
+    candidates = sorted(
+        (p for p in connected if p[0] not in protected),
+        key=lambda p: p[1],  # worst score first
+    )
+    return 0, [pid for pid, _s, _n in candidates[:excess]]
+
+
+class PeerManager:
+    """Owns the connected-peer set over a ReqRespBeaconNode.
+
+    `discover(n) -> [(peer_id, connect_fn)]` supplies candidates;
+    `connect_fn()` must wire the transport and return True on success
+    (the in-memory bus pairs do this in tests; a real stack would dial).
+    """
+
+    def __init__(
+        self,
+        reqresp_node,
+        score_book: Optional[PeerScoreBook] = None,
+        target_peers: int = 55,  # reference: defaultNetworkOptions
+        max_peers: int = 65,
+        discover: Optional[Callable[[int], List]] = None,
+        active_subnets_fn: Optional[Callable[[], Sequence[int]]] = None,
+        clock=time.monotonic,
+    ):
+        self.node = reqresp_node
+        self.reqresp = reqresp_node.reqresp
+        self.score_book = score_book or PeerScoreBook()
+        self.target_peers = target_peers
+        self.max_peers = max_peers
+        self.discover = discover
+        self.active_subnets_fn = active_subnets_fn
+        self.clock = clock
+        self.peers: Dict[str, PeerData] = {}
+
+    # -- connection lifecycle ----------------------------------------------
+
+    def on_connect(
+        self, peer_id: str, direction: str, send: Callable
+    ) -> None:
+        """Transport established: register + handshake (reference:
+        onLibp2pPeerConnect -> requestStatus/Ping/Metadata)."""
+        self.reqresp.connect(peer_id, send)
+        self.peers[peer_id] = PeerData(
+            direction=direction, connected_at=self.clock()
+        )
+        try:
+            self.request_status(peer_id)
+            self.request_ping(peer_id)
+        except ReqRespError:
+            self.disconnect(peer_id, GOODBYE_ERROR)
+
+    def disconnect(self, peer_id: str, reason: int) -> None:
+        """Goodbye (best effort) + drop transport + forget."""
+        try:
+            self.reqresp.send_request(
+                peer_id, self.node.protocols["goodbye"], reason
+            )
+        except Exception:  # noqa: BLE001 — goodbye is courtesy
+            pass
+        self.forget(peer_id)
+
+    def forget(self, peer_id: str) -> None:
+        """Drop transport + registry WITHOUT a goodbye — for remote-
+        initiated goodbyes (the remote already left; sending one back
+        would just error)."""
+        self.reqresp.disconnect(peer_id)
+        self.peers.pop(peer_id, None)
+
+    @property
+    def connected_peers(self) -> List[str]:
+        return list(self.peers)
+
+    # -- req/resp exchanges ------------------------------------------------
+
+    @staticmethod
+    def _one_chunk(chunks, what: str) -> bytes:
+        """A single-response protocol MUST answer exactly one chunk; an
+        empty stream is a peer fault, not an IndexError."""
+        from .reqresp import RespCode
+
+        if not chunks:
+            raise ReqRespError(RespCode.SERVER_ERROR, f"empty {what} response")
+        return chunks[0][0]
+
+    def request_status(self, peer_id: str) -> None:
+        chunks = self.reqresp.send_request(
+            peer_id, self.node.protocols["status"], self.node._local_status()
+        )
+        from .reqresp_protocols import StatusType
+
+        st = StatusType.deserialize(self._one_chunk(chunks, "status"))
+        self.score_book.on_status(
+            peer_id,
+            PeerStatus(
+                fork_digest=bytes(st["fork_digest"]),
+                finalized_root=bytes(st["finalized_root"]),
+                finalized_epoch=int(st["finalized_epoch"]),
+                head_root=bytes(st["head_root"]),
+                head_slot=int(st["head_slot"]),
+            ),
+        )
+        if peer_id in self.peers:
+            self.peers[peer_id].last_status = self.clock()
+
+    def request_ping(self, peer_id: str) -> None:
+        """Ping; a seq ahead of our cached metadata triggers a metadata
+        re-fetch (reference: onPing -> requestMetadata on seq bump)."""
+        md = self.node.metadata_fn() if self.node.metadata_fn else {"seq_number": 0}
+        chunks = self.reqresp.send_request(
+            peer_id, self.node.protocols["ping"], int(md["seq_number"])
+        )
+        seq = int.from_bytes(self._one_chunk(chunks, "ping"), "little")
+        data = self.peers.get(peer_id)
+        if data is not None:
+            data.last_ping = self.clock()
+            known = (
+                int(data.metadata["seq_number"]) if data.metadata else -1
+            )
+            if seq > known:
+                self.request_metadata(peer_id)
+
+    def request_metadata(self, peer_id: str) -> None:
+        from .reqresp_protocols import METADATA_TYPE
+
+        chunks = self.reqresp.send_request(
+            peer_id, self.node.protocols["metadata"]
+        )
+        if peer_id in self.peers:
+            self.peers[peer_id].metadata = METADATA_TYPE.deserialize(
+                self._one_chunk(chunks, "metadata")
+            )
+
+    # -- the heartbeat (reference: peerManager.ts heartbeat) ---------------
+
+    def heartbeat(self) -> dict:
+        """One maintenance pass; returns what it did (observability)."""
+        actions = {"banned": [], "dialed": 0, "pruned": []}
+        # 1. drop banned/disconnect-scored peers
+        for pid in list(self.peers):
+            state = self.score_book.state(pid)
+            if state is ScoreState.banned:
+                self.disconnect(pid, GOODBYE_BANNED)
+                actions["banned"].append(pid)
+            elif state is ScoreState.disconnected:
+                self.disconnect(pid, GOODBYE_ERROR)
+                actions["banned"].append(pid)
+        # 2. below target: dial discovered candidates
+        subnets = (
+            list(self.active_subnets_fn()) if self.active_subnets_fn else []
+        )
+        to_connect, to_disconnect = prioritize_peers(
+            [
+                (pid, self.score_book.score(pid), self._peer_subnets(pid))
+                for pid in self.peers
+            ],
+            subnets,
+            self.target_peers,
+            self.max_peers,
+        )
+        if to_connect and self.discover is not None:
+            for peer_id, connect_fn in self.discover(to_connect):
+                if peer_id in self.peers:
+                    continue
+                # never dial a peer the score book still condemns
+                if self.score_book.state(peer_id) is not ScoreState.healthy:
+                    continue
+                send = connect_fn()
+                if send is not None:
+                    self.on_connect(peer_id, "outbound", send)
+                    # a failed handshake disconnects inside on_connect —
+                    # only a peer that SURVIVED counts toward the target
+                    if peer_id in self.peers:
+                        actions["dialed"] += 1
+                if actions["dialed"] >= to_connect:
+                    break
+        # 3. above target: prune the worst-scored unprotected peers
+        for pid in to_disconnect:
+            self.disconnect(pid, GOODBYE_TOO_MANY_PEERS)
+            actions["pruned"].append(pid)
+        return actions
+
+    def _peer_subnets(self, peer_id: str) -> List[int]:
+        md = self.peers[peer_id].metadata
+        if not md:
+            return []
+        return [i for i, bit in enumerate(md.get("attnets", [])) if bit]
+
+    def ping_and_status_timeouts(self) -> None:
+        """Re-ping / re-status stale peers (reference:
+        pingAndStatusTimeouts, CHECK_PING_STATUS_INTERVAL)."""
+        now = self.clock()
+        for pid, data in list(self.peers.items()):
+            interval = (
+                PING_INTERVAL_INBOUND_S
+                if data.direction == "inbound"
+                else PING_INTERVAL_OUTBOUND_S
+            )
+            try:
+                if now - data.last_ping > interval:
+                    self.request_ping(pid)
+                if now - data.last_status > STATUS_INTERVAL_S:
+                    self.request_status(pid)
+            except ReqRespError:
+                self.score_book.apply_action(pid, PeerAction.low_tolerance)
+
+    def close(self) -> None:
+        for pid in list(self.peers):
+            self.disconnect(pid, GOODBYE_CLIENT_SHUTDOWN)

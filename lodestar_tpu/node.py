@@ -45,6 +45,9 @@ class NodeOptions:
     gossip_bus: Optional[object] = None  # InMemoryGossipBus to join
     node_id: str = "node"  # bus identity
     active_validator_count_hint: int = 0  # for the scoring params
+    # discovery candidate source for the PeerManager:
+    # discover(n) -> [(peer_id, connect_fn)]
+    peer_discovery: Optional[object] = None
 
 
 class BeaconNode:
@@ -312,6 +315,8 @@ class FullBeaconNode:
                 "syncnets": syncnets,
             }
 
+        from .network.peer_manager import HEARTBEAT_INTERVAL_S, PeerManager
+
         self.reqresp = ReqResp()
         self.reqresp_node = ReqRespBeaconNode(
             self.reqresp,
@@ -320,8 +325,12 @@ class FullBeaconNode:
             db=self.db,
             light_client_server=self.light_client_server,
             metadata_fn=_metadata,
-            on_goodbye=lambda peer, reason: self.log.info(
-                "peer goodbye", peer=peer, reason=reason
+            # a remote goodbye means the peer already left: forget it so
+            # it stops counting toward the target and being pinged
+            # (self.peer_manager is created below; the lambda late-binds)
+            on_goodbye=lambda peer, reason: (
+                self.log.info("peer goodbye", peer=peer, reason=reason),
+                self.peer_manager.forget(peer),
             ),
             on_status=lambda peer, st: self.score_book.on_status(
                 peer,
@@ -335,10 +344,37 @@ class FullBeaconNode:
             ),
         )
 
+        # peer lifecycle over the req/resp surface (reference:
+        # peerManager.ts; discovery candidates come from opts)
+        self.peer_manager = PeerManager(
+            self.reqresp_node,
+            score_book=self.score_book,
+            discover=opts.peer_discovery,
+            active_subnets_fn=lambda: sorted(
+                self.attnets.active_subnets(
+                    self.clock.current_slot // params.SLOTS_PER_EPOCH,
+                    self.clock.current_slot,
+                )
+            ),
+        )
+        heartbeat_slots = max(
+            1, int(HEARTBEAT_INTERVAL_S // params.SECONDS_PER_SLOT)
+        )
+
         # clock wiring: processor ticks, boost lifecycle, cache pruning
         self.clock.on_slot(self.processor.on_clock_slot)
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
+        # ping/status cadence EVERY slot (the methods rate-limit by
+        # their own intervals); heartbeat on its own modulus
+        self.clock.on_slot(
+            lambda _s: self.peer_manager.ping_and_status_timeouts()
+        )
+        self.clock.on_slot(
+            lambda s: self.peer_manager.heartbeat()
+            if s % heartbeat_slots == 0
+            else None
+        )
         # rate-limiter TAT entries for churned peers must not pile up
         self.clock.on_slot(
             lambda s: self.reqresp.prune_limiters()

@@ -1,0 +1,199 @@
+"""PeerManager lifecycle: handshake, heartbeat, pruning, ping cadence.
+
+Reference behaviors: packages/beacon-node/src/network/peers/
+peerManager.ts (heartbeat loop, ping/status timeouts, goodbye reasons)
+and utils/prioritizePeers.ts (excess pruning, duty-peer protection).
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.network.peer_manager import (
+    GOODBYE_BANNED,
+    GOODBYE_TOO_MANY_PEERS,
+    PeerManager,
+    prioritize_peers,
+)
+from lodestar_tpu.network.peers import PeerAction, PeerScoreBook
+from lodestar_tpu.network.reqresp import ReqResp, connect_inmemory
+from lodestar_tpu.network.reqresp_protocols import (
+    METADATA_TYPE,
+    ReqRespBeaconNode,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def test_prioritize_peers_below_target():
+    n, drop = prioritize_peers([("a", 0.0, [])], [], target_peers=5, max_peers=8)
+    assert n == 4 and drop == []
+
+
+def test_prioritize_peers_prunes_worst_but_protects_subnet_providers():
+    connected = [
+        ("good", 5.0, []),
+        ("bad", -20.0, []),
+        ("provider", -30.0, [7]),  # worst score BUT serves subnet 7
+        ("mid", -1.0, []),
+    ]
+    n, drop = prioritize_peers(connected, [7], target_peers=2, max_peers=3)
+    assert n == 0
+    assert drop == ["bad", "mid"]  # worst unprotected first; provider kept
+
+
+class _World:
+    """A server node + a factory of client peers over in-memory wires."""
+
+    def __init__(self):
+        from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+        from lodestar_tpu.params import ForkName
+
+        self.cfg = create_chain_config(
+            MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+        )
+
+        class _St:
+            slot = 3
+            finalized_checkpoint = {"epoch": 0, "root": b"\x00" * 32}
+
+        class _Chain:
+            config = self.cfg
+            head_state = _St()
+
+            def get_head_root(self):
+                return b"\x07" * 32
+
+        self.now = [1000.0]
+        self.md_seq = [5]
+        self.server = ReqResp(clock=lambda: self.now[0])
+        self.node = ReqRespBeaconNode(
+            self.server,
+            self.cfg,
+            chain=_Chain(),
+            metadata_fn=lambda: {
+                "seq_number": self.md_seq[0],
+                "attnets": [i == 7 for i in range(params.ATTESTATION_SUBNET_COUNT)],
+                "syncnets": [False] * params.SYNC_COMMITTEE_SUBNET_COUNT,
+            },
+        )
+
+    def make_peer(self, name):
+        """A remote peer node; returns (send_fn_for_manager, its ReqResp)."""
+        remote = ReqResp(clock=lambda: self.now[0])
+        ReqRespBeaconNode(
+            remote,
+            self.cfg,
+            chain=self.node.chain,
+            metadata_fn=self.node.metadata_fn,
+        )
+        # manager-side transport into the remote; remote can answer back
+        remote.connect(
+            "manager", lambda pid, req: self.server.handle_request(name, pid, req)
+        )
+        return (
+            lambda pid, req: remote.handle_request("manager", pid, req),
+            remote,
+        )
+
+
+def test_handshake_heartbeat_and_pruning():
+    w = _World()
+    book = PeerScoreBook(clock=lambda: w.now[0])
+    candidates = {}
+    for name in ("p1", "p2", "p3", "p4"):
+        send, _remote = w.make_peer(name)
+        candidates[name] = send
+
+    def discover(n):
+        # a discovery source yields a candidate stream; the manager
+        # filters (connected/banned) and dials until satisfied
+        return [
+            (name, lambda s=send: s)
+            for name, send in candidates.items()
+        ]
+
+    mgr = PeerManager(
+        w.node,
+        score_book=book,
+        target_peers=3,
+        max_peers=4,
+        discover=discover,
+        clock=lambda: w.now[0],
+    )
+    # heartbeat dials up to target
+    actions = mgr.heartbeat()
+    assert actions["dialed"] == 3
+    assert len(mgr.connected_peers) == 3
+    # the handshake recorded status + fetched metadata (seq 5 > -1)
+    p = mgr.peers[mgr.connected_peers[0]]
+    assert book.status_of(mgr.connected_peers[0]).head_slot == 3
+    assert p.metadata is not None and int(p.metadata["seq_number"]) == 5
+
+    # a banned peer is dropped on the next heartbeat
+    banned = mgr.connected_peers[0]
+    book.apply_action(banned, PeerAction.fatal)
+    actions = mgr.heartbeat()
+    assert banned in actions["banned"]
+    assert banned not in mgr.peers
+    # ...and the heartbeat refilled toward target from candidates
+    assert len(mgr.connected_peers) == 3
+
+    # over-target pruning drops the worst score
+    extra = [n for n in candidates if n not in mgr.peers][0]
+    mgr.on_connect(extra, "inbound", candidates[extra])
+    mgr.target_peers = 2
+    worst = mgr.connected_peers[0]
+    book.add(worst, -5.0)  # worst, but still above the disconnect gate
+    actions = mgr.heartbeat()
+    assert worst in actions["pruned"]
+    assert len(mgr.connected_peers) == 2
+
+
+def test_ping_seq_bump_triggers_metadata_refetch():
+    w = _World()
+    send, _remote = w.make_peer("px")
+    mgr = PeerManager(w.node, clock=lambda: w.now[0])
+    mgr.on_connect("px", "outbound", send)
+    assert int(mgr.peers["px"].metadata["seq_number"]) == 5
+    # bump the remote's metadata seq; cadence re-ping sees it
+    w.md_seq[0] = 6
+    w.now[0] += 25.0  # past PING_INTERVAL_OUTBOUND_S
+    mgr.ping_and_status_timeouts()
+    assert int(mgr.peers["px"].metadata["seq_number"]) == 6
+
+
+def test_close_sends_goodbyes():
+    from lodestar_tpu.network.peer_manager import GOODBYE_CLIENT_SHUTDOWN
+
+    w = _World()
+    send, remote = w.make_peer("pz")
+    # intercept the goodbye on the REMOTE node's handler
+    seen = []
+    gp = "/eth2/beacon_chain/req/goodbye/1/ssz_snappy"
+    orig = remote._handlers[gp]
+    remote._handlers[gp] = lambda peer, reason: (
+        seen.append(int(reason)),
+        orig(peer, reason),
+    )[1]
+    mgr = PeerManager(w.node, clock=lambda: w.now[0])
+    mgr.on_connect("pz", "outbound", send)
+    mgr.close()
+    assert mgr.connected_peers == []
+    assert seen == [GOODBYE_CLIENT_SHUTDOWN]
+
+
+def test_remote_goodbye_forgets_without_reply():
+    """forget() drops a remote-goodbyed peer without sending a goodbye
+    back (the remote already left)."""
+    w = _World()
+    send, remote = w.make_peer("pq")
+    mgr = PeerManager(w.node, clock=lambda: w.now[0])
+    mgr.on_connect("pq", "outbound", send)
+    sent = []
+    gp = "/eth2/beacon_chain/req/goodbye/1/ssz_snappy"
+    remote._handlers[gp] = lambda peer, reason: (
+        sent.append(reason), [(b"\x00" * 8, None)])[1]
+    mgr.forget("pq")
+    assert mgr.connected_peers == []
+    assert sent == []  # no goodbye traveled
