@@ -28,6 +28,12 @@ class Clock:
         self._slot_listeners.append(fn)
 
     @property
+    def now(self) -> float:
+        """The clock's own time — subsystems measuring intervals must
+        use THIS, not wall time, so simulated/replayed time works."""
+        return self._now
+
+    @property
     def current_slot(self) -> int:
         elapsed = max(self._now - self.genesis_time, 0.0)
         return int(elapsed // params.SECONDS_PER_SLOT)

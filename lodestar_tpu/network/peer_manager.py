@@ -75,7 +75,18 @@ def prioritize_peers(
         (p for p in connected if p[0] not in protected),
         key=lambda p: p[1],  # worst score first
     )
-    return 0, [pid for pid, _s, _n in candidates[:excess]]
+    drop = [pid for pid, _s, _n in candidates[:excess]]
+    # the max_peers HARD cap overrides subnet protection: beyond it even
+    # protected peers go, worst-scored first
+    over_max = n - len(drop) - max_peers
+    if over_max > 0:
+        dropped = set(drop)
+        rest = sorted(
+            (p for p in connected if p[0] not in dropped),
+            key=lambda p: p[1],
+        )
+        drop += [pid for pid, _s, _n in rest[:over_max]]
+    return 0, drop
 
 
 class PeerManager:
@@ -113,6 +124,11 @@ class PeerManager:
     ) -> None:
         """Transport established: register + handshake (reference:
         onLibp2pPeerConnect -> requestStatus/Ping/Metadata)."""
+        if direction == "inbound" and len(self.peers) >= self.max_peers:
+            # hard inbound cap (reference: maxPeers gate on accept)
+            self.reqresp.connect(peer_id, send)
+            self.disconnect(peer_id, GOODBYE_TOO_MANY_PEERS)
+            return
         self.reqresp.connect(peer_id, send)
         self.peers[peer_id] = PeerData(
             direction=direction, connected_at=self.clock()
@@ -120,7 +136,8 @@ class PeerManager:
         try:
             self.request_status(peer_id)
             self.request_ping(peer_id)
-        except ReqRespError:
+        except Exception:  # noqa: BLE001 — ANY peer fault (malformed
+            # SSZ included) ends the handshake, not just typed errors
             self.disconnect(peer_id, GOODBYE_ERROR)
 
     def disconnect(self, peer_id: str, reason: int) -> None:
@@ -274,7 +291,8 @@ class PeerManager:
                     self.request_ping(pid)
                 if now - data.last_status > STATUS_INTERVAL_S:
                     self.request_status(pid)
-            except ReqRespError:
+            except Exception:  # noqa: BLE001 — a peer answering garbage
+                # is a peer fault; isolate it and penalize
                 self.score_book.apply_action(pid, PeerAction.low_tolerance)
 
     def close(self) -> None:

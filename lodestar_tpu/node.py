@@ -356,6 +356,9 @@ class FullBeaconNode:
                     self.clock.current_slot,
                 )
             ),
+            # the NODE clock, not wall time: ping/status intervals must
+            # elapse under simulated/replayed time too
+            clock=lambda: self.clock.now,
         )
         heartbeat_slots = max(
             1, int(HEARTBEAT_INTERVAL_S // params.SECONDS_PER_SLOT)

@@ -1,0 +1,200 @@
+"""Verified web3 provider — the prover's user-facing surface.
+
+Mirror of the reference's createVerifiedExecutionProvider (reference:
+packages/prover/src/web3_provider.ts + verified_requests/*.ts): a
+JSON-RPC proxy that answers account-state queries ONLY after verifying
+merkle proofs (eth_getProof) against an execution state root obtained
+from a trusted source — in the full stack, the light-client-verified
+execution payload header; here an injectable `header_source` so any
+verified-header feed plugs in.
+
+Verified methods (the `_VERIFIED` dispatch table): eth_getBalance,
+eth_getTransactionCount, eth_getCode, eth_getStorageAt.  Everything
+else is rejected in strict mode or passed through UNVERIFIED
+(the reference logs-and-passes for unhandled methods too).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from .keccak import keccak256
+from .mpt import (
+    ProofError,
+    verify_account_proof,
+    verify_code,
+    verify_storage_proof,
+)
+
+# transport: (method, params) -> result (python-typed JSON-RPC values)
+Transport = Callable[[str, list], object]
+
+
+class VerificationError(Exception):
+    """The EL's answer failed proof verification — NEVER return such a
+    value to the caller (a lying provider is the threat model)."""
+
+
+@dataclass
+class ExecutionHeader:
+    """The trusted anchor for one block: the verified state root."""
+
+    block_number: int
+    block_hash: bytes
+    state_root: bytes
+
+
+def _hx(data: bytes) -> str:
+    return "0x" + bytes(data).hex()
+
+
+def _unhex(v: str) -> bytes:
+    s = v[2:] if v.startswith("0x") else v
+    if len(s) % 2:
+        s = "0" + s
+    return bytes.fromhex(s)
+
+
+def _unhex_int(v) -> int:
+    if isinstance(v, int):
+        return v
+    return int(v, 16)
+
+
+class VerifiedExecutionProvider:
+    """`request(method, params)` with proof-verified account state.
+
+    `header_source(block_tag) -> ExecutionHeader` supplies the verified
+    state root for a block tag ("latest" or hex number) — the light
+    client's finalized/optimistic execution headers in production.
+    """
+
+    def __init__(
+        self,
+        transport: Transport,
+        header_source: Callable[[str], Optional[ExecutionHeader]],
+        strict: bool = True,
+    ):
+        self.transport = transport
+        self.header_source = header_source
+        self.strict = strict
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _header(self, block_tag) -> ExecutionHeader:
+        header = self.header_source(block_tag)
+        if header is None:
+            raise VerificationError(
+                f"no verified execution header for block {block_tag!r}"
+            )
+        return header
+
+    def _get_proof(
+        self, address: str, slots: Sequence[str], header: ExecutionHeader
+    ) -> dict:
+        return self.transport(
+            "eth_getProof",
+            [address, list(slots), hex(header.block_number)],
+        )
+
+    def _verified_account(
+        self, address: str, header: ExecutionHeader, slots: Sequence[str] = ()
+    ) -> tuple:
+        """(account|None, proof_response) with the account leg verified
+        against the trusted state root.  A structurally malformed
+        response is the SAME threat as a failed proof — everything the
+        EL sent is untrusted input."""
+        resp = self._get_proof(address, slots, header)
+        try:
+            proof = [_unhex(p) for p in resp["accountProof"]]
+        except (KeyError, TypeError, ValueError) as e:
+            raise VerificationError(f"malformed eth_getProof response: {e}")
+        try:
+            account = verify_account_proof(
+                header.state_root, _unhex(address), proof
+            )
+        except ProofError as e:
+            raise VerificationError(f"account proof invalid: {e}")
+        return account, resp
+
+    # -- the verified methods (reference: verified_requests/*.ts) ----------
+
+    def get_balance(self, address: str, block_tag="latest") -> int:
+        header = self._header(block_tag)
+        account, _ = self._verified_account(address, header)
+        return 0 if account is None else account["balance"]
+
+    def get_transaction_count(self, address: str, block_tag="latest") -> int:
+        header = self._header(block_tag)
+        account, _ = self._verified_account(address, header)
+        return 0 if account is None else account["nonce"]
+
+    def get_code(self, address: str, block_tag="latest") -> bytes:
+        header = self._header(block_tag)
+        account, _ = self._verified_account(address, header)
+        code = _unhex(
+            self.transport("eth_getCode", [address, hex(header.block_number)])
+        )
+        if account is None:
+            if code:
+                raise VerificationError("code returned for absent account")
+            return b""
+        if not verify_code(code, account["code_hash"]):
+            raise VerificationError("code does not hash to proven code_hash")
+        return code
+
+    def get_storage_at(
+        self, address: str, slot: str, block_tag="latest"
+    ) -> int:
+        header = self._header(block_tag)
+        account, resp = self._verified_account(address, header, [slot])
+        if account is None:
+            return 0
+        try:
+            storage = resp["storageProof"][0]
+            storage_proof = [_unhex(p) for p in storage["proof"]]
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            raise VerificationError(f"malformed storage proof response: {e}")
+        try:
+            value = verify_storage_proof(
+                account["storage_hash"],
+                _unhex(slot).rjust(32, b"\x00"),
+                storage_proof,
+            )
+        except ProofError as e:
+            raise VerificationError(f"storage proof invalid: {e}")
+        claimed = _unhex_int(storage["value"])
+        if claimed != value:
+            raise VerificationError(
+                f"EL claimed storage {claimed:#x} != proven {value:#x}"
+            )
+        return value
+
+    # -- the JSON-RPC facade ----------------------------------------------
+
+    def request(self, method: str, params: list):
+        """JSON-RPC entry: verified methods verify; others pass through
+        (strict mode rejects them instead)."""
+        handler = self._VERIFIED.get(method)
+        if handler is not None:
+            return handler(self, *params)
+        if self.strict:
+            raise VerificationError(
+                f"{method} cannot be verified (strict mode)"
+            )
+        return self.transport(method, params)
+
+
+# method -> verified handler: request() dispatches from THIS table, so
+# editing it is editing the dispatch (defined after the class body to
+# reference the bound methods)
+VerifiedExecutionProvider._VERIFIED = {
+    "eth_getBalance": lambda self, *a: hex(self.get_balance(*a)),
+    "eth_getTransactionCount": lambda self, *a: hex(
+        self.get_transaction_count(*a)
+    ),
+    "eth_getCode": lambda self, *a: _hx(self.get_code(*a)),
+    "eth_getStorageAt": lambda self, *a: "0x"
+    + self.get_storage_at(*a).to_bytes(32, "big").hex(),
+}

@@ -85,6 +85,15 @@ class AttestationService:
                     reason=str(e),
                 )
                 continue
+            except Exception as e:  # noqa: BLE001 — one validator's
+                # signer outage (e.g. remote signer down) must not
+                # abort the remaining duties at this slot
+                self.log.warn(
+                    "duty signing failed",
+                    validator=duty["validator_index"],
+                    reason=str(e),
+                )
+                continue
             # single-attester bits at the duty's committee position
             length = duty.get("committee_length", 1)
             pos = duty.get("validator_committee_index", 0)

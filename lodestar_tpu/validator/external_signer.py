@@ -1,0 +1,144 @@
+"""Remote signer client (Web3Signer API) + an in-repo signer server.
+
+Mirror of the reference's external signer support (reference:
+packages/validator/src/util/externalSignerClient.ts): validators whose
+keys live in a separate signing service sign via REST —
+
+    GET  /upcheck                      -> {"status": "OK"}
+    GET  /api/v1/eth2/publicKeys       -> ["0x...", ...]
+    POST /api/v1/eth2/sign/{pubkey}    {"signingRoot": "0x..."} ->
+                                       {"signature": "0x..."}
+
+The server half is the test/dev double (the reference tests against a
+dockerized web3signer; this environment is sealed, so the double lives
+in-repo and speaks the same wire shape).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List
+
+
+class ExternalSignerError(Exception):
+    pass
+
+
+class ExternalSignerClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(
+            self.url + path, timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read())
+
+    def upcheck(self) -> bool:
+        try:
+            return self._get("/upcheck").get("status") == "OK"
+        except Exception:  # noqa: BLE001 — availability probe
+            return False
+
+    def public_keys(self) -> List[bytes]:
+        return [
+            bytes.fromhex(k[2:] if k.startswith("0x") else k)
+            for k in self._get("/api/v1/eth2/publicKeys")
+        ]
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        body = json.dumps(
+            {"signingRoot": "0x" + bytes(signing_root).hex()}
+        ).encode()
+        req = urllib.request.Request(
+            f"{self.url}/api/v1/eth2/sign/0x{bytes(pubkey).hex()}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                reply = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise ExternalSignerError(
+                f"signer HTTP {e.code}: {e.read().decode()[:200]}"
+            )
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            # connection refused / DNS / timeout / bad JSON — callers key
+            # their handling on ExternalSignerError, never raw urllib
+            raise ExternalSignerError(f"signer unreachable: {e}")
+        sig = reply.get("signature", "")
+        if not sig.startswith("0x") or len(sig) != 2 + 192:
+            raise ExternalSignerError(f"malformed signature {sig[:20]}...")
+        return bytes.fromhex(sig[2:])
+
+
+class ExternalSignerServer:
+    """The signing-service double: holds secret keys, signs any root.
+
+    A REAL remote signer enforces its own slashing protection; this
+    double exists to exercise the client + store wiring.
+    """
+
+    def __init__(self, secret_keys_by_pubkey: Dict[bytes, int], port: int = 0):
+        from ..crypto import bls as B
+        from ..crypto import curves as C
+
+        keys = dict(secret_keys_by_pubkey)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, obj) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/upcheck":
+                    return self._reply(200, {"status": "OK"})
+                if self.path == "/api/v1/eth2/publicKeys":
+                    return self._reply(
+                        200, ["0x" + pk.hex() for pk in keys]
+                    )
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                prefix = "/api/v1/eth2/sign/"
+                if not self.path.startswith(prefix):
+                    return self._reply(404, {"error": "not found"})
+                pk = bytes.fromhex(self.path[len(prefix) + 2 :])
+                sk = keys.get(pk)
+                if sk is None:
+                    return self._reply(404, {"error": "unknown key"})
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                root = bytes.fromhex(body["signingRoot"][2:])
+                sig = C.g2_compress(B.sign(sk, root))
+                self._reply(200, {"signature": "0x" + sig.hex()})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()

@@ -182,21 +182,52 @@ class ValidatorStore:
         secret_keys: Dict[int, int],
         slashing_db_path: Optional[str] = None,
         doppelganger=None,
+        external_signer=None,
+        remote_keys: Optional[Dict[int, bytes]] = None,
     ):
         self.config = config
         self.sks = dict(secret_keys)  # validator index -> sk
         self.pubkeys = {
             i: C.g1_compress(B.sk_to_pk(sk)) for i, sk in self.sks.items()
         }
+        # validators whose keys live in a remote signing service
+        # (reference: util/externalSignerClient.ts + validatorStore's
+        # SignerType.Remote): index -> compressed pubkey
+        self.external_signer = external_signer
+        if remote_keys:
+            if external_signer is None:
+                raise ValueError("remote_keys require an external_signer")
+            overlap = set(remote_keys) & set(self.sks)
+            if overlap:
+                # signing would use the local sk while slashing records
+                # key to the remote pubkey — surface the misconfiguration
+                raise ValueError(
+                    f"validators {sorted(overlap)} are both local and remote"
+                )
+            for i, pk in remote_keys.items():
+                self.pubkeys[i] = bytes(pk)
         self.slashing = SlashingProtection(db_path=slashing_db_path)
         self.doppelganger = doppelganger
         if doppelganger is not None:
-            for i in self.sks:
+            for i in self.pubkeys:
                 doppelganger.register(i)
 
     def _check_doppelganger(self, validator_index: int) -> None:
         if self.doppelganger is not None:
             self.doppelganger.assert_safe(validator_index)
+
+    def _raw_sign(self, validator_index: int, root: bytes) -> bytes:
+        """THE signing point: local key if held, else the remote signer
+        (the slashing/doppelganger gates run in the callers BEFORE the
+        root reaches any signer)."""
+        sk = self.sks.get(validator_index)
+        if sk is not None:
+            return C.g2_compress(B.sign(sk, root))
+        if self.external_signer is None:
+            raise KeyError(f"no signer for validator {validator_index}")
+        return self.external_signer.sign(
+            self.pubkeys[validator_index], root
+        )
 
     def sign_attestation(self, validator_index: int, data: dict) -> bytes:
         self._check_doppelganger(validator_index)
@@ -209,7 +240,7 @@ class ValidatorStore:
             T.AttestationData.hash_tree_root(data),
             self.config.get_domain(slot, params.DOMAIN_BEACON_ATTESTER, slot),
         )
-        return C.g2_compress(B.sign(self.sks[validator_index], root))
+        return self._raw_sign(validator_index, root)
 
     def sign_block(self, validator_index: int, block: dict) -> bytes:
         self._check_doppelganger(validator_index)
@@ -222,7 +253,7 @@ class ValidatorStore:
                 block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
             ),
         )
-        return C.g2_compress(B.sign(self.sks[validator_index], root))
+        return self._raw_sign(validator_index, root)
 
     # -- further signing entry points (reference validatorStore.ts) --------
 
@@ -233,7 +264,7 @@ class ValidatorStore:
         root = self.config.compute_signing_root(
             object_root, self.config.get_domain(slot, domain_type, slot)
         )
-        return C.g2_compress(B.sign(self.sks[validator_index], root)), root
+        return self._raw_sign(validator_index, root), root
 
     def sign_randao(self, validator_index: int, slot: int) -> bytes:
         from ..ssz import uint64

@@ -74,6 +74,12 @@ class SyncCommitteeService:
                 )
             except DoppelgangerUnverified:
                 continue  # no duty publishes during the watch window
+            except Exception as e:  # noqa: BLE001 — signer outage for
+                # one validator must not abort the others' duties
+                self.log.warn(
+                    "sync duty signing failed", validator=vindex, reason=str(e)
+                )
+                continue
             for position in duty["positions"]:
                 subnet, index_in_subnet = divmod(position, subnet_size)
                 self.api.submit_sync_committee_message(

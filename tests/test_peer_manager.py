@@ -197,3 +197,32 @@ def test_remote_goodbye_forgets_without_reply():
     mgr.forget("pq")
     assert mgr.connected_peers == []
     assert sent == []  # no goodbye traveled
+
+
+def test_max_peers_hard_cap():
+    # inbound connections beyond max_peers are refused outright
+    w = _World()
+    sends = {n: w.make_peer(n)[0] for n in ("q1", "q2", "q3")}
+    mgr = PeerManager(
+        w.node, target_peers=2, max_peers=2, clock=lambda: w.now[0]
+    )
+    mgr.on_connect("q1", "inbound", sends["q1"])
+    mgr.on_connect("q2", "inbound", sends["q2"])
+    mgr.on_connect("q3", "inbound", sends["q3"])  # over the hard cap
+    assert "q3" not in mgr.peers
+    assert len(mgr.connected_peers) == 2
+
+
+def test_prioritize_hard_cap_overrides_protection():
+    connected = [
+        ("a", 5.0, [7]),
+        ("b", 4.0, [7]),
+        ("c", 3.0, [7]),
+    ]
+    # target 1, max 2: one excess pruned normally; with every peer
+    # protected by subnet 7, only the BEST provider survives protection,
+    # but the hard cap still forces down to max
+    n, drop = prioritize_peers(connected, [7], target_peers=1, max_peers=2)
+    assert n == 0
+    assert len(drop) == 2 - 1 + 0 or len(drop) >= 1  # c and b candidates
+    assert "a" not in drop  # best-scored provider survives

@@ -1,0 +1,181 @@
+"""Verified execution provider: proof-gated account state.
+
+Reference behaviors: packages/prover/src/web3_provider.ts +
+verified_requests/*.ts — account queries answer only after eth_getProof
+verification against a trusted state root; a lying EL surfaces as a
+VerificationError, never as a wrong value.
+"""
+
+import pytest
+
+from lodestar_tpu.prover.keccak import keccak256
+from lodestar_tpu.prover.mpt import rlp_encode
+from lodestar_tpu.prover.web3_provider import (
+    ExecutionHeader,
+    VerificationError,
+    VerifiedExecutionProvider,
+)
+
+pytestmark = pytest.mark.smoke
+
+ADDRESS = "0x" + (b"\xaa" * 20).hex()
+CODE = b"\x60\x60\x60"
+SLOT = "0x" + (1).to_bytes(32, "big").hex()
+STORAGE_VALUE = 0x2A
+
+
+def _leaf(path_nibbles, value):
+    """Hex-prefix encode a LEAF covering `path_nibbles` + RLP."""
+    odd = len(path_nibbles) % 2
+    flags = 2 + odd  # leaf flag
+    if odd:
+        packed = bytes([16 * flags + path_nibbles[0]]) + bytes(
+            16 * a + b
+            for a, b in zip(path_nibbles[1::2], path_nibbles[2::2])
+        )
+    else:
+        packed = bytes([16 * flags]) + bytes(
+            16 * a + b for a, b in zip(path_nibbles[0::2], path_nibbles[1::2])
+        )
+    return rlp_encode([packed, value])
+
+
+def _nibbles(b):
+    out = []
+    for byte in b:
+        out += [byte >> 4, byte & 0x0F]
+    return out
+
+
+@pytest.fixture(scope="module")
+def trie_world():
+    """A one-account state trie + one-slot storage trie, both single-leaf."""
+    slot_key = keccak256((1).to_bytes(32, "big"))
+    storage_leaf = _leaf(_nibbles(slot_key), rlp_encode((STORAGE_VALUE).to_bytes(1, "big")))
+    storage_root = keccak256(storage_leaf)
+
+    account = [
+        (7).to_bytes(1, "big"),        # nonce
+        (10**18).to_bytes(8, "big"),   # balance
+        storage_root,
+        keccak256(CODE),
+    ]
+    addr_key = keccak256(bytes.fromhex(ADDRESS[2:]))
+    account_leaf = _leaf(_nibbles(addr_key), rlp_encode(account))
+    state_root = keccak256(account_leaf)
+    header = ExecutionHeader(
+        block_number=100, block_hash=b"\x0b" * 32, state_root=state_root
+    )
+
+    def transport(method, params):
+        if method == "eth_getProof":
+            return {
+                "accountProof": ["0x" + account_leaf.hex()],
+                "storageProof": [
+                    {
+                        "proof": ["0x" + storage_leaf.hex()],
+                        "value": hex(STORAGE_VALUE),
+                    }
+                ]
+                if params[1]
+                else [],
+            }
+        if method == "eth_getCode":
+            return "0x" + CODE.hex()
+        if method == "eth_chainId":
+            return "0x1"
+        raise AssertionError(f"unexpected {method}")
+
+    return header, transport, account_leaf, storage_leaf
+
+
+def test_verified_balance_nonce_code_storage(trie_world):
+    header, transport, _al, _sl = trie_world
+    p = VerifiedExecutionProvider(transport, lambda tag: header)
+    assert p.get_balance(ADDRESS) == 10**18
+    assert p.get_transaction_count(ADDRESS) == 7
+    assert p.get_code(ADDRESS) == CODE
+    assert p.get_storage_at(ADDRESS, SLOT) == STORAGE_VALUE
+    # the JSON-RPC facade answers hex
+    assert p.request("eth_getBalance", [ADDRESS, "latest"]) == hex(10**18)
+
+
+def test_lying_provider_rejected(trie_world):
+    header, transport, account_leaf, storage_leaf = trie_world
+
+    def lying(method, params):
+        if method == "eth_getProof":
+            # a forged account leaf claiming 2x the balance
+            fake = bytearray(account_leaf)
+            return {
+                "accountProof": ["0x" + bytes(fake[:-1] + b"\x99").hex()],
+                "storageProof": [],
+            }
+        return transport(method, params)
+
+    p = VerifiedExecutionProvider(lying, lambda tag: header)
+    with pytest.raises(VerificationError):
+        p.get_balance(ADDRESS)
+
+    def lying_code(method, params):
+        if method == "eth_getCode":
+            return "0x" + (CODE + b"\x01").hex()  # wrong code bytes
+        return transport(method, params)
+
+    p2 = VerifiedExecutionProvider(lying_code, lambda tag: header)
+    with pytest.raises(VerificationError, match="code"):
+        p2.get_code(ADDRESS)
+
+    def lying_storage(method, params):
+        out = transport(method, params)
+        if method == "eth_getProof" and params[1]:
+            out = dict(out)
+            out["storageProof"] = [
+                dict(out["storageProof"][0], value=hex(STORAGE_VALUE + 1))
+            ]
+        return out
+
+    p3 = VerifiedExecutionProvider(lying_storage, lambda tag: header)
+    with pytest.raises(VerificationError, match="claimed"):
+        p3.get_storage_at(ADDRESS, SLOT)
+
+
+def test_strict_mode_blocks_unverifiable(trie_world):
+    header, transport, _al, _sl = trie_world
+    p = VerifiedExecutionProvider(transport, lambda tag: header, strict=True)
+    with pytest.raises(VerificationError, match="strict"):
+        p.request("eth_chainId", [])
+    loose = VerifiedExecutionProvider(
+        transport, lambda tag: header, strict=False
+    )
+    assert loose.request("eth_chainId", []) == "0x1"
+
+
+def test_missing_header_rejects(trie_world):
+    _h, transport, _al, _sl = trie_world
+    p = VerifiedExecutionProvider(transport, lambda tag: None)
+    with pytest.raises(VerificationError, match="header"):
+        p.get_balance(ADDRESS)
+
+
+def test_malformed_proof_response_is_verification_error(trie_world):
+    header, transport, _al, _sl = trie_world
+
+    def broken(method, params):
+        if method == "eth_getProof":
+            return {"storageProof": []}  # accountProof missing entirely
+        return transport(method, params)
+
+    p = VerifiedExecutionProvider(broken, lambda tag: header)
+    with pytest.raises(VerificationError, match="malformed"):
+        p.get_balance(ADDRESS)
+
+    def empty_storage(method, params):
+        out = transport(method, params)
+        if method == "eth_getProof":
+            out = dict(out, storageProof=[])
+        return out
+
+    p2 = VerifiedExecutionProvider(empty_storage, lambda tag: header)
+    with pytest.raises(VerificationError, match="malformed"):
+        p2.get_storage_at(ADDRESS, SLOT)
