@@ -88,6 +88,42 @@ ROUTES: Tuple[Route, ...] = (
     ),
     # debug namespace (reference: routes/debug.ts — checkpoint sync source)
     Route("GET", "/eth/v2/debug/beacon/states/{state_id}", "get_debug_state"),
+    Route("GET", "/eth/v2/debug/beacon/heads", "get_debug_heads"),
+    Route("GET", "/eth/v1/debug/fork_choice", "get_debug_fork_choice"),
+    # light_client namespace (reference: routes/lightclient.ts)
+    Route(
+        "GET",
+        "/eth/v1/beacon/light_client/bootstrap/{block_root}",
+        "get_light_client_bootstrap",
+    ),
+    Route(
+        "GET", "/eth/v1/beacon/light_client/updates", "get_light_client_updates"
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/light_client/finality_update",
+        "get_light_client_finality_update",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/light_client/optimistic_update",
+        "get_light_client_optimistic_update",
+    ),
+    # builder namespace (reference: routes/beacon/state.ts)
+    Route(
+        "GET",
+        "/eth/v1/builder/states/{state_id}/expected_withdrawals",
+        "get_expected_withdrawals",
+    ),
+    # node namespace additions (reference: routes/node.ts)
+    Route("GET", "/eth/v1/node/identity", "get_node_identity"),
+    Route("GET", "/eth/v1/node/peers", "get_node_peers"),
+    # proof namespace (reference: routes/proof.ts)
+    Route("GET", "/eth/v0/beacon/proof/state/{state_id}", "get_state_proof"),
+    # keymanager namespace (reference: api/src/keymanager/routes.ts)
+    Route("GET", "/eth/v1/keystores", "list_keys"),
+    Route("GET", "/eth/v1/remotekeys", "list_remote_keys"),
+    Route("DELETE", "/eth/v1/remotekeys", "delete_remote_keys"),
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)

@@ -31,6 +31,9 @@ class DefaultHandlers:
         spec: Optional[dict] = None,
         chain=None,
         attnets=None,
+        light_client_server=None,
+        peer_manager=None,
+        validator_store=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -41,6 +44,9 @@ class DefaultHandlers:
         self.spec = spec or {}
         self.chain = chain  # BeaconChain for the stateful endpoints
         self.attnets = attnets  # AttnetsService for duty subscriptions
+        self.light_client_server = light_client_server
+        self.peer_manager = peer_manager  # node/peers namespace
+        self.validator_store = validator_store  # keymanager namespace
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -610,6 +616,299 @@ class DefaultHandlers:
             }
         }
 
+    # -- light_client namespace (reference: api/src/beacon/routes/
+    # lightclient.ts served by chain/lightClient) --------------------------
+
+    def _need_lc(self):
+        if self.light_client_server is None:
+            return 501, {"message": "no light client server wired"}
+        return None
+
+    def _lc_update_json(self, upd) -> dict:
+        from ..network.reqresp_protocols import (
+            LightClientUpdateType,
+            light_client_update_to_value,
+        )
+        from .encoding import to_json
+
+        return to_json(LightClientUpdateType, light_client_update_to_value(upd))
+
+    def get_light_client_bootstrap(self, params, body):
+        err = self._need_lc()
+        if err:
+            return err
+        root = bytes.fromhex(params["block_root"].replace("0x", ""))
+        boot = self.light_client_server.get_bootstrap(root)
+        if boot is None:
+            return 404, {"message": "no bootstrap for root"}
+        from ..network.reqresp_protocols import LightClientBootstrapType
+        from .encoding import to_json
+
+        return 200, {"data": to_json(LightClientBootstrapType, boot)}
+
+    def get_light_client_updates(self, params, body):
+        err = self._need_lc()
+        if err:
+            return err
+        start = int(params.get("start_period", 0))
+        count = min(int(params.get("count", 1)), 128)
+        out = []
+        for period in range(start, start + count):
+            upd = self.light_client_server.get_update(period)
+            if upd is not None:
+                # per-item version: consumers key container decoding on
+                # the update's fork (Beacon API response shape)
+                slot = int(upd.attested_header["slot"])
+                out.append(
+                    {
+                        "version": (
+                            self.chain.config.get_fork_name(slot).value
+                            if self.chain is not None
+                            else "altair"
+                        ),
+                        "data": self._lc_update_json(upd),
+                    }
+                )
+        return 200, out
+
+    def get_light_client_finality_update(self, params, body):
+        err = self._need_lc()
+        if err:
+            return err
+        upd = self.light_client_server.get_finality_update()
+        if upd is None:
+            return 404, {"message": "no finality update available"}
+        return 200, {"data": self._lc_update_json(upd)}
+
+    def get_light_client_optimistic_update(self, params, body):
+        err = self._need_lc()
+        if err:
+            return err
+        upd = self.light_client_server.get_optimistic_update()
+        if upd is None:
+            return 404, {"message": "no optimistic update available"}
+        return 200, {"data": self._lc_update_json(upd)}
+
+    # -- debug namespace: fork choice + heads (reference: api/src/beacon/
+    # routes/debug.ts) -----------------------------------------------------
+
+    def get_debug_heads(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        arr = self.chain.fork_choice.proto
+        child_parents = {n.parent for n in arr.nodes if n.parent is not None}
+        heads = [
+            {
+                # roots travel as the array's hex identifiers
+                "root": "0x" + n.root if len(n.root) == 64 else n.root,
+                "slot": str(n.slot),
+                "execution_optimistic": n.root
+                in getattr(self.chain, "optimistic_roots", set()),
+            }
+            for i, n in enumerate(arr.nodes)
+            if i not in child_parents
+        ]
+        return 200, {"data": heads}
+
+    def get_debug_fork_choice(self, params, body):
+        """The proto-array dump (reference: debug.ts getDebugForkChoice)."""
+        err = self._need_chain()
+        if err:
+            return err
+        arr = self.chain.fork_choice.proto
+        def _root_hex(r):
+            # 64-hex array identifiers travel 0x-prefixed like every
+            # other root on this API; symbolic test roots pass through
+            return "0x" + r if len(r) == 64 else r
+
+        nodes = [
+            {
+                "root": _root_hex(n.root),
+                "parent_root": (
+                    _root_hex(arr.nodes[n.parent].root)
+                    if n.parent is not None
+                    else None
+                ),
+                "slot": str(n.slot),
+                "weight": str(int(n.weight)),
+                "validity": (
+                    "optimistic"
+                    if n.root in getattr(self.chain, "optimistic_roots", set())
+                    else "valid"
+                ),
+                "justified_epoch": str(n.justified_epoch),
+                "finalized_epoch": str(n.finalized_epoch),
+            }
+            for n in arr.nodes
+        ]
+        return 200, {
+            "justified_checkpoint": {
+                "epoch": str(
+                    self.chain.head_state.current_justified_checkpoint["epoch"]
+                ),
+            },
+            "fork_choice_nodes": nodes,
+        }
+
+    # -- builder namespace (reference: api/src/beacon/routes/beacon/
+    # state.ts getExpectedWithdrawals) -------------------------------------
+
+    def get_expected_withdrawals(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..state_transition.block import get_expected_withdrawals
+
+        st = self.chain.head_state
+        if st.next_withdrawal_index is None:
+            return 400, {"message": "pre-capella state has no withdrawals"}
+        return 200, {
+            "data": [
+                {
+                    "index": str(w["index"]),
+                    "validator_index": str(w["validator_index"]),
+                    "address": "0x" + bytes(w["address"]).hex(),
+                    "amount": str(w["amount"]),
+                }
+                for w in get_expected_withdrawals(st)
+            ]
+        }
+
+    # -- node peers namespace (reference: api/src/beacon/routes/node.ts) ---
+
+    def get_node_identity(self, params, body):
+        return 200, {
+            "data": {
+                "peer_id": getattr(self.peer_manager, "node_id", "self")
+                if self.peer_manager
+                else "self",
+                "enr": "",
+                "p2p_addresses": [],
+                "discovery_addresses": [],
+                "metadata": {},
+            }
+        }
+
+    def get_node_peers(self, params, body):
+        if self.peer_manager is None:
+            return 200, {"data": [], "meta": {"count": 0}}
+        out = [
+            {
+                "peer_id": pid,
+                "state": "connected",
+                "direction": data.direction,
+                "last_seen_p2p_address": "",
+            }
+            for pid, data in self.peer_manager.peers.items()
+        ]
+        return 200, {"data": out, "meta": {"count": len(out)}}
+
+    # -- proof namespace (reference: api/src/beacon/routes/proof.ts over
+    # createProof; the producer here is ssz.container_branch) --------------
+
+    def get_state_proof(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        path = params.get("paths", "")
+        parts = [p for p in path.split(".") if p]
+        if not parts:
+            return 400, {"message": "paths query parameter required"}
+        from ..ssz.core import container_branch
+
+        st = self.chain.head_state
+        try:
+            leaf, branch, depth, index = container_branch(
+                st._container(), st.to_value(), parts
+            )
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"message": f"bad path: {e}"}
+        return 200, {
+            "data": {
+                "leaf": "0x" + leaf.hex(),
+                "branch": ["0x" + b.hex() for b in branch],
+                "depth": depth,
+                "index": index,
+                "state_root": "0x" + st.hash_tree_root().hex(),
+            }
+        }
+
+    # -- keymanager namespace (reference: api/src/keymanager/routes.ts;
+    # remote-key records are crypto-free, local keystores list/delete) -----
+
+    def _need_store(self):
+        if self.validator_store is None:
+            return 501, {"message": "no validator store wired"}
+        return None
+
+    def list_keys(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        store = self.validator_store
+        # LOCAL keystores only — remote keys list under /remotekeys
+        # (keymanager API separates the two namespaces)
+        return 200, {
+            "data": [
+                {
+                    "validating_pubkey": "0x" + pk.hex(),
+                    "derivation_path": "",
+                    "readonly": False,
+                }
+                for i, pk in sorted(store.pubkeys.items())
+                if i in store.sks
+            ]
+        }
+
+    def list_remote_keys(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        store = self.validator_store
+        url = (
+            getattr(store.external_signer, "url", "")
+            if store.external_signer
+            else ""
+        )
+        return 200, {
+            "data": [
+                {"pubkey": "0x" + pk.hex(), "url": url, "readonly": False}
+                for i, pk in sorted(store.pubkeys.items())
+                if i not in store.sks
+            ]
+        }
+
+    def delete_remote_keys(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        store = self.validator_store
+        statuses = []
+        for entry in (body or {}).get("pubkeys", []):
+            try:
+                hexpart = entry[2:] if entry.startswith("0x") else entry
+                pk = bytes.fromhex(hexpart)
+            except (ValueError, AttributeError):
+                # per-key error status: one malformed entry must not
+                # abort deletion of the valid keys after it
+                statuses.append({"status": "error"})
+                continue
+            idx = next(
+                (
+                    i
+                    for i, p in store.pubkeys.items()
+                    if p == pk and i not in store.sks
+                ),
+                None,
+            )
+            if idx is None:
+                statuses.append({"status": "not_found"})
+            else:
+                del store.pubkeys[idx]
+                statuses.append({"status": "deleted"})
+        return 200, {"data": statuses}
+
 
 class BeaconApiServer:
     def __init__(self, handlers, host: str = "127.0.0.1", port: int = 0):
@@ -676,6 +975,9 @@ class BeaconApiServer:
 
             def do_POST(self):  # noqa: N802
                 self._respond("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._respond("DELETE")
 
             def log_message(self, *args):
                 pass

@@ -397,6 +397,9 @@ class FullBeaconNode:
                     bls_service=self.bls,
                     chain=self.chain,
                     spec={"SECONDS_PER_SLOT": params.SECONDS_PER_SLOT},
+                    attnets=self.attnets,
+                    light_client_server=self.light_client_server,
+                    peer_manager=self.peer_manager,
                 ),
                 port=opts.api_port,
             )

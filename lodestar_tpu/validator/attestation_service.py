@@ -45,7 +45,9 @@ class AttestationService:
     # -- duties (reference: attestationDuties.ts pollBeaconAttesters) ------
 
     def poll_duties(self, epoch: int) -> None:
-        indices = sorted(self.store.sks)
+        # ALL managed validators — remote-signer keys live in pubkeys
+        # only (store.sks holds just the local ones)
+        indices = sorted(self.store.pubkeys)
         duties = self.api.get_attester_duties(epoch, indices)
         self._duties[epoch] = duties
         for old in [e for e in self._duties if e < epoch - 1]:

@@ -27,7 +27,9 @@ class BlockProposalService:
         self.skipped_slashable = 0
 
     def poll_duties(self, epoch: int) -> None:
-        indices = sorted(self.store.sks)
+        # ALL managed validators — remote-signer keys live in pubkeys
+        # only (store.sks holds just the local ones)
+        indices = sorted(self.store.pubkeys)
         duties = self.api.get_proposer_duties(epoch)
         self._duties[epoch] = [
             d for d in duties if d["validator_index"] in indices

@@ -48,7 +48,9 @@ class SyncCommitteeService:
 
     def poll_duties(self, epoch: int) -> None:
         period = self.period_of(epoch)
-        indices = sorted(self.store.sks)
+        # ALL managed validators — remote-signer keys live in pubkeys
+        # only (store.sks holds just the local ones)
+        indices = sorted(self.store.pubkeys)
         self._duties[period] = self.api.get_sync_committee_duties(
             epoch, indices
         )

@@ -214,15 +214,22 @@ def test_max_peers_hard_cap():
 
 
 def test_prioritize_hard_cap_overrides_protection():
+    # each peer is the SOLE best provider of a needed subnet, so normal
+    # excess pruning finds no unprotected candidates — only the max_peers
+    # hard-cap branch can bring the count down, and it drops the
+    # worst-scored protected peer
     connected = [
-        ("a", 5.0, [7]),
-        ("b", 4.0, [7]),
-        ("c", 3.0, [7]),
+        ("a", 5.0, [1]),
+        ("b", 4.0, [2]),
+        ("c", 3.0, [3]),
     ]
-    # target 1, max 2: one excess pruned normally; with every peer
-    # protected by subnet 7, only the BEST provider survives protection,
-    # but the hard cap still forces down to max
-    n, drop = prioritize_peers(connected, [7], target_peers=1, max_peers=2)
+    n, drop = prioritize_peers(
+        connected, [1, 2, 3], target_peers=1, max_peers=2
+    )
     assert n == 0
-    assert len(drop) == 2 - 1 + 0 or len(drop) >= 1  # c and b candidates
-    assert "a" not in drop  # best-scored provider survives
+    assert drop == ["c"]  # worst-scored goes despite protection
+    # without the cap pressure nothing is dropped (all protected)
+    n2, drop2 = prioritize_peers(
+        connected, [1, 2, 3], target_peers=1, max_peers=3
+    )
+    assert drop2 == []
