@@ -422,6 +422,133 @@ def gen_operations(out: str) -> None:
     )
 
 
+def gen_capella_operations(out: str) -> None:
+    """Capella operation vectors in the upstream case shapes:
+    operations/withdrawals (op file `execution_payload`) and
+    operations/bls_to_execution_change (op file `address_change`)."""
+    from lodestar_tpu.state_transition.block import (
+        get_expected_withdrawals,
+        process_bls_to_execution_change,
+        process_withdrawals,
+    )
+    from lodestar_tpu.state_transition.slot import (
+        upgrade_to_bellatrix,
+        upgrade_to_capella,
+    )
+
+    cfg_cap = dataclasses.replace(
+        create_chain_config(
+            MAINNET_CHAIN_CONFIG,
+            fork_epochs={
+                ForkName.altair: 0,
+                ForkName.bellatrix: 0,
+                ForkName.capella: 0,
+            },
+        ),
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    sks = [B.keygen(b"spec-cap-%d" % i) for i in range(8)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg_cap, pks, genesis_time=2)
+    upgrade_to_bellatrix(genesis)
+    upgrade_to_capella(genesis)
+    base = os.path.join(out, "consensus", "capella", "operations")
+
+    def case(op_name, case_name, op_file, op_type, op_value, apply_fn,
+             valid=True, setup=None):
+        case_dir = os.path.join(base, op_name, case_name)
+        pre = genesis.clone()
+        process_slots(pre, 2)
+        if setup is not None:
+            setup(pre)
+        write_ssz(case_dir, "pre", pre.serialize())
+        write_ssz(case_dir, op_file, op_type.serialize(op_value))
+        write_json(
+            os.path.join(case_dir, "meta.json"),
+            {"config": {"fork": "capella"}, "bls_setting": 1},
+        )
+        if valid:
+            apply_fn(pre, op_value)
+            write_ssz(case_dir, "post", pre.serialize())
+        else:
+            from lodestar_tpu.state_transition.block import BlockProcessError
+
+            failed = False
+            try:
+                apply_fn(pre, op_value)
+            except BlockProcessError:
+                # the SPECIFIC error the runner's pytest.raises expects:
+                # a TypeError here is a generator bug, not an invalid op
+                failed = True
+            if not failed:
+                raise RuntimeError(f"{op_name}/{case_name} unexpectedly valid")
+
+    # withdrawals: validator 1 becomes partially withdrawable
+    def make_withdrawable(state):
+        state.withdrawal_credentials[1] = (
+            params.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x11" * 20
+        )
+        state.balances[1] = P.MAX_EFFECTIVE_BALANCE + 12345
+
+    probe = genesis.clone()
+    process_slots(probe, 2)
+    make_withdrawable(probe)
+    payload = T.ExecutionPayloadCapella.default()
+    payload["withdrawals"] = get_expected_withdrawals(probe)
+    case(
+        "withdrawals", "valid", "execution_payload",
+        T.ExecutionPayloadCapella, payload,
+        lambda st, p: process_withdrawals(st, p),
+        setup=make_withdrawable,
+    )
+    bad_payload = T.ExecutionPayloadCapella.default()
+    bad_payload["withdrawals"] = [
+        dict(w, amount=int(w["amount"]) + 1) for w in payload["withdrawals"]
+    ]
+    case(
+        "withdrawals", "invalid_amount", "execution_payload",
+        T.ExecutionPayloadCapella, bad_payload,
+        lambda st, p: process_withdrawals(st, p),
+        valid=False, setup=make_withdrawable,
+    )
+
+    # bls_to_execution_change: genesis creds hash the signing key
+    change = {
+        "validator_index": 3,
+        "from_bls_pubkey": pks[3],
+        "to_execution_address": b"\x33" * 20,
+    }
+    domain = cfg_cap.compute_domain(
+        params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg_cap.fork_versions[ForkName.phase0],
+        genesis.genesis_validators_root,
+    )
+    signed = {
+        "message": change,
+        "signature": _sign_root(
+            sks[3],
+            cfg_cap.compute_signing_root(
+                T.BLSToExecutionChange.hash_tree_root(change), domain
+            ),
+        ),
+    }
+    case(
+        "bls_to_execution_change", "valid", "address_change",
+        T.SignedBLSToExecutionChange, signed,
+        lambda st, c: process_bls_to_execution_change(st, c, True),
+    )
+    wrong = {
+        "message": dict(change, from_bls_pubkey=pks[4]),
+        "signature": signed["signature"],
+    }
+    case(
+        "bls_to_execution_change", "invalid_wrong_pubkey", "address_change",
+        T.SignedBLSToExecutionChange, wrong,
+        lambda st, c: process_bls_to_execution_change(st, c, True),
+        valid=False,
+    )
+
+
 def gen_epoch_processing(out: str) -> None:
     from lodestar_tpu.state_transition.epoch import (
         EpochTransitionCache,
@@ -540,6 +667,8 @@ def main():
     gen_hash_to_curve(args.out)
     print("generating operations ...")
     gen_operations(args.out)
+    print("generating capella operations ...")
+    gen_capella_operations(args.out)
     print("generating epoch_processing ...")
     gen_epoch_processing(args.out)
     print("generating ssz_static ...")

@@ -18,6 +18,9 @@ class Route:
     method: str
     path: str  # template with {param} segments
     handler: str  # name on the handler object
+    # keymanager-style routes require the bearer token when the server
+    # has one configured (reference: keymanager authEnabled)
+    auth: bool = False
 
 
 ROUTES: Tuple[Route, ...] = (
@@ -120,10 +123,11 @@ ROUTES: Tuple[Route, ...] = (
     Route("GET", "/eth/v1/node/peers", "get_node_peers"),
     # proof namespace (reference: routes/proof.ts)
     Route("GET", "/eth/v0/beacon/proof/state/{state_id}", "get_state_proof"),
-    # keymanager namespace (reference: api/src/keymanager/routes.ts)
-    Route("GET", "/eth/v1/keystores", "list_keys"),
-    Route("GET", "/eth/v1/remotekeys", "list_remote_keys"),
-    Route("DELETE", "/eth/v1/remotekeys", "delete_remote_keys"),
+    # keymanager namespace (reference: api/src/keymanager/routes.ts —
+    # bearer-token-authenticated; see BeaconApiServer's auth gate)
+    Route("GET", "/eth/v1/keystores", "list_keys", auth=True),
+    Route("GET", "/eth/v1/remotekeys", "list_remote_keys", auth=True),
+    Route("DELETE", "/eth/v1/remotekeys", "delete_remote_keys", auth=True),
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)

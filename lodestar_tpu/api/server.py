@@ -34,6 +34,7 @@ class DefaultHandlers:
         light_client_server=None,
         peer_manager=None,
         validator_store=None,
+        keymanager_token: Optional[str] = None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -47,6 +48,8 @@ class DefaultHandlers:
         self.light_client_server = light_client_server
         self.peer_manager = peer_manager  # node/peers namespace
         self.validator_store = validator_store  # keymanager namespace
+        # bearer token gating the keymanager routes; None = disabled
+        self.keymanager_token = keymanager_token
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -637,7 +640,13 @@ class DefaultHandlers:
         err = self._need_lc()
         if err:
             return err
-        root = bytes.fromhex(params["block_root"].replace("0x", ""))
+        raw = params["block_root"]
+        try:
+            root = bytes.fromhex(raw[2:] if raw.startswith("0x") else raw)
+            if len(root) != 32:
+                raise ValueError("not 32 bytes")
+        except ValueError as e:
+            return 400, {"message": f"invalid block root: {e}"}
         boot = self.light_client_server.get_bootstrap(root)
         if boot is None:
             return 404, {"message": "no bootstrap for root"}
@@ -692,6 +701,12 @@ class DefaultHandlers:
     # -- debug namespace: fork choice + heads (reference: api/src/beacon/
     # routes/debug.ts) -----------------------------------------------------
 
+    @staticmethod
+    def _root_hex(r: str) -> str:
+        """64-hex proto-array identifiers travel 0x-prefixed like every
+        other root on this API; symbolic test roots pass through."""
+        return "0x" + r if len(r) == 64 else r
+
     def get_debug_heads(self, params, body):
         err = self._need_chain()
         if err:
@@ -700,8 +715,7 @@ class DefaultHandlers:
         child_parents = {n.parent for n in arr.nodes if n.parent is not None}
         heads = [
             {
-                # roots travel as the array's hex identifiers
-                "root": "0x" + n.root if len(n.root) == 64 else n.root,
+                "root": self._root_hex(n.root),
                 "slot": str(n.slot),
                 "execution_optimistic": n.root
                 in getattr(self.chain, "optimistic_roots", set()),
@@ -717,16 +731,11 @@ class DefaultHandlers:
         if err:
             return err
         arr = self.chain.fork_choice.proto
-        def _root_hex(r):
-            # 64-hex array identifiers travel 0x-prefixed like every
-            # other root on this API; symbolic test roots pass through
-            return "0x" + r if len(r) == 64 else r
-
         nodes = [
             {
-                "root": _root_hex(n.root),
+                "root": self._root_hex(n.root),
                 "parent_root": (
-                    _root_hex(arr.nodes[n.parent].root)
+                    self._root_hex(arr.nodes[n.parent].root)
                     if n.parent is not None
                     else None
                 ),
@@ -754,13 +763,26 @@ class DefaultHandlers:
     # -- builder namespace (reference: api/src/beacon/routes/beacon/
     # state.ts getExpectedWithdrawals) -------------------------------------
 
+    def _head_only_state(self, state_id: str):
+        """(state, None) for ids this composition serves from head, or
+        (None, error) — silently answering head data for other ids would
+        present head-divergent values as finalized/genesis."""
+        if state_id == "head":
+            return self.chain.head_state, None
+        return None, (
+            400,
+            {"message": f"unsupported state id {state_id!r} (head only)"},
+        )
+
     def get_expected_withdrawals(self, params, body):
         err = self._need_chain()
         if err:
             return err
         from ..state_transition.block import get_expected_withdrawals
 
-        st = self.chain.head_state
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
         if st.next_withdrawal_index is None:
             return 400, {"message": "pre-capella state has no withdrawals"}
         return 200, {
@@ -817,7 +839,9 @@ class DefaultHandlers:
             return 400, {"message": "paths query parameter required"}
         from ..ssz.core import container_branch
 
-        st = self.chain.head_state
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
         try:
             leaf, branch, depth, index = container_branch(
                 st._container(), st.to_value(), parts
@@ -924,6 +948,21 @@ class BeaconApiServer:
                     self._send(404, {"message": "route not found"})
                     return
                 route, params = m
+                if route.auth:
+                    # keymanager-namespace routes are bearer-token gated
+                    # (reference: the keymanager server's authEnabled);
+                    # without a configured token they are NOT served
+                    token = getattr(outer_handlers, "keymanager_token", None)
+                    if token is None:
+                        self._send(
+                            403,
+                            {"message": "keymanager API disabled (no token)"},
+                        )
+                        return
+                    got = self.headers.get("Authorization", "")
+                    if got != f"Bearer {token}":
+                        self._send(401, {"message": "invalid bearer token"})
+                        return
                 # query params merge under the path params (reference:
                 # fastify querystring handling)
                 for k, v in parse_qsl(split.query):

@@ -80,6 +80,7 @@ def world():
             light_client_server=lc,
             peer_manager=_FakePeerManager(),
             validator_store=store,
+            keymanager_token="km-secret",
         )
     )
     server.listen()
@@ -88,8 +89,11 @@ def world():
     server.close()
 
 
-def _get(base, path):
-    with urllib.request.urlopen(base + path, timeout=30) as r:
+def _get(base, path, token=None):
+    req = urllib.request.Request(base + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=30) as r:
         return json.loads(r.read())
 
 
@@ -133,25 +137,39 @@ def test_proof_namespace_state_proof(world):
 
 
 def test_keymanager_lists_and_deletes_remote_keys(world):
+    import urllib.error
+
     cfg, sks, chain, lc, store, base = world
-    keys = _get(base, "/eth/v1/keystores")
-    assert len(keys["data"]) == N_KEYS
+    # unauthenticated access to keymanager routes is rejected
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/eth/v1/keystores")
+    assert ei.value.code == 401
+    keys = _get(base, "/eth/v1/keystores", token="km-secret")
+    assert len(keys["data"]) == N_KEYS  # LOCAL keystores only
     assert all(not k["readonly"] for k in keys["data"])
     # add a remote key record directly (import path needs a signer URL)
     extra_pk = C.g1_compress(B.sk_to_pk(B.keygen(b"remote-x")))
     store.external_signer = object()
     store.pubkeys[99] = extra_pk
-    remote = _get(base, "/eth/v1/remotekeys")
+    keys2 = _get(base, "/eth/v1/keystores", token="km-secret")
+    assert len(keys2["data"]) == N_KEYS  # the remote key is NOT a keystore
+    remote = _get(base, "/eth/v1/remotekeys", token="km-secret")
     assert [r["pubkey"] for r in remote["data"]] == ["0x" + extra_pk.hex()]
     req = urllib.request.Request(
         base + "/eth/v1/remotekeys",
-        data=json.dumps({"pubkeys": ["0x" + extra_pk.hex()]}).encode(),
+        data=json.dumps(
+            {"pubkeys": ["0xzz-malformed", "0x" + extra_pk.hex()]}
+        ).encode(),
         method="DELETE",
-        headers={"Content-Type": "application/json"},
+        headers={
+            "Content-Type": "application/json",
+            "Authorization": "Bearer km-secret",
+        },
     )
     with urllib.request.urlopen(req, timeout=30) as r:
         out = json.loads(r.read())
-    assert out["data"] == [{"status": "deleted"}]
+    # per-key statuses: the malformed entry errors, the valid one deletes
+    assert out["data"] == [{"status": "error"}, {"status": "deleted"}]
     assert 99 not in store.pubkeys
 
 

@@ -164,6 +164,65 @@ def test_operations_vectors():
     check_all_consumed(consumed, "consensus", "altair", "operations")
 
 
+# -- consensus: capella operations (withdrawals + address changes) ----------
+
+CFG_CAPELLA = dataclasses.replace(
+    create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={
+            ForkName.altair: 0,
+            ForkName.bellatrix: 0,
+            ForkName.capella: 0,
+        },
+    ),
+    SHARD_COMMITTEE_PERIOD=0,
+)
+
+# op_name -> (operation file name, ssz type, apply) — the upstream
+# capella case shapes (operations/withdrawals carries the payload as
+# `execution_payload`, bls_to_execution_change as `address_change`)
+CAPELLA_OPERATION_TYPES = {
+    "withdrawals": (
+        "execution_payload",
+        T.ExecutionPayloadCapella,
+        lambda BL, st, op: BL.process_withdrawals(st, op),
+    ),
+    "bls_to_execution_change": (
+        "address_change",
+        T.SignedBLSToExecutionChange,
+        lambda BL, st, op: BL.process_bls_to_execution_change(st, op, True),
+    ),
+}
+
+
+def test_capella_operations_vectors():
+    from lodestar_tpu.state_transition import block as BL
+    from lodestar_tpu.state_transition.block import BlockProcessError
+
+    consumed = {}
+    for op_name, (op_file, typ, apply_fn) in CAPELLA_OPERATION_TYPES.items():
+        consumed[op_name] = 0
+        for case_dir in iter_case_dirs(
+            "consensus", "capella", "operations", op_name
+        ):
+            consumed[op_name] += 1
+            pre = BeaconState.deserialize(
+                read_ssz_snappy(case_dir, "pre"), CFG_CAPELLA
+            )
+            assert pre.next_withdrawal_index is not None, (
+                "capella pre state lost its withdrawal fields"
+            )
+            op = typ.deserialize(read_ssz_snappy(case_dir, op_file))
+            post_bytes = maybe_read_ssz_snappy(case_dir, "post")
+            if post_bytes is None:
+                with pytest.raises(BlockProcessError):
+                    apply_fn(BL, pre, op)
+            else:
+                apply_fn(BL, pre, op)
+                assert pre.serialize() == post_bytes, case_dir
+    check_all_consumed(consumed, "consensus", "capella", "operations")
+
+
 # -- consensus: epoch processing (reference: presets/epoch_processing.ts) ---
 
 
