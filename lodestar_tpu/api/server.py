@@ -959,8 +959,10 @@ class BeaconApiServer:
                             {"message": "keymanager API disabled (no token)"},
                         )
                         return
+                    import hmac as _hmac
+
                     got = self.headers.get("Authorization", "")
-                    if got != f"Bearer {token}":
+                    if not _hmac.compare_digest(got, f"Bearer {token}"):
                         self._send(401, {"message": "invalid bearer token"})
                         return
                 # query params merge under the path params (reference:

@@ -442,6 +442,48 @@ class GossipValidators:
         self.chain.op_pool.insert_voluntary_exit(signed_exit)
         return vindex
 
+    # -- bls_to_execution_change (capella; reference: validation/
+    # blsToExecutionChange.ts) ---------------------------------------------
+
+    def validate_bls_to_execution_change_gossip(self, signed_change: dict) -> int:
+        """ACCEPT inserts into the op pool; returns the validator index."""
+        change = signed_change["message"]
+        vindex = int(change["validator_index"])
+        pool = getattr(self.chain, "op_pool", None)
+        if pool is not None and vindex in pool._bls_to_execution_changes:
+            _ignore("change already known for validator")
+        head = self.chain.head_state
+        if vindex >= head.num_validators:
+            _reject("unknown validator index")
+        if (
+            bytes(head.withdrawal_credentials[vindex][:1])
+            != params.BLS_WITHDRAWAL_PREFIX
+        ):
+            _ignore("credentials already rotated")
+        # structural + credential checks via the STF on a throwaway
+        # clone (signature verified through the batch extractor below)
+        from ..state_transition.block import process_bls_to_execution_change
+        from ..state_transition.signature_sets import (
+            get_bls_to_execution_change_signature_sets,
+        )
+
+        try:
+            process_bls_to_execution_change(
+                head.clone(), signed_change, verify_signatures=False
+            )
+        except Exception as e:  # noqa: BLE001 — STF validation failure
+            _reject(f"invalid change: {e}")
+        view = self._view()
+        wrapper = {
+            "message": {"body": {"bls_to_execution_changes": [signed_change]}}
+        }
+        self._verify(
+            get_bls_to_execution_change_signature_sets(view, wrapper)
+        )
+        if pool is not None:
+            pool.insert_bls_to_execution_change(signed_change)
+        return vindex
+
     # -- blob_sidecar_{subnet} (deneb; reference: validation/
     # blobsSidecar.ts updated to the per-blob mainnet sidecar shape) -------
 

@@ -38,6 +38,10 @@ class GossipTopicName(str, enum.Enum):
     sync_committee = "sync_committee_{subnet}"
     light_client_finality_update = "light_client_finality_update"
     light_client_optimistic_update = "light_client_optimistic_update"
+    # capella (reference: gossip/interface.ts GossipType additions)
+    bls_to_execution_change = "bls_to_execution_change"
+    # deneb: one subnet per blob index
+    blob_sidecar = "blob_sidecar_{subnet}"
 
 
 def topic_string(

@@ -38,7 +38,7 @@ class GossipHandlers:
     reference's message deserialization errors.
     """
 
-    def __init__(self, chain, verifier, current_slot_fn=None):
+    def __init__(self, chain, verifier, current_slot_fn=None, kzg_setup=None):
         self.chain = chain
         self.validators = GossipValidators(
             chain, verifier, current_slot_fn=current_slot_fn
@@ -47,6 +47,9 @@ class GossipHandlers:
         self.seen_block_proposers = SeenBlockProposers()
         self.results: Dict[str, Dict[str, int]] = {}
         self._last_pruned_slot = 0
+        # deneb blob verification needs a KZG trusted setup; without one
+        # the blob topics are not served
+        self.kzg_setup = kzg_setup
 
     def _block_is_timely(self, slot: int) -> bool:
         """Measured arrival delay < 1/3 slot (reference: forkChoice.ts
@@ -174,6 +177,17 @@ class GossipHandlers:
                 T.SignedContributionAndProof.deserialize(payload)
             )
             return None
+        if name == "bls_to_execution_change":
+            v.validate_bls_to_execution_change_gossip(
+                T.SignedBLSToExecutionChange.deserialize(payload)
+            )
+            return None
+        if name.startswith("blob_sidecar_"):
+            subnet = int(name.rsplit("_", 1)[1])
+            self.handle_blob_sidecar(
+                T.BlobSidecar.deserialize(payload), subnet
+            )
+            return None
         if name.startswith("sync_committee_"):
             subnet = int(name.rsplit("_", 1)[1])
             v.validate_sync_committee_message(
@@ -183,6 +197,20 @@ class GossipHandlers:
         raise GossipValidationError(
             GossipAction.REJECT, f"no handler for topic {name}"
         )
+
+    def handle_blob_sidecar(self, sidecar: dict, subnet: int) -> None:
+        """The blob_sidecar_{subnet} topic body (value level, so tests
+        at non-preset blob widths can drive it without SSZ)."""
+        if self.kzg_setup is None:
+            raise GossipValidationError(
+                GossipAction.IGNORE, "no KZG setup loaded"
+            )
+        if int(sidecar["index"]) != subnet:
+            # sidecars ride the subnet of their own index (p2p spec)
+            raise GossipValidationError(
+                GossipAction.REJECT, "sidecar index != subnet"
+            )
+        self.validators.validate_blob_sidecar(sidecar, self.kzg_setup)
 
     # -- subscriptions (reference: network.ts subscribeGossipCoreTopics) ---
 
@@ -218,5 +246,19 @@ class GossipHandlers:
             topic_string(fork_digest, GossipTopicName.sync_committee, subnet=s)
             for s in syncnets
         ]
+        # capella-era topics (per-fork topic sets; reference: forks.ts
+        # getCoreTopicsAtFork — harmless pre-fork on the bus transport)
+        from .. import params as _p
+
+        topics.append(
+            topic_string(fork_digest, GossipTopicName.bls_to_execution_change)
+        )
+        if self.kzg_setup is not None:
+            topics += [
+                topic_string(
+                    fork_digest, GossipTopicName.blob_sidecar, subnet=i
+                )
+                for i in range(_p.MAX_BLOBS_PER_BLOCK)
+            ]
         for t in topics:
             bus.subscribe(node_id, t, self.handle, scorer=scorer)

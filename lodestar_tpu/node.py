@@ -48,6 +48,11 @@ class NodeOptions:
     # discovery candidate source for the PeerManager:
     # discover(n) -> [(peer_id, connect_fn)]
     peer_discovery: Optional[object] = None
+    # KZG trusted setup (crypto/kzg.TrustedSetup) enabling the deneb
+    # blob_sidecar gossip topics; None = blobs not served
+    kzg_setup: Optional[object] = None
+    # bearer token enabling the keymanager REST namespace; None = off
+    keymanager_token: Optional[str] = None
 
 
 class BeaconNode:
@@ -247,6 +252,7 @@ class FullBeaconNode:
             self.chain,
             verifier,
             current_slot_fn=lambda: self.clock.current_slot,
+            kzg_setup=opts.kzg_setup,
         )
         self.scorer = None
         n_val = opts.active_validator_count_hint or anchor_state.num_validators
@@ -400,6 +406,7 @@ class FullBeaconNode:
                     attnets=self.attnets,
                     light_client_server=self.light_client_server,
                     peer_manager=self.peer_manager,
+                    keymanager_token=opts.keymanager_token,
                 ),
                 port=opts.api_port,
             )

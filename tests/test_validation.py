@@ -565,3 +565,137 @@ def test_blob_sidecar_validation(world):
     assert v.validate_blob_sidecar(
         sidecars[1], setup, body_type=T.BeaconBlockBodyDeneb
     ) == bytes(got_root)
+
+
+def test_bls_to_execution_change_gossip_flow(world):
+    """capella: change rides the bus, validates, lands in the op pool;
+    duplicates IGNORE; junk pubkeys REJECT."""
+    from lodestar_tpu.chain.validation import (
+        GossipValidationError,
+        GossipValidators,
+    )
+
+    w = world
+    index = 5
+    change = {
+        "validator_index": index,
+        "from_bls_pubkey": w["pks"][index],
+        "to_execution_address": b"\x55" * 20,
+    }
+    domain = w["cfg"].compute_domain(
+        params.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        w["cfg"].fork_versions[ForkName.phase0],
+        w["genesis"].genesis_validators_root,
+    )
+    root = w["cfg"].compute_signing_root(
+        T.BLSToExecutionChange.hash_tree_root(change), domain
+    )
+    signed = {
+        "message": change,
+        "signature": C.g2_compress(B.sign(w["sks"][index], root)),
+    }
+    n = _publish(
+        w,
+        GossipTopicName.bls_to_execution_change,
+        T.SignedBLSToExecutionChange,
+        signed,
+    )
+    assert n == 1
+    res = w["handlers"].results["bls_to_execution_change"]
+    assert res.get("accept") == 1
+    assert index in w["chain_b"].op_pool._bls_to_execution_changes
+    # a SECOND change for the same validator (different address, so the
+    # bus message-id dedup does not swallow it) -> validator IGNORE
+    change2 = dict(change, to_execution_address=b"\x66" * 20)
+    root2 = w["cfg"].compute_signing_root(
+        T.BLSToExecutionChange.hash_tree_root(change2), domain
+    )
+    signed2 = {
+        "message": change2,
+        "signature": C.g2_compress(B.sign(w["sks"][index], root2)),
+    }
+    _publish(
+        w,
+        GossipTopicName.bls_to_execution_change,
+        T.SignedBLSToExecutionChange,
+        signed2,
+    )
+    assert res.get("ignore") == 1
+    # wrong withdrawal pubkey -> REJECT
+    v = GossipValidators(w["chain_a"], w["verifier"])
+    bad = {
+        "message": dict(change, from_bls_pubkey=w["pks"][(index + 1) % N_KEYS]),
+        "signature": signed["signature"],
+    }
+    with pytest.raises(GossipValidationError, match="invalid change"):
+        v.validate_bls_to_execution_change_gossip(bad)
+
+
+def test_blob_sidecar_gossip_flow(world):
+    """deneb blob sidecars over the bus: index-matched subnet ACCEPTs;
+    a sidecar published on the wrong subnet REJECTs."""
+    import hashlib as _hl
+
+    from lodestar_tpu.chain import blobs as BL
+    from lodestar_tpu.crypto import kzg as K
+    from lodestar_tpu.network.gossip import InMemoryGossipBus
+    from lodestar_tpu.network.gossip_handlers import GossipHandlers
+
+    w = world
+    setup = K.insecure_dev_setup(8)
+    handlers = GossipHandlers(w["chain_a"], w["verifier"], kzg_setup=setup)
+    bus = InMemoryGossipBus()
+    handlers.subscribe_all(bus, "blobnode", w["digest"], attnets=(), syncnets=())
+
+    blob = K.polynomial_to_blob(
+        [int.from_bytes(_hl.sha256(b"gb-%d" % i).digest(), "big") % K.R
+         for i in range(8)]
+    )
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    body = T.BeaconBlockBodyDeneb.default()
+    body["blob_kzg_commitments"] = [commitment]
+    duties = w["chain_a"].get_proposer_duties(0)
+    proposer = int(duties[1]["validator_index"])
+    block = {
+        "slot": 1, "proposer_index": proposer,
+        "parent_root": b"\x01" * 32, "state_root": b"\x02" * 32,
+        "body": body,
+    }
+    header_root = w["cfg"].compute_signing_root(
+        T.BeaconBlockHeader.hash_tree_root(
+            {
+                "slot": 1, "proposer_index": proposer,
+                "parent_root": b"\x01" * 32, "state_root": b"\x02" * 32,
+                "body_root": T.BeaconBlockBodyDeneb.hash_tree_root(body),
+            }
+        ),
+        w["cfg"].get_domain(1, params.DOMAIN_BEACON_PROPOSER, 1),
+    )
+    signed = {
+        "message": block,
+        "signature": C.g2_compress(B.sign(w["sks"][proposer], header_root)),
+    }
+    sidecars = BL.make_blob_sidecars(
+        signed, T.BeaconBlockBodyDeneb, [blob], setup
+    )
+    # NOTE: the SSZ Blob type is preset-width; the dev setup is width 8,
+    # so drive the handler's value-level entry (the _dispatch branch
+    # calls the same method after deserializing)
+    from lodestar_tpu.chain.validation import GossipValidationError
+
+    # sidecar's own validator needs the deneb-shaped body type: swap the
+    # config fork dispatch for this altair test world
+    handlers.validators.validate_blob_sidecar = (
+        lambda sc, st, _orig=handlers.validators.validate_blob_sidecar: _orig(
+            sc, st, body_type=T.BeaconBlockBodyDeneb
+        )
+    )
+    # correct subnet (index 0) ACCEPTs through the handler entry
+    handlers.handle_blob_sidecar(sidecars[0], subnet=0)
+    # wrong subnet REJECTs through the SAME handler entry
+    with pytest.raises(GossipValidationError, match="subnet"):
+        handlers.handle_blob_sidecar(sidecars[0], subnet=3)
+    # without a KZG setup the topic IGNOREs
+    handlers_no_kzg = GossipHandlers(w["chain_a"], w["verifier"])
+    with pytest.raises(GossipValidationError, match="no KZG setup"):
+        handlers_no_kzg.handle_blob_sidecar(sidecars[0], subnet=0)
