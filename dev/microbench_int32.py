@@ -1,0 +1,142 @@
+"""Bisect the rpk-stage cost anomaly (dev tool, needs the real chip).
+
+Round-4 found the G1 randomizer scalar-mul stage at 78 ms / 128-lane
+tile — ~30-100x over the op-count estimate (~1.5k mont_muls x ~1 us).
+This script times each candidate cost in isolation:
+
+  mul-chain   K chained mont_muls              -> per-mult cost
+  prod-chain  K chained RAW column products    -> product vs REDC split
+  i32-mul     K chained elementwise int32 muls -> int32 multiply rate
+  f32-mul     same in f32                      -> the native-rate baseline
+  loop        fori_loop with a trivial body    -> per-iteration overhead
+  dblchain    K chained jac_dbl (G1)           -> curve-op composition cost
+
+Usage: python dev/microbench_int32.py [K]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lodestar_tpu.kernels import core as C
+from lodestar_tpu.kernels import curve as CV
+from lodestar_tpu.kernels import layout as LY
+
+K = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+NL = LY.NL
+B = 128
+
+
+def timed(name, fn, *a, per=1):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*a))
+    t1 = time.perf_counter()
+    out = jax.block_until_ready(fn(*a))
+    t2 = time.perf_counter()
+    print(
+        f"{name:10s} compile {t1-t0:7.2f}s  warm {t2-t1:9.6f}s  "
+        f"per-op {(t2-t1)/per*1e6:9.3f} us",
+        flush=True,
+    )
+    return out
+
+
+def k_mul_chain(a, b, o):
+    def body(_i, acc):
+        return C.mont_mul(acc, b[...])
+
+    o[...] = lax.fori_loop(0, K, body, a[...])
+
+
+def k_prod_chain(a, b, o):
+    def body(_i, acc):
+        # raw column product folded back to NL rows (no REDC)
+        return C.fold3(C.mul_cols(acc, b[...]))[..., :NL, :]
+
+    o[...] = lax.fori_loop(0, K, body, a[...])
+
+
+def k_i32_chain(a, b, o):
+    def body(_i, acc):
+        return acc * b[...] + jnp.int32(1)
+
+    o[...] = lax.fori_loop(0, K * 33, body, a[...])
+
+
+def k_loop_only(a, b, o):
+    def body(_i, acc):
+        return acc + jnp.int32(1)
+
+    o[...] = lax.fori_loop(0, K, body, a[...])
+
+
+def k_dbl_chain(x, y, z, ox, oy, oz):
+    def body(_i, pt):
+        return CV.jac_dbl(CV.FP_OPS, pt)
+
+    X, Y, Z = lax.fori_loop(0, K, body, (x[...], y[...], z[...]))
+    ox[...], oy[...], oz[...] = X, Y, Z
+
+
+def run(kernel, n_in, n_out, args, name, per):
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((NL, B), jnp.int32)] * n_out,
+        interpret=jax.default_backend() != "tpu",
+    )
+    timed(name, jax.jit(lambda *a: fn(*a)), *args, per=per)
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    run(k_loop_only, 2, 1, (a, b), "loop", K)
+    run(k_i32_chain, 2, 1, (a, b), "i32-mul", K * 33)
+    # f32 comparison in plain XLA (dtype parity check of raw multiply)
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+
+    def f32_chain(x, y):
+        def body(_i, acc):
+            return acc * y + jnp.float32(1)
+
+        return lax.fori_loop(0, K * 33, body, x)
+
+    def k_f32(x, y, o):
+        o[...] = f32_chain(x[...], y[...]).astype(jnp.int32)
+
+    fnf = pl.pallas_call(
+        k_f32,
+        out_shape=[jax.ShapeDtypeStruct((NL, B), jnp.int32)],
+        interpret=jax.default_backend() != "tpu",
+    )
+    timed("f32-mul", jax.jit(lambda x, y: fnf(x, y)), af, bf, per=K * 33)
+    run(k_prod_chain, 2, 1, (a, b), "prod-chain", K)
+    run(k_mul_chain, 2, 1, (a, b), "mul-chain", K)
+    one = jnp.asarray(
+        np.broadcast_to(np.asarray(LY.MONT_ONE, np.int32)[:, None], (NL, B))
+    ).copy()
+    run(k_dbl_chain, 3, 3, (a, b, one), "dblchain", K)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

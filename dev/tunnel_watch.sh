@@ -15,6 +15,9 @@ assert int(f(jnp.zeros((8, 128), jnp.int32))[0, 0]) == 1
 EOF
   if [ $? -eq 0 ]; then
     echo "$ts COMPILE OK — running stage probes" >> "$LOG"
+    # the cost-anomaly bisect first (small, answers the big question)
+    timeout 1800 python dev/microbench_int32.py > /tmp/microbench_int32.log 2>&1
+    echo "$ts int32 bisect done rc=$?" >> "$LOG"
     # full stage list: finished stages replay from the persistent cache
     python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
     echo "$ts probes done rc=$?" >> "$LOG"

@@ -962,7 +962,12 @@ class BeaconApiServer:
                     import hmac as _hmac
 
                     got = self.headers.get("Authorization", "")
-                    if not _hmac.compare_digest(got, f"Bearer {token}"):
+                    # compare BYTES: compare_digest raises on non-ASCII
+                    # str, which would crash the request instead of 401
+                    if not _hmac.compare_digest(
+                        got.encode("latin-1", "replace"),
+                        f"Bearer {token}".encode(),
+                    ):
                         self._send(401, {"message": "invalid bearer token"})
                         return
                 # query params merge under the path params (reference:

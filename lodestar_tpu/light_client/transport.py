@@ -1,0 +1,138 @@
+"""Light-client transports: fetch bootstrap/updates over req/resp or REST.
+
+Mirror of the reference's transport split (reference:
+packages/light-client/src/transport/{rest,p2p}.ts): the Lightclient
+consumes updates from ANY source; these adapters bind it to
+
+  - the req/resp protocol layer (the p2p analog — LightClientBootstrap
+    and LightClientUpdatesByRange over `network/reqresp`), and
+  - the beacon REST API (`/eth/v1/beacon/light_client/*`).
+
+Both return the repo's LightClientUpdate dataclass (wire containers
+decode through network/reqresp_protocols' converters).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from ..network.reqresp_protocols import (
+    LightClientBootstrapType,
+    LightClientUpdateType,
+    light_client_update_from_value,
+)
+from .lightclient import LightClientUpdate
+
+
+class ReqRespLightClientTransport:
+    """Fetches over a connected ReqResp peer (reference: transport/p2p.ts)."""
+
+    def __init__(self, reqresp, reqresp_node, peer_id: str):
+        self.reqresp = reqresp
+        self.protocols = reqresp_node.protocols
+        self.peer_id = peer_id
+
+    def get_bootstrap(self, block_root: bytes) -> dict:
+        chunks = self.reqresp.send_request(
+            self.peer_id, self.protocols["lc_bootstrap"], bytes(block_root)
+        )
+        return LightClientBootstrapType.deserialize(chunks[0][0])
+
+    def get_updates(
+        self, start_period: int, count: int
+    ) -> List[LightClientUpdate]:
+        chunks = self.reqresp.send_request(
+            self.peer_id,
+            self.protocols["lc_updates"],
+            {"start_period": start_period, "count": count},
+        )
+        return [
+            light_client_update_from_value(
+                LightClientUpdateType.deserialize(data)
+            )
+            for data, _ctx in chunks
+        ]
+
+
+class RestLightClientTransport:
+    """Fetches over the beacon REST API (reference: transport/rest.ts)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(
+            self.base + path, timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read())
+
+    def get_bootstrap(self, block_root: bytes) -> dict:
+        from ..api.encoding import from_json
+
+        out = self._get(
+            "/eth/v1/beacon/light_client/bootstrap/0x"
+            + bytes(block_root).hex()
+        )
+        return from_json(LightClientBootstrapType, out["data"])
+
+    def get_updates(
+        self, start_period: int, count: int
+    ) -> List[LightClientUpdate]:
+        from ..api.encoding import from_json
+
+        out = self._get(
+            "/eth/v1/beacon/light_client/updates"
+            f"?start_period={start_period}&count={count}"
+        )
+        return [
+            light_client_update_from_value(
+                from_json(LightClientUpdateType, item["data"])
+            )
+            for item in out
+        ]
+
+    def get_optimistic_update(self) -> Optional[LightClientUpdate]:
+        from ..api.encoding import from_json
+
+        try:
+            out = self._get("/eth/v1/beacon/light_client/optimistic_update")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        return light_client_update_from_value(
+            from_json(LightClientUpdateType, out["data"])
+        )
+
+
+def bootstrap_lightclient(config, transport, trusted_root: bytes):
+    """Trusted-root bootstrap through a transport (reference:
+    Lightclient.initializeFromCheckpointRoot)."""
+    from .lightclient import Lightclient
+
+    boot = transport.get_bootstrap(trusted_root)
+    return Lightclient(
+        config,
+        dict(boot["header"]),
+        [bytes(pk) for pk in boot["current_sync_committee"]["pubkeys"]],
+    )
+
+
+def advance_lightclient(client, transport, head_period: int) -> int:
+    """Pull + apply committee-period updates up to `head_period`;
+    returns how many applied (reference: LightclientSync run loop)."""
+    from .lightclient import sync_period
+
+    applied = 0
+    start = sync_period(client.finalized_header["slot"])
+    count = max(0, head_period - start + 1)
+    if count == 0:
+        return 0
+    for upd in transport.get_updates(start, count):
+        client.process_update(upd)
+        applied += 1
+    return applied

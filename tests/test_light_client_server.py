@@ -160,3 +160,72 @@ def test_bootstrap(lc_world):
         22,  # current_sync_committee is field 22 of the altair state
         state.hash_tree_root(),
     )
+
+
+def test_transports_bootstrap_and_update(lc_world):
+    """Both transports (req/resp + REST) bootstrap from a trusted root
+    and deliver the server's updates into a validating Lightclient
+    (reference: light-client/src/transport/{p2p,rest}.ts)."""
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.light_client.transport import (
+        ReqRespLightClientTransport,
+        RestLightClientTransport,
+        bootstrap_lightclient,
+    )
+    from lodestar_tpu.network.reqresp import ReqResp, connect_inmemory
+    from lodestar_tpu.network.reqresp_protocols import ReqRespBeaconNode
+
+    cfg, sks, pks, genesis, chain, server = lc_world
+    sk_of = {pks[i]: sks[i] for i in range(N_KEYS)}
+    # ensure an update exists (idempotent when the earlier test ran)
+    if server.get_optimistic_update() is None:
+        _import_block(chain, cfg, sks, 1)
+        _import_block(chain, cfg, sks, 2, sync_signers=sk_of)
+    update = server.get_optimistic_update()
+    head_root = chain.get_head_root()
+
+    # -- req/resp transport
+    server_rr = ReqResp()
+    client_rr = ReqResp()
+    connect_inmemory(client_rr, "lc-client", server_rr, "lc-server")
+    node = ReqRespBeaconNode(
+        server_rr, cfg, chain=chain, db=chain.db, light_client_server=server
+    )
+    # a peer-side node only for protocol definitions
+    peer_node = ReqRespBeaconNode(client_rr, cfg, chain=chain)
+    peer_node.protocols.update(
+        {k: v for k, v in node.protocols.items() if k.startswith("lc_")}
+    )
+    t_rr = ReqRespLightClientTransport(client_rr, peer_node, "lc-server")
+    boot = t_rr.get_bootstrap(head_root)
+    assert bytes(boot["header"]["state_root"]) != b"\x00" * 32
+    lc = bootstrap_lightclient(cfg, t_rr, head_root)
+    assert lc.finalized_header["slot"] == boot["header"]["slot"]
+    updates = t_rr.get_updates(0, 1)
+    assert updates and updates[0].signature_slot == update.signature_slot
+
+    # -- REST transport
+    api = BeaconApiServer(
+        DefaultHandlers(chain=chain, light_client_server=server)
+    )
+    api.listen()
+    try:
+        t_rest = RestLightClientTransport(f"http://127.0.0.1:{api.port}")
+        boot2 = t_rest.get_bootstrap(head_root)
+        assert boot2["header"] == {
+            k: boot["header"][k] for k in boot2["header"]
+        }
+        ups = t_rest.get_updates(0, 1)
+        assert ups and ups[0].attested_header == updates[0].attested_header
+        opt = t_rest.get_optimistic_update()
+        assert opt is not None and opt.signature_slot == update.signature_slot
+        # a fresh client validates the REST-delivered update end-to-end
+        anchor_header = dict(genesis.latest_block_header)
+        anchor_header["state_root"] = genesis.hash_tree_root()
+        lc2 = Lightclient(
+            cfg, anchor_header, genesis.current_sync_committee["pubkeys"]
+        )
+        lc2.process_update(opt)
+        assert lc2.optimistic_header["slot"] == opt.attested_header["slot"]
+    finally:
+        api.close()
