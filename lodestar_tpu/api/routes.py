@@ -66,6 +66,11 @@ ROUTES: Tuple[Route, ...] = (
     Route("POST", "/eth/v1/validator/liveness/{epoch}", "get_liveness"),
     Route(
         "POST",
+        "/eth/v1/validator/prepare_beacon_proposer",
+        "prepare_beacon_proposer",
+    ),
+    Route(
+        "POST",
         "/eth/v1/validator/beacon_committee_subscriptions",
         "prepare_beacon_committee_subnet",
     ),

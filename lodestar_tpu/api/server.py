@@ -35,6 +35,7 @@ class DefaultHandlers:
         peer_manager=None,
         validator_store=None,
         keymanager_token: Optional[str] = None,
+        proposer_cache=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -50,6 +51,7 @@ class DefaultHandlers:
         self.validator_store = validator_store  # keymanager namespace
         # bearer token gating the keymanager routes; None = disabled
         self.keymanager_token = keymanager_token
+        self.proposer_cache = proposer_cache  # prepare_beacon_proposer
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -133,6 +135,40 @@ class DefaultHandlers:
                 )
             )
         return 200, {"data": [str(s) for s in subnets]}
+
+    def prepare_beacon_proposer(self, params, body):
+        """Register local proposers' fee recipients (reference:
+        routes/validator.ts prepareBeaconProposer -> beaconProposerCache;
+        consumed by the next-slot payload preparation)."""
+        if self.proposer_cache is None:
+            return 501, {"message": "no proposer cache attached"}
+        import time as _time
+
+        from .. import params as _p
+
+        # stamp from the WALL clock: a syncing node's stale head epoch
+        # would make registrations expire instantly
+        epoch = max(
+            0,
+            int(_time.time() - self.genesis_time)
+            // _p.SECONDS_PER_SLOT
+            // _p.SLOTS_PER_EPOCH,
+        )
+        # validate the WHOLE body before committing any entry
+        parsed = []
+        for entry in body or []:
+            try:
+                fr = entry["fee_recipient"]
+                fee = bytes.fromhex(fr[2:] if fr.startswith("0x") else fr)
+                index = int(entry["validator_index"])
+            except (KeyError, TypeError, ValueError, AttributeError) as e:
+                return 400, {"message": f"bad registration entry: {e}"}
+            if len(fee) != 20:
+                return 400, {"message": f"bad fee recipient {fr}"}
+            parsed.append((index, fee))
+        for index, fee in parsed:
+            self.proposer_cache.add(epoch, index, fee)
+        return 200, None
 
     def get_validator_monitor(self, params, body):
         """Per-tracked-validator epoch summaries (reference:

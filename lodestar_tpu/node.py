@@ -246,6 +246,21 @@ class FullBeaconNode:
         self.light_client_server = LightClientServer(self.chain)
         self.archiver = Archiver(self.chain)
 
+        # next-slot preparation: epoch-state precompute + payload prep
+        # for locally-registered proposers (reference: prepareNextSlot.ts)
+        from .chain.prepare_next_slot import (
+            BeaconProposerCache,
+            PrepareNextSlotScheduler,
+        )
+
+        self.proposer_cache = BeaconProposerCache()
+        self.prepare_scheduler = PrepareNextSlotScheduler(
+            self.chain, self.proposer_cache
+        )
+        from .chain.emitter import ChainEvent
+
+        self.chain.emitter.on(ChainEvent.head, self.prepare_scheduler.on_head)
+
         # gossip handlers + peer scoring, joined to a bus when provided
         self.score_book = PeerScoreBook()
         self.handlers = GossipHandlers(
@@ -374,6 +389,7 @@ class FullBeaconNode:
         self.clock.on_slot(self.processor.on_clock_slot)
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
+        self.clock.on_slot(self.prepare_scheduler.on_slot)
         # ping/status cadence EVERY slot (the methods rate-limit by
         # their own intervals); heartbeat on its own modulus
         self.clock.on_slot(
@@ -407,6 +423,7 @@ class FullBeaconNode:
                     light_client_server=self.light_client_server,
                     peer_manager=self.peer_manager,
                     keymanager_token=opts.keymanager_token,
+                    proposer_cache=self.proposer_cache,
                 ),
                 port=opts.api_port,
             )

@@ -1,0 +1,160 @@
+"""PrepareNextSlotScheduler + BeaconProposerCache.
+
+Reference behaviors: packages/beacon-node/src/chain/prepareNextSlot.ts
+(epoch-boundary state precompute + fcU payload preparation for local
+proposers) and chain/beaconProposerCache.ts (fee-recipient registry
+with epoch expiry), registered via
+/eth/v1/validator/prepare_beacon_proposer (routes/validator.ts).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.prepare_next_slot import (
+    BeaconProposerCache,
+    PrepareNextSlotScheduler,
+)
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.execution import ExecutionEngineMock
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.accessors import get_beacon_proposer_index
+from lodestar_tpu.state_transition.slot import process_slots
+from lodestar_tpu.validator import ValidatorStore
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+N_KEYS = 8
+
+
+def test_proposer_cache_expiry():
+    cache = BeaconProposerCache()
+    cache.add(epoch=5, proposer_index=1, fee_recipient=b"\x01" * 20)
+    cache.add(epoch=7, proposer_index=2, fee_recipient=b"\x02" * 20)
+    assert cache.get(1) == b"\x01" * 20
+    cache.prune(epoch=8)  # preserve window = 2 epochs
+    assert cache.get(1) is None  # registered at 5, expired
+    assert cache.get(2) == b"\x02" * 20
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0, ForkName.bellatrix: 0},
+    )
+    sks = [B.keygen(b"pns-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    from lodestar_tpu.state_transition.slot import upgrade_to_bellatrix
+
+    upgrade_to_bellatrix(genesis)
+    el = ExecutionEngineMock()
+    chain = BeaconChain(cfg, genesis, execution=el)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+    return cfg, sks, chain, el, store
+
+
+def _propose(cfg, sks, chain, store, slot):
+    st = chain.head_state.clone()
+    if st.slot < slot:
+        process_slots(st, slot)
+    proposer = get_beacon_proposer_index(st)
+    block = chain.produce_block(slot, store.sign_randao(proposer, slot))
+    bt = cfg.get_fork_types(slot)[0]
+    root = cfg.compute_signing_root(
+        bt.hash_tree_root(block),
+        cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
+    )
+    return chain.process_block(
+        {
+            "message": block,
+            "signature": C.g2_compress(B.sign(sks[proposer], root)),
+        }
+    )
+
+
+def test_epoch_precompute_lands_in_checkpoint_cache(world):
+    cfg, sks, chain, el, store = world
+    sched = PrepareNextSlotScheduler(chain)
+    boundary = P.SLOTS_PER_EPOCH  # slot 32 = epoch-1 boundary
+    head_root = chain.get_head_root()
+    # a mid-epoch head update precomputes nothing epoch-wise
+    sched.on_head(head_root, 3)
+    assert sched.prepared_epochs == 0
+    # a head update in the epoch's LAST slot precomputes the boundary
+    sched.on_head(head_root, boundary - 1)
+    assert sched.prepared_epochs == 1
+    checkpoint = {"epoch": 1, "root": chain.get_head_root()}
+    cached = chain.regen.checkpoint_cache.get(checkpoint)
+    assert cached is not None and cached.slot == boundary
+    # idempotent: a repeat is a cache hit, no recompute
+    sched.on_head(head_root, boundary - 1)
+    assert sched.prepared_epochs == 1
+    # the empty-slot fallback also prepares (head is behind the clock)
+    sched.on_slot(boundary)
+    assert sched.prepared_epochs == 1  # same boundary, still cached
+
+
+def test_payload_preparation_for_registered_proposer(world):
+    cfg, sks, chain, el, store = world
+    # cross the merge so the head has an execution block hash
+    root1 = _propose(cfg, sks, chain, store, 1)
+    assert chain.head_root_hex in chain._execution_block_hash
+    sched = PrepareNextSlotScheduler(chain)
+    # next slot's proposer, from the duty shuffle
+    duties = chain.get_proposer_duties(0)
+    nxt = int(duties[2]["validator_index"])
+    # unregistered: the head update must NOT prepare a payload
+    before = len(el.preparing)
+    sched.on_head(root1, 1)
+    assert sched.payloads_prepared == 0 and len(el.preparing) == before
+    # registered: the head update fires fcU with attributes; the EL
+    # starts building with the registered fee recipient and the
+    # ADVANCED state's randao (matching produce_block's attributes)
+    sched.proposer_cache.add(0, nxt, b"\xfe" * 20)
+    sched.on_head(root1, 1)
+    assert sched.payloads_prepared == 1
+    assert len(el.preparing) == before + 1
+    payload = list(el.preparing.values())[-1]
+    assert bytes(payload["fee_recipient"]) == b"\xfe" * 20
+    from lodestar_tpu.state_transition.accessors import get_randao_mix
+
+    adv = chain.regen.get_block_slot_state(bytes(root1).hex(), 2)
+    assert bytes(payload["prev_randao"]) == bytes(get_randao_mix(adv, 0))
+
+
+def test_prepare_beacon_proposer_endpoint(world):
+    cfg, sks, chain, el, store = world
+    cache = BeaconProposerCache()
+    server = BeaconApiServer(
+        DefaultHandlers(chain=chain, proposer_cache=cache)
+    )
+    server.listen()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}"
+            "/eth/v1/validator/prepare_beacon_proposer",
+            data=json.dumps(
+                [
+                    {
+                        "validator_index": "3",
+                        "fee_recipient": "0x" + "ab" * 20,
+                    }
+                ]
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        assert cache.get(3) == bytes.fromhex("ab" * 20)
+    finally:
+        server.close()
