@@ -361,16 +361,22 @@ class BeaconChain:
             f"({st.validation_error})"
         )
 
+    def execution_head_hashes(self):
+        """(head_el_hash | None, finalized_el_hash) — THE beacon-root ->
+        EL-hash mapping, shared by forkchoice pushes and the next-slot
+        payload preparation (None head = pre-merge)."""
+        head_hash = self._execution_block_hash.get(self.head_root_hex)
+        fin = self.head_state.finalized_checkpoint["root"].hex()
+        return head_hash, self._execution_block_hash.get(fin, b"\x00" * 32)
+
     def _notify_forkchoice(self) -> None:
         """Push the beacon head to the EL after head updates (reference:
         importBlock.ts -> executionEngine.notifyForkchoiceUpdate)."""
         if self.execution is None:
             return
-        head_hash = self._execution_block_hash.get(self.head_root_hex)
+        head_hash, fin_hash = self.execution_head_hashes()
         if head_hash is None:
             return  # pre-merge head
-        fin = self.head_state.finalized_checkpoint["root"].hex()
-        fin_hash = self._execution_block_hash.get(fin, b"\x00" * 32)
         from ..execution import ExecutePayloadStatus
 
         try:
@@ -406,6 +412,10 @@ class BeaconChain:
         graffiti: bytes = b"\x00" * 32,
     ) -> dict:
         head = self.head_state
+        # the proposer's registered fee recipient (prepare_beacon_proposer)
+        # — matching the next-slot prep attributes lets the EL serve the
+        # pre-built payload instead of starting a fresh build
+        cache = getattr(self, "proposer_cache", None)
         block, _post = produce_block_from_pools(
             head,
             slot,
@@ -417,6 +427,7 @@ class BeaconChain:
             graffiti=graffiti,
             eth1=self.eth1,
             execution=self.execution,
+            fee_recipient_fn=cache.get if cache is not None else None,
         )
         return block
 

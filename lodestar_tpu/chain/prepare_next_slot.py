@@ -76,11 +76,12 @@ class PrepareNextSlotScheduler:
         self._prepare(int(block_slot) + 1)
 
     def on_slot(self, clock_slot: int) -> None:
-        """Empty-slot fallback: at the tick, the head did not advance
-        last slot — prepare for the just-started slot (late, but the
-        epoch transition and EL build still help)."""
+        """Empty-slot fallback: LAST slot produced no block (so on_head
+        never prepared this one) — prepare late.  head_slot == clock-1
+        is the normal case already prepared by on_head; preparing again
+        would clone + shuffle + fcU every slot for nothing."""
         head_slot = int(self.chain.head_state.slot)
-        if head_slot < clock_slot:
+        if head_slot < clock_slot - 1:
             self._prepare(clock_slot)
         self.proposer_cache.prune(clock_slot // P.SLOTS_PER_EPOCH)
 
@@ -125,7 +126,7 @@ class PrepareNextSlotScheduler:
         chain = self.chain
         if chain.execution is None:
             return
-        head_hash = chain._execution_block_hash.get(chain.head_root_hex)
+        head_hash, fin_hash = chain.execution_head_hashes()
         if head_hash is None:
             return  # pre-merge head: nothing to build on
         epoch = next_slot // P.SLOTS_PER_EPOCH
@@ -151,8 +152,6 @@ class PrepareNextSlotScheduler:
             parent_beacon_root = BeaconBlockHeader.hash_tree_root(
                 advanced.latest_block_header
             )
-        fin = advanced.finalized_checkpoint["root"].hex()
-        fin_hash = chain._execution_block_hash.get(fin, b"\x00" * 32)
         chain.execution.notify_forkchoice_update(
             head_hash,
             head_hash,

@@ -83,6 +83,7 @@ def produce_block_from_pools(
     deposits: Optional[List[Dict]] = None,
     eth1=None,
     execution=None,
+    fee_recipient_fn=None,
 ) -> Tuple[Dict, object]:
     """produceBlockBody from the op pools (reference
     produceBlockBody.ts:66-118): attestations ranked by participation,
@@ -125,6 +126,7 @@ def produce_block_from_pools(
         slot,
         randao_reveal,
         execution=execution,
+        fee_recipient_fn=fee_recipient_fn,
         graffiti=graffiti,
         eth1_data=eth1_data,
         deposits=deposits,
@@ -137,10 +139,12 @@ def produce_block_from_pools(
     )
 
 
-def _fetch_payload(execution, pre) -> Dict:
+def _fetch_payload(execution, pre, fee_recipient: bytes = b"\x00" * 20) -> Dict:
     """engine_forkchoiceUpdated(attributes) + engine_getPayload against
     the state's latest header (reference: produceBlockBody.ts
-    prepareExecutionPayload)."""
+    prepareExecutionPayload).  `fee_recipient` comes from the proposer's
+    prepare_beacon_proposer registration — matching the next-slot
+    preparation's attributes lets the EL serve the PRE-BUILT payload."""
     from ..execution import PayloadAttributes
     from ..state_transition.accessors import get_randao_mix
 
@@ -177,7 +181,7 @@ def _fetch_payload(execution, pre) -> Dict:
             prev_randao=get_randao_mix(
                 pre, pre.slot // P.SLOTS_PER_EPOCH
             ),
-            suggested_fee_recipient=b"\x00" * 20,
+            suggested_fee_recipient=bytes(fee_recipient),
             withdrawals=withdrawals,
             parent_beacon_block_root=parent_beacon_root,
         ),
@@ -204,6 +208,8 @@ def produce_block(
     slot: int,
     randao_reveal: bytes,
     execution=None,
+    fee_recipient: bytes = b"\x00" * 20,
+    fee_recipient_fn=None,  # proposer_index -> bytes|None (the cache)
     **body_kwargs,
 ) -> Tuple[Dict, object]:
     """Build an unsigned block at `slot` on top of `state`.
@@ -215,6 +221,12 @@ def produce_block(
         process_slots(pre, slot)
     proposer_index = get_beacon_proposer_index(pre)
     parent_root = BeaconBlockHeader.hash_tree_root(pre.latest_block_header)
+    if fee_recipient_fn is not None:
+        # the proposer's prepare_beacon_proposer registration (looked up
+        # HERE where the advanced state already names the proposer)
+        registered = fee_recipient_fn(int(proposer_index))
+        if registered is not None:
+            fee_recipient = registered
     if (
         pre.latest_execution_payload_header is not None
         and body_kwargs.get("execution_payload") is None
@@ -225,7 +237,9 @@ def produce_block(
             raise ValueError(
                 "post-bellatrix proposal requires an execution engine"
             )
-        body_kwargs["execution_payload"] = _fetch_payload(execution, pre)
+        body_kwargs["execution_payload"] = _fetch_payload(
+            execution, pre, fee_recipient
+        )
     body = produce_block_body(pre, randao_reveal, **body_kwargs)
     block = {
         "slot": slot,

@@ -62,6 +62,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "--doppelganger-protection", action="store_true",
         help="delay duties until keys prove silent on the network",
     )
+    validator.add_argument(
+        "--external-signer-url", default=None,
+        help="Web3Signer-API remote signer; indices NOT in the local "
+        "key set sign through it (their pubkeys come from the signer)",
+    )
+    validator.add_argument(
+        "--remote-indices", type=int, nargs="*", default=(),
+        help="validator indices whose keys live in the external signer",
+    )
 
     bench = sub.add_parser("bench", help="run the headline TPU benchmark")
     bench.add_argument("--mode", default="wire", choices=["wire", "decoded"])
@@ -213,6 +222,13 @@ def cmd_validator(args) -> int:
     )
     from . import params as _p
 
+    remote = [
+        i for i in getattr(args, "remote_indices", ()) or ()
+        if i not in args.interop_indices
+    ]
+    if remote and not getattr(args, "external_signer_url", None):
+        print(json.dumps({"error": "--remote-indices needs --external-signer-url"}))
+        return 2
     client = ApiClient(args.beacon_urls, timeout=120)
     genesis = client.get_genesis()
     sks, _pks = _interop_keys(max(args.interop_indices) + 1)
@@ -242,11 +258,24 @@ def cmd_validator(args) -> int:
             liveness_fn=_liveness,
             current_epoch_fn=_wall_epoch,
         )
+    external_signer = None
+    remote_keys = None
+    if getattr(args, "external_signer_url", None):
+        from .validator.external_signer import ExternalSignerClient
+
+        external_signer = ExternalSignerClient(args.external_signer_url)
+        if remote:
+            # the interop key schedule also derives the REMOTE pubkeys
+            # (a real deployment would match the signer's publicKeys)
+            all_sks, all_pks = _interop_keys(max(remote) + 1)
+            remote_keys = {i: all_pks[i] for i in remote}
     store = ValidatorStore(
         MAINNET_CHAIN_CONFIG,
         {i: sks[i] for i in args.interop_indices},
         slashing_db_path=args.slashing_db_path,
         doppelganger=doppelganger,
+        external_signer=external_signer,
+        remote_keys=remote_keys,
     )
     blocks = BlockProposalService(store, client)
     atts = AttestationService(store, client)

@@ -181,14 +181,32 @@ def jac_eq(fo: FieldOps, p, p_inf, q, q_inf):
 def scalar_mul_bits_jac(fo: FieldOps, q, q_inf, get_bit, nbits: int):
     """k*Q for per-lane scalars given as MSB-first bit planes.
 
+    2-bit WINDOWED double-and-add: nbits must be even; each of nbits/2
+    iterations doubles twice and adds a table-selected multiple from
+    {O, Q, 2Q, 3Q}.  Versus bit-at-a-time this halves the (expensive,
+    always-computed-then-selected) additions — 64-bit randomizers drop
+    from 64 to 32 full adds for three setup additions.
+
     q is jacobian (aggregate bases allowed).  get_bit(i) -> int32[..., B]
     bit plane (a ref read inside kernels, a dynamic slice under jit).
     Full additions (no mixed shortcut: Z_Q != 1 in general); the
-    accumulator-infinity and T==Q cases are handled by mask selects — no
-    exact zero tests inside the loop (T==Q is impossible once T = m*Q with
-    m >= 2, and m=1 happens only at the first set bit where the mask path
-    assigns Q directly).
+    accumulator-infinity and T==table-entry cases are handled by mask
+    selects — no exact zero tests inside the loop.  T == m*Q with the
+    window digit d can only collide when m == d, which happens only
+    while the accumulator is still infinity (handled by the t_inf mask:
+    the digit's multiple is assigned directly).
     """
+    assert nbits % 2 == 0, nbits
+    # window table: 2Q, 3Q (Q itself is the input).  2Q = dbl, 3Q = 2Q+Q
+    # (2Q == +-Q only for 5-torsion — impossible in a prime-order group).
+    q2 = jac_dbl(fo, q)
+    q3 = jac_add_mixed_or_full(fo, q2, q)
+
+    def digit_multiple(d):
+        """table[d] for d in {1,2,3} as masked selects (d==0 is handled
+        by the outer bit-select keeping T)."""
+        m = select_pt(fo, d == 2, q2, q)
+        return select_pt(fo, d == 3, q3, m)
 
     # The accumulator-infinity mask is carried as int32, not bool: an i1
     # vector as an scf.for loop carry fails Mosaic legalization on real
@@ -196,17 +214,21 @@ def scalar_mul_bits_jac(fo: FieldOps, q, q_inf, get_bit, nbits: int):
     # vector<8x128xi1> block argument).
     def body(i, st):
         (T, t_inf) = st
-        T = jac_dbl(fo, T)
-        bit = get_bit(i) != 0
-        cand = jac_add_mixed_or_full(fo, T, q)
-        cand = select_pt(fo, t_inf != 0, q, cand)
-        T = select_pt(fo, bit, cand, T)
-        t_inf = t_inf & (~bit).astype(jnp.int32)
+        T = jac_dbl(fo, jac_dbl(fo, T))
+        hi = get_bit(2 * i)
+        lo = get_bit(2 * i + 1)
+        d = 2 * hi + lo
+        add = digit_multiple(d)
+        cand = jac_add_mixed_or_full(fo, T, add)
+        cand = select_pt(fo, t_inf != 0, add, cand)
+        nz = d != 0
+        T = select_pt(fo, nz, cand, T)
+        t_inf = t_inf & (~nz).astype(jnp.int32)
         return (T, t_inf)
 
     t0 = q  # placeholder value; masked by t_inf
     inf0 = jnp.ones(q_inf.shape, jnp.int32)
-    T, t_inf = lax.fori_loop(0, nbits, body, (t0, inf0))
+    T, t_inf = lax.fori_loop(0, nbits // 2, body, (t0, inf0))
     # k*O = O for infinity bases; k = 0 (all-zero bits) stays infinity.
     return T, (t_inf != 0) | q_inf
 

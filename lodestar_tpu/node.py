@@ -254,6 +254,8 @@ class FullBeaconNode:
         )
 
         self.proposer_cache = BeaconProposerCache()
+        # production looks up registered fee recipients on the chain
+        self.chain.proposer_cache = self.proposer_cache
         self.prepare_scheduler = PrepareNextSlotScheduler(
             self.chain, self.proposer_cache
         )
