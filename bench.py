@@ -49,6 +49,10 @@ import time
 os.environ.setdefault("XLA_FLAGS", "")
 
 BENCH_INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
+# the axon tunnel FLAPS (round 4 observed hours-long outages with brief
+# windows of life): retry the init probe a few times before giving up
+BENCH_PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+BENCH_PROBE_RETRY_DELAY_S = float(os.environ.get("BENCH_PROBE_RETRY_DELAY", "60"))
 # Watchdog default sized to the measured warm-up reality on the driver
 # host (dev/NOTES.md "CPU-host costs": ~700 s of per-process tracing
 # before any compile/run) — the deadline is a last-resort diagnostic,
@@ -80,10 +84,28 @@ def _emit_failure(stage: str, detail: str) -> None:
 
 
 def _probe_backend() -> None:
-    """Initialize the TPU backend in a THROWAWAY subprocess with a hard
-    timeout, so an unresponsive axon tunnel is diagnosed instead of
+    """Initialize the TPU backend in THROWAWAY subprocesses with hard
+    timeouts, so an unresponsive axon tunnel is diagnosed instead of
     hanging this process (jax backend init is not interruptible once
-    started).  Exits the process with a JSON diagnosis on failure."""
+    started).  Retries a few times — the tunnel flaps — then exits the
+    process with a JSON diagnosis on failure."""
+    last = None
+    for attempt in range(max(1, BENCH_PROBE_RETRIES)):
+        if attempt:
+            time.sleep(BENCH_PROBE_RETRY_DELAY_S)
+        last, retryable = _probe_backend_once()
+        if last is None:
+            return
+        print(f"# probe attempt {attempt + 1} failed: {last}", file=sys.stderr)
+        if not retryable:
+            break  # cpu fallback / missing plugin: waiting cannot help
+    _emit_failure("backend-init-probe", last or "probe failed")
+    sys.exit(1)
+
+
+def _probe_backend_once():
+    """One probe attempt; returns (failure_detail | None, retryable) —
+    only tunnel unresponsiveness is plausibly transient."""
     code = (
         "import jax\n"
         "d = jax.devices()\n"
@@ -110,32 +132,32 @@ def _probe_backend() -> None:
             os.killpg(p.pid, signal.SIGKILL)
         except OSError:
             pass
-        _emit_failure(
-            "backend-init-probe",
+        return (
             f"TPU backend init exceeded {BENCH_INIT_TIMEOUT_S:.0f}s "
             "(axon tunnel unresponsive?)",
+            True,
         )
-        sys.exit(1)
     ok_lines = [l for l in out.splitlines() if l.startswith("PROBE_OK")]
     if p.returncode != 0 or not ok_lines:
-        _emit_failure(
-            "backend-init-probe",
+        detail = (
             (err or out).strip().splitlines()[-1]
             if (err or out).strip()
-            else f"probe exited rc={p.returncode}",
+            else f"probe exited rc={p.returncode}"
         )
-        sys.exit(1)
+        # backend errors (UNAVAILABLE etc.) can clear when the tunnel
+        # returns; treat crashes as retryable too — the delay is bounded
+        return detail, True
     platform = ok_lines[-1].split()[1]
     if platform == "cpu":
         # A silent CPU fallback must not publish interpret-mode numbers
         # as the TPU headline (BENCH_PLATFORM=cpu is the explicit opt-in).
-        _emit_failure(
-            "backend-init-probe",
+        return (
             "backend initialized but resolved to 'cpu' "
             "(TPU plugin missing / silent fallback)",
+            False,
         )
-        sys.exit(1)
     print(f"# probe: {ok_lines[-1]}", file=sys.stderr)
+    return None, False
 
 
 _WATCHDOG_ARMED = False
@@ -168,8 +190,11 @@ if _BENCH_PLATFORM not in ("tpu", "cpu"):
     sys.exit(2)
 
 if __name__ == "__main__" and _BENCH_PLATFORM == "tpu":
-    _arm_watchdog()  # armed BEFORE the probe: the deadline covers it too
+    # The probe is SELF-bounded (subprocess timeouts x retries); the
+    # watchdog arms AFTER it so probe retries cannot eat the deadline
+    # budget of a run that would finish.
     _probe_backend()
+    _arm_watchdog()
 
 import numpy as np
 

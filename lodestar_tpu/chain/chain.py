@@ -61,10 +61,14 @@ class BeaconChain:
         execution=None,
         monitor=None,
         emitter: Optional[ChainEventEmitter] = None,
+        proposer_cache=None,
     ):
         self.config = config
         self.log = get_logger("chain")
         self.emitter = emitter or ChainEventEmitter()
+        # prepare_beacon_proposer registrations consumed by production
+        # (a BeaconProposerCache; None = zero fee recipient)
+        self.proposer_cache = proposer_cache
         self.db = db
         self.bls = bls_verifier  # optional batched signature service
         self.eth1 = eth1  # optional Eth1DepositDataTracker
@@ -415,7 +419,7 @@ class BeaconChain:
         # the proposer's registered fee recipient (prepare_beacon_proposer)
         # — matching the next-slot prep attributes lets the EL serve the
         # pre-built payload instead of starting a fresh build
-        cache = getattr(self, "proposer_cache", None)
+        cache = self.proposer_cache
         block, _post = produce_block_from_pools(
             head,
             slot,

@@ -69,6 +69,7 @@ class PrepareNextSlotScheduler:
         self.log = get_logger("chain/prepare_next_slot")
         self.prepared_epochs = 0
         self.payloads_prepared = 0
+        self._last_prepared_slot = -1
 
     def on_head(self, _head_root: bytes, block_slot: int) -> None:
         """The slot's block imported: prepare for the NEXT slot on the
@@ -76,16 +77,18 @@ class PrepareNextSlotScheduler:
         self._prepare(int(block_slot) + 1)
 
     def on_slot(self, clock_slot: int) -> None:
-        """Empty-slot fallback: LAST slot produced no block (so on_head
-        never prepared this one) — prepare late.  head_slot == clock-1
-        is the normal case already prepared by on_head; preparing again
-        would clone + shuffle + fcU every slot for nothing."""
-        head_slot = int(self.chain.head_state.slot)
-        if head_slot < clock_slot - 1:
+        """Fallback for slots on_head never prepared: empty previous
+        slot, or the first tick after a (re)start.  The at-most-once
+        ledger (_last_prepared_slot) prevents double work on the normal
+        path where on_head already prepared this slot."""
+        if self._last_prepared_slot < clock_slot:
             self._prepare(clock_slot)
         self.proposer_cache.prune(clock_slot // P.SLOTS_PER_EPOCH)
 
     def _prepare(self, next_slot: int) -> None:
+        # records but never dedups here: a same-slot re-fire means the
+        # head CHANGED (reorg) and the prep must re-run on the new head
+        self._last_prepared_slot = max(self._last_prepared_slot, next_slot)
         try:
             advanced = self._advanced_state(next_slot)
             self._prepare_payload(next_slot, advanced)
@@ -136,34 +139,16 @@ class PrepareNextSlotScheduler:
         fee_recipient = self.proposer_cache.get(proposer)
         if fee_recipient is None:
             return  # not one of ours
-        from ..execution import PayloadAttributes
-        from ..state_transition.accessors import get_randao_mix
-        from ..state_transition.block import get_expected_withdrawals
-        from ..types import BeaconBlockHeader
+        from .produce_block import build_payload_attributes
 
-        withdrawals = (
-            get_expected_withdrawals(advanced)
-            if advanced.next_withdrawal_index is not None
-            else None
-        )
-        parent_beacon_root = None
-        if advanced.fork_at_least(params.ForkName.deneb):
-            # fcU V3 rejects attributes without the parent beacon root
-            parent_beacon_root = BeaconBlockHeader.hash_tree_root(
-                advanced.latest_block_header
-            )
         chain.execution.notify_forkchoice_update(
             head_hash,
             head_hash,
             fin_hash,
-            PayloadAttributes(
-                timestamp=int(advanced.genesis_time)
-                + next_slot * params.SECONDS_PER_SLOT,
-                prev_randao=get_randao_mix(advanced, epoch),
-                suggested_fee_recipient=fee_recipient,
-                withdrawals=withdrawals,
-                parent_beacon_block_root=parent_beacon_root,
-            ),
+            # the ONE shared builder — proposal-time _fetch_payload uses
+            # it too, so the EL recognizes and serves the pre-built
+            # payload instead of starting over
+            build_payload_attributes(advanced, next_slot, fee_recipient),
         )
         self.payloads_prepared += 1
         self.log.debug(

@@ -139,52 +139,52 @@ def produce_block_from_pools(
     )
 
 
+def build_payload_attributes(advanced, slot: int, fee_recipient: bytes):
+    """THE payload attributes for proposing at `slot` on `advanced` (the
+    state already processed to `slot`).  Shared by the proposal-time
+    fetch and the next-slot preparation — the EL serves the pre-built
+    payload only when the two match byte-for-byte."""
+    from ..execution import PayloadAttributes
+    from ..state_transition.accessors import get_randao_mix
+    from ..state_transition.block import get_expected_withdrawals
+
+    withdrawals = (
+        get_expected_withdrawals(advanced)
+        if advanced.next_withdrawal_index is not None
+        else None
+    )
+    parent_beacon_root = None
+    if advanced.fork_at_least(params.ForkName.deneb):
+        # fcU V3 rejects attributes without the parent beacon root
+        parent_beacon_root = BeaconBlockHeader.hash_tree_root(
+            advanced.latest_block_header
+        )
+    return PayloadAttributes(
+        timestamp=int(advanced.genesis_time) + slot * params.SECONDS_PER_SLOT,
+        prev_randao=get_randao_mix(advanced, slot // P.SLOTS_PER_EPOCH),
+        suggested_fee_recipient=bytes(fee_recipient),
+        withdrawals=withdrawals,
+        parent_beacon_block_root=parent_beacon_root,
+    )
+
+
 def _fetch_payload(execution, pre, fee_recipient: bytes = b"\x00" * 20) -> Dict:
     """engine_forkchoiceUpdated(attributes) + engine_getPayload against
     the state's latest header (reference: produceBlockBody.ts
     prepareExecutionPayload).  `fee_recipient` comes from the proposer's
-    prepare_beacon_proposer registration — matching the next-slot
-    preparation's attributes lets the EL serve the PRE-BUILT payload."""
-    from ..execution import PayloadAttributes
-    from ..state_transition.accessors import get_randao_mix
-
-    from ..state_transition.block import (
-        get_expected_withdrawals,
-        is_merge_transition_complete,
-    )
+    prepare_beacon_proposer registration."""
+    from ..state_transition.block import is_merge_transition_complete
 
     parent_hash = (
         bytes(pre.latest_execution_payload_header["block_hash"])
         if is_merge_transition_complete(pre)
         else b"\x00" * 32
     )
-    # capella onward (engine API v2): ship the protocol-expected
-    # withdrawals so the built payload passes process_withdrawals
-    withdrawals = (
-        get_expected_withdrawals(pre)
-        if pre.next_withdrawal_index is not None
-        else None
-    )
-    # deneb (v3): the parent beacon block root must ride the attributes
-    parent_beacon_root = None
-    if pre.fork_at_least(params.ForkName.deneb):
-        parent_beacon_root = BeaconBlockHeader.hash_tree_root(
-            pre.latest_block_header
-        )
     r = execution.notify_forkchoice_update(
         parent_hash,
         parent_hash,
         b"\x00" * 32,
-        PayloadAttributes(
-            timestamp=int(pre.genesis_time)
-            + pre.slot * params.SECONDS_PER_SLOT,
-            prev_randao=get_randao_mix(
-                pre, pre.slot // P.SLOTS_PER_EPOCH
-            ),
-            suggested_fee_recipient=bytes(fee_recipient),
-            withdrawals=withdrawals,
-            parent_beacon_block_root=parent_beacon_root,
-        ),
+        build_payload_attributes(pre, pre.slot, fee_recipient),
     )
     if r.payload_id is None:
         raise ValueError(f"EL did not prepare a payload ({r.status})")

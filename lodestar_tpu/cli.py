@@ -231,7 +231,9 @@ def cmd_validator(args) -> int:
         return 2
     client = ApiClient(args.beacon_urls, timeout=120)
     genesis = client.get_genesis()
-    sks, _pks = _interop_keys(max(args.interop_indices) + 1)
+    # ONE derivation covering local + remote indices (keygen per index)
+    n_keys = max([*args.interop_indices, *remote]) + 1
+    sks, pks = _interop_keys(n_keys)
     doppelganger = None
     if args.doppelganger_protection:
         from .validator import DoppelgangerService
@@ -267,8 +269,7 @@ def cmd_validator(args) -> int:
         if remote:
             # the interop key schedule also derives the REMOTE pubkeys
             # (a real deployment would match the signer's publicKeys)
-            all_sks, all_pks = _interop_keys(max(remote) + 1)
-            remote_keys = {i: all_pks[i] for i in remote}
+            remote_keys = {i: pks[i] for i in remote}
     store = ValidatorStore(
         MAINNET_CHAIN_CONFIG,
         {i: sks[i] for i in args.interop_indices},

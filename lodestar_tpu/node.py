@@ -233,6 +233,12 @@ class FullBeaconNode:
             for i in opts.track_validators:
                 self.monitor.register_local_validator(int(i))
 
+        # proposer fee-recipient registry (REST prepare_beacon_proposer;
+        # consumed by production + the next-slot payload preparation)
+        from .chain.prepare_next_slot import BeaconProposerCache
+
+        self.proposer_cache = BeaconProposerCache()
+
         # the chain composition
         self.chain = BeaconChain(
             config,
@@ -241,6 +247,7 @@ class FullBeaconNode:
             bls_verifier=self.bls,
             execution=opts.execution,
             monitor=self.monitor,
+            proposer_cache=self.proposer_cache,
         )
         self.fork_choice = self.chain.fork_choice
         self.light_client_server = LightClientServer(self.chain)
@@ -248,14 +255,8 @@ class FullBeaconNode:
 
         # next-slot preparation: epoch-state precompute + payload prep
         # for locally-registered proposers (reference: prepareNextSlot.ts)
-        from .chain.prepare_next_slot import (
-            BeaconProposerCache,
-            PrepareNextSlotScheduler,
-        )
+        from .chain.prepare_next_slot import PrepareNextSlotScheduler
 
-        self.proposer_cache = BeaconProposerCache()
-        # production looks up registered fee recipients on the chain
-        self.chain.proposer_cache = self.proposer_cache
         self.prepare_scheduler = PrepareNextSlotScheduler(
             self.chain, self.proposer_cache
         )
