@@ -34,7 +34,9 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from lodestar_tpu.crypto import fields as GT
 from lodestar_tpu.kernels import core as C
+from lodestar_tpu.kernels import core_f32 as F32
 from lodestar_tpu.kernels import curve as CV
 from lodestar_tpu.kernels import layout as LY
 
@@ -60,6 +62,20 @@ def timed(name, fn, *a, per=1):
 def k_mul_chain(a, b, o):
     def body(_i, acc):
         return C.mont_mul(acc, b[...])
+
+    o[...] = lax.fori_loop(0, K, body, a[...])
+
+
+def k_f32core_chain(a, b, t_np, t_p, o):
+    """The f32/MXU engine's mont_mul chained K times (core_f32).
+
+    The Toeplitz REDC matrices ride as kernel inputs — pallas rejects
+    captured array constants."""
+    mode = "mxu" if jax.default_backend() == "tpu" else "f32"
+    mats = (t_np[...], t_p[...])
+
+    def body(_i, acc):
+        return F32.mont_mul(acc, b[...], matmul_mode=mode, toeplitz=mats)
 
     o[...] = lax.fori_loop(0, K, body, a[...])
 
@@ -131,6 +147,31 @@ def main():
     timed("f32-mul", jax.jit(lambda x, y: fnf(x, y)), af, bf, per=K * 33)
     run(k_prod_chain, 2, 1, (a, b), "prod-chain", K)
     run(k_mul_chain, 2, 1, (a, b), "mul-chain", K)
+    # the f32/MXU candidate engine at the same chain length
+    xs = [int(v) for v in rng.integers(1, 1 << 62, B)]
+    ys = [int(v) for v in rng.integers(1, 1 << 62, B)]
+    af = jnp.asarray(F32.encode_batch(xs))
+    bf = jnp.asarray(F32.encode_batch(ys))
+    t_np = jnp.asarray(F32.T_NPRIME)
+    t_p = jnp.asarray(F32.T_P)
+    fnf32 = pl.pallas_call(
+        k_f32core_chain,
+        out_shape=[jax.ShapeDtypeStruct((F32.K, B), jnp.float32)],
+        interpret=jax.default_backend() != "tpu",
+    )
+    out = timed(
+        "f32core",
+        jax.jit(lambda x, y, tn, tp: fnf32(x, y, tn, tp)),
+        af, bf, t_np, t_p,
+        per=K,
+    )
+    # correctness spot-check against the oracle through the chain
+    got = F32.decode_batch(np.asarray(out[0]))
+    want = list(xs)
+    for _ in range(K):
+        want = [x * y % GT.P for x, y in zip(want, ys)]
+    assert got == want, "f32core chain diverged from the oracle!"
+    print("f32core chain matches the oracle", flush=True)
     one = jnp.asarray(
         np.broadcast_to(np.asarray(LY.MONT_ONE, np.int32)[:, None], (NL, B))
     ).copy()

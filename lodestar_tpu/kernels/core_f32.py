@@ -1,0 +1,277 @@
+"""f32/MXU field-core prototype: 48x8-bit limbs, REDC on the matrix unit.
+
+Round-4's on-chip probes put the int32 core's scalar-mul stage ~30x over
+its op-count estimate; the prime suspect is int32-multiply emulation on
+the VPU (TPUs are float machines — the CPU interpret run already shows
+a 13x int32/f32 multiply gap).  This module reformulates the field
+layer for float hardware:
+
+  - limbs: 48 x 8-bit, SIGNED-lazy, carried in f32.  f32 integers are
+    exact to 2^24; 8-bit canonical limbs make schoolbook columns
+    (<= 48 terms x 2^16) and the REDC matmuls exact.
+  - Montgomery radix R = 2^384 (48 * 8) — tighter than the int32
+    core's 2^396, so folds run more often; the payoff is below.
+  - THE PAYOFF: REDC's two big products have a SHARED constant operand
+    (NPRIME and p), so they are literal matrix multiplies
+        m = fold(t_lo) @ TOEPLITZ_NPRIME   [B,48] x [48,48]  (mod R free)
+        u = fold(m)    @ TOEPLITZ_P        [B,48] x [48,96]
+    which the MXU executes at matrix rates — in bf16 x bf16 -> f32,
+    EXACT for 8-bit entries (bf16 holds integers <= 256 exactly; the
+    f32 accumulator holds the <= 2^21.6 columns exactly).  Only the
+    per-lane a*b schoolbook stays on the VPU, in native-rate f32.
+
+Bound discipline (mirrors kernels/layout.py's, scaled to 8-bit limbs;
+tests/test_kernels_core_f32.py checks against exact integer mirrors):
+  mul inputs need |limbs| <= 511 (one lazy add of canonicals), giving
+  |columns| <= 48 * 511^2 < 2^23.6 — f32-exact.  `fold` (floor-based,
+  value-preserving for signed values) restores limbs to [0, 256) with a
+  small signed top.  add/sub are lazy; chains beyond 2 terms fold.
+
+Everything is value-level ([..., K, B] planes, limbs on sublanes) and
+runs inside pallas kernels or plain jit.  `matmul_mode` selects the
+REDC product engine: 'mxu' (bf16 dot, real TPUs) or 'f32' (plain dot,
+exactness-equal; the CPU test path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto import fields as GT
+
+K = 48  # limbs
+LIMB_BITS = 8
+BASE = 1 << LIMB_BITS  # 256
+KC = 2 * K  # product columns
+R_BITS = K * LIMB_BITS  # 384
+P = GT.P
+R = 1 << R_BITS
+R2 = R * R % P
+NPRIME = (-pow(P, -1, R)) % R
+R_INV = pow(R, -1, P)
+
+_INV_BASE = np.float32(1.0 / BASE)
+_BASE_F = np.float32(BASE)
+
+
+# -- host codecs ------------------------------------------------------------
+
+
+def to_limbs(x: int, n: int = K) -> np.ndarray:
+    assert 0 <= x < 1 << (LIMB_BITS * n)
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & (BASE - 1) for i in range(n)], np.float32
+    )
+
+
+def from_limbs(arr) -> int:
+    total = 0
+    for i, v in enumerate(np.asarray(arr, np.float64)):
+        total += int(v) << (LIMB_BITS * i)
+    return total
+
+
+def encode_batch(xs) -> np.ndarray:
+    """Canonical ints -> MONTGOMERY-form planes f32[K, B]."""
+    return np.stack(
+        [to_limbs(x * R % P) for x in xs], axis=-1
+    )
+
+
+def encode_plain_batch(xs) -> np.ndarray:
+    return np.stack([to_limbs(x % P) for x in xs], axis=-1)
+
+
+def decode_batch(arr) -> list:
+    """Montgomery planes -> canonical ints (host side, exact)."""
+    a = np.asarray(arr, np.float64)
+    out = []
+    for j in range(a.shape[-1]):
+        v = 0
+        for i in range(K):
+            v += int(a[i, j]) << (LIMB_BITS * i)
+        out.append(v * R_INV % P)
+    return out
+
+
+_NP_LIMBS = to_limbs(NPRIME)
+_P_LIMBS = to_limbs(P)
+
+# Toeplitz matrices for the REDC matmuls (host-built, baked into
+# kernels as constants).  M[i, j] = limb[j - i]: row i of the product
+# accumulates a_i * c_{j-i} into column j; truncation at 48 columns IS
+# the mod-R of the m-product.
+T_NPRIME = np.zeros((K, K), np.float32)
+T_P = np.zeros((K, KC), np.float32)
+for _i in range(K):
+    for _j in range(_i, K):
+        T_NPRIME[_i, _j] = _NP_LIMBS[_j - _i]
+    for _j in range(_i, _i + K):
+        T_P[_i, _j] = _P_LIMBS[_j - _i]
+
+
+# -- value-level primitives -------------------------------------------------
+
+
+def _pad2(t, lo, hi):
+    cfg = [(0, 0)] * (t.ndim - 2) + [(lo, hi), (0, 0)]
+    return jnp.pad(t, cfg)
+
+
+def fold(t):
+    """One carry-fold along axis -2; value-preserving for all signed
+    inputs (floor division is exact for f32 integers / a power of 2).
+    Rows 0..n-2 land in [0, 256); the top limb absorbs its carry."""
+    car = jnp.floor(t * _INV_BASE)
+    body = (t - car * _BASE_F)[..., :-1, :] + _pad2(car[..., :-2, :], 1, 0)
+    top = t[..., -1:, :] + car[..., -2:-1, :]
+    return jnp.concatenate([body, top], axis=-2)
+
+
+def fold2(t):
+    return fold(fold(t))
+
+
+def fold3(t):
+    return fold(fold(fold(t)))
+
+
+def fold_modR(t):
+    """Masked-top fold: the top limb is reduced like the body, dropping
+    its carry — i.e. the represented value is taken modulo 2^(8*rows).
+    Feeds the REDC matmuls, whose operands only matter mod R and whose
+    bf16 entries must be STRICTLY 8-bit."""
+    car = jnp.floor(t * _INV_BASE)
+    return (t - car * _BASE_F) + _pad2(car[..., :-1, :], 1, 0)
+
+
+def mul_cols(a, b):
+    """Schoolbook columns [..., K, B] x [..., K, B] -> [..., KC, B].
+
+    Inputs need |limbs| <= 511 for f32-exact columns.  48 unrolled
+    broadcast-row multiply-adds on the VPU at native f32 rate."""
+    acc = _pad2(a[..., 0:1, :] * b, 0, KC - K)
+    for j in range(1, K):
+        acc = acc + _pad2(a[..., j : j + 1, :] * b, j, KC - K - j)
+    return acc
+
+
+def _matmul(x_kb, toeplitz, mode: str):
+    """[..., K, B] x const[K, N] -> [..., N, B] via the matrix unit.
+
+    Contraction is over the LIMB axis: out[n, b] = sum_k x[k, b] T[k, n].
+    mode 'mxu': bf16 inputs, f32 accumulate (exact for 8-bit entries);
+    mode 'f32': plain f32 dot (CPU tests, same exactness)."""
+    t = jnp.asarray(toeplitz)
+    if mode == "mxu":
+        x16 = x_kb.astype(jnp.bfloat16)
+        t16 = t.astype(jnp.bfloat16)
+        return jax.lax.dot_general(
+            t16,
+            x16,
+            (((0,), (x_kb.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return jax.lax.dot_general(
+        t,
+        x_kb,
+        (((0,), (x_kb.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def redc(tcols, matmul_mode: str = "f32", toeplitz=None):
+    """Montgomery reduction: columns [..., KC, B] -> limbs [..., K, B].
+
+    value_out = value_in / R (mod p).  Requires |column values| f32-exact
+    (mul_cols output or <= 2-term sums of them after a fold).
+
+    `toeplitz`: (T_NPRIME, T_P) operands.  Inside pallas kernels the
+    matrices MUST be threaded as kernel inputs (pallas rejects captured
+    array constants); under plain jit the module constants serve."""
+    t_np, t_p = toeplitz if toeplitz is not None else (T_NPRIME, T_P)
+    t = fold3(tcols)
+    # m = (t mod R) * NPRIME mod R — strictly-8-bit limbs feed the
+    # matmul (mod-R folds: dropping top carries IS the mod)
+    t_lo = fold_modR(fold_modR(t[..., :K, :]))
+    m = _matmul(t_lo, t_np, matmul_mode)
+    m = fold_modR(fold_modR(fold_modR(m)))
+    u = _matmul(m, t_p, matmul_mode)
+    s = fold3(t + u)
+    # low half's value is exactly 0 or R: resolve the residual carry
+    # (binary Kogge-Stone; generate = 256, propagate = 255)
+    low = s[..., :K, :]
+    g = (low == _BASE_F).astype(jnp.float32)
+    p_ = (low == _BASE_F - 1).astype(jnp.float32)
+    span = 1
+    while span < K:
+        g_lo = _pad2(g[..., :-span, :], span, 0)
+        p_lo = _pad2(p_[..., :-span, :], span, 0)
+        g = jnp.maximum(g, p_ * g_lo)
+        p_ = p_ * p_lo
+        span *= 2
+    carry = g[..., K - 1 : K, :]
+    return fold(s[..., K:, :] + _pad2(carry, 0, K - 1))
+
+
+def mont_mul(a, b, matmul_mode: str = "f32", toeplitz=None):
+    return redc(mul_cols(a, b), matmul_mode, toeplitz)
+
+
+def mont_sqr(a, matmul_mode: str = "f32", toeplitz=None):
+    return redc(mul_cols(a, a), matmul_mode, toeplitz)
+
+
+_2P_LIMBS = to_limbs(2 * P)
+
+
+def _c2p(like):
+    return jnp.asarray(_2P_LIMBS)[:, None] * jnp.ones_like(like[..., :1, :])
+
+
+def add(a, b):
+    return fold(a + b)
+
+
+def sub(a, b):
+    """a - b + 2p: values stay NONNEGATIVE (the carry-resolution Kogge
+    in redc assumes a nonnegative low half).  Closure: publics < 2p, so
+    sub < 4p and redc(mul of < 4p inputs) < 2p again (R > 8p)."""
+    return fold(a - b + _c2p(a))
+
+
+def mul_small(a, k: int):
+    assert -8 <= k <= 8
+    return fold2(np.float32(k) * a)
+
+
+def select(mask, a, b):
+    return jnp.where(mask[..., None, :], a, b)
+
+
+# -- bridges to the int32 engine (12-bit limbs <-> 8-bit limbs) -------------
+
+
+def from_int32_planes(planes12) -> jnp.ndarray:
+    """int32 [NL(33), B] 12-bit planes -> f32 [48, B] 8-bit planes.
+
+    Exact device-side rebase: every 12-bit limb contributes to at most
+    two 8-bit limbs; done via bit arithmetic in int32 then cast."""
+    from . import layout as LY
+
+    # int32 suffices: 12-bit limbs shifted <= 11 bits stay < 2^24
+    x = planes12.astype(jnp.int32)
+    # value bits: limb i covers bits [12i, 12i+12)
+    out = []
+    for k in range(K):
+        lo_bit = 8 * k
+        i = lo_bit // 12
+        off = lo_bit - 12 * i
+        v = x[..., i, :] >> off
+        if off > 4 and i + 1 < LY.NL:  # spills into the next limb
+            v = v | (x[..., i + 1, :] << (12 - off))
+        out.append((v & 0xFF).astype(jnp.float32))
+    return jnp.stack(out, axis=-2)
