@@ -1,4 +1,4 @@
-"""f32/MXU field-core prototype: 48x8-bit limbs, REDC on the matrix unit.
+"""f32/MXU field-core prototype: 52x8-bit limbs, REDC on the matrix unit.
 
 Round-4's on-chip probes put the int32 core's scalar-mul stage ~30x over
 its op-count estimate; the prime suspect is int32-multiply emulation on
@@ -6,26 +6,30 @@ the VPU (TPUs are float machines — the CPU interpret run already shows
 a 13x int32/f32 multiply gap).  This module reformulates the field
 layer for float hardware:
 
-  - limbs: 48 x 8-bit, SIGNED-lazy, carried in f32.  f32 integers are
+  - limbs: 52 x 8-bit, SIGNED-lazy, carried in f32.  f32 integers are
     exact to 2^24; 8-bit canonical limbs make schoolbook columns
-    (<= 48 terms x 2^16) and the REDC matmuls exact.
-  - Montgomery radix R = 2^384 (48 * 8) — tighter than the int32
-    core's 2^396, so folds run more often; the payoff is below.
+    (<= 52 terms x 2^16) and the REDC matmuls exact.
+  - Montgomery radix R = 2^416 (52 * 8): ~2^35 slack over p, so lazy
+    add/sub/mul_small chains (curve formulas) keep the TOP limb tiny —
+    a 48-limb/2^384 first cut exploded after 4 chained doublings
+    because 2^3 slack let the top limb outgrow the 8-bit mul budget.
   - THE PAYOFF: REDC's two big products have a SHARED constant operand
     (NPRIME and p), so they are literal matrix multiplies
-        m = fold(t_lo) @ TOEPLITZ_NPRIME   [B,48] x [48,48]  (mod R free)
-        u = fold(m)    @ TOEPLITZ_P        [B,48] x [48,96]
+        m = fold(t_lo) @ TOEPLITZ_NPRIME   [B,52] x [52,52]  (mod R free)
+        u = fold(m)    @ TOEPLITZ_P        [B,52] x [52,104]
     which the MXU executes at matrix rates — in bf16 x bf16 -> f32,
     EXACT for 8-bit entries (bf16 holds integers <= 256 exactly; the
-    f32 accumulator holds the <= 2^21.6 columns exactly).  Only the
+    f32 accumulator holds the <= 2^22 columns exactly).  Only the
     per-lane a*b schoolbook stays on the VPU, in native-rate f32.
 
 Bound discipline (mirrors kernels/layout.py's, scaled to 8-bit limbs;
 tests/test_kernels_core_f32.py checks against exact integer mirrors):
   mul inputs need |limbs| <= 511 (one lazy add of canonicals), giving
-  |columns| <= 48 * 511^2 < 2^23.6 — f32-exact.  `fold` (floor-based,
+  |columns| <= 52 * 511^2 < 2^23.7 — f32-exact.  `fold` (floor-based,
   value-preserving for signed values) restores limbs to [0, 256) with a
-  small signed top.  add/sub are lazy; chains beyond 2 terms fold.
+  tiny signed top (values stay < ~2^390 << 2^408, so the top limb a
+  fold leaves unmasked cannot approach the budget).  add/sub are lazy;
+  chains beyond 2 terms fold.
 
 Everything is value-level ([..., K, B] planes, limbs on sublanes) and
 runs inside pallas kernels or plain jit.  `matmul_mode` selects the
@@ -42,11 +46,11 @@ import jax.numpy as jnp
 
 from ..crypto import fields as GT
 
-K = 48  # limbs
+K = 52  # limbs
 LIMB_BITS = 8
 BASE = 1 << LIMB_BITS  # 256
 KC = 2 * K  # product columns
-R_BITS = K * LIMB_BITS  # 384
+R_BITS = K * LIMB_BITS  # 416
 P = GT.P
 R = 1 << R_BITS
 R2 = R * R % P
@@ -151,7 +155,7 @@ def fold_modR(t):
 def mul_cols(a, b):
     """Schoolbook columns [..., K, B] x [..., K, B] -> [..., KC, B].
 
-    Inputs need |limbs| <= 511 for f32-exact columns.  48 unrolled
+    Inputs need |limbs| <= 511 for f32-exact columns.  K unrolled
     broadcast-row multiply-adds on the VPU at native f32 rate."""
     acc = _pad2(a[..., 0:1, :] * b, 0, KC - K)
     for j in range(1, K):
@@ -225,22 +229,16 @@ def mont_sqr(a, matmul_mode: str = "f32", toeplitz=None):
     return redc(mul_cols(a, a), matmul_mode, toeplitz)
 
 
-_2P_LIMBS = to_limbs(2 * P)
-
-
-def _c2p(like):
-    return jnp.asarray(_2P_LIMBS)[:, None] * jnp.ones_like(like[..., :1, :])
-
-
 def add(a, b):
     return fold(a + b)
 
 
 def sub(a, b):
-    """a - b + 2p: values stay NONNEGATIVE (the carry-resolution Kogge
-    in redc assumes a nonnegative low half).  Closure: publics < 2p, so
-    sub < 4p and redc(mul of < 4p inputs) < 2p again (R > 8p)."""
-    return fold(a - b + _c2p(a))
+    """Plain signed subtraction (like the int32 core): redc's Kogge
+    carry resolution tolerates the slightly-negative limbs folds of
+    signed values produce — the low half of t+u is ≡ 0 mod R, bounded
+    in (-small, 2R), hence exactly {0, R}."""
+    return fold(a - b)
 
 
 def mul_small(a, k: int):
@@ -256,7 +254,7 @@ def select(mask, a, b):
 
 
 def from_int32_planes(planes12) -> jnp.ndarray:
-    """int32 [NL(33), B] 12-bit planes -> f32 [48, B] 8-bit planes.
+    """int32 [NL(33), B] 12-bit planes -> f32 [K, B] 8-bit planes.
 
     Exact device-side rebase: every 12-bit limb contributes to at most
     two 8-bit limbs; done via bit arithmetic in int32 then cast."""
@@ -270,6 +268,11 @@ def from_int32_planes(planes12) -> jnp.ndarray:
         lo_bit = 8 * k
         i = lo_bit // 12
         off = lo_bit - 12 * i
+        if i >= LY.NL:
+            # beyond the 33x12 = 396 source bits: ZERO, not a clamped
+            # re-read of limb 32 (jax clamps out-of-bounds indices)
+            out.append(jnp.zeros_like(x[..., 0, :], jnp.float32))
+            continue
         v = x[..., i, :] >> off
         if off > 4 and i + 1 < LY.NL:  # spills into the next limb
             v = v | (x[..., i + 1, :] << (12 - off))

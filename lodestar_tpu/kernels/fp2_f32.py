@@ -1,0 +1,79 @@
+"""Fp2 on the f32/MXU field core (prototype tier).
+
+Mirrors kernels/fp2.py's surface over core_f32: (c0, c1) pairs of
+[..., K, B] f32 planes, Karatsuba (3-mult) complex arithmetic over
+u^2 = -1.  Enough surface to run curve doubling chains for the engine
+bake-off; the full tower follows if the on-chip bisect picks this
+engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import core_f32 as F
+
+
+def add2(a, b):
+    return (F.add(a[0], b[0]), F.add(a[1], b[1]))
+
+
+def sub2(a, b):
+    return (F.sub(a[0], b[0]), F.sub(a[1], b[1]))
+
+
+def neg2(a):
+    return (-a[0], -a[1])
+
+
+def double2(a):
+    return (F.mul_small(a[0], 2), F.mul_small(a[1], 2))
+
+
+def mul2_small(a, k: int):
+    return (F.mul_small(a[0], k), F.mul_small(a[1], k))
+
+
+def mul2(a, b, mode: str = "f32", toeplitz=None):
+    """(a0 + a1 u)(b0 + b1 u), u^2 = -1 — Karatsuba: 3 mults."""
+    t0 = F.mont_mul(a[0], b[0], mode, toeplitz)
+    t1 = F.mont_mul(a[1], b[1], mode, toeplitz)
+    s = F.mont_mul(
+        F.add(a[0], a[1]), F.add(b[0], b[1]), mode, toeplitz
+    )
+    c0 = F.sub(t0, t1)
+    c1 = F.sub(F.sub(s, t0), t1)
+    return (c0, c1)
+
+
+def sqr2(a, mode: str = "f32", toeplitz=None):
+    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u — 2 mults."""
+    c0 = F.mont_mul(
+        F.add(a[0], a[1]), F.sub(a[0], a[1]), mode, toeplitz
+    )
+    c1 = F.mul_small(F.mont_mul(a[0], a[1], mode, toeplitz), 2)
+    return (c0, c1)
+
+
+def select2(mask, a, b):
+    return (F.select(mask, a[0], b[0]), F.select(mask, a[1], b[1]))
+
+
+def jac_dbl_g1(pt, mode: str = "f32", toeplitz=None):
+    """2P on E1 (a=0 short Weierstrass), Fp coordinates — the f32-core
+    twin of kernels/curve.jac_dbl(FP_OPS) for the engine bake-off."""
+    X, Y, Z = pt
+    A = F.mont_sqr(X, mode, toeplitz)
+    B = F.mont_sqr(Y, mode, toeplitz)
+    CC = F.mont_sqr(B, mode, toeplitz)
+    inner = F.sub(F.sub(F.mont_sqr(F.add(X, B), mode, toeplitz), A), CC)
+    D = F.mul_small(inner, 2)
+    E = F.mul_small(A, 3)
+    Ff = F.mont_sqr(E, mode, toeplitz)
+    X3 = F.sub(Ff, F.mul_small(D, 2))
+    Y3 = F.sub(
+        F.mont_mul(E, F.sub(D, X3), mode, toeplitz),
+        F.mul_small(CC, 8),
+    )
+    Z3 = F.mul_small(F.mont_mul(Y, Z, mode, toeplitz), 2)
+    return (X3, Y3, Z3)

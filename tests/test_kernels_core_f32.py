@@ -34,7 +34,7 @@ def test_codec_roundtrip():
     planes = jnp.asarray(F.encode_batch(xs))
     assert _decode_mont(planes) == xs
     # limb/value constants hold
-    assert F.R == 1 << 384 and F.R > 8 * GT.P
+    assert F.R == 1 << (8 * F.K) and F.R > 8 * GT.P
 
 
 def test_mont_mul_matches_oracle():
@@ -109,3 +109,51 @@ def test_bridge_from_int32_planes():
     a = np.asarray(planes8, np.float64)
     got = [F.from_limbs(a[:, j]) for j in range(B)]
     assert got == raw
+
+
+def test_f32_jac_dbl_chain_matches_oracle():
+    """64 chained G1 doublings on the f32 engine — signed-value paths
+    (subs, negatives through folds and the redc Kogge) under stress."""
+    from lodestar_tpu.crypto import curves as GC
+    from lodestar_tpu.crypto import fields as GF2
+    from lodestar_tpu.kernels import fp2_f32 as F2F
+
+    ks = [3, 5, 7, 11, 13, 17, 19, 23]
+    pts = [GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, k) for k in ks]
+    X = jnp.asarray(F.encode_batch([p[0] for p in pts]))
+    Y = jnp.asarray(F.encode_batch([p[1] for p in pts]))
+    Z = jnp.asarray(F.encode_batch([1] * len(pts)))
+    pt = (X, Y, Z)
+    for _ in range(64):
+        pt = F2F.jac_dbl_g1(pt)
+    xs = F.decode_batch(np.asarray(pt[0]))
+    ys = F.decode_batch(np.asarray(pt[1]))
+    zs = F.decode_batch(np.asarray(pt[2]))
+    mult = 1 << 64
+    for k, x, y, z in zip(ks, xs, ys, zs):
+        want = GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, k * mult % GF2.R)
+        zi = GT.fp_inv(z)
+        zi2 = GT.fp_mul(zi, zi)
+        got = (GT.fp_mul(x, zi2), GT.fp_mul(y, GT.fp_mul(zi2, zi)))
+        assert got == want, f"k={k}"
+
+
+def test_f32_fp2_mul_matches_oracle():
+    from lodestar_tpu.crypto import fields as GF2
+    from lodestar_tpu.kernels import fp2_f32 as F2F
+
+    a = [(x, y) for x, y in zip(_rand_elems(B), _rand_elems(B))]
+    b = [(x, y) for x, y in zip(_rand_elems(B), _rand_elems(B))]
+    pa = (jnp.asarray(F.encode_batch([v[0] for v in a])),
+          jnp.asarray(F.encode_batch([v[1] for v in a])))
+    pb = (jnp.asarray(F.encode_batch([v[0] for v in b])),
+          jnp.asarray(F.encode_batch([v[1] for v in b])))
+    c0, c1 = F2F.mul2(pa, pb)
+    s0, s1 = F2F.sqr2(pa)
+    for j, (x, y) in enumerate(zip(a, b)):
+        want = GF2.fp2_mul(x, y)
+        assert (F.decode_batch(np.asarray(c0))[j],
+                F.decode_batch(np.asarray(c1))[j]) == want
+        wsq = GF2.fp2_mul(x, x)
+        assert (F.decode_batch(np.asarray(s0))[j],
+                F.decode_batch(np.asarray(s1))[j]) == wsq
