@@ -252,13 +252,22 @@ def build_wire_world():
 
 
 def main_wire():
+    t_build0 = time.perf_counter()
     table, jobs = build_wire_world()
     verifier = TpuBlsVerifier(table, max_job_sets=BATCH)
+    t_build = time.perf_counter() - t_build0
 
     # Warm-up / compile on the throwaway job (its own roots, so the timed
     # region still pays its own hash-to-curve batches).
+    t_warm0 = time.perf_counter()
     warm = verifier.begin_job(jobs[0], batchable=True)
     assert verifier.finish_job(warm), "bench warmup failed verification"
+    t_warm = time.perf_counter() - t_warm0
+    print(
+        f"# breakdown: world-build {t_build:.1f}s, warmup (trace+compile+run) "
+        f"{t_warm:.1f}s",
+        file=sys.stderr,
+    )
 
     t0 = time.perf_counter()
     # hash all fresh signing roots in ONE device batch (the per-slot
