@@ -68,14 +68,22 @@ def compute_subnet_for_attestation(
 
 class AttnetsService:
     """Long-lived node-id subnets + short-lived committee-duty
-    subscriptions (reference: attnetsService.ts)."""
+    subscriptions (reference: attnetsService.ts).
 
-    def __init__(self, node_id: int):
+    `all_subnets` mirrors the reference's --subscribeAllSubnets: the
+    service reports EVERY subnet as active and advertises all metadata
+    bits, so gossip subscriptions, req/resp metadata, and peer
+    selection stay consistent from one switch."""
+
+    def __init__(self, node_id: int, all_subnets: bool = False):
         self.node_id = node_id
+        self.all_subnets = all_subnets
         # (slot, subnet) -> expiry slot for duty subscriptions
         self._short_lived: Dict[int, int] = {}
 
     def long_lived_subnets(self, epoch: int) -> List[int]:
+        if self.all_subnets:
+            return list(range(params.ATTESTATION_SUBNET_COUNT))
         return compute_subscribed_subnets(self.node_id, epoch)
 
     def prepare_committee_subscription(
@@ -121,7 +129,8 @@ class SyncnetsService:
     syncnetsService.ts: subscribe while any local validator serves the
     committee period)."""
 
-    def __init__(self):
+    def __init__(self, all_subnets: bool = False):
+        self.all_subnets = all_subnets
         # subnet -> until_epoch
         self._subscriptions: Dict[int, int] = {}
 
@@ -133,6 +142,8 @@ class SyncnetsService:
         )
 
     def active_subnets(self, epoch: int) -> Set[int]:
+        if self.all_subnets:
+            return set(range(params.SYNC_COMMITTEE_SUBNET_COUNT))
         self.prune(epoch)
         return set(self._subscriptions)
 

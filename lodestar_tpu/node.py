@@ -53,6 +53,9 @@ class NodeOptions:
     kzg_setup: Optional[object] = None
     # bearer token enabling the keymanager REST namespace; None = off
     keymanager_token: Optional[str] = None
+    # subscribe every attestation/sync subnet (reference:
+    # --subscribeAllSubnets; sims and aggregator-heavy deployments)
+    subscribe_all_subnets: bool = False
 
 
 class BeaconNode:
@@ -264,6 +267,24 @@ class FullBeaconNode:
 
         self.chain.emitter.on(ChainEvent.head, self.prepare_scheduler.on_head)
 
+        # subnet POLICY first (reference: attnetsService.ts) — gossip
+        # subscriptions, req/resp metadata, and peer selection must all
+        # read the same source (opts.subscribe_all_subnets flips it to
+        # the reference's --subscribeAllSubnets behavior)
+        from .network.subnets import AttnetsService, SyncnetsService
+
+        # the 256-bit discovery node-id, derived from the bus identity
+        # (a real discv5 integration would use the ENR node-id)
+        node_id_int = int.from_bytes(
+            hashlib.sha256((opts.node_id or "node").encode()).digest(), "big"
+        )
+        self.attnets = AttnetsService(
+            node_id_int, all_subnets=opts.subscribe_all_subnets
+        )
+        self.syncnets = SyncnetsService(
+            all_subnets=opts.subscribe_all_subnets
+        )
+
         # gossip handlers + peer scoring, joined to a bus when provided
         self.score_book = PeerScoreBook()
         self.handlers = GossipHandlers(
@@ -286,8 +307,25 @@ class FullBeaconNode:
                 self.score_book,
             )
             if opts.gossip_bus is not None:
+                epoch0 = self.chain.head_state.slot // params.SLOTS_PER_EPOCH
                 self.handlers.subscribe_all(
-                    opts.gossip_bus, opts.node_id, digest, scorer=self.scorer
+                    opts.gossip_bus,
+                    opts.node_id,
+                    digest,
+                    # THE policy decides (long-lived node-id subnets, or
+                    # everything under --subscribeAllSubnets)
+                    attnets=tuple(
+                        sorted(
+                            self.attnets.active_subnets(
+                                epoch0, self.chain.head_state.slot
+                            )
+                        )
+                    ),
+                    syncnets=tuple(
+                        sorted(self.syncnets.active_subnets(epoch0))
+                        or range(params.SYNC_COMMITTEE_SUBNET_COUNT)
+                    ),
+                    scorer=self.scorer,
                 )
 
         # network processor over the validators' backpressure
@@ -308,16 +346,7 @@ class FullBeaconNode:
         from .network.peers import PeerStatus
         from .network.reqresp import ReqResp
         from .network.reqresp_protocols import ReqRespBeaconNode
-        from .network.subnets import AttnetsService, SyncnetsService
 
-        # subnet policy wants the 256-bit discovery node-id; derive it
-        # from the bus identity string (a real discv5 integration would
-        # use the ENR node-id)
-        node_id_int = int.from_bytes(
-            hashlib.sha256((opts.node_id or "node").encode()).digest(), "big"
-        )
-        self.attnets = AttnetsService(node_id_int)
-        self.syncnets = SyncnetsService()
         # the p2p spec requires seq_number to BUMP whenever the metadata
         # content changes — peers re-fetch metadata only on a new seq
         self._metadata_seq = 0
