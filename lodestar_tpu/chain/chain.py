@@ -130,10 +130,25 @@ class BeaconChain:
         """Import one signed block.  `timely` marks a proposal that
         arrived before 1/3 slot — it receives the proposer score boost
         (reference: forkChoice.ts onBlock blockDelaySec gate)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         block = signed_block["message"]
         root = self._block_type(int(block["slot"])).hash_tree_root(block)
         if self.fork_choice.has_block(root.hex()):
             return root  # already imported
+        try:
+            return self._process_block_inner(
+                signed_block, block, root, timely
+            )
+        finally:
+            timer = getattr(self, "import_timer", None)
+            if timer is not None:
+                timer.observe(_time.perf_counter() - t0)
+
+    def _process_block_inner(
+        self, signed_block: dict, block: dict, root: bytes, timely: bool
+    ) -> bytes:
 
         pre_state = self.regen.get_pre_state(block)
 

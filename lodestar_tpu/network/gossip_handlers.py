@@ -50,6 +50,9 @@ class GossipHandlers:
         # deneb blob verification needs a KZG trusted setup; without one
         # the blob topics are not served
         self.kzg_setup = kzg_setup
+        # optional {verdict: LabeledCounter} incremented at the source
+        # (utils/beacon_metrics.py observe_gossip)
+        self.verdict_counters = None
 
     def _block_is_timely(self, slot: int) -> bool:
         """Measured arrival delay < 1/3 slot (reference: forkChoice.ts
@@ -102,6 +105,10 @@ class GossipHandlers:
     def _count(self, name: str, verdict: str) -> None:
         self.results.setdefault(name, {}).setdefault(verdict, 0)
         self.results[name][verdict] += 1
+        if self.verdict_counters is not None:
+            counter = self.verdict_counters.get(verdict)
+            if counter is not None:
+                counter.inc(name, 1.0)
 
     def _prune(self, slot: int) -> None:
         if slot > self._last_pruned_slot:

@@ -323,7 +323,6 @@ class FullBeaconNode:
                     ),
                     syncnets=tuple(
                         sorted(self.syncnets.active_subnets(epoch0))
-                        or range(params.SYNC_COMMITTEE_SUBNET_COUNT)
                     ),
                     scorer=self.scorer,
                 )
@@ -437,6 +436,20 @@ class FullBeaconNode:
             lambda s: self.reqresp.prune_limiters()
             if s % params.SLOTS_PER_EPOCH == 0
             else None
+        )
+
+        # beacon-chain spec metrics over the shared registry
+        # (reference: metrics/metrics/beacon.ts + lodestar.ts chain/
+        # network families; the bls_thread_pool family lives in
+        # utils/metrics.py already).  Verdicts count at the handler;
+        # only the peer gauge samples on the tick.
+        from .utils.beacon_metrics import BeaconMetrics
+
+        self.beacon_metrics = BeaconMetrics(self.registry)
+        self.beacon_metrics.observe_chain(self.chain)
+        self.beacon_metrics.observe_gossip(self.handlers)
+        self.clock.on_slot(
+            lambda _s: self.beacon_metrics.sample_peers(self.peer_manager)
         )
 
         # REST API over everything

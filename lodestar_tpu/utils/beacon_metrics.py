@@ -1,0 +1,127 @@
+"""Beacon-chain spec metrics — the chain/network instrument family.
+
+Mirror of the reference's beacon metric surface (reference:
+packages/beacon-node/src/metrics/metrics/beacon.ts + the chain/network
+counters in metrics/lodestar.ts beyond the bls_thread_pool family the
+repo already exposes in utils/metrics.py): head/finality gauges, block
+import counters and latencies, reorg detection, gossip verdicts per
+topic (counted AT the handler, Prometheus counter type), op-pool
+sizes, peer counts.  One object wires into the chain emitter + gossip
+handlers + peer manager and feeds the shared Registry/HTTP exposition.
+"""
+
+from __future__ import annotations
+
+from .metrics import Registry
+
+_IMPORT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+class BeaconMetrics:
+    def __init__(self, registry: Registry):
+        g = registry.gauge
+        c = registry.counter
+        # spec gauges (beacon.ts)
+        self.head_slot = g("beacon_head_slot", "Latest head slot")
+        self.finalized_epoch = g(
+            "beacon_finalized_epoch", "Latest finalized epoch"
+        )
+        self.current_justified_epoch = g(
+            "beacon_current_justified_epoch", "Current justified epoch"
+        )
+        self.reorg_count = c(
+            "beacon_reorgs_total", "Head moved to a non-descendant block"
+        )
+        # block import (lodestar.ts beacon_block metrics)
+        self.blocks_imported = c(
+            "lodestar_block_import_total", "Blocks imported"
+        )
+        self.block_import_time = registry.histogram(
+            "lodestar_block_import_seconds",
+            "Full import pipeline time per block",
+            _IMPORT_BUCKETS,
+        )
+        # gossip verdicts per topic — real counters, incremented at the
+        # handler the moment the verdict lands
+        self.gossip_verdicts = {
+            verdict: registry.labeled_counter(
+                f"lodestar_gossip_{verdict}_total",
+                f"Gossip messages {verdict}ed",
+                "topic",
+            )
+            for verdict in ("accept", "ignore", "reject")
+        }
+        # op pools (opPool metrics)
+        self.op_pool_attestations = g(
+            "lodestar_oppool_attestation_pool_size",
+            "Unaggregated attestation pool size",
+        )
+        self.op_pool_aggregates = g(
+            "lodestar_oppool_aggregated_attestation_pool_size",
+            "Aggregated attestation pool size",
+        )
+        # peers (peer manager)
+        self.peers_connected = g("libp2p_peers", "Connected peer count")
+        self._last_head: str | None = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def observe_chain(self, chain) -> None:
+        """Subscribe to block/head events; instrument import timing."""
+        from ..chain.emitter import ChainEvent
+
+        def on_block(_signed, _root):
+            # ONE per import, at the layer that owns the count
+            self.blocks_imported.inc()
+
+        def on_head(head_root, _block_slot):
+            # the HEAD's slot (a side-fork import emits too; the block's
+            # own slot would make the gauge regress)
+            st = chain.head_state
+            self.head_slot.set(int(st.slot))
+            self.current_justified_epoch.set(
+                int(st.current_justified_checkpoint["epoch"])
+            )
+            self.finalized_epoch.set(int(st.finalized_checkpoint["epoch"]))
+            new_head = bytes(head_root).hex()
+            if self._last_head is not None and new_head != self._last_head:
+                # reorg iff the new head does NOT descend from the old
+                # one (normal advance = old head is the parent chain)
+                if not _descends_from(
+                    chain.fork_choice, new_head, self._last_head
+                ):
+                    self.reorg_count.inc()
+            self._last_head = new_head
+            try:
+                self.op_pool_attestations.set(chain.attestation_pool.size())
+                self.op_pool_aggregates.set(
+                    chain.aggregated_attestation_pool.size()
+                )
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                pass
+
+        chain.emitter.on(ChainEvent.block, on_block)
+        chain.emitter.on(ChainEvent.head, on_head)
+        # the import pipeline observes into this histogram when present
+        chain.import_timer = self.block_import_time
+
+    def observe_gossip(self, handlers) -> None:
+        """Count verdicts at the source (the handler ledger increments
+        these counters the moment each verdict lands)."""
+        handlers.verdict_counters = self.gossip_verdicts
+
+    def sample_peers(self, peer_manager) -> None:
+        self.peers_connected.set(len(peer_manager.peers))
+
+
+def _descends_from(fork_choice, descendant_hex: str, ancestor_hex: str) -> bool:
+    proto = fork_choice.proto
+    idx = proto.indices.get(descendant_hex)
+    target = proto.indices.get(ancestor_hex)
+    if idx is None or target is None:
+        return False
+    while idx is not None:
+        if idx == target:
+            return True
+        idx = proto.nodes[idx].parent
+    return False

@@ -67,6 +67,8 @@ class LabeledGauge:
     """Gauge with one label dimension (the reference's per-worker
     jobsWorkerTime gauge, labelNames: ["workerId"])."""
 
+    TYPE = "gauge"
+
     def __init__(self, name: str, help_: str, label: str):
         self.name, self.help, self.label = name, help_, label
         self._v: Dict[str, float] = {}
@@ -79,14 +81,26 @@ class LabeledGauge:
     def get(self, label_value: str) -> float:
         return self._v.get(label_value, 0.0)
 
+    def set(self, label_value: str, v: float) -> None:
+        """Idempotent resample (ledger mirroring)."""
+        with self._lock:
+            self._v[label_value] = v
+
     def expose(self) -> List[str]:
         out = [
             f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} gauge",
+            f"# TYPE {self.name} {self.TYPE}",
         ]
         for lv, v in sorted(self._v.items()):
             out.append(f'{self.name}{{{self.label}="{lv}"}} {v}')
         return out
+
+
+class LabeledCounter(LabeledGauge):
+    """Monotonic labeled counter (exposition TYPE counter — rate() and
+    increase() in Prometheus need the counter contract)."""
+
+    TYPE = "counter"
 
 
 class Histogram:
@@ -147,6 +161,9 @@ class Registry:
 
     def labeled_gauge(self, name: str, help_: str, label: str) -> LabeledGauge:
         return self._get(name, lambda: LabeledGauge(name, help_, label))
+
+    def labeled_counter(self, name: str, help_: str, label: str) -> "LabeledCounter":
+        return self._get(name, lambda: LabeledCounter(name, help_, label))
 
     def _get(self, name, factory):
         if name not in self._metrics:

@@ -147,3 +147,69 @@ def test_timings_visible_over_rest(world):
     finally:
         server.close()
         service.close()
+
+
+def test_beacon_metrics_family():
+    """Spec gauges, import counter/timer, reorg detection, and source-
+    counted gossip verdicts (reference: metrics/metrics/beacon.ts)."""
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+    from lodestar_tpu.state_transition.slot import process_slots
+    from lodestar_tpu.utils.beacon_metrics import BeaconMetrics
+    from lodestar_tpu.utils.metrics import Registry
+    from lodestar_tpu.validator import ValidatorStore
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"bm-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    chain = BeaconChain(cfg, genesis)
+    reg = Registry()
+    m = BeaconMetrics(reg)
+    m.observe_chain(chain)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+    # a REAL import drives block/head events + the import timer
+    st = genesis.clone()
+    process_slots(st, 1)
+    proposer = int(get_beacon_proposer_index(st))
+    block = chain.produce_block(1, store.sign_randao(proposer, 1))
+    chain.process_block(
+        {"message": block, "signature": store.sign_block(proposer, block)}
+    )
+    assert m.blocks_imported.value == 1
+    assert m.head_slot.value == 1  # the HEAD's slot, not the block arg
+    assert m.block_import_time.count == 1
+    assert m.reorg_count.value == 0  # linear advance is not a reorg
+    assert m.op_pool_attestations.value == 0
+
+    # gossip verdicts count AT the handler
+    from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+    from lodestar_tpu.network.gossip_handlers import GossipHandlers
+
+    handlers = GossipHandlers(chain, CpuBlsVerifier(pubkeys=[]))
+    m.observe_gossip(handlers)
+    handlers._count("beacon_block", "accept")
+    handlers._count("beacon_block", "reject")
+    handlers._count("beacon_block", "accept")
+    assert m.gossip_verdicts["accept"].get("beacon_block") == 2
+    assert m.gossip_verdicts["reject"].get("beacon_block") == 1
+
+    class _PM:
+        peers = {"a": 1, "b": 2}
+
+    m.sample_peers(_PM())
+    assert m.peers_connected.value == 2
+    text = reg.expose()
+    assert "beacon_head_slot 1" in text
+    assert "# TYPE lodestar_gossip_accept_total counter" in text
+    assert 'lodestar_gossip_accept_total{topic="beacon_block"} 2.0' in text
+    assert "libp2p_peers 2" in text

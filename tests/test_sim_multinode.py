@@ -151,13 +151,11 @@ def test_three_node_sim_reaches_justification():
         proposer = int(get_beacon_proposer_index(st))
         owner = stores[owners[proposer]]
         block = ref.produce_block(slot, owner.sign_randao(proposer, slot))
-        root = cfg.compute_signing_root(
-            cfg.get_fork_types(slot)[0].hash_tree_root(block),
-            cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
-        )
+        # sign through the owning store: slashing protection +
+        # fork-aware domain dispatch live there
         signed = {
             "message": block,
-            "signature": C.g2_compress(B.sign(sks[proposer], root)),
+            "signature": owner.sign_block(proposer, block),
         }
         n_recv = bus.publish(
             "proposer",
