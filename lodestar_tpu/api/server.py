@@ -46,6 +46,9 @@ class DefaultHandlers:
         self.spec = spec or {}
         self.chain = chain  # BeaconChain for the stateful endpoints
         self.attnets = attnets  # AttnetsService for duty subscriptions
+        # set by the node: pushes subnet policy to the gossip transport
+        # immediately after a duty announcement (no next-tick wait)
+        self.on_subnet_policy_change = None
         self.light_client_server = light_client_server
         self.peer_manager = peer_manager  # node/peers namespace
         self.validator_store = validator_store  # keymanager namespace
@@ -134,6 +137,12 @@ class DefaultHandlers:
                     is_aggregator=bool(sub["is_aggregator"]),
                 )
             )
+        # push the new policy to the transport NOW — a duty for the
+        # CURRENT slot must not wait for the next slot tick, or the
+        # aggregator misses this slot's attestations (reference:
+        # attnetsService.ts subscribes gossip on the subscription event)
+        if subnets and self.on_subnet_policy_change is not None:
+            self.on_subnet_policy_change()
         return 200, {"data": [str(s) for s in subnets]}
 
     def prepare_beacon_proposer(self, params, body):

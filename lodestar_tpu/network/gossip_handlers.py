@@ -53,6 +53,14 @@ class GossipHandlers:
         # optional {verdict: LabeledCounter} incremented at the source
         # (utils/beacon_metrics.py observe_gossip)
         self.verdict_counters = None
+        # live subnet-subscription state (set by subscribe_all, diffed
+        # by sync_subnet_subscriptions on slot ticks)
+        self._bus = None
+        self._bus_node_id = None
+        self._bus_digest = None
+        self._bus_scorer = None
+        self._subscribed_attnets: set = set()
+        self._subscribed_syncnets: set = set()
 
     def _block_is_timely(self, slot: int) -> bool:
         """Measured arrival delay < 1/3 slot (reference: forkChoice.ts
@@ -269,3 +277,42 @@ class GossipHandlers:
             ]
         for t in topics:
             bus.subscribe(node_id, t, self.handle, scorer=scorer)
+        self._bus = bus
+        self._bus_node_id = node_id
+        self._bus_digest = fork_digest
+        self._bus_scorer = scorer
+        self._subscribed_attnets = set(attnets)
+        self._subscribed_syncnets = set(syncnets)
+
+    def sync_subnet_subscriptions(self, attnets, syncnets) -> None:
+        """Diff the CURRENT policy-active subnets against what is live on
+        the bus, subscribing/unsubscribing the delta.  This is the live
+        leg the reference drives from attnetsService's subscription
+        events (reference: attnetsService.ts onSlot -> gossip.subscribe
+        TopicSubscription churn) — without it, duty subscriptions made
+        after init (REST beacon_committee_subscriptions, sync-committee
+        duty windows) never reach the transport and long-lived subnets
+        never rotate."""
+        if self._bus is None:
+            return
+        want_att, want_sync = set(attnets), set(syncnets)
+        for want, have, topic_name in (
+            (want_att, self._subscribed_attnets,
+             GossipTopicName.beacon_attestation),
+            (want_sync, self._subscribed_syncnets,
+             GossipTopicName.sync_committee),
+        ):
+            for s in want - have:
+                self._bus.subscribe(
+                    self._bus_node_id,
+                    topic_string(self._bus_digest, topic_name, subnet=s),
+                    self.handle,
+                    scorer=self._bus_scorer,
+                )
+            for s in have - want:
+                self._bus.unsubscribe(
+                    self._bus_node_id,
+                    topic_string(self._bus_digest, topic_name, subnet=s),
+                )
+        self._subscribed_attnets = want_att
+        self._subscribed_syncnets = want_sync

@@ -421,6 +421,21 @@ class FullBeaconNode:
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
         self.clock.on_slot(self.prepare_scheduler.on_slot)
+        # live subnet churn: duty subscriptions made after init and
+        # long-lived rotations must reach the bus (reference:
+        # attnetsService.ts slot-driven gossip subscription updates).
+        # Runs on every slot tick AND immediately after REST duty
+        # announcements (a current-slot aggregator duty cannot wait).
+        def _push_subnet_policy(slot=None):
+            s = self.clock.current_slot if slot is None else slot
+            epoch = s // params.SLOTS_PER_EPOCH
+            self.handlers.sync_subnet_subscriptions(
+                self.attnets.active_subnets(epoch, s),
+                self.syncnets.active_subnets(epoch),
+            )
+
+        self._push_subnet_policy = _push_subnet_policy
+        self.clock.on_slot(_push_subnet_policy)
         # ping/status cadence EVERY slot (the methods rate-limit by
         # their own intervals); heartbeat on its own modulus
         self.clock.on_slot(
@@ -455,8 +470,7 @@ class FullBeaconNode:
         # REST API over everything
         self.api = None
         if opts.serve_api:
-            self.api = BeaconApiServer(
-                DefaultHandlers(
+            api_handlers = DefaultHandlers(
                     genesis_time=config.genesis_time,
                     genesis_validators_root=config.genesis_validators_root,
                     processor=self.processor,
@@ -469,9 +483,9 @@ class FullBeaconNode:
                     peer_manager=self.peer_manager,
                     keymanager_token=opts.keymanager_token,
                     proposer_cache=self.proposer_cache,
-                ),
-                port=opts.api_port,
-            )
+                )
+            api_handlers.on_subnet_policy_change = _push_subnet_policy
+            self.api = BeaconApiServer(api_handlers, port=opts.api_port)
         return self
 
     def _process_gossip_message(self, msg) -> None:

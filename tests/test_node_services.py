@@ -358,3 +358,90 @@ def test_full_beacon_node_single_init_path(tmp_path):
         assert sum(md["attnets"]) >= 2  # long-lived subnet policy active
     finally:
         node.close()
+
+
+def test_live_subnet_subscription_churn(tmp_path):
+    """Duty subscriptions made AFTER init reach the gossip bus on the
+    next slot tick, and expire off it (reference: attnetsService.ts
+    slot-driven gossip subscription churn).  A one-shot snapshot at
+    init would silently drop aggregator duties announced over REST."""
+    from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.network.gossip import (
+        GossipTopicName,
+        InMemoryGossipBus,
+        topic_string,
+    )
+    from lodestar_tpu.network.subnets import SUBSCRIPTION_EXPIRY_SLOTS
+    from lodestar_tpu.node import FullBeaconNode, NodeOptions
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu import params as _p
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0},
+        genesis_time=10,
+    )
+    sks = [B.keygen(b"churn-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=10)
+    bus = InMemoryGossipBus()
+    node = FullBeaconNode.init(
+        cfg,
+        genesis,
+        NodeOptions(
+            serve_api=False,
+            verifier=CpuBlsVerifier(pubkeys=[]),
+            gossip_bus=bus,
+            node_id="churn-node",
+        ),
+    )
+    try:
+        digest = cfg.fork_digest(0)
+
+        def att_topic(s):
+            return topic_string(
+                digest, GossipTopicName.beacon_attestation, subnet=s
+            )
+
+        long_lived = node.attnets.long_lived_subnets(0)
+        duty_subnet = next(
+            s
+            for s in range(_p.ATTESTATION_SUBNET_COUNT)
+            if s not in long_lived
+        )
+        # not yet subscribed: nobody receives on that subnet
+        assert bus.publish("peer", att_topic(duty_subnet), b"x1") == 0
+        # an aggregator duty announces itself through the REAL policy
+        # entry point (the REST beacon_committee_subscriptions flow):
+        # with one committee per slot the subnet is (slot + index) % N,
+        # so invert it to land on duty_subnet
+        duty_slot = 2
+        index = (duty_subnet - duty_slot) % _p.ATTESTATION_SUBNET_COUNT
+        got = node.attnets.prepare_committee_subscription(
+            committees_per_slot=1,
+            slot=duty_slot,
+            committee_index=index,
+            is_aggregator=True,
+        )
+        assert got == duty_subnet
+        # announcements push to the transport immediately — a duty for
+        # the current slot cannot wait for the next tick
+        node._push_subnet_policy()
+        assert bus.publish("peer", att_topic(duty_subnet), b"now") == 1
+        # ticks keep it (still inside the expiry window)
+        node.clock.set_time(10 + 1 * _p.SECONDS_PER_SLOT)
+        assert bus.publish("peer", att_topic(duty_subnet), b"x2") == 1
+        # long-lived subnets arrived at init and stay
+        assert bus.publish("peer", att_topic(long_lived[0]), b"x3") == 1
+        # past expiry the tick unsubscribes it again
+        node.clock.set_time(
+            10 + (duty_slot + SUBSCRIPTION_EXPIRY_SLOTS + 1)
+            * _p.SECONDS_PER_SLOT
+        )
+        assert bus.publish("peer", att_topic(duty_subnet), b"x4") == 0
+        assert bus.publish("peer", att_topic(long_lived[0]), b"x5") == 1
+    finally:
+        node.close()
