@@ -131,6 +131,8 @@ ROUTES: Tuple[Route, ...] = (
     # keymanager namespace (reference: api/src/keymanager/routes.ts —
     # bearer-token-authenticated; see BeaconApiServer's auth gate)
     Route("GET", "/eth/v1/keystores", "list_keys", auth=True),
+    Route("POST", "/eth/v1/keystores", "import_keystores", auth=True),
+    Route("DELETE", "/eth/v1/keystores", "delete_keystores", auth=True),
     Route("GET", "/eth/v1/remotekeys", "list_remote_keys", auth=True),
     Route("DELETE", "/eth/v1/remotekeys", "delete_remote_keys", auth=True),
     # events namespace (reference: routes/events.ts — SSE stream)

@@ -930,6 +930,97 @@ class DefaultHandlers:
             ]
         }
 
+    def import_keystores(self, params, body):
+        """POST /eth/v1/keystores (reference: keymanager routes
+        importKeystores): decrypt each EIP-2335 keystore with its
+        password, resolve the pubkey to its validator index in the head
+        state registry, and add the signer.  Per-keystore statuses —
+        one bad password must not abort the rest."""
+        err = self._need_store()
+        if err:
+            return err
+        from ..crypto import bls as _B
+        from ..crypto import curves as _C
+        from ..validator.keystore import KeystoreError, decrypt_keystore
+
+        body = body or {}
+        keystores = body.get("keystores", [])
+        passwords = body.get("passwords", [])
+        if len(keystores) != len(passwords):
+            return 400, {"message": "keystores/passwords length mismatch"}
+        # slashing records travel WITH keys between clients
+        if body.get("slashing_protection"):
+            try:
+                self.validator_store.slashing.import_interchange(
+                    json.loads(body["slashing_protection"])
+                )
+            except Exception as e:
+                return 400, {"message": f"bad slashing_protection: {e}"}
+        head = self.chain.head_state if self.chain is not None else None
+        statuses = []
+        for ks_json, pw in zip(keystores, passwords):
+            try:
+                ks = (
+                    json.loads(ks_json)
+                    if isinstance(ks_json, str)
+                    else ks_json
+                )
+                secret = decrypt_keystore(ks, pw)
+                sk = int.from_bytes(secret, "big")
+                pk = _C.g1_compress(_B.sk_to_pk(sk))
+                if self.validator_store.local_index_of(pk) is not None:
+                    statuses.append({"status": "duplicate"})
+                    continue
+                idx = head.pubkey_index(pk) if head is not None else None
+                if idx is None:
+                    statuses.append(
+                        {
+                            "status": "error",
+                            "message": "pubkey not in validator registry",
+                        }
+                    )
+                    continue
+                self.validator_store.import_local_key(idx, sk)
+                statuses.append({"status": "imported"})
+            except (KeystoreError, ValueError, KeyError, TypeError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return 200, {"data": statuses}
+
+    def delete_keystores(self, params, body):
+        """DELETE /eth/v1/keystores: remove local signers and return
+        their slashing-protection interchange so the keys can move to
+        another client without double-signing."""
+        err = self._need_store()
+        if err:
+            return err
+        store = self.validator_store
+        wanted = []
+        statuses = []
+        for entry in (body or {}).get("pubkeys", []):
+            try:
+                hexpart = entry[2:] if entry.startswith("0x") else entry
+                pk = bytes.fromhex(hexpart)
+            except (ValueError, AttributeError):
+                statuses.append({"status": "error"})
+                continue
+            wanted.append(pk)
+            idx = store.local_index_of(pk)
+            if idx is None:
+                statuses.append({"status": "not_found"})
+                continue
+            store.remove_local_key(idx)
+            statuses.append({"status": "deleted"})
+        interchange = store.slashing.export_interchange()
+        interchange["data"] = [
+            d
+            for d in interchange["data"]
+            if bytes.fromhex(d["pubkey"][2:]) in wanted
+        ]
+        return 200, {
+            "data": statuses,
+            "slashing_protection": json.dumps(interchange),
+        }
+
     def list_remote_keys(self, params, body):
         err = self._need_store()
         if err:
@@ -963,14 +1054,7 @@ class DefaultHandlers:
                 # abort deletion of the valid keys after it
                 statuses.append({"status": "error"})
                 continue
-            idx = next(
-                (
-                    i
-                    for i, p in store.pubkeys.items()
-                    if p == pk and i not in store.sks
-                ),
-                None,
-            )
+            idx = store.remote_index_of(pk)
             if idx is None:
                 statuses.append({"status": "not_found"})
             else:

@@ -53,6 +53,10 @@ class NodeOptions:
     kzg_setup: Optional[object] = None
     # bearer token enabling the keymanager REST namespace; None = off
     keymanager_token: Optional[str] = None
+    # the node's ValidatorStore, exposed to the keymanager namespace
+    # (keystore import/delete, remote-key management); None = the
+    # keymanager routes answer 501
+    validator_store: Optional[object] = None
     # subscribe every attestation/sync subnet (reference:
     # --subscribeAllSubnets; sims and aggregator-heavy deployments)
     subscribe_all_subnets: bool = False
@@ -483,6 +487,7 @@ class FullBeaconNode:
                     peer_manager=self.peer_manager,
                     keymanager_token=opts.keymanager_token,
                     proposer_cache=self.proposer_cache,
+                    validator_store=opts.validator_store,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)

@@ -78,6 +78,13 @@ class DoppelgangerService:
             ),
         )
 
+    def unregister(self, validator_index: int) -> None:
+        """Stop watching a key that left this node (keymanager delete).
+        Its liveness on another client is then EXPECTED, not a
+        doppelganger; and a later re-import restarts a fresh watch
+        window instead of inheriting stale state."""
+        self._keys.pop(validator_index, None)
+
     def status(self, validator_index: int) -> DoppelgangerStatus:
         st = self._keys.get(validator_index)
         return st.status if st else DoppelgangerStatus.VERIFIED

@@ -1,0 +1,240 @@
+"""EIP-2335 encrypted BLS keystores.
+
+Mirror of the reference's keystore handling (reference:
+packages/cli/src/cmds/validator/keymanager/importKeystores and the
+@chainsafe/bls-keystore dependency): scrypt/pbkdf2 key derivation,
+AES-128-CTR secret encryption, sha256 checksum binding the derived key
+to the ciphertext.  The reference rides native crypto; here the cipher
+is a self-contained AES-128 (keystore payloads are 32 bytes — one to
+two blocks — so pure Python costs microseconds) and the KDFs come from
+hashlib.  The format is byte-compatible with EIP-2335 so keystores made
+by any client decrypt here and vice versa.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import unicodedata
+import uuid
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# AES-128 core, built from the algebraic definition (FIPS-197).  The
+# S-box is COMPUTED (GF(2^8) inverse + affine map) rather than typed in
+# as 256 literals, so the table is correct by construction; the FIPS-197
+# appendix vector in tests/test_keystore.py seals the whole cipher.
+
+
+def _gf_mul(a: int, b: int) -> int:
+    r = 0
+    for _ in range(8):
+        if b & 1:
+            r ^= a
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        if hi:
+            a ^= 0x1B  # x^8 = x^4 + x^3 + x + 1
+        b >>= 1
+    return r
+
+
+def _build_sbox():
+    # inverse table via exp/log over the generator 3
+    exp = [0] * 510
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x = _gf_mul(x, 3)
+    for i in range(255, 510):
+        exp[i] = exp[i - 255]
+    sbox = [0] * 256
+    for v in range(256):
+        inv = 0 if v == 0 else exp[255 - log[v]]
+        b = inv
+        s = b
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            s ^= b
+        sbox[v] = s ^ 0x63
+    return bytes(sbox)
+
+
+_SBOX = _build_sbox()
+_RCON = (0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36)
+
+
+def _expand_key(key: bytes):
+    """AES-128 key schedule: 11 round keys of 16 bytes."""
+    w = [key[4 * i : 4 * i + 4] for i in range(4)]
+    for i in range(4, 44):
+        t = w[i - 1]
+        if i % 4 == 0:
+            t = bytes(
+                _SBOX[t[(j + 1) % 4]] ^ (_RCON[i // 4 - 1] if j == 0 else 0)
+                for j in range(4)
+            )
+        w.append(bytes(a ^ b for a, b in zip(w[i - 4], t)))
+    return [b"".join(w[4 * r : 4 * r + 4]) for r in range(11)]
+
+
+def _encrypt_block(rk, block: bytes) -> bytes:
+    s = bytes(a ^ b for a, b in zip(block, rk[0]))
+    for rnd in range(1, 11):
+        # SubBytes + ShiftRows (column-major state: byte r + 4c)
+        s = bytes(
+            _SBOX[s[(r + 4 * ((c + r) % 4))]]
+            for c in range(4)
+            for r in range(4)
+        )
+        if rnd < 10:  # MixColumns
+            out = bytearray(16)
+            for c in range(4):
+                a = s[4 * c : 4 * c + 4]
+                out[4 * c + 0] = _gf_mul(a[0], 2) ^ _gf_mul(a[1], 3) ^ a[2] ^ a[3]
+                out[4 * c + 1] = a[0] ^ _gf_mul(a[1], 2) ^ _gf_mul(a[2], 3) ^ a[3]
+                out[4 * c + 2] = a[0] ^ a[1] ^ _gf_mul(a[2], 2) ^ _gf_mul(a[3], 3)
+                out[4 * c + 3] = _gf_mul(a[0], 3) ^ a[1] ^ a[2] ^ _gf_mul(a[3], 2)
+            s = bytes(out)
+        s = bytes(a ^ b for a, b in zip(s, rk[rnd]))
+    return s
+
+
+def aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """CTR keystream xor (encrypt == decrypt); iv is the 16-byte initial
+    counter block, incremented big-endian per block."""
+    if len(key) != 16 or len(iv) != 16:
+        raise ValueError("aes-128-ctr needs 16-byte key and iv")
+    rk = _expand_key(key)
+    ctr = int.from_bytes(iv, "big")
+    out = bytearray()
+    for off in range(0, len(data), 16):
+        ks = _encrypt_block(rk, ctr.to_bytes(16, "big"))
+        ctr = (ctr + 1) % (1 << 128)
+        chunk = data[off : off + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, ks))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# EIP-2335 container
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def normalize_password(password: str) -> bytes:
+    """EIP-2335 password rules: NFKD normalize, strip C0/C1 control
+    codes and DEL, encode UTF-8."""
+    norm = unicodedata.normalize("NFKD", password)
+    stripped = "".join(
+        ch
+        for ch in norm
+        if not (ord(ch) < 0x20 or 0x7F <= ord(ch) <= 0x9F)
+    )
+    return stripped.encode("utf-8")
+
+
+def _derive_key(kdf: dict, password: bytes) -> bytes:
+    fn = kdf["function"]
+    p = kdf["params"]
+    salt = bytes.fromhex(p["salt"])
+    dklen = int(p["dklen"])
+    if fn == "scrypt":
+        n, r, rp = int(p["n"]), int(p["r"]), int(p["p"])
+        return hashlib.scrypt(
+            password,
+            salt=salt,
+            n=n,
+            r=r,
+            p=rp,
+            dklen=dklen,
+            # stdlib default maxmem (32MiB) rejects the EIP-2335
+            # standard n=2^18,r=8 (needs 128*n*r = 256MiB)
+            maxmem=128 * n * r + (1 << 20),
+        )
+    if fn == "pbkdf2":
+        if p.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise KeystoreError(f"unsupported prf {p['prf']!r}")
+        return hashlib.pbkdf2_hmac(
+            "sha256", password, salt, int(p["c"]), dklen
+        )
+    raise KeystoreError(f"unsupported kdf {fn!r}")
+
+
+def decrypt_keystore(keystore: dict, password: str) -> bytes:
+    """Returns the secret (32-byte BLS sk).  Raises KeystoreError on a
+    wrong password (checksum mismatch) or unsupported modules."""
+    crypto = keystore["crypto"]
+    dk = _derive_key(crypto["kdf"], normalize_password(password))
+    if len(dk) < 32:
+        raise KeystoreError("derived key shorter than 32 bytes")
+    cipher = crypto["cipher"]
+    if cipher["function"] != "aes-128-ctr":
+        raise KeystoreError(f"unsupported cipher {cipher['function']!r}")
+    ct = bytes.fromhex(cipher["message"])
+    checksum = crypto["checksum"]
+    if checksum["function"] != "sha256":
+        raise KeystoreError(
+            f"unsupported checksum {checksum['function']!r}"
+        )
+    want = bytes.fromhex(checksum["message"])
+    got = hashlib.sha256(dk[16:32] + ct).digest()
+    if not hmac.compare_digest(want, got):
+        raise KeystoreError("checksum mismatch (wrong password?)")
+    return aes128_ctr(dk[:16], bytes.fromhex(cipher["params"]["iv"]), ct)
+
+
+def create_keystore(
+    secret: bytes,
+    password: str,
+    pubkey: Optional[bytes] = None,
+    path: str = "",
+    kdf: str = "scrypt",
+    kdf_params: Optional[Dict] = None,
+    description: str = "",
+) -> dict:
+    """Encrypt `secret` into an EIP-2335 keystore dict.
+
+    `kdf_params` overrides the cost parameters (tests use small ones;
+    the defaults are the EIP-2335 standard costs)."""
+    if kdf == "scrypt":
+        params = dict(kdf_params or {"n": 262144, "r": 8, "p": 1})
+        params.setdefault("dklen", 32)
+        params["salt"] = os.urandom(32).hex()
+        kdf_mod = {"function": "scrypt", "params": params}
+    elif kdf == "pbkdf2":
+        params = dict(kdf_params or {"c": 262144})
+        params.setdefault("dklen", 32)
+        params.setdefault("prf", "hmac-sha256")
+        params["salt"] = os.urandom(32).hex()
+        kdf_mod = {"function": "pbkdf2", "params": params}
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf!r}")
+    dk = _derive_key(kdf_mod, normalize_password(password))
+    iv = os.urandom(16)
+    ct = aes128_ctr(dk[:16], iv, secret)
+    return {
+        "version": 4,
+        "uuid": str(uuid.uuid4()),
+        "description": description,
+        "path": path,
+        "pubkey": pubkey.hex() if pubkey else "",
+        "crypto": {
+            "kdf": kdf_mod,
+            "checksum": {
+                "function": "sha256",
+                "params": {},
+                "message": hashlib.sha256(dk[16:32] + ct).digest().hex(),
+            },
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": ct.hex(),
+            },
+        },
+    }

@@ -212,6 +212,56 @@ class ValidatorStore:
             for i in self.pubkeys:
                 doppelganger.register(i)
 
+    def import_local_key(self, validator_index: int, sk: int) -> None:
+        """Keymanager import (reference: keymanager importKeystores ->
+        validatorStore.addSigner): rejects indices already held — a
+        second signer for one validator would bypass the slashing
+        records keyed to the first."""
+        if validator_index in self.sks:
+            raise ValueError(f"validator {validator_index} already local")
+        if validator_index in self.pubkeys:
+            raise ValueError(
+                f"validator {validator_index} already remote-signed"
+            )
+        self.sks[validator_index] = sk
+        self.pubkeys[validator_index] = C.g1_compress(B.sk_to_pk(sk))
+        if self.doppelganger is not None:
+            self.doppelganger.register(validator_index)
+
+    def remove_local_key(self, validator_index: int) -> None:
+        """Keymanager delete; slashing records are kept (the keymanager
+        API returns them so the key can move clients safely)."""
+        if validator_index not in self.sks:
+            raise KeyError(f"validator {validator_index} not local")
+        del self.sks[validator_index]
+        del self.pubkeys[validator_index]
+        if self.doppelganger is not None:
+            # the key now signs elsewhere legitimately: stop watching it
+            # (and give any re-import a fresh watch window)
+            self.doppelganger.unregister(validator_index)
+
+    def local_index_of(self, pubkey: bytes) -> Optional[int]:
+        """Index of a LOCALLY-signed pubkey (in both pubkeys and sks) —
+        THE definition of 'local', shared by the keymanager handlers."""
+        return next(
+            (
+                i
+                for i, p in self.pubkeys.items()
+                if p == pubkey and i in self.sks
+            ),
+            None,
+        )
+
+    def remote_index_of(self, pubkey: bytes) -> Optional[int]:
+        return next(
+            (
+                i
+                for i, p in self.pubkeys.items()
+                if p == pubkey and i not in self.sks
+            ),
+            None,
+        )
+
     def _check_doppelganger(self, validator_index: int) -> None:
         if self.doppelganger is not None:
             self.doppelganger.assert_safe(validator_index)

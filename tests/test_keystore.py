@@ -1,0 +1,190 @@
+"""EIP-2335 keystores: cipher seal, container roundtrip, keymanager
+import/delete over the REST API (reference: @chainsafe/bls-keystore +
+packages/cli/src/cmds/validator/keymanager/ importKeystores flow)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.validator import keystore as K
+
+pytestmark = pytest.mark.smoke
+
+FAST_SCRYPT = {"n": 1024, "r": 8, "p": 1}
+
+
+def test_aes128_fips197_vector():
+    """FIPS-197 Appendix C.1 seals the whole cipher (computed S-box,
+    key schedule, rounds)."""
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    ct = K._encrypt_block(K._expand_key(key), pt)
+    assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_ctr_keystream_xor_roundtrip():
+    key, iv = b"k" * 16, b"\x00" * 15 + b"\xff"  # crosses a block carry
+    data = bytes(range(48))  # 3 blocks
+    ct = K.aes128_ctr(key, iv, data)
+    assert ct != data
+    assert K.aes128_ctr(key, iv, ct) == data
+
+
+def test_keystore_roundtrip_both_kdfs():
+    secret = bytes(range(32))
+    for kdf, params in (
+        ("scrypt", FAST_SCRYPT),
+        ("pbkdf2", {"c": 1000}),
+    ):
+        ks = K.create_keystore(
+            secret, "p@ssw0rd", kdf=kdf, kdf_params=params
+        )
+        assert ks["version"] == 4
+        assert K.decrypt_keystore(ks, "p@ssw0rd") == secret
+        with pytest.raises(K.KeystoreError, match="checksum"):
+            K.decrypt_keystore(ks, "wrong")
+
+
+def test_password_normalization_nfkd_and_control_strip():
+    # EIP-2335: NFKD first (fraktur letters decompose to ASCII), then
+    # control codes (C0, C1, DEL) stripped
+    fancy = "\U0001d531\U0001d522\U0001d530\U0001d531"  # 𝔱𝔢𝔰𝔱
+    assert K.normalize_password(fancy) == b"test"
+    assert K.normalize_password("a\x00b\x1fc\x7fd\x9de") == b"abcde"
+    secret = b"\x42" * 32
+    ks = K.create_keystore(secret, fancy, kdf_params=FAST_SCRYPT)
+    # a keystore made with the fancy password opens with the plain one
+    assert K.decrypt_keystore(ks, "test") == secret
+
+
+def test_keymanager_import_and_delete_over_rest():
+    """End-to-end: POST /eth/v1/keystores adds a working signer (the
+    index resolved from the head-state registry), duplicate and
+    bad-password imports get per-key statuses, DELETE removes the key
+    and hands back its slashing-protection interchange."""
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu.validator import ValidatorStore
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"km-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    chain = BeaconChain(cfg, genesis)
+    # the store starts with validator 0 only; we import validator 1
+    store = ValidatorStore(cfg, {0: sks[0]})
+    server = BeaconApiServer(
+        DefaultHandlers(
+            chain=chain, validator_store=store, keymanager_token="kmtok"
+        ),
+        port=0,
+    )
+    server.listen()
+    try:
+        base = f"http://127.0.0.1:{server.port}/eth/v1/keystores"
+
+        def call(method, payload):
+            req = urllib.request.Request(
+                base,
+                data=json.dumps(payload).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": "Bearer kmtok",
+                },
+                method=method,
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        secret1 = sks[1].to_bytes(32, "big")
+        ks1 = K.create_keystore(secret1, "pw1", kdf_params=FAST_SCRYPT)
+        # an sk NOT in the registry, and a wrong-password import
+        stranger = B.keygen(b"stranger").to_bytes(32, "big")
+        ks_stranger = K.create_keystore(
+            stranger, "pw", kdf_params=FAST_SCRYPT
+        )
+        out = call(
+            "POST",
+            {
+                "keystores": [
+                    json.dumps(ks1),
+                    json.dumps(ks_stranger),
+                    json.dumps(ks1),
+                ],
+                "passwords": ["pw1", "pw", "BAD"],
+            },
+        )
+        assert [s["status"] for s in out["data"]] == [
+            "imported",
+            "error",
+            "error",
+        ]
+        assert "registry" in out["data"][1]["message"]
+        # the imported signer WORKS and records slashing history
+        store.sign_attestation(
+            1,
+            {
+                "slot": 5,
+                "index": 0,
+                "beacon_block_root": b"\x00" * 32,
+                "source": {"epoch": 0, "root": b"\x00" * 32},
+                "target": {"epoch": 1, "root": b"\x00" * 32},
+            },
+        )
+        # re-import of a live key is a duplicate, not an error
+        out = call(
+            "POST",
+            {"keystores": [json.dumps(ks1)], "passwords": ["pw1"]},
+        )
+        assert out["data"][0]["status"] == "duplicate"
+        # DELETE returns the key's interchange and removes the signer
+        out = call("DELETE", {"pubkeys": ["0x" + pks[1].hex()]})
+        assert [s["status"] for s in out["data"]] == ["deleted"]
+        interchange = json.loads(out["slashing_protection"])
+        assert interchange["data"][0]["pubkey"] == "0x" + pks[1].hex()
+        assert interchange["data"][0]["signed_attestations"]
+        assert 1 not in store.sks
+        out = call("DELETE", {"pubkeys": ["0x" + pks[1].hex()]})
+        assert [s["status"] for s in out["data"]] == ["not_found"]
+    finally:
+        server.close()
+
+
+def test_delete_unregisters_doppelganger_and_reimport_rewatches():
+    """A deleted key signs elsewhere legitimately — the doppelganger
+    service must stop watching it, and a re-import must get a FRESH
+    watch window rather than inherited state."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.validator import ValidatorStore
+    from lodestar_tpu.validator.doppelganger import (
+        DoppelgangerService,
+        DoppelgangerStatus,
+    )
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    dg = DoppelgangerService(
+        liveness_fn=lambda epoch, idxs: {i: False for i in idxs},
+        current_epoch_fn=lambda: 0,
+    )
+    sk = B.keygen(b"dg-key")
+    store = ValidatorStore(cfg, {}, doppelganger=dg)
+    store.import_local_key(7, sk)
+    assert dg.status(7) == DoppelgangerStatus.UNVERIFIED
+    store.remove_local_key(7)
+    # no longer watched: its liveness elsewhere is expected, and
+    # status() for unknown keys reads VERIFIED (not a false alarm)
+    assert 7 not in dg._keys
+    store.import_local_key(7, sk)
+    assert dg.status(7) == DoppelgangerStatus.UNVERIFIED
