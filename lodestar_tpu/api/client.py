@@ -102,6 +102,29 @@ class ApiClient:
             "GET", f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
         )["data"]
 
+    def get_state_validators(
+        self, ids=None, statuses=None, state_id: str = "head"
+    ) -> list:
+        """getStateValidators (reference: routes/beacon/state.ts) —
+        ids may be decimal indices or 0x-pubkeys."""
+        from urllib.parse import urlencode
+
+        query = []
+        for v in ids or ():
+            query.append(("id", v if isinstance(v, str) else str(v)))
+        for s in statuses or ():
+            query.append(("status", s))
+        path = f"/eth/v1/beacon/states/{state_id}/validators"
+        if query:
+            path += "?" + urlencode(query)
+        return self._request("GET", path)["data"]
+
+    def get_state_validator(self, validator_id, state_id: str = "head") -> dict:
+        return self._request(
+            "GET",
+            f"/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+        )["data"]
+
     def get_block(self, block_id: str = "head") -> dict:
         from ..types import SignedBeaconBlockAltair, SignedBeaconBlockBellatrix
         from .encoding import from_json

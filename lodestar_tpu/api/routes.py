@@ -55,6 +55,16 @@ ROUTES: Tuple[Route, ...] = (
         "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
         "get_finality_checkpoints",
     ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validators",
+        "get_state_validators",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+        "get_state_validator",
+    ),
     # config namespace (reference: routes/config.ts)
     Route("GET", "/eth/v1/config/spec", "get_spec"),
     # validator namespace (reference: routes/validator.ts)

@@ -602,6 +602,124 @@ class DefaultHandlers:
             }
         }
 
+    # -- state validators (reference: api/src/beacon/routes/beacon/
+    # state.ts getStateValidators/getStateValidator — the pubkey->index
+    # resolution every validator client does at startup) ------------------
+
+    @staticmethod
+    def _validator_status(st, i: int, epoch: int) -> str:
+        """Beacon-API validator status taxonomy (the spec's
+        getValidatorStatus pseudocode)."""
+        from .. import params as _p
+
+        FAR = _p.FAR_FUTURE_EPOCH
+        activation = int(st.activation_epoch[i])
+        if epoch < activation:
+            return (
+                "pending_queued"
+                if int(st.activation_eligibility_epoch[i]) != FAR
+                else "pending_initialized"
+            )
+        exit_ep = int(st.exit_epoch[i])
+        if epoch < exit_ep:
+            if bool(st.slashed[i]):
+                return "active_slashed"
+            return "active_exiting" if exit_ep != FAR else "active_ongoing"
+        if epoch < int(st.withdrawable_epoch[i]):
+            return "exited_slashed" if bool(st.slashed[i]) else "exited_unslashed"
+        return (
+            "withdrawal_done"
+            if int(st.balances[i]) == 0
+            else "withdrawal_possible"
+        )
+
+    def _validator_record(self, st, i: int, epoch: int) -> dict:
+        return {
+            "index": str(i),
+            "balance": str(int(st.balances[i])),
+            "status": self._validator_status(st, i, epoch),
+            "validator": {
+                "pubkey": "0x" + bytes(st.pubkeys[i]).hex(),
+                "withdrawal_credentials": "0x"
+                + bytes(st.withdrawal_credentials[i]).hex(),
+                "effective_balance": str(int(st.effective_balance[i])),
+                "slashed": bool(st.slashed[i]),
+                "activation_eligibility_epoch": str(
+                    int(st.activation_eligibility_epoch[i])
+                ),
+                "activation_epoch": str(int(st.activation_epoch[i])),
+                "exit_epoch": str(int(st.exit_epoch[i])),
+                "withdrawable_epoch": str(int(st.withdrawable_epoch[i])),
+            },
+        }
+
+    def _resolve_validator_id(self, st, vid: str):
+        """Index | None from a decimal index or 0x-pubkey id."""
+        vid = vid.strip()
+        if vid.startswith("0x"):
+            try:
+                return st.pubkey_index(bytes.fromhex(vid[2:]))
+            except ValueError:
+                return None
+        if vid.isdigit() and int(vid) < st.num_validators:
+            return int(vid)
+        return None
+
+    def get_state_validators(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        from .. import params as _p
+
+        epoch = int(st.slot) // _p.SLOTS_PER_EPOCH
+        ids = params.get("id")
+        statuses = params.get("status")
+        if isinstance(statuses, str):
+            statuses = statuses.split(",")
+        if ids is None:
+            indices = range(st.num_validators)
+        else:
+            if isinstance(ids, str):
+                ids = ids.split(",")
+            indices = []
+            for vid in ids:
+                i = self._resolve_validator_id(st, vid)
+                if i is not None:
+                    indices.append(i)
+        data = []
+        for i in indices:
+            rec = self._validator_record(st, i, epoch)
+            # the spec allows umbrella values (active, pending, exited,
+            # withdrawal) alongside the fine-grained ones
+            umbrella = rec["status"].split("_", 1)[0]
+            if statuses and not (
+                rec["status"] in statuses or umbrella in statuses
+            ):
+                continue
+            data.append(rec)
+        return 200, {"execution_optimistic": False, "data": data}
+
+    def get_state_validator(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        from .. import params as _p
+
+        i = self._resolve_validator_id(st, params["validator_id"])
+        if i is None:
+            return 404, {"message": "validator not found"}
+        epoch = int(st.slot) // _p.SLOTS_PER_EPOCH
+        return 200, {
+            "execution_optimistic": False,
+            "data": self._validator_record(st, i, epoch),
+        }
+
     def _lookup_block(self, block_id: str):
         """(root, signed_block_value) or an error tuple."""
         if self.chain.db is None:
@@ -980,7 +1098,15 @@ class DefaultHandlers:
                         }
                     )
                     continue
-                self.validator_store.import_local_key(idx, sk)
+                try:
+                    self.validator_store.import_local_key(idx, sk)
+                except ValueError as e:
+                    if "already local" in str(e):
+                        # lost a race with a concurrent import of the
+                        # same key — still a duplicate, not an error
+                        statuses.append({"status": "duplicate"})
+                        continue
+                    raise
                 statuses.append({"status": "imported"})
             except (KeystoreError, ValueError, KeyError, TypeError) as e:
                 statuses.append({"status": "error", "message": str(e)})
@@ -1006,7 +1132,18 @@ class DefaultHandlers:
             wanted.append(pk)
             idx = store.local_index_of(pk)
             if idx is None:
-                statuses.append({"status": "not_found"})
+                # keymanager spec: a key we don't sign with but DO hold
+                # slashing history for is not_active (the caller must
+                # keep the returned interchange), not_found otherwise
+                statuses.append(
+                    {
+                        "status": (
+                            "not_active"
+                            if store.slashing.has_records(pk)
+                            else "not_found"
+                        )
+                    }
+                )
                 continue
             store.remove_local_key(idx)
             statuses.append({"status": "deleted"})
@@ -1100,8 +1237,18 @@ class BeaconApiServer:
                         self._send(401, {"message": "invalid bearer token"})
                         return
                 # query params merge under the path params (reference:
-                # fastify querystring handling)
+                # fastify querystring handling); a REPEATED key becomes
+                # a list (beacon-API array params, e.g. ?id=1&id=2)
+                q = {}
                 for k, v in parse_qsl(split.query):
+                    if k in q:
+                        if isinstance(q[k], list):
+                            q[k].append(v)
+                        else:
+                            q[k] = [q[k], v]
+                    else:
+                        q[k] = v
+                for k, v in q.items():
                     params.setdefault(k, v)
                 fn = getattr(outer_handlers, route.handler, None)
                 if fn is None:

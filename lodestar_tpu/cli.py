@@ -50,8 +50,17 @@ def _build_parser() -> argparse.ArgumentParser:
     validator = sub.add_parser("validator", help="run a validator client")
     validator.add_argument("--beacon-urls", nargs="+", required=True)
     validator.add_argument(
-        "--interop-indices", type=int, nargs="+", required=True,
+        "--interop-indices", type=int, nargs="*", default=(),
         help="interop validator indices to run (keys derived as in dev mode)",
+    )
+    validator.add_argument(
+        "--keystores-dir", default=None,
+        help="directory of EIP-2335 keystore *.json files to load; "
+        "indices resolve from the beacon node's validator registry",
+    )
+    validator.add_argument(
+        "--keystores-password-file", default=None,
+        help="file holding the password for --keystores-dir keystores",
     )
     validator.add_argument("--slots", type=int, default=1)
     validator.add_argument(
@@ -232,8 +241,56 @@ def cmd_validator(args) -> int:
     client = ApiClient(args.beacon_urls, timeout=120)
     genesis = client.get_genesis()
     # ONE derivation covering local + remote indices (keygen per index)
-    n_keys = max([*args.interop_indices, *remote]) + 1
+    n_keys = max([*args.interop_indices, *remote], default=-1) + 1
     sks, pks = _interop_keys(n_keys)
+    local_sks = {i: sks[i] for i in args.interop_indices}
+
+    if getattr(args, "keystores_dir", None):
+        # EIP-2335 keystores from disk (reference: cli validator
+        # keymanager importKeystoresFromDir): decrypt each *.json with
+        # the password file, resolve indices from the node's registry
+        import os as _os
+
+        from .crypto import bls as _B
+        from .crypto import curves as _C
+        from .validator.keystore import KeystoreError, decrypt_keystore
+
+        if not args.keystores_password_file:
+            print(json.dumps(
+                {"error": "--keystores-dir needs --keystores-password-file"}
+            ))
+            return 2
+        try:
+            with open(args.keystores_password_file) as f:
+                password = f.read().strip()
+            names = sorted(_os.listdir(args.keystores_dir))
+        except OSError as e:
+            print(json.dumps({"error": f"keystore config: {e}"}))
+            return 2
+        loaded = 0
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = _os.path.join(args.keystores_dir, name)
+            try:
+                with open(path) as f:
+                    ks = json.load(f)
+                sk = int.from_bytes(decrypt_keystore(ks, password), "big")
+            except (KeystoreError, ValueError, OSError) as e:
+                print(json.dumps({"keystore_error": f"{name}: {e}"}))
+                continue
+            pk = _C.g1_compress(_B.sk_to_pk(sk))
+            try:
+                rec = client.get_state_validator("0x" + pk.hex())
+            except Exception as e:  # not (yet) in the registry
+                print(json.dumps({"keystore_skipped": f"{name}: {e}"}))
+                continue
+            local_sks[int(rec["index"])] = sk
+            loaded += 1
+        print(json.dumps({"keystores_loaded": loaded}))
+    if not local_sks and not remote:
+        print(json.dumps({"error": "no validator keys (interop or keystores)"}))
+        return 2
     doppelganger = None
     if args.doppelganger_protection:
         from .validator import DoppelgangerService
@@ -272,7 +329,7 @@ def cmd_validator(args) -> int:
             remote_keys = {i: pks[i] for i in remote}
     store = ValidatorStore(
         MAINNET_CHAIN_CONFIG,
-        {i: sks[i] for i in args.interop_indices},
+        local_sks,
         slashing_db_path=args.slashing_db_path,
         doppelganger=doppelganger,
         external_signer=external_signer,

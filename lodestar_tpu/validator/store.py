@@ -149,6 +149,11 @@ class SlashingProtection:
             "data": data,
         }
 
+    def has_records(self, pubkey: bytes) -> bool:
+        """Any signing history for this key (keymanager delete uses it
+        to distinguish not_active from not_found)."""
+        return pubkey in self._atts or pubkey in self._blocks
+
     def import_interchange(self, data: dict) -> None:
         for entry in data.get("data", []):
             pk = bytes.fromhex(entry["pubkey"][2:])
@@ -206,6 +211,11 @@ class ValidatorStore:
                 )
             for i, pk in remote_keys.items():
                 self.pubkeys[i] = bytes(pk)
+        import threading as _threading
+
+        # guards the key dicts against concurrent keymanager mutation
+        # (REST requests run on ThreadingHTTPServer threads)
+        self._keys_lock = _threading.RLock()
         self.slashing = SlashingProtection(db_path=slashing_db_path)
         self.doppelganger = doppelganger
         if doppelganger is not None:
@@ -217,24 +227,28 @@ class ValidatorStore:
         validatorStore.addSigner): rejects indices already held — a
         second signer for one validator would bypass the slashing
         records keyed to the first."""
-        if validator_index in self.sks:
-            raise ValueError(f"validator {validator_index} already local")
-        if validator_index in self.pubkeys:
-            raise ValueError(
-                f"validator {validator_index} already remote-signed"
-            )
-        self.sks[validator_index] = sk
-        self.pubkeys[validator_index] = C.g1_compress(B.sk_to_pk(sk))
+        with self._keys_lock:
+            if validator_index in self.sks:
+                raise ValueError(
+                    f"validator {validator_index} already local"
+                )
+            if validator_index in self.pubkeys:
+                raise ValueError(
+                    f"validator {validator_index} already remote-signed"
+                )
+            self.sks[validator_index] = sk
+            self.pubkeys[validator_index] = C.g1_compress(B.sk_to_pk(sk))
         if self.doppelganger is not None:
             self.doppelganger.register(validator_index)
 
     def remove_local_key(self, validator_index: int) -> None:
         """Keymanager delete; slashing records are kept (the keymanager
         API returns them so the key can move clients safely)."""
-        if validator_index not in self.sks:
-            raise KeyError(f"validator {validator_index} not local")
-        del self.sks[validator_index]
-        del self.pubkeys[validator_index]
+        with self._keys_lock:
+            if validator_index not in self.sks:
+                raise KeyError(f"validator {validator_index} not local")
+            del self.sks[validator_index]
+            del self.pubkeys[validator_index]
         if self.doppelganger is not None:
             # the key now signs elsewhere legitimately: stop watching it
             # (and give any re-import a fresh watch window)
@@ -242,25 +256,30 @@ class ValidatorStore:
 
     def local_index_of(self, pubkey: bytes) -> Optional[int]:
         """Index of a LOCALLY-signed pubkey (in both pubkeys and sks) —
-        THE definition of 'local', shared by the keymanager handlers."""
-        return next(
-            (
-                i
-                for i, p in self.pubkeys.items()
-                if p == pubkey and i in self.sks
-            ),
-            None,
-        )
+        THE definition of 'local', shared by the keymanager handlers.
+        Lock held while iterating: keymanager requests run on
+        ThreadingHTTPServer threads, and a concurrent import/delete
+        mutating the dicts mid-iteration is a RuntimeError."""
+        with self._keys_lock:
+            return next(
+                (
+                    i
+                    for i, p in self.pubkeys.items()
+                    if p == pubkey and i in self.sks
+                ),
+                None,
+            )
 
     def remote_index_of(self, pubkey: bytes) -> Optional[int]:
-        return next(
-            (
-                i
-                for i, p in self.pubkeys.items()
-                if p == pubkey and i not in self.sks
-            ),
-            None,
-        )
+        with self._keys_lock:
+            return next(
+                (
+                    i
+                    for i, p in self.pubkeys.items()
+                    if p == pubkey and i not in self.sks
+                ),
+                None,
+            )
 
     def _check_doppelganger(self, validator_index: int) -> None:
         if self.doppelganger is not None:

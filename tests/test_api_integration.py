@@ -147,3 +147,75 @@ def test_finality_checkpoints_endpoint(http_world):
     cps = client.get_finality_checkpoints()
     assert cps["finalized"]["epoch"] == "0"
     assert cps["current_justified"]["root"].startswith("0x")
+
+
+def test_state_validators_endpoint(http_world):
+    """getStateValidators/getStateValidator (reference: routes/beacon/
+    state.ts): lookup by index and by 0x-pubkey, repeated-id array
+    params, status filtering, and the single-validator route."""
+    cfg, chain, client, store = http_world
+    recs = client.get_state_validators()
+    assert len(recs) == N_KEYS
+    assert all(r["status"] == "active_ongoing" for r in recs)
+    pk5 = store.pubkeys[5]
+    # by repeated ids: one decimal index + one hex pubkey
+    two = client.get_state_validators(ids=["3", "0x" + pk5.hex()])
+    assert [int(r["index"]) for r in two] == [3, 5]
+    assert two[1]["validator"]["pubkey"] == "0x" + pk5.hex()
+    # status filter excludes everything for a non-matching status
+    none = client.get_state_validators(statuses=["exited_slashed"])
+    assert none == []
+    one = client.get_state_validator("0x" + pk5.hex())
+    assert int(one["index"]) == 5
+    assert int(one["balance"]) > 0
+    v = one["validator"]
+    assert v["exit_epoch"] == str(2**64 - 1)
+    from lodestar_tpu.api.client import ApiError
+
+    with pytest.raises(ApiError, match="not found"):
+        client.get_state_validator("0x" + b"\xaa".hex() * 48)
+
+
+def test_cli_validator_loads_keystores(http_world, tmp_path, capsys):
+    """The validator client CLI loads EIP-2335 keystores from disk and
+    resolves their indices from the node's registry (reference: cli
+    validator keymanager local keystore discovery)."""
+    import argparse
+    import json as _json
+
+    from lodestar_tpu import cli as cli_mod
+    from lodestar_tpu.validator import keystore as K
+
+    cfg, chain, client, store = http_world
+    ksdir = tmp_path / "keys"
+    ksdir.mkdir()
+    sk5 = store.sks[5]
+    (ksdir / "val5.json").write_text(
+        _json.dumps(
+            K.create_keystore(
+                sk5.to_bytes(32, "big"),
+                "cli-pw",
+                kdf_params={"n": 1024, "r": 8, "p": 1},
+            )
+        )
+    )
+    # a corrupt file must be skipped, not abort the load
+    (ksdir / "junk.json").write_text("{not json")
+    pwfile = tmp_path / "pw.txt"
+    pwfile.write_text("cli-pw\n")
+    args = argparse.Namespace(
+        beacon_urls=list(client.base_urls),
+        interop_indices=(),
+        slots=0,  # key loading only; duty loops covered elsewhere
+        slashing_db_path=None,
+        doppelganger_protection=False,
+        external_signer_url=None,
+        remote_indices=(),
+        keystores_dir=str(ksdir),
+        keystores_password_file=str(pwfile),
+    )
+    rc = cli_mod.cmd_validator(args)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"keystores_loaded": 1' in out
+    assert "junk.json" in out  # the corrupt file surfaced as an error

@@ -152,8 +152,10 @@ def test_keymanager_import_and_delete_over_rest():
         assert interchange["data"][0]["pubkey"] == "0x" + pks[1].hex()
         assert interchange["data"][0]["signed_attestations"]
         assert 1 not in store.sks
+        # the key is gone but its slashing history remains: the spec's
+        # not_active status tells the caller to keep the interchange
         out = call("DELETE", {"pubkeys": ["0x" + pks[1].hex()]})
-        assert [s["status"] for s in out["data"]] == ["not_found"]
+        assert [s["status"] for s in out["data"]] == ["not_active"]
     finally:
         server.close()
 
