@@ -65,6 +65,21 @@ ROUTES: Tuple[Route, ...] = (
         "/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
         "get_state_validator",
     ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/validator_balances",
+        "get_validator_balances",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/committees",
+        "get_epoch_committees",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/sync_committees",
+        "get_epoch_sync_committees",
+    ),
     # config namespace (reference: routes/config.ts)
     Route("GET", "/eth/v1/config/spec", "get_spec"),
     # validator namespace (reference: routes/validator.ts)

@@ -720,6 +720,140 @@ class DefaultHandlers:
             "data": self._validator_record(st, i, epoch),
         }
 
+    def get_validator_balances(self, params, body):
+        """GET /states/{id}/validator_balances (reference:
+        routes/beacon/state.ts getStateValidatorBalances)."""
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        ids = params.get("id")
+        if ids is None:
+            indices = range(st.num_validators)
+        else:
+            if isinstance(ids, str):
+                ids = ids.split(",")
+            indices = [
+                i
+                for vid in ids
+                if (i := self._resolve_validator_id(st, vid)) is not None
+            ]
+        return 200, {
+            "execution_optimistic": False,
+            "data": [
+                {"index": str(i), "balance": str(int(st.balances[i]))}
+                for i in indices
+            ],
+        }
+
+    def get_epoch_committees(self, params, body):
+        """GET /states/{id}/committees (reference: routes/beacon/
+        state.ts getEpochCommittees): every (slot, index) committee of
+        the epoch, with optional epoch/index/slot filters."""
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        from .. import params as _p
+        from ..state_transition.accessors import (
+            get_beacon_committee,
+            get_committee_count_per_slot,
+        )
+
+        current = int(st.slot) // _p.SLOTS_PER_EPOCH
+        try:
+            epoch = (
+                int(params["epoch"])
+                if params.get("epoch") is not None
+                else current
+            )
+            want_index = (
+                int(params["index"])
+                if params.get("index") is not None
+                else None
+            )
+            want_slot = (
+                int(params["slot"])
+                if params.get("slot") is not None
+                else None
+            )
+        except (ValueError, TypeError) as e:
+            return 400, {"message": f"bad query parameter: {e}"}
+        if epoch < 0 or abs(epoch - current) > 1:
+            # committees are only computable one epoch around the state
+            return 400, {"message": f"epoch {epoch} not within 1 of state"}
+        per_slot = int(get_committee_count_per_slot(st, epoch))
+        data = []
+        for slot in range(
+            epoch * _p.SLOTS_PER_EPOCH, (epoch + 1) * _p.SLOTS_PER_EPOCH
+        ):
+            if want_slot is not None and slot != want_slot:
+                continue
+            for ci in range(per_slot):
+                if want_index is not None and ci != want_index:
+                    continue
+                members = get_beacon_committee(st, slot, ci)
+                data.append(
+                    {
+                        "index": str(ci),
+                        "slot": str(slot),
+                        "validators": [str(int(v)) for v in members],
+                    }
+                )
+        return 200, {"execution_optimistic": False, "data": data}
+
+    def get_epoch_sync_committees(self, params, body):
+        """GET /states/{id}/sync_committees (reference: routes/beacon/
+        state.ts getEpochSyncCommittees): the committee as validator
+        indices, plus the per-subcommittee aggregate view."""
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        from .. import params as _p
+
+        sc = st.current_sync_committee
+        if not sc:
+            return 400, {"message": "state has no sync committee (phase0)"}
+        if params.get("epoch") is not None:
+            # only the state's CURRENT sync-committee period is served
+            # (wrong-period data must be a refusal, never silently the
+            # current committee)
+            try:
+                epoch = int(params["epoch"])
+            except (ValueError, TypeError) as e:
+                return 400, {"message": f"bad query parameter: {e}"}
+            current = int(st.slot) // _p.SLOTS_PER_EPOCH
+            period = _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            if epoch < 0 or epoch // period != current // period:
+                return 400, {
+                    "message": f"epoch {epoch} outside the state's "
+                    "sync-committee period"
+                }
+        indices = []
+        for pk in sc["pubkeys"]:
+            i = st.pubkey_index(bytes(pk))
+            if i is None:
+                return 500, {"message": "sync committee pubkey unknown"}
+            indices.append(str(i))
+        per_sub = len(indices) // _p.SYNC_COMMITTEE_SUBNET_COUNT
+        return 200, {
+            "execution_optimistic": False,
+            "data": {
+                "validators": indices,
+                "validator_aggregates": [
+                    indices[k * per_sub : (k + 1) * per_sub]
+                    for k in range(_p.SYNC_COMMITTEE_SUBNET_COUNT)
+                ],
+            },
+        }
+
     def _lookup_block(self, block_id: str):
         """(root, signed_block_value) or an error tuple."""
         if self.chain.db is None:
@@ -1131,22 +1265,27 @@ class DefaultHandlers:
                 continue
             wanted.append(pk)
             idx = store.local_index_of(pk)
-            if idx is None:
-                # keymanager spec: a key we don't sign with but DO hold
-                # slashing history for is not_active (the caller must
-                # keep the returned interchange), not_found otherwise
-                statuses.append(
-                    {
-                        "status": (
-                            "not_active"
-                            if store.slashing.has_records(pk)
-                            else "not_found"
-                        )
-                    }
-                )
-                continue
-            store.remove_local_key(idx)
-            statuses.append({"status": "deleted"})
+            if idx is not None:
+                try:
+                    store.remove_local_key(idx)
+                    statuses.append({"status": "deleted"})
+                    continue
+                except KeyError:
+                    # lost a race with a concurrent delete of the same
+                    # key — fall through to the absent-key statuses
+                    pass
+            # keymanager spec: a key we don't sign with but DO hold
+            # slashing history for is not_active (the caller must keep
+            # the returned interchange), not_found otherwise
+            statuses.append(
+                {
+                    "status": (
+                        "not_active"
+                        if store.slashing.has_records(pk)
+                        else "not_found"
+                    )
+                }
+            )
         interchange = store.slashing.export_interchange()
         interchange["data"] = [
             d
@@ -1237,16 +1376,18 @@ class BeaconApiServer:
                         self._send(401, {"message": "invalid bearer token"})
                         return
                 # query params merge under the path params (reference:
-                # fastify querystring handling); a REPEATED key becomes
-                # a list (beacon-API array params, e.g. ?id=1&id=2)
+                # fastify querystring handling).  Keys the beacon API
+                # defines as ARRAYS (?id=1&id=2) collect into lists;
+                # scalar keys keep their first value, so a duplicated
+                # scalar can't hand handlers a surprise list
                 q = {}
                 for k, v in parse_qsl(split.query):
-                    if k in q:
+                    if k in q and k in ("id", "status"):
                         if isinstance(q[k], list):
                             q[k].append(v)
                         else:
                             q[k] = [q[k], v]
-                    else:
+                    elif k not in q:
                         q[k] = v
                 for k, v in q.items():
                     params.setdefault(k, v)

@@ -219,3 +219,77 @@ def test_cli_validator_loads_keystores(http_world, tmp_path, capsys):
     out = capsys.readouterr().out
     assert '"keystores_loaded": 1' in out
     assert "junk.json" in out  # the corrupt file surfaced as an error
+
+
+def test_state_balances_committees_sync_committees(http_world):
+    """The remaining beacon state routes (reference: routes/beacon/
+    state.ts): validator_balances, epoch committees (cross-checked
+    against the accessor), sync_committees as indices."""
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_committee,
+        get_committee_count_per_slot,
+    )
+
+    cfg, chain, client, store = http_world
+    st = chain.head_state
+    bal = client._request(
+        "GET",
+        "/eth/v1/beacon/states/head/validator_balances?id=2&id=0x"
+        + store.pubkeys[7].hex(),
+    )["data"]
+    assert [int(b["index"]) for b in bal] == [2, 7]
+    assert all(int(b["balance"]) > 0 for b in bal)
+
+    epoch = int(st.slot) // params.SLOTS_PER_EPOCH
+    comms = client._request(
+        "GET", "/eth/v1/beacon/states/head/committees"
+    )["data"]
+    per_slot = int(get_committee_count_per_slot(st, epoch))
+    assert len(comms) == per_slot * P.SLOTS_PER_EPOCH
+    probe = comms[3]
+    expect = get_beacon_committee(
+        st, int(probe["slot"]), int(probe["index"])
+    )
+    assert [int(v) for v in probe["validators"]] == [int(v) for v in expect]
+    # slot filter narrows to that slot's committees
+    one_slot = client._request(
+        "GET",
+        f"/eth/v1/beacon/states/head/committees?slot={probe['slot']}",
+    )["data"]
+    assert {c["slot"] for c in one_slot} == {probe["slot"]}
+    # far-future epoch is a clean 400
+    from lodestar_tpu.api.client import ApiError
+
+    with pytest.raises(ApiError, match="within 1"):
+        client._request(
+            "GET", "/eth/v1/beacon/states/head/committees?epoch=999"
+        )
+
+    with pytest.raises(ApiError, match="bad query"):
+        client._request(
+            "GET", "/eth/v1/beacon/states/head/committees?slot=abc"
+        )
+    # a repeated SCALAR param keeps its first value (no surprise lists)
+    again = client._request(
+        "GET",
+        f"/eth/v1/beacon/states/head/committees?slot={probe['slot']}"
+        f"&slot=999999",
+    )["data"]
+    assert {c["slot"] for c in again} == {probe["slot"]}
+
+    sc = client._request(
+        "GET", "/eth/v1/beacon/states/head/sync_committees"
+    )["data"]
+    assert len(sc["validators"]) == P.SYNC_COMMITTEE_SIZE
+    assert len(sc["validator_aggregates"]) == params.SYNC_COMMITTEE_SUBNET_COUNT
+    # every listed index really is in the registry
+    assert all(0 <= int(v) < N_KEYS for v in sc["validators"])
+    # an epoch inside the state's period is served; outside is refused
+    same = client._request(
+        "GET", "/eth/v1/beacon/states/head/sync_committees?epoch=0"
+    )["data"]
+    assert same["validators"] == sc["validators"]
+    with pytest.raises(ApiError, match="period"):
+        client._request(
+            "GET", "/eth/v1/beacon/states/head/sync_committees?epoch=512"
+        )
