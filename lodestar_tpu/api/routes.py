@@ -70,6 +70,15 @@ ROUTES: Tuple[Route, ...] = (
         "/eth/v1/beacon/states/{state_id}/validator_balances",
         "get_validator_balances",
     ),
+    Route("GET", "/eth/v1/beacon/states/{state_id}/root", "get_state_root"),
+    Route("GET", "/eth/v1/beacon/states/{state_id}/fork", "get_state_fork"),
+    Route(
+        "GET", "/eth/v1/beacon/blocks/{block_id}/root", "get_block_root"
+    ),
+    Route("GET", "/eth/v1/config/fork_schedule", "get_fork_schedule"),
+    Route(
+        "GET", "/eth/v1/config/deposit_contract", "get_deposit_contract"
+    ),
     Route(
         "GET",
         "/eth/v1/beacon/states/{state_id}/committees",

@@ -720,6 +720,107 @@ class DefaultHandlers:
             "data": self._validator_record(st, i, epoch),
         }
 
+    def get_state_root(self, params, body):
+        """GET /states/{id}/root (reference: routes/beacon/state.ts
+        getStateRoot)."""
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        # full-state merkleization is O(validators) SHA-256 — cache on
+        # the head root, which changes exactly when the state does
+        key = self.chain.head_root_hex
+        cached = getattr(self, "_state_root_cache", None)
+        if cached is None or cached[0] != key:
+            cached = (key, st.hash_tree_root())
+            self._state_root_cache = cached
+        return 200, {
+            "execution_optimistic": False,
+            "data": {"root": "0x" + cached[1].hex()},
+        }
+
+    def get_state_fork(self, params, body):
+        """GET /states/{id}/fork."""
+        err = self._need_chain()
+        if err:
+            return err
+        st, err = self._head_only_state(params["state_id"])
+        if err:
+            return err
+        from ..types import Fork
+        from .encoding import to_json
+
+        return 200, {
+            "execution_optimistic": False,
+            "data": to_json(Fork, st.fork),
+        }
+
+    def get_block_root(self, params, body):
+        """GET /blocks/{id}/root (reference: routes/beacon/block.ts
+        getBlockRoot)."""
+        err = self._need_chain()
+        if err:
+            return err
+        # resolve the ROOT only — requiring the body in the db would
+        # 404 ids the chain itself resolves (e.g. head at the anchor,
+        # whose block body is never stored)
+        try:
+            root = self.chain.resolve_block_id(params["block_id"])
+        except ValueError:
+            return 400, {
+                "message": f"invalid block id {params['block_id']!r}"
+            }
+        if root is None:
+            return 404, {"message": "block not found"}
+        return 200, {
+            "execution_optimistic": False,
+            "data": {"root": "0x" + bytes(root).hex()},
+        }
+
+    def get_fork_schedule(self, params, body):
+        """GET /eth/v1/config/fork_schedule: every scheduled fork with
+        its version transition (reference: routes/config.ts)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from .. import params as _p
+
+        cfg = self.chain.config
+        data = []
+        prev_version = None
+        for f in _p.FORK_ORDER:
+            if f not in cfg.fork_versions:
+                continue
+            # known-but-unscheduled forks ARE served, with FAR_FUTURE
+            # as their epoch — the API contract covers "past, present
+            # and future" forks the node is aware of
+            epoch = cfg.fork_epochs.get(f, _p.FAR_FUTURE_EPOCH)
+            version = cfg.fork_versions[f]
+            data.append(
+                {
+                    "previous_version": "0x"
+                    + (prev_version or version).hex(),
+                    "current_version": "0x" + version.hex(),
+                    "epoch": str(epoch),
+                }
+            )
+            prev_version = version
+        return 200, {"data": data}
+
+    def get_deposit_contract(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        cfg = self.chain.config
+        return 200, {
+            "data": {
+                "chain_id": str(cfg.DEPOSIT_CHAIN_ID),
+                "address": cfg.DEPOSIT_CONTRACT_ADDRESS,
+            }
+        }
+
     def get_validator_balances(self, params, body):
         """GET /states/{id}/validator_balances (reference:
         routes/beacon/state.ts getStateValidatorBalances)."""

@@ -52,6 +52,13 @@ class ChainConfig:
     INACTIVITY_SCORE_RECOVERY_RATE: int = 16
     MIN_PER_EPOCH_CHURN_LIMIT: int = 4
     CHURN_LIMIT_QUOTIENT: int = 65536
+    # the eth1 deposit contract (reference: chainConfig DEPOSIT_CHAIN_ID
+    # / DEPOSIT_CONTRACT_ADDRESS; served by /eth/v1/config/
+    # deposit_contract).  Mainnet values by default.
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_CONTRACT_ADDRESS: str = (
+        "0x00000000219ab540356cbb839cbe05303d7705fa"
+    )
 
     def __post_init__(self):
         self._domain_cache: Dict[Tuple[bytes, bytes], bytes] = {}

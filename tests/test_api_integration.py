@@ -293,3 +293,30 @@ def test_state_balances_committees_sync_committees(http_world):
         client._request(
             "GET", "/eth/v1/beacon/states/head/sync_committees?epoch=512"
         )
+
+
+def test_state_fork_root_and_config_routes(http_world):
+    """/states/{id}/root + /fork, /blocks/{id}/root, /config/
+    fork_schedule, /config/deposit_contract (reference: routes/beacon/
+    state.ts, block.ts, config.ts)."""
+    cfg, chain, client, store = http_world
+    st = chain.head_state
+    r = client._request("GET", "/eth/v1/beacon/states/head/root")["data"]
+    assert r["root"] == "0x" + st.hash_tree_root().hex()
+    f = client._request("GET", "/eth/v1/beacon/states/head/fork")["data"]
+    assert f["current_version"] == "0x" + bytes(
+        st.fork["current_version"]
+    ).hex()
+    assert int(f["epoch"]) == int(st.fork["epoch"])
+    br = client._request("GET", "/eth/v1/beacon/blocks/head/root")["data"]
+    assert br["root"] == "0x" + chain.head_root_hex
+    sched = client._request("GET", "/eth/v1/config/fork_schedule")["data"]
+    # every KNOWN fork is served; unscheduled ones carry FAR_FUTURE
+    assert len(sched) == len(cfg.fork_versions)
+    assert sched[0]["previous_version"] == sched[0]["current_version"]
+    assert int(sched[1]["epoch"]) == 0  # altair at genesis here
+    assert sched[1]["previous_version"] == sched[0]["current_version"]
+    assert int(sched[-1]["epoch"]) == 2**64 - 1  # deneb unscheduled
+    dc = client._request("GET", "/eth/v1/config/deposit_contract")["data"]
+    assert dc["chain_id"] == "1"
+    assert dc["address"].startswith("0x") and len(dc["address"]) == 42
