@@ -470,6 +470,110 @@ class DefaultHandlers:
         self.chain.op_pool.insert_voluntary_exit(signed)
         return 200, None
 
+    def submit_bls_to_execution_change(self, params, body):
+        """POST /pool/bls_to_execution_changes (reference: routes/
+        beacon/pool.ts submitPoolBLSToExecutionChange — takes a LIST)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedBLSToExecutionChange
+        from .encoding import from_json
+
+        for item in body or []:
+            signed = from_json(SignedBLSToExecutionChange, item)
+            try:
+                self.chain.validate_bls_to_execution_change(signed)
+            except Exception as e:
+                return 400, {"message": f"invalid bls change: {e}"}
+            self.chain.op_pool.insert_bls_to_execution_change(signed)
+        return 200, None
+
+    # -- pool reads (reference: routes/beacon/pool.ts getPool*) ------------
+
+    def get_pool_attestations(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import Attestation
+        from .encoding import to_json
+
+        try:
+            want_slot = (
+                int(params["slot"])
+                if params.get("slot") is not None
+                else None
+            )
+            want_index = (
+                int(params["committee_index"])
+                if params.get("committee_index") is not None
+                else None
+            )
+        except (ValueError, TypeError) as e:
+            return 400, {"message": f"bad query parameter: {e}"}
+        data = []
+        pool = self.chain.aggregated_attestation_pool
+        for slot, by_root in pool._by_slot.items():
+            if want_slot is not None and slot != want_slot:
+                continue
+            for atts in by_root.values():
+                for att in atts:
+                    if (
+                        want_index is not None
+                        and int(att["data"]["index"]) != want_index
+                    ):
+                        continue
+                    data.append(to_json(Attestation, att))
+        return 200, {"data": data}
+
+    def _pool_listing(self, ssz_type, records):
+        from .encoding import to_json
+
+        return 200, {"data": [to_json(ssz_type, r) for r in records]}
+
+    def get_pool_attester_slashings(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import AttesterSlashing
+
+        return self._pool_listing(
+            AttesterSlashing,
+            self.chain.op_pool._attester_slashings.values(),
+        )
+
+    def get_pool_proposer_slashings(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import ProposerSlashing
+
+        return self._pool_listing(
+            ProposerSlashing,
+            self.chain.op_pool._proposer_slashings.values(),
+        )
+
+    def get_pool_voluntary_exits(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedVoluntaryExit
+
+        return self._pool_listing(
+            SignedVoluntaryExit,
+            self.chain.op_pool._voluntary_exits.values(),
+        )
+
+    def get_pool_bls_to_execution_changes(self, params, body):
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedBLSToExecutionChange
+
+        return self._pool_listing(
+            SignedBLSToExecutionChange,
+            self.chain.op_pool._bls_to_execution_changes.values(),
+        )
+
     def get_events(self, params, body):
         """SSE stream of chain events (reference routes/events.ts):
         `?topics=head,block,finalized_checkpoint` and an optional

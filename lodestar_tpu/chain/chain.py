@@ -593,6 +593,15 @@ class BeaconChain:
 
         process_attester_slashing(self.head_state.clone(), slashing, True)
 
+    def validate_bls_to_execution_change(self, signed_change: dict) -> None:
+        from ..state_transition.block import (
+            process_bls_to_execution_change,
+        )
+
+        process_bls_to_execution_change(
+            self.head_state.clone(), signed_change, True
+        )
+
     def on_attester_slashing(self, slashing: dict) -> None:
         """Zero the equivocating validators' fork-choice influence
         (reference: chain.ts emitter AttesterSlashing ->
