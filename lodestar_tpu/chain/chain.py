@@ -44,9 +44,20 @@ from .op_pools import (
 from .produce_block import produce_block_from_pools
 from .regen import StateRegenerator
 from .seen_cache import SeenAttesters
-from ..fork_choice import ForkChoice, ProtoArray
+from ..fork_choice import ExecutionStatus, ForkChoice, ProtoArray
 
 P = params.ACTIVE_PRESET
+
+
+class PayloadInvalidError(ValueError):
+    """The EL rejected the payload; carries the latestValidHash so the
+    caller can invalidate the bad ancestor chain (reference:
+    verifyBlocksExecutionPayloads.ts:304-314)."""
+
+    def __init__(self, msg: str, latest_valid_hash: Optional[str] = None):
+        super().__init__(msg)
+        # plain-hex (no 0x) EL hash, or None when the EL gave none
+        self.latest_valid_hash = latest_valid_hash
 
 
 class BeaconChain:
@@ -159,7 +170,44 @@ class BeaconChain:
         # (_execution_block_hash / optimistic_roots) is recorded only
         # AFTER the whole import lands, so invalid-block spam cannot
         # grow the maps.
-        exec_result = self._verify_execution_payload(block)
+        try:
+            exec_result = self._verify_execution_payload(block)
+        except PayloadInvalidError as e:
+            # the bad payload's ancestors up to the LVH are also invalid:
+            # evict them from head candidacy before rejecting this block
+            # (reference: chain/blocks/index.ts:86 validateLatestHash on
+            # invalidSegmentLHV, from-root = the block's parent)
+            parent_hex = block["parent_root"].hex()
+            # Only act on a non-null LVH (reference:
+            # verifyBlocksExecutionPayloads.ts:375 skips a null LVH —
+            # the engine API allows INVALID with latestValidHash=null,
+            # and invalidating the innocent parent on that would let one
+            # cheap bad block evict the honest chain), and only when the
+            # LVH is NOT the parent's own payload (:396-399 — if it is,
+            # the parent chain is clean and only this never-imported
+            # block was bad).
+            parent_el = self._execution_block_hash.get(parent_hex)
+            lvh_is_parent = (
+                parent_el is not None and parent_el.hex() == e.latest_valid_hash
+            ) or (parent_el is None and e.latest_valid_hash == "00" * 32)
+            if (
+                e.latest_valid_hash is not None
+                and not lvh_is_parent
+                and self.fork_choice.has_block(parent_hex)
+            ):
+                try:
+                    self.fork_choice.validate_latest_hash(
+                        ExecutionStatus.Invalid,
+                        e.latest_valid_hash,
+                        invalidate_from_block_root=parent_hex,
+                    )
+                    self.head_root_hex = self.fork_choice.update_head()
+                except Exception as fc_err:  # noqa: BLE001
+                    self.log.warn(
+                        "payload-invalidation fork-choice update failed",
+                        error=str(fc_err),
+                    )
+            raise
 
         view = None
         if self.bls is not None or (
@@ -191,12 +239,26 @@ class BeaconChain:
             )
 
         # land it (fork choice + caches + db)
+        unrealized = self._unrealized_checkpoints(block, post)
+        if exec_result is None:
+            exec_status, exec_hash = ExecutionStatus.PreMerge, None
+        else:
+            exec_status = (
+                ExecutionStatus.Syncing
+                if exec_result[1]
+                else ExecutionStatus.Valid
+            )
+            exec_hash = bytes(exec_result[0]).hex()
         self.fork_choice.on_block(
             block["slot"],
             root.hex(),
             block["parent_root"].hex(),
             justified_epoch=int(post.current_justified_checkpoint["epoch"]),
             finalized_epoch=int(post.finalized_checkpoint["epoch"]),
+            unrealized_justified_epoch=unrealized["justified_epoch"],
+            unrealized_finalized_epoch=unrealized["finalized_epoch"],
+            execution_status=exec_status,
+            execution_block_hash=exec_hash,
         )
         # clock surrogate: a block at a later slot clears any stale boost
         self.fork_choice.set_current_slot(int(block["slot"]))
@@ -234,6 +296,9 @@ class BeaconChain:
             self.op_pool.prune_all(post)
             froot = post.finalized_checkpoint["root"].hex()
             if self.fork_choice.has_block(froot):
+                # spec-form finalized viability: nodes must DESCEND from
+                # this root, not merely match its epoch
+                self.fork_choice.proto.finalized_root = froot
                 # drop pre-finalized proto nodes (reference maybePrune;
                 # no-op below the prune threshold)
                 removed = self.fork_choice.prune(froot)
@@ -245,11 +310,19 @@ class BeaconChain:
             )
 
         # head via proto-array vote accounting (reference updateHead)
+        from ..fork_choice import LVHConsensusError
+
         try:
             self.fork_choice.set_balances(
                 post.effective_balance.astype("int64")
             )
             self.head_root_hex = self.fork_choice.update_head()
+        except LVHConsensusError:
+            # EL verdict flip-flop latched the array as perma-damaged:
+            # this is irrecoverable consensus failure — escalate, never
+            # fall back to "newest block wins" (reference:
+            # cli/cmds/beacon/handler.ts:37-41 escalates to SIGINT)
+            raise
         except Exception:
             self.head_root_hex = root.hex()
         self.emitter.emit(
@@ -317,6 +390,40 @@ class BeaconChain:
             if entered >= 2:
                 mon.on_epoch_close(entered - 2)
 
+    # NOTE on the broad except blocks around validate_latest_hash /
+    # update_head in the invalidation paths: LVHConsensusError latches
+    # proto.lvh_error, so even where a handler logs-and-continues, every
+    # subsequent update_head re-raises it — the perma-damage signal
+    # cannot be lost, only deferred one import.
+
+    def _unrealized_checkpoints(self, block: dict, post) -> dict:
+        """Pulled-up checkpoints for the fork-choice node (reference:
+        forkChoice.ts:377-415).  If the parent's unrealized justification
+        already reached this block's epoch (and finalization is at most
+        one epoch behind), the child cannot move them — reuse the
+        parent's values and skip the clone+epoch-weighing entirely."""
+        block_epoch = compute_epoch_at_slot(int(block["slot"]))
+        parent_idx = self.fork_choice.proto.indices.get(
+            block["parent_root"].hex()
+        )
+        if parent_idx is not None:
+            p = self.fork_choice.proto.nodes[parent_idx]
+            if (
+                p.unrealized_justified_epoch == block_epoch
+                and p.unrealized_finalized_epoch + 1 >= block_epoch
+            ):
+                return {
+                    "justified_epoch": p.unrealized_justified_epoch,
+                    "finalized_epoch": p.unrealized_finalized_epoch,
+                }
+        from ..state_transition.epoch import compute_unrealized_checkpoints
+
+        cps = compute_unrealized_checkpoints(post)
+        return {
+            "justified_epoch": int(cps["justified"]["epoch"]),
+            "finalized_epoch": int(cps["finalized"]["epoch"]),
+        }
+
     def _verify_execution_payload(self, block: dict):
         """The third verification leg (reference: verifyBlock.ts
         verifyBlocksExecutionPayload -> engine notifyNewPayload).
@@ -375,9 +482,13 @@ class BeaconChain:
             raise ExecutionEngineUnavailable(
                 f"EL outage: {st.status.value} ({st.validation_error})"
             )
-        raise ValueError(
+        lvh = st.latest_valid_hash
+        raise PayloadInvalidError(
             f"execution payload rejected: {st.status.value} "
-            f"({st.validation_error})"
+            f"({st.validation_error})",
+            latest_valid_hash=(
+                lvh[2:] if isinstance(lvh, str) and lvh.startswith("0x") else lvh
+            ),
         )
 
     def execution_head_hashes(self):
@@ -402,11 +513,42 @@ class BeaconChain:
             r = self.execution.notify_forkchoice_update(
                 head_hash, head_hash, fin_hash
             )
-            # the EL confirming the head resolves its optimistic status
-            if r.status == ExecutePayloadStatus.VALID:
-                self.optimistic_roots.discard(self.head_root_hex)
         except Exception as e:  # noqa: BLE001 - EL outage must not kill import
             self.log.warn("engine forkchoiceUpdated failed", error=str(e))
+            return
+        # the EL confirming the head resolves optimistic statuses all
+        # the way down the branch (reference: importBlock.ts fcU response
+        # -> forkChoice.validateLatestHash)
+        if r.status == ExecutePayloadStatus.VALID:
+            try:
+                # the confirmed head's root is known: O(branch depth)
+                # propagation, not the O(n) exec-hash scan
+                self.fork_choice.proto.propagate_valid_root(
+                    self.head_root_hex
+                )
+            except Exception as e:  # noqa: BLE001
+                self.log.warn("valid-propagation failed", error=str(e))
+            pa = self.fork_choice.proto
+            self.optimistic_roots = {
+                rt
+                for rt in self.optimistic_roots
+                if rt in pa.indices
+                and pa.nodes[pa.indices[rt]].execution_status
+                != ExecutionStatus.Valid
+            }
+        elif r.status == ExecutePayloadStatus.INVALID:
+            # the current head's payload chain is bad: invalidate and
+            # move the head off it
+            lvh = r.latest_valid_hash
+            try:
+                self.fork_choice.validate_latest_hash(
+                    ExecutionStatus.Invalid,
+                    lvh[2:] if isinstance(lvh, str) and lvh.startswith("0x") else lvh,
+                    invalidate_from_block_root=self.head_root_hex,
+                )
+                self.head_root_hex = self.fork_choice.update_head()
+            except Exception as e:  # noqa: BLE001
+                self.log.warn("head invalidation failed", error=str(e))
 
     def _verify_signatures_batched(self, view, signed_block) -> bool:
         """One batched job through the injected verifier service using the

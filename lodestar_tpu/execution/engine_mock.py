@@ -64,6 +64,10 @@ class ExecutionEngineMock:
         self.finalized: bytes = ZERO_HASH
         # test fault injection (reference mock error modes)
         self.fail_with: Optional[ExecutePayloadStatus] = None
+        # block hashes the EL rules INVALID (optimistic-sync tests);
+        # responses carry the nearest known-valid ancestor as the LVH,
+        # or the zero hash when the ancestry is unknown
+        self.invalid_hashes: set = set()
 
     # -- engine_newPayload -------------------------------------------------
 
@@ -76,6 +80,15 @@ class ExecutionEngineMock:
         if self.fail_with is not None:
             return ExecutionPayloadStatus(self.fail_with)
         declared = bytes(payload["block_hash"])
+        if declared in self.invalid_hashes:
+            return ExecutionPayloadStatus(
+                ExecutePayloadStatus.INVALID,
+                latest_valid_hash="0x"
+                + self._latest_valid_ancestor(
+                    bytes(payload["parent_hash"])
+                ).hex(),
+                validation_error="mock: hash ruled invalid",
+            )
         actual = compute_block_hash(payload)
         if declared != actual:
             return ExecutionPayloadStatus(
@@ -106,6 +119,14 @@ class ExecutionEngineMock:
         if self.fail_with is not None:
             return ForkchoiceUpdateResult(self.fail_with)
         head_block_hash = bytes(head_block_hash)
+        if head_block_hash in self.invalid_hashes:
+            return ForkchoiceUpdateResult(
+                ExecutePayloadStatus.INVALID,
+                latest_valid_hash="0x"
+                + self._latest_valid_ancestor(
+                    self.valid_blocks.get(head_block_hash, ZERO_HASH)
+                ).hex(),
+            )
         if head_block_hash not in self.valid_blocks:
             return ForkchoiceUpdateResult(ExecutePayloadStatus.SYNCING)
         self.head = head_block_hash
@@ -148,6 +169,18 @@ class ExecutionEngineMock:
             latest_valid_hash="0x" + head_block_hash.hex(),
             payload_id=payload_id,
         )
+
+    def _latest_valid_ancestor(self, start: bytes) -> bytes:
+        """Nearest ancestor that is known-valid and not ruled invalid;
+        zero hash when the ancestry is unknown (optimistic peer)."""
+        cur = bytes(start)
+        seen = 0
+        while cur != ZERO_HASH and seen < 10_000:
+            if cur in self.valid_blocks and cur not in self.invalid_hashes:
+                return cur
+            cur = self.valid_blocks.get(cur, ZERO_HASH)
+            seen += 1
+        return ZERO_HASH
 
     def _block_number(self, block_hash: bytes) -> int:
         n = 0

@@ -8,6 +8,12 @@ latest-messages, and viability filtering by justified/finalized
 checkpoints.
 """
 
-from .proto_array import ProtoArray, ProtoNode  # noqa: F401
+from .proto_array import (  # noqa: F401
+    ExecutionStatus,
+    LVHConsensusError,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoNode,
+)
 from .fork_choice import ForkChoice, LatestMessage  # noqa: F401
 from .compute_deltas import compute_deltas  # noqa: F401

@@ -27,7 +27,7 @@ import numpy as np
 
 from .. import params
 from .compute_deltas import compute_deltas
-from .proto_array import ProtoArray, ProtoNode
+from .proto_array import ExecutionStatus, ProtoArray, ProtoNode
 
 SLOTS_PER_EPOCH = params.SLOTS_PER_EPOCH  # preset-aware
 PROPOSER_SCORE_BOOST_PCT = 40  # config presets mainnet.ts:73
@@ -79,6 +79,10 @@ class ForkChoice:
         parent_root: Optional[str],
         justified_epoch: int = None,
         finalized_epoch: int = None,
+        unrealized_justified_epoch: int = None,
+        unrealized_finalized_epoch: int = None,
+        execution_status: str = ExecutionStatus.PreMerge,
+        execution_block_hash: Optional[str] = None,
     ) -> None:
         self.proto.on_block(
             slot,
@@ -86,7 +90,29 @@ class ForkChoice:
             parent_root,
             self.proto.justified_epoch if justified_epoch is None else justified_epoch,
             self.proto.finalized_epoch if finalized_epoch is None else finalized_epoch,
+            unrealized_justified_epoch=unrealized_justified_epoch,
+            unrealized_finalized_epoch=unrealized_finalized_epoch,
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash,
         )
+
+    def validate_latest_hash(
+        self,
+        execution_status: str,
+        latest_valid_exec_hash: Optional[str],
+        invalidate_from_block_root: Optional[str] = None,
+    ) -> None:
+        """Forward an EL latestValidHash verdict to the proto array
+        (reference: forkChoice.ts validateLatestHash passthrough)."""
+        self.proto.validate_latest_hash(
+            execution_status,
+            latest_valid_exec_hash,
+            invalidate_from_block_root,
+        )
+
+    def get_execution_status(self, root: str) -> Optional[str]:
+        idx = self.proto.indices.get(root)
+        return self.proto.nodes[idx].execution_status if idx is not None else None
 
     def on_timely_block(self, root: str, slot: Optional[int] = None) -> None:
         """Arm the proposer boost for a block arriving before 1/3 slot
@@ -108,7 +134,9 @@ class ForkChoice:
     def set_current_slot(self, slot: int) -> None:
         """Clock surrogate for clock-less compositions (BeaconChain):
         any evidence that time moved past the boosted slot clears the
-        boost (reference: forkChoice.ts updateTime)."""
+        boost (reference: forkChoice.ts updateTime); the proto array's
+        clock drives the prev-epoch unrealized-checkpoint filter."""
+        self.proto.current_slot = max(self.proto.current_slot, slot)
         if self._boost_slot is not None and slot > self._boost_slot:
             self.on_tick_slot()
 

@@ -430,6 +430,45 @@ def process_sync_committee_updates(
 # -- entry ------------------------------------------------------------------
 
 
+def compute_unrealized_checkpoints(state) -> Dict[str, Dict]:
+    """Pulled-up justification: the checkpoints the chain WOULD realize
+    if the epoch transition ran right after this state's latest block
+    (reference: state-transition/src/epoch/computeUnrealizedCheckpoints.ts:15).
+
+    Runs justification-and-finalization on a clone; the fork-choice
+    stores the result per node for the prev-epoch viability filter."""
+    epoch = compute_epoch_at_slot(state.slot)
+    if epoch <= params.GENESIS_EPOCH + 1:
+        return {
+            "justified": dict(state.current_justified_checkpoint),
+            "finalized": dict(state.finalized_checkpoint),
+        }
+    # weigh_justification_and_finalization touches exactly four fields;
+    # save/restore them instead of deep-cloning the whole registry —
+    # this runs in the per-block import hot path
+    saved = (
+        dict(state.previous_justified_checkpoint),
+        dict(state.current_justified_checkpoint),
+        list(state.justification_bits),
+        dict(state.finalized_checkpoint),
+    )
+    try:
+        process_justification_and_finalization(
+            state, EpochTransitionCache(state)
+        )
+        return {
+            "justified": dict(state.current_justified_checkpoint),
+            "finalized": dict(state.finalized_checkpoint),
+        }
+    finally:
+        (
+            state.previous_justified_checkpoint,
+            state.current_justified_checkpoint,
+            state.justification_bits,
+            state.finalized_checkpoint,
+        ) = saved
+
+
 def process_epoch(state) -> Dict:
     """Run the full altair epoch transition in spec order; returns the
     cache for callers that want the precomputed masks (regen metrics)."""

@@ -1,0 +1,293 @@
+"""Optimistic-sync fork choice: ExecutionStatus, LVH invalidation,
+unrealized-checkpoint viability.
+
+Reference behaviors: packages/fork-choice/src/protoArray/interface.ts:16-40
+(ExecutionStatus / LVH responses), protoArray.ts:245-446 (validateLatestHash,
+propagateInValidExecutionStatusByIndex, consensus-failure latching) and
+protoArray.ts:725-753 (nodeIsViableForHead with unrealized checkpoints).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.fork_choice import (
+    ExecutionStatus,
+    ForkChoice,
+    LVHConsensusError,
+    ProtoArray,
+    ProtoArrayError,
+)
+
+pytestmark = pytest.mark.smoke
+
+SPE = params.SLOTS_PER_EPOCH
+
+
+def exec_chain():
+    """genesis(PreMerge) -> a(Valid) -> b(Syncing) -> (c, d)(Syncing);
+    c and d compete on top of b."""
+    pa = ProtoArray("genesis")
+    pa.on_block(
+        1, "a", "genesis", 0, 0,
+        execution_status=ExecutionStatus.Valid, execution_block_hash="aa" * 32,
+    )
+    pa.on_block(
+        2, "b", "a", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="bb" * 32,
+    )
+    pa.on_block(
+        3, "c", "b", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="cc" * 32,
+    )
+    pa.on_block(
+        3, "d", "b", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="dd" * 32,
+    )
+    return pa
+
+
+# -- invalidation ---------------------------------------------------------
+
+
+def test_invalid_payload_evicts_descendants_from_head():
+    """An EL-invalid verdict on b (LVH=a) must evict b, c, d from head
+    candidacy: the head falls back to a."""
+    pa = exec_chain()
+    fc = ForkChoice(pa, "genesis", np.array([10, 10], np.int64))
+    fc.on_attestation(0, 1, "c")
+    fc.on_attestation(1, 1, "d")
+    assert fc.update_head() in ("c", "d")
+
+    # EL: the branch ending at d is invalid, last valid payload is a's
+    pa.validate_latest_hash(
+        ExecutionStatus.Invalid, "aa" * 32, invalidate_from_block_root="d"
+    )
+    for root in ("b", "c", "d"):
+        assert (
+            pa.nodes[pa.indices[root]].execution_status
+            == ExecutionStatus.Invalid
+        )
+    assert pa.nodes[pa.indices["a"]].execution_status == ExecutionStatus.Valid
+    # votes for c/d still exist but invalid nodes are not viable
+    assert fc.update_head() == "a"
+
+
+def test_invalid_without_lvh_invalidates_only_named_node():
+    """Null/unknown LVH: be forgiving — only the named payload flips
+    (reference protoArray.ts:296-311)."""
+    pa = exec_chain()
+    pa.validate_latest_hash(
+        ExecutionStatus.Invalid, None, invalidate_from_block_root="c"
+    )
+    assert pa.nodes[pa.indices["c"]].execution_status == ExecutionStatus.Invalid
+    assert pa.nodes[pa.indices["b"]].execution_status == ExecutionStatus.Syncing
+    assert pa.nodes[pa.indices["d"]].execution_status == ExecutionStatus.Syncing
+    # d remains a viable head
+    assert pa.find_head("genesis") == "d"
+
+
+def test_invalidation_of_unknown_root_errors():
+    pa = exec_chain()
+    with pytest.raises(ProtoArrayError):
+        pa.validate_latest_hash(
+            ExecutionStatus.Invalid, None, invalidate_from_block_root="zz"
+        )
+
+
+def test_invalid_child_of_invalid_sibling_branch():
+    """Pass 2: descendants of invalidated nodes flip even when they were
+    not on the reported ancestry walk."""
+    pa = exec_chain()
+    pa.on_block(
+        4, "e", "c", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="ee" * 32,
+    )
+    # report names d (sibling of c); the walk invalidates d and b, and
+    # pass 2 sweeps c (child of b) and e (child of c)
+    pa.validate_latest_hash(
+        ExecutionStatus.Invalid, "aa" * 32, invalidate_from_block_root="d"
+    )
+    for root in ("b", "c", "d", "e"):
+        assert (
+            pa.nodes[pa.indices[root]].execution_status
+            == ExecutionStatus.Invalid
+        )
+
+
+def test_invalidated_subtree_weight_stops_counting():
+    """Votes parked on an invalidated subtree must stop counting toward
+    its ancestors (reference protoArray.ts:146-150: an Invalid node's
+    delta is forced to -weight).  Branch A carries heavy votes on a
+    subtree the EL rules invalid plus light votes on a clean sibling;
+    branch B carries medium votes — B must win."""
+    pa = ProtoArray("genesis")
+    pa.on_block(1, "A", "genesis", 0, 0,
+                execution_status=ExecutionStatus.Syncing,
+                execution_block_hash="a1" * 32)
+    pa.on_block(2, "A1", "A", 0, 0,
+                execution_status=ExecutionStatus.Syncing,
+                execution_block_hash="a2" * 32)
+    pa.on_block(2, "A2", "A", 0, 0,
+                execution_status=ExecutionStatus.Syncing,
+                execution_block_hash="a3" * 32)
+    pa.on_block(1, "B", "genesis", 0, 0,
+                execution_status=ExecutionStatus.Syncing,
+                execution_block_hash="b1" * 32)
+    fc = ForkChoice(pa, "genesis", np.array([100, 10, 50], np.int64))
+    fc.on_attestation(0, 1, "A1")  # 100 on the soon-invalid subtree
+    fc.on_attestation(1, 1, "A2")  # 10 on A's clean sibling subtree
+    fc.on_attestation(2, 1, "B")   # 50 on branch B
+    assert fc.update_head() == "A1"
+    # EL: A1 invalid, LVH = A's payload
+    pa.validate_latest_hash(
+        ExecutionStatus.Invalid, "a1" * 32, invalidate_from_block_root="A1"
+    )
+    # A1's 100 no longer counts: A carries only 10, B's 50 wins
+    assert fc.update_head() == "B"
+    assert pa.nodes[pa.indices["A1"]].weight == 0
+    assert pa.nodes[pa.indices["A"]].weight == 10
+    assert pa.nodes[pa.indices["B"]].weight == 50
+
+
+# -- valid propagation ----------------------------------------------------
+
+
+def test_valid_verdict_propagates_to_ancestors():
+    pa = exec_chain()
+    pa.validate_latest_hash(ExecutionStatus.Valid, "cc" * 32)
+    assert pa.nodes[pa.indices["c"]].execution_status == ExecutionStatus.Valid
+    assert pa.nodes[pa.indices["b"]].execution_status == ExecutionStatus.Valid
+    # sibling branch untouched
+    assert pa.nodes[pa.indices["d"]].execution_status == ExecutionStatus.Syncing
+
+
+def test_valid_child_insert_validates_ancestry():
+    """Inserting a Valid block proves its whole Syncing ancestry
+    (reference protoArray.ts:227-229)."""
+    pa = exec_chain()
+    pa.on_block(
+        4, "e", "c", 0, 0,
+        execution_status=ExecutionStatus.Valid, execution_block_hash="ee" * 32,
+    )
+    assert pa.nodes[pa.indices["c"]].execution_status == ExecutionStatus.Valid
+    assert pa.nodes[pa.indices["b"]].execution_status == ExecutionStatus.Valid
+
+
+def test_unknown_valid_hash_is_noop():
+    pa = exec_chain()
+    pa.validate_latest_hash(ExecutionStatus.Valid, "99" * 32)
+    assert pa.nodes[pa.indices["b"]].execution_status == ExecutionStatus.Syncing
+
+
+# -- consensus-failure latching -------------------------------------------
+
+
+def test_invalidating_valid_node_latches_error():
+    pa = exec_chain()
+    pa.validate_latest_hash(ExecutionStatus.Valid, "dd" * 32)  # d now Valid
+    with pytest.raises(LVHConsensusError):
+        # EL flip-flop: now claims the whole branch below d is invalid
+        pa.validate_latest_hash(
+            ExecutionStatus.Invalid, "aa" * 32, invalidate_from_block_root="d"
+        )
+    # the array is perma-damaged: every head lookup raises
+    with pytest.raises(LVHConsensusError):
+        pa.find_head("genesis")
+
+
+def test_insert_invalid_block_rejected():
+    pa = exec_chain()
+    with pytest.raises(ProtoArrayError):
+        pa.on_block(
+            4, "e", "c", 0, 0, execution_status=ExecutionStatus.Invalid
+        )
+
+
+# -- LVH anchored at the pre-merge boundary -------------------------------
+
+
+def test_lvh_zero_hash_matches_premerge_anchor():
+    """LVH = 0x00..00 means 'everything post-merge is bad': the walk must
+    stop at the PreMerge genesis and invalidate the whole exec chain."""
+    pa = exec_chain()
+    # a is Valid — invalidating it is a consensus failure; build a purely
+    # Syncing chain instead
+    pa2 = ProtoArray("genesis")
+    pa2.on_block(
+        1, "x", "genesis", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="11" * 32,
+    )
+    pa2.on_block(
+        2, "y", "x", 0, 0,
+        execution_status=ExecutionStatus.Syncing, execution_block_hash="22" * 32,
+    )
+    pa2.validate_latest_hash(
+        ExecutionStatus.Invalid, "00" * 32, invalidate_from_block_root="y"
+    )
+    assert pa2.nodes[pa2.indices["x"]].execution_status == ExecutionStatus.Invalid
+    assert pa2.nodes[pa2.indices["y"]].execution_status == ExecutionStatus.Invalid
+    assert pa2.find_head("genesis") == "genesis"
+
+
+# -- unrealized-checkpoint viability --------------------------------------
+
+
+def test_prev_epoch_node_filtered_on_unrealized_justification():
+    """A prev-epoch block whose UNREALIZED justification does not match
+    the store's justified checkpoint is not viable, even if its realized
+    justified epoch matches (protoArray.ts:733-736)."""
+    pa = ProtoArray("genesis")
+    # two competing epoch-1 blocks: p pulled up to epoch 2, q stuck at 0
+    pa.on_block(
+        SPE + 1, "p", "genesis", 0, 0,
+        unrealized_justified_epoch=2, unrealized_finalized_epoch=0,
+    )
+    pa.on_block(
+        SPE + 2, "q", "genesis", 0, 0,
+        unrealized_justified_epoch=0, unrealized_finalized_epoch=0,
+    )
+    # clock enters epoch 3; the store justifies epoch 2
+    pa.current_slot = 3 * SPE
+    pa.apply_score_changes([0, 0, 0], justified_epoch=2, finalized_epoch=0)
+    # p (voting source = unrealized 2) is viable; q (unrealized 0) is not
+    assert pa._node_is_viable_for_head(pa.nodes[pa.indices["p"]])
+    assert not pa._node_is_viable_for_head(pa.nodes[pa.indices["q"]])
+    assert pa.find_head("genesis") == "p"
+
+
+def test_pulled_up_allowance_two_epoch_stale_source():
+    """Current-epoch node with a stale realized source stays viable while
+    the store justified the previous epoch and the node's unrealized
+    justification caught up (protoArray.ts:742-746)."""
+    pa = ProtoArray("genesis")
+    cur_epoch = 3
+    pa.current_slot = cur_epoch * SPE + 1
+    # node in the CURRENT epoch: realized source epoch 1 (two back),
+    # unrealized justification reached epoch 2
+    pa.on_block(
+        cur_epoch * SPE + 1, "r", "genesis", 1, 0,
+        unrealized_justified_epoch=2, unrealized_finalized_epoch=0,
+    )
+    pa.apply_score_changes([0, 0], justified_epoch=2, finalized_epoch=0)
+    assert pa._node_is_viable_for_head(pa.nodes[pa.indices["r"]])
+    # but a realized source three epochs back is out of the allowance
+    pa.on_block(
+        cur_epoch * SPE + 2, "s", "genesis", 0, 0,
+        unrealized_justified_epoch=2, unrealized_finalized_epoch=0,
+    )
+    assert not pa._node_is_viable_for_head(pa.nodes[pa.indices["s"]])
+
+
+def test_finalized_root_ancestor_check():
+    """With finalized_root tracked, viability requires descending from
+    the finalized block, not merely matching its epoch."""
+    pa = ProtoArray("genesis")
+    pa.on_block(SPE, "f", "genesis", 0, 1)  # finalized epoch-1 block
+    pa.on_block(SPE + 1, "m", "f", 1, 1)
+    pa.on_block(SPE + 1, "n", "genesis", 1, 1)  # NOT descending from f
+    pa.current_slot = SPE + 2
+    pa.finalized_root = "f"
+    pa.apply_score_changes([0] * 4, justified_epoch=1, finalized_epoch=1)
+    assert pa._node_is_viable_for_head(pa.nodes[pa.indices["m"]])
+    assert not pa._node_is_viable_for_head(pa.nodes[pa.indices["n"]])

@@ -1,0 +1,163 @@
+"""Chain-level optimistic sync: SYNCING imports, EL verdicts, eviction.
+
+Reference behaviors: packages/beacon-node/src/chain/blocks/
+verifyBlocksExecutionPayloads.ts (SYNCING -> optimistic import,
+INVALID -> invalidSegmentLHV) and chain/blocks/index.ts:86
+(forkChoice.validateLatestHash) — an EL-invalid payload must
+retroactively evict its optimistically-imported ancestors from head
+candidacy.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.execution import ExecutePayloadStatus, ExecutionEngineMock
+from lodestar_tpu.fork_choice import ExecutionStatus
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.accessors import get_beacon_proposer_index
+from lodestar_tpu.state_transition.slot import process_slots
+from lodestar_tpu.validator import ValidatorStore
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+N_KEYS = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0, ForkName.bellatrix: 1},
+    )
+    sks = [B.keygen(b"opt-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    return cfg, sks, genesis
+
+
+def _make_proposer(cfg, sks, genesis, chain):
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+
+    def propose(slot):
+        st = genesis.clone()
+        process_slots(st, slot)
+        proposer = get_beacon_proposer_index(st)
+        block = chain.produce_block(slot, store.sign_randao(proposer, slot))
+        block_type = (
+            T.BeaconBlockBellatrix
+            if "execution_payload" in block["body"]
+            else T.BeaconBlockAltair
+        )
+        root = cfg.compute_signing_root(
+            block_type.hash_tree_root(block),
+            cfg.get_domain(slot, params.DOMAIN_BEACON_PROPOSER, slot),
+        )
+        return {
+            "message": block,
+            "signature": C.g2_compress(B.sign(sks[proposer], root)),
+        }
+
+    return propose
+
+
+def test_invalid_verdict_evicts_optimistic_branch(world):
+    """Import two payload blocks optimistically (EL syncing), then the
+    EL rules the branch INVALID on fcU: both nodes flip Invalid and the
+    head falls back to the pre-merge block."""
+    cfg, sks, genesis = world
+    el_build = ExecutionEngineMock()  # fully-working EL builds payloads
+    builder = BeaconChain(cfg, genesis, execution=el_build)
+    propose = _make_proposer(cfg, sks, genesis, builder)
+
+    el = ExecutionEngineMock()
+    chain = BeaconChain(cfg, genesis, execution=el)
+
+    # altair block: PreMerge node
+    b_alt = propose(1)
+    builder.process_block(b_alt)
+    r_alt = chain.process_block(b_alt)
+    alt_hex = bytes(r_alt).hex()
+    assert (
+        chain.fork_choice.get_execution_status(alt_hex)
+        == ExecutionStatus.PreMerge
+    )
+
+    # merge block M: force the chain's EL into syncing -> optimistic
+    b_merge = propose(P.SLOTS_PER_EPOCH + 1)
+    builder.process_block(b_merge)
+    el.fail_with = ExecutePayloadStatus.SYNCING
+    r_merge = chain.process_block(b_merge)
+    el.fail_with = None
+    m_hex = bytes(r_merge).hex()
+    assert m_hex in chain.optimistic_roots
+    assert (
+        chain.fork_choice.get_execution_status(m_hex)
+        == ExecutionStatus.Syncing
+    )
+
+    # child C: EL has unknown ancestry -> SYNCING organically
+    b_child = propose(P.SLOTS_PER_EPOCH + 2)
+    builder.process_block(b_child)
+    r_child = chain.process_block(b_child)
+    c_hex = bytes(r_child).hex()
+    assert c_hex in chain.optimistic_roots
+    assert chain.head_root_hex == c_hex
+
+    # EL finishes syncing: the whole payload branch is INVALID
+    p1 = chain._execution_block_hash[m_hex]
+    p2 = chain._execution_block_hash[c_hex]
+    el.invalid_hashes = {p1, p2}
+    chain._notify_forkchoice()
+
+    assert (
+        chain.fork_choice.get_execution_status(m_hex)
+        == ExecutionStatus.Invalid
+    )
+    assert (
+        chain.fork_choice.get_execution_status(c_hex)
+        == ExecutionStatus.Invalid
+    )
+    # head fell back to the last pre-merge block
+    assert chain.head_root_hex == alt_hex
+
+
+def test_valid_fcu_resolves_optimistic_branch(world):
+    """The EL confirming the head flips the whole Syncing branch Valid
+    and empties optimistic_roots (reference: importBlock.ts fcU VALID ->
+    validateLatestHash)."""
+    cfg, sks, genesis = world
+    el_build = ExecutionEngineMock()
+    builder = BeaconChain(cfg, genesis, execution=el_build)
+    propose = _make_proposer(cfg, sks, genesis, builder)
+
+    el = ExecutionEngineMock()
+    chain = BeaconChain(cfg, genesis, execution=el)
+
+    b_alt = propose(1)
+    builder.process_block(b_alt)
+    chain.process_block(b_alt)
+
+    b_merge = propose(P.SLOTS_PER_EPOCH + 1)
+    builder.process_block(b_merge)
+    el.fail_with = ExecutePayloadStatus.SYNCING
+    r_merge = chain.process_block(b_merge)
+    el.fail_with = None
+    m_hex = bytes(r_merge).hex()
+    assert m_hex in chain.optimistic_roots
+
+    # EL catches up: it now knows the payload chain end-to-end
+    p1 = chain._execution_block_hash[m_hex]
+    el.valid_blocks[p1] = b"\x00" * 32
+    chain._notify_forkchoice()
+
+    assert (
+        chain.fork_choice.get_execution_status(m_hex) == ExecutionStatus.Valid
+    )
+    assert not chain.optimistic_roots
