@@ -49,6 +49,14 @@ from ..fork_choice import ExecutionStatus, ForkChoice, ProtoArray
 P = params.ACTIVE_PRESET
 
 
+class BlobsUnavailableError(Exception):
+    """A deneb block's blob sidecars are not (yet) available: the block
+    cannot be imported until every commitment has a validated sidecar.
+    Retryable — the gossip layer IGNOREs and the processor may park the
+    block (reference: the importBlock availability gate; p2p spec
+    IGNORE, not REJECT)."""
+
+
 class PayloadInvalidError(ValueError):
     """The EL rejected the payload; carries the latestValidHash so the
     caller can invalidate the bad ancestor chain (reference:
@@ -89,6 +97,16 @@ class BeaconChain:
         self._execution_block_hash: Dict[str, bytes] = {}
         # roots imported optimistically (EL said SYNCING/ACCEPTED)
         self.optimistic_roots: set = set()
+        # data availability (deneb): block root -> {index: commitment}
+        # of KZG-verified sidecars, fed by gossip validation / reqresp;
+        # import requires full coverage of the block's commitments
+        self._available_sidecars: Dict[str, Dict[int, bytes]] = {}
+        self._sidecar_slots: Dict[str, int] = {}
+        # blocks waiting on sidecar availability (gossip ordering race:
+        # a block often beats its sidecars by ~100ms) — re-imported from
+        # on_blob_sidecar once coverage completes; bounded
+        self._da_pending: Dict[str, dict] = {}
+        self._da_pending_max = 16
 
         anchor_root = BeaconBlockHeader.hash_tree_root(
             dict(
@@ -161,6 +179,17 @@ class BeaconChain:
         self, signed_block: dict, block: dict, root: bytes, timely: bool
     ) -> bytes:
 
+        # availability first: cheap, and a data-less block must not cost
+        # an EL round-trip or a state transition; a not-yet-available
+        # block parks until its sidecars arrive (re-imported from
+        # on_blob_sidecar), so gossip ordering cannot lose it
+        try:
+            self._check_data_availability(block, root)
+        except BlobsUnavailableError:
+            if len(self._da_pending) < self._da_pending_max:
+                self._da_pending[root.hex()] = signed_block
+            raise
+
         pre_state = self.regen.get_pre_state(block)
 
         # Execution-payload leg: runs alongside signatures + the state
@@ -201,7 +230,7 @@ class BeaconChain:
                         e.latest_valid_hash,
                         invalidate_from_block_root=parent_hex,
                     )
-                    self.head_root_hex = self.fork_choice.update_head()
+                    self._after_invalidation(int(block["slot"]))
                 except Exception as fc_err:  # noqa: BLE001
                     self.log.warn(
                         "payload-invalidation fork-choice update failed",
@@ -298,13 +327,15 @@ class BeaconChain:
             if self.fork_choice.has_block(froot):
                 # spec-form finalized viability: nodes must DESCEND from
                 # this root, not merely match its epoch
-                self.fork_choice.proto.finalized_root = froot
+                self.fork_choice.set_finalized_root(froot)
                 # drop pre-finalized proto nodes (reference maybePrune;
                 # no-op below the prune threshold)
                 removed = self.fork_choice.prune(froot)
                 for node in removed:
                     self._execution_block_hash.pop(node.root, None)
                     self.optimistic_roots.discard(node.root)
+                    self._available_sidecars.pop(node.root, None)
+                    self._sidecar_slots.pop(node.root, None)
             self.emitter.emit(
                 ChainEvent.finalized, dict(post.finalized_checkpoint)
             )
@@ -389,6 +420,103 @@ class BeaconChain:
         for entered in range(parent_epoch + 1, block_epoch + 1):
             if entered >= 2:
                 mon.on_epoch_close(entered - 2)
+
+    def _after_invalidation(self, slot: Optional[int] = None) -> None:
+        """Post-invalidation bookkeeping every eviction path shares:
+        known-Invalid roots leave optimistic_roots (the API must not
+        report them as merely optimistic), and a head change is a REAL
+        head change — event emitted, EL notified — not a silent
+        reassignment (review r5)."""
+        self.optimistic_roots = {
+            r
+            for r in self.optimistic_roots
+            if self.fork_choice.get_execution_status(r)
+            not in (None, ExecutionStatus.Invalid)
+        }
+        old = self.head_root_hex
+        self.head_root_hex = self.fork_choice.update_head()
+        if self.head_root_hex != old:
+            node = self.fork_choice.get_node(self.head_root_hex)
+            self.emitter.emit(
+                ChainEvent.head,
+                bytes.fromhex(self.head_root_hex),
+                node.slot if node is not None else slot,
+            )
+            if not getattr(self, "_in_head_recovery", False):
+                self._in_head_recovery = True
+                try:
+                    self._notify_forkchoice()
+                finally:
+                    self._in_head_recovery = False
+
+    # -- data availability (deneb) -----------------------------------------
+
+    def on_blob_sidecar(
+        self,
+        block_root: bytes,
+        index: int,
+        commitment: bytes,
+        slot: Optional[int] = None,
+    ) -> None:
+        """Record a VALIDATED (inclusion-proof + KZG-verified) sidecar as
+        available for its block.  Gossip validation calls this on ACCEPT;
+        the import gate in _check_data_availability consumes it."""
+        root_hex = bytes(block_root).hex()
+        self._available_sidecars.setdefault(root_hex, {})[int(index)] = bytes(
+            commitment
+        )
+        if slot is not None:
+            self._sidecar_slots[root_hex] = int(slot)
+        # a block parked on this root retries now that data arrived
+        pending = self._da_pending.get(root_hex)
+        if pending is not None:
+            try:
+                self._check_data_availability(
+                    pending["message"], bytes(block_root)
+                )
+            except BlobsUnavailableError:
+                return  # still short — keep waiting
+            except ValueError:
+                del self._da_pending[root_hex]  # mismatched data: drop
+                return
+            del self._da_pending[root_hex]
+            try:
+                self.process_block(pending)
+            except Exception as e:  # noqa: BLE001 - import errors are the
+                # block's own problem now; availability did its job
+                self.log.warn(
+                    "parked block import failed", error=str(e)
+                )
+
+    def _check_data_availability(self, block: dict, root: bytes) -> None:
+        """Every blob commitment in the block must have an available,
+        KZG-verified sidecar with the SAME commitment at that index —
+        versioned hashes only bind commitments to EL transactions, they
+        do not prove the blobs themselves exist (reference: importBlock
+        gates on blob availability; ADVICE r4 medium)."""
+        body = block.get("body", {})
+        commitments = (
+            body.get("blob_kzg_commitments")
+            if isinstance(body, dict)
+            else None
+        )
+        if not commitments:
+            return
+        have = self._available_sidecars.get(bytes(root).hex(), {})
+        for i, c in enumerate(commitments):
+            got = have.get(i)
+            if got is None:
+                raise BlobsUnavailableError(
+                    f"blob {i}/{len(commitments)} not available for "
+                    f"block {bytes(root).hex()[:12]}"
+                )
+            if got != bytes(c):
+                # an available sidecar whose commitment diverges from the
+                # block's is a hard mismatch, not a wait-for-data case
+                raise ValueError(
+                    f"blob sidecar {i} commitment mismatch for block "
+                    f"{bytes(root).hex()[:12]}"
+                )
 
     # NOTE on the broad except blocks around validate_latest_hash /
     # update_head in the invalidation paths: LVHConsensusError latches
@@ -523,18 +651,14 @@ class BeaconChain:
             try:
                 # the confirmed head's root is known: O(branch depth)
                 # propagation, not the O(n) exec-hash scan
-                self.fork_choice.proto.propagate_valid_root(
-                    self.head_root_hex
-                )
+                self.fork_choice.propagate_valid_root(self.head_root_hex)
             except Exception as e:  # noqa: BLE001
                 self.log.warn("valid-propagation failed", error=str(e))
-            pa = self.fork_choice.proto
             self.optimistic_roots = {
                 rt
                 for rt in self.optimistic_roots
-                if rt in pa.indices
-                and pa.nodes[pa.indices[rt]].execution_status
-                != ExecutionStatus.Valid
+                if self.fork_choice.get_execution_status(rt)
+                not in (None, ExecutionStatus.Valid)
             }
         elif r.status == ExecutePayloadStatus.INVALID:
             # the current head's payload chain is bad: invalidate and
@@ -546,7 +670,7 @@ class BeaconChain:
                     lvh[2:] if isinstance(lvh, str) and lvh.startswith("0x") else lvh,
                     invalidate_from_block_root=self.head_root_hex,
                 )
-                self.head_root_hex = self.fork_choice.update_head()
+                self._after_invalidation()
             except Exception as e:  # noqa: BLE001
                 self.log.warn("head invalidation failed", error=str(e))
 
@@ -771,3 +895,11 @@ class BeaconChain:
         self.aggregated_attestation_pool.prune(clock_slot)
         self.sync_committee_message_pool.prune(clock_slot)
         self.sync_contribution_pool.prune(clock_slot)
+        # availability entries outlive their usefulness one epoch after
+        # their slot (blocks import within the gossip window)
+        horizon = clock_slot - P.SLOTS_PER_EPOCH
+        for root in [
+            r for r, s in self._sidecar_slots.items() if s < horizon
+        ]:
+            self._sidecar_slots.pop(root, None)
+            self._available_sidecars.pop(root, None)

@@ -495,12 +495,32 @@ class GossipValidators:
             _reject(f"blob index {index} out of range")
         header = sidecar["signed_block_header"]["message"]
         slot = int(header["slot"])
+        proposer_index = int(header["proposer_index"])
         self._check_slot_window(slot)
         block_root = T.BeaconBlockHeader.hash_tree_root(header)
         if not hasattr(self, "seen_blob_sidecars"):
-            self.seen_blob_sidecars = {}  # (root, index) -> slot
-        if (bytes(block_root), index) in self.seen_blob_sidecars:
+            # keyed (slot, proposer_index, index) per the p2p spec's
+            # IGNORE condition — NOT by block root: an equivocating
+            # proposer minting sidecars under distinct self-signed
+            # headers for the same slot/index must not get a fresh
+            # signature+KZG pipeline run per header (CPU amplification;
+            # ADVICE r4)
+            self.seen_blob_sidecars = {}  # (slot, proposer, index) -> slot
+        if (slot, proposer_index, index) in self.seen_blob_sidecars:
             _ignore("duplicate blob sidecar")
+        # parent gates (p2p spec blob_sidecar_{subnet_id} conditions):
+        # unknown parent -> IGNORE (may arrive later); parent not older
+        # than the sidecar, or not descending from finalized -> REJECT
+        fc = getattr(self.chain, "fork_choice", None)
+        if fc is not None:
+            parent_hex = bytes(header["parent_root"]).hex()
+            parent_node = fc.get_node(parent_hex)
+            if parent_node is None:
+                _ignore("sidecar parent block unknown")
+            if parent_node.slot >= slot:
+                _reject("sidecar slot not after parent slot")
+            if not fc.descends_from_finalized(parent_hex):
+                _reject("sidecar does not descend from finalized")
         # the CLAIMED proposer must be the shuffle-expected proposer for
         # the slot — otherwise any validator could mint accepted sidecars
         # with a self-signed header (spec REJECT condition)
@@ -537,7 +557,17 @@ class GossipValidators:
             kzg_setup,
         ):
             _reject("blob KZG proof invalid")
-        self.seen_blob_sidecars[(bytes(block_root), index)] = slot
+        self.seen_blob_sidecars[(slot, proposer_index, index)] = slot
+        # ACCEPT: the sidecar is proven (inclusion + KZG) — record it as
+        # available so the block import DA gate can consume it
+        on_avail = getattr(self.chain, "on_blob_sidecar", None)
+        if on_avail is not None:
+            on_avail(
+                bytes(block_root),
+                index,
+                bytes(sidecar["kzg_commitment"]),
+                slot=slot,
+            )
         return bytes(block_root)
 
     # -- pruning -----------------------------------------------------------

@@ -114,6 +114,31 @@ class ForkChoice:
         idx = self.proto.indices.get(root)
         return self.proto.nodes[idx].execution_status if idx is not None else None
 
+    def get_node(self, root: str) -> Optional[ProtoNode]:
+        """Read-only node lookup (reference: forkChoice.getBlock)."""
+        idx = self.proto.indices.get(root)
+        return self.proto.nodes[idx] if idx is not None else None
+
+    def propagate_valid_root(self, root: str) -> None:
+        self.proto.propagate_valid_root(root)
+
+    def set_finalized_root(self, root: str) -> None:
+        """Arm the spec-form finalized viability filter (nodes must
+        descend from this root, not merely match its epoch)."""
+        self.proto.finalized_root = root
+
+    def descends_from_finalized(self, root: str) -> bool:
+        """Does `root`'s chain contain the tracked finalized root?
+        True when no finalized root is tracked yet (bootstrap)."""
+        fin = self.proto.finalized_root
+        if fin is None:
+            return True
+        node = self.get_node(root)
+        if node is None:
+            return False
+        fin_slot = self.proto.finalized_epoch * self.slots_per_epoch
+        return self.proto._ancestor_root_at_slot(node, fin_slot) == fin
+
     def on_timely_block(self, root: str, slot: Optional[int] = None) -> None:
         """Arm the proposer boost for a block arriving before 1/3 slot
         (reference: forkChoice.ts onBlock's blockDelaySec gate).

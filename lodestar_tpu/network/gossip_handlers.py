@@ -146,15 +146,21 @@ class GossipHandlers:
                 raise GossipValidationError(
                     GossipAction.IGNORE, "proposer already seen this slot"
                 )
+            from ..chain.chain import BlobsUnavailableError
+
             try:
                 self.chain.process_block(
                     signed, timely=self._block_is_timely(slot)
                 )
-            except (RegenError, ExecutionEngineUnavailable) as e:
-                # unknown parent / missing state / EL outage: not the
-                # sender's fault — IGNORE (and park for reprocess at
-                # the processor layer), never penalize (p2p spec
-                # IGNORE conditions)
+            except (
+                RegenError,
+                ExecutionEngineUnavailable,
+                BlobsUnavailableError,
+            ) as e:
+                # unknown parent / missing state / EL outage / blobs not
+                # yet available: not the sender's fault — IGNORE (and
+                # park for reprocess at the processor layer), never
+                # penalize (p2p spec IGNORE conditions)
                 raise GossipValidationError(
                     GossipAction.IGNORE, f"not verifiable now: {e}"
                 )

@@ -114,15 +114,30 @@ class RateLimiterGRCA:
         self._tat: Dict[object, float] = {}
         self._clock = clock
 
-    def allows(self, key, tokens: float = 1.0) -> bool:
+    def peek(self, key, tokens: float = 1.0):
+        """Admission decision WITHOUT committing: returns (ok, commit)
+        where commit() applies the TAT update.  Lets callers coordinate
+        several limiters — admit only if all admit, then commit all —
+        so a request denied by one bucket never burns another's quota
+        (ADVICE r4: per-peer tokens were consumed before the total
+        limiter was consulted)."""
         now_ms = self._clock() * 1000.0
         tat = self._tat.get(key, now_ms)
         # earliest time the bucket could accept `tokens` more
         new_tat = max(now_ms, tat) + tokens * self.ms_per_token
         if new_tat - now_ms > self.ms_per_bucket:
-            return False
-        self._tat[key] = new_tat
-        return True
+            return False, (lambda: None)
+
+        def commit(_key=key, _tat=new_tat):
+            self._tat[_key] = _tat
+
+        return True, commit
+
+    def allows(self, key, tokens: float = 1.0) -> bool:
+        ok, commit = self.peek(key, tokens)
+        if ok:
+            commit()
+        return ok
 
     def prune(self, older_than_ms: float = 60_000.0) -> None:
         now_ms = self._clock() * 1000.0
@@ -324,14 +339,20 @@ class ReqResp:
                     tokens = 1.0
             limiter = self._by_peer[protocol.method]
             total = self._total.get(protocol.method)
-            if not limiter.allows(peer_id, tokens) or (
-                total is not None and not total.allows("total", tokens)
-            ):
+            # peek/commit split: both limiters decide before either
+            # commits, so a denial by one never burns the other's quota
+            peer_ok, peer_commit = limiter.peek(peer_id, tokens)
+            total_ok, total_commit = (
+                total.peek("total", tokens) if total is not None else (True, lambda: None)
+            )
+            if not (peer_ok and total_ok):
                 if self._on_rate_limit is not None:
                     self._on_rate_limit(peer_id, protocol_id)
                 return encode_error_chunk(
                     RespCode.RATE_LIMITED, "rate limited"
                 )
+            peer_commit()
+            total_commit()
         try:
             chunks = self._handlers[protocol_id](peer_id, body)
             return encode_response_chunks(chunks)

@@ -1,0 +1,71 @@
+"""Deneb data-availability gate: imports require validated sidecars.
+
+Reference behavior: the reference gates importBlock on blob availability
+(beacon-node blockInput handling) — versioned hashes only bind
+commitments to EL transactions; the blobs themselves must be present and
+KZG-verified (ADVICE r4 medium).
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.chain import BeaconChain, BlobsUnavailableError
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def chain():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"da-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    return BeaconChain(cfg, create_genesis_state(cfg, pks, genesis_time=2))
+
+
+def _block_with_commitments(commitments):
+    return {"body": {"blob_kzg_commitments": commitments}}
+
+
+def test_import_blocked_until_all_sidecars_available(chain):
+    root = b"\x11" * 32
+    c0, c1 = b"\xaa" * 48, b"\xbb" * 48
+    block = _block_with_commitments([c0, c1])
+    with pytest.raises(BlobsUnavailableError):
+        chain._check_data_availability(block, root)
+    chain.on_blob_sidecar(root, 0, c0, slot=5)
+    with pytest.raises(BlobsUnavailableError, match="blob 1"):
+        chain._check_data_availability(block, root)
+    chain.on_blob_sidecar(root, 1, c1, slot=5)
+    chain._check_data_availability(block, root)  # now passes
+
+
+def test_commitment_mismatch_is_hard_failure(chain):
+    root = b"\x22" * 32
+    block = _block_with_commitments([b"\xaa" * 48])
+    chain.on_blob_sidecar(root, 0, b"\xcc" * 48, slot=5)
+    with pytest.raises(ValueError, match="mismatch"):
+        chain._check_data_availability(block, root)
+
+
+def test_commitment_free_blocks_unaffected(chain):
+    chain._check_data_availability({"body": {}}, b"\x33" * 32)
+    chain._check_data_availability(
+        _block_with_commitments([]), b"\x33" * 32
+    )
+
+
+def test_availability_pruned_by_clock(chain):
+    root = b"\x44" * 32
+    chain.on_blob_sidecar(root, 0, b"\xaa" * 48, slot=3)
+    chain.prune_pools(3 + params.SLOTS_PER_EPOCH + 1)
+    with pytest.raises(BlobsUnavailableError):
+        chain._check_data_availability(
+            _block_with_commitments([b"\xaa" * 48]), root
+        )

@@ -479,10 +479,11 @@ def test_blob_sidecar_validation(world):
     # the claimed proposer must be the shuffle-expected one for the slot
     duties = w["chain_a"].get_proposer_duties(0)
     proposer = int(duties[1]["validator_index"])
+    anchor = bytes.fromhex(w["chain_a"].anchor_root_hex)
     block = {
         "slot": 1,
         "proposer_index": proposer,
-        "parent_root": b"\x01" * 32,
+        "parent_root": anchor,
         "state_root": b"\x02" * 32,
         "body": body,
     }
@@ -491,7 +492,7 @@ def test_blob_sidecar_validation(world):
     header = {
         "slot": 1,
         "proposer_index": proposer,
-        "parent_root": b"\x01" * 32,
+        "parent_root": anchor,
         "state_root": b"\x02" * 32,
         "body_root": T.BeaconBlockBodyDeneb.hash_tree_root(body),
     }
@@ -656,16 +657,17 @@ def test_blob_sidecar_gossip_flow(world):
     body["blob_kzg_commitments"] = [commitment]
     duties = w["chain_a"].get_proposer_duties(0)
     proposer = int(duties[1]["validator_index"])
+    anchor = bytes.fromhex(w["chain_a"].anchor_root_hex)
     block = {
         "slot": 1, "proposer_index": proposer,
-        "parent_root": b"\x01" * 32, "state_root": b"\x02" * 32,
+        "parent_root": anchor, "state_root": b"\x02" * 32,
         "body": body,
     }
     header_root = w["cfg"].compute_signing_root(
         T.BeaconBlockHeader.hash_tree_root(
             {
                 "slot": 1, "proposer_index": proposer,
-                "parent_root": b"\x01" * 32, "state_root": b"\x02" * 32,
+                "parent_root": anchor, "state_root": b"\x02" * 32,
                 "body_root": T.BeaconBlockBodyDeneb.hash_tree_root(body),
             }
         ),
