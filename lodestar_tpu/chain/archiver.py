@@ -73,6 +73,12 @@ class Archiver:
                 continue
             db.archive_block(slot, signed, root=rbytes)
             db.block.delete(rbytes)
+            # blob sidecars ride along hot->cold (reference:
+            # archiveBlocks.ts migrates blobsSidecar the same way)
+            if hasattr(db, "blobs_sidecar"):
+                sidecars = db.blobs_sidecar.get(rbytes)
+                if sidecars is not None:
+                    db.archive_blob_sidecars(slot, sidecars, root=rbytes)
             self.archived_blocks += 1
 
         # prune non-canonical forks at/below the finalized slot

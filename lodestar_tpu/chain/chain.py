@@ -101,6 +101,7 @@ class BeaconChain:
         # of KZG-verified sidecars, fed by gossip validation / reqresp;
         # import requires full coverage of the block's commitments
         self._available_sidecars: Dict[str, Dict[int, bytes]] = {}
+        self._sidecar_bodies: Dict[str, Dict[int, dict]] = {}
         self._sidecar_slots: Dict[str, int] = {}
         # blocks waiting on sidecar availability (gossip ordering race:
         # a block often beats its sidecars by ~100ms) — re-imported from
@@ -301,6 +302,13 @@ class BeaconChain:
         self.regen.on_imported_block(root, post)
         if self.db is not None:
             self.db.put_block(root, signed_block)
+            bodies = self._sidecar_bodies.get(root.hex())
+            if bodies and hasattr(self.db, "put_blob_sidecars"):
+                # imported deneb blocks persist their (validated) data so
+                # peers can fetch it over blob_sidecars_by_range/root
+                self.db.put_blob_sidecars(
+                    root, [bodies[i] for i in sorted(bodies)]
+                )
         self.imported_blocks += 1
         self.emitter.emit(ChainEvent.block, signed_block, root)
 
@@ -335,6 +343,7 @@ class BeaconChain:
                     self._execution_block_hash.pop(node.root, None)
                     self.optimistic_roots.discard(node.root)
                     self._available_sidecars.pop(node.root, None)
+                    self._sidecar_bodies.pop(node.root, None)
                     self._sidecar_slots.pop(node.root, None)
             self.emitter.emit(
                 ChainEvent.finalized, dict(post.finalized_checkpoint)
@@ -457,14 +466,19 @@ class BeaconChain:
         index: int,
         commitment: bytes,
         slot: Optional[int] = None,
+        sidecar: Optional[dict] = None,
     ) -> None:
         """Record a VALIDATED (inclusion-proof + KZG-verified) sidecar as
         available for its block.  Gossip validation calls this on ACCEPT;
-        the import gate in _check_data_availability consumes it."""
+        the import gate in _check_data_availability consumes it.  When
+        the full `sidecar` body rides along it is kept so the import can
+        persist it to the db (served over blob_sidecars_by_range/root)."""
         root_hex = bytes(block_root).hex()
         self._available_sidecars.setdefault(root_hex, {})[int(index)] = bytes(
             commitment
         )
+        if sidecar is not None:
+            self._sidecar_bodies.setdefault(root_hex, {})[int(index)] = sidecar
         if slot is not None:
             self._sidecar_slots[root_hex] = int(slot)
         # a block parked on this root retries now that data arrived
@@ -487,6 +501,15 @@ class BeaconChain:
                 self.log.warn(
                     "parked block import failed", error=str(e)
                 )
+
+    def get_blob_sidecars(self, block_root: bytes) -> Optional[list]:
+        """Validated sidecar bodies held for a block (gossip-window
+        blocks not yet archived) — the public read path for reqresp
+        serving; db-backed lookups happen at the db layer."""
+        bodies = self._sidecar_bodies.get(bytes(block_root).hex())
+        if not bodies:
+            return None
+        return [bodies[i] for i in sorted(bodies)]
 
     def _check_data_availability(self, block: dict, root: bytes) -> None:
         """Every blob commitment in the block must have an available,
@@ -903,3 +926,4 @@ class BeaconChain:
         ]:
             self._sidecar_slots.pop(root, None)
             self._available_sidecars.pop(root, None)
+            self._sidecar_bodies.pop(root, None)

@@ -567,6 +567,7 @@ class GossipValidators:
                 index,
                 bytes(sidecar["kzg_commitment"]),
                 slot=slot,
+                sidecar=sidecar,
             )
         return bytes(block_root)
 

@@ -41,6 +41,102 @@ class ForkAwareSignedBlockCodec:
         return self.config.get_fork_types(slot)[1].deserialize(data)
 
 
+class BlobSidecarListCodec:
+    """Binary codec for a block's sidecar list.
+
+    Blobs are length-prefixed instead of using the preset-width SSZ
+    ByteVector so dev-width test blobs (and future preset changes)
+    store without re-encoding; everything else is fixed-width
+    (reference: db/repositories/blobsSidecar.ts stores the SSZ
+    BlobSidecars — same content, self-describing width here)."""
+
+    _HEADER_LEN = 112  # slot u64 + proposer u64 + 3 roots
+    _PROOF_DEPTH = 17
+
+    def serialize(self, sidecars) -> bytes:
+        out = [len(sidecars).to_bytes(4, "little")]
+        for sc in sidecars:
+            h = sc["signed_block_header"]["message"]
+            blob = bytes(sc["blob"])
+            out.append(int(sc["index"]).to_bytes(8, "little"))
+            out.append(len(blob).to_bytes(4, "little"))
+            out.append(blob)
+            out.append(bytes(sc["kzg_commitment"]))
+            out.append(bytes(sc["kzg_proof"]))
+            out.append(int(h["slot"]).to_bytes(8, "little"))
+            out.append(int(h["proposer_index"]).to_bytes(8, "little"))
+            out.append(bytes(h["parent_root"]))
+            out.append(bytes(h["state_root"]))
+            out.append(bytes(h["body_root"]))
+            out.append(bytes(sc["signed_block_header"]["signature"]))
+            proof = list(sc["kzg_commitment_inclusion_proof"])
+            assert len(proof) == self._PROOF_DEPTH
+            out.extend(bytes(p) for p in proof)
+        return b"".join(out)
+
+    # each sidecar is at least this many bytes after the blob
+    # (index 8 + blen 4 + commitment 48 + proof 48 + header 112 +
+    # sig 96 + branch 17*32)
+    _FIXED_PART = 8 + 4 + 48 + 48 + 112 + 96 + 17 * 32
+    _MAX_BLOB_LEN = 32 * 4096  # largest preset width
+
+    def deserialize(self, data: bytes):
+        """Strict bounds checks throughout: this codec decodes UNTRUSTED
+        peer responses (blob_sidecars_by_range/root), so a hostile
+        count/length must be a decode error, not a 4-billion-iteration
+        loop or silently misaligned fields."""
+        if len(data) < 4:
+            raise ValueError("blob sidecar list: truncated header")
+        n = int.from_bytes(data[0:4], "little")
+        if n * self._FIXED_PART > len(data):
+            raise ValueError(f"blob sidecar list: count {n} exceeds data")
+        pos = 4
+        sidecars = []
+        for _ in range(n):
+            if pos + 12 > len(data):
+                raise ValueError("blob sidecar list: truncated entry")
+            index = int.from_bytes(data[pos : pos + 8], "little"); pos += 8
+            blen = int.from_bytes(data[pos : pos + 4], "little"); pos += 4
+            if blen > self._MAX_BLOB_LEN or pos + blen + (
+                self._FIXED_PART - 12
+            ) > len(data):
+                raise ValueError("blob sidecar list: bad blob length")
+            blob = data[pos : pos + blen]; pos += blen
+            commitment = data[pos : pos + 48]; pos += 48
+            proof = data[pos : pos + 48]; pos += 48
+            slot = int.from_bytes(data[pos : pos + 8], "little"); pos += 8
+            proposer = int.from_bytes(data[pos : pos + 8], "little"); pos += 8
+            parent = data[pos : pos + 32]; pos += 32
+            state = data[pos : pos + 32]; pos += 32
+            body = data[pos : pos + 32]; pos += 32
+            sig = data[pos : pos + 96]; pos += 96
+            branch = [
+                data[pos + i * 32 : pos + (i + 1) * 32]
+                for i in range(self._PROOF_DEPTH)
+            ]
+            pos += self._PROOF_DEPTH * 32
+            sidecars.append(
+                {
+                    "index": index,
+                    "blob": blob,
+                    "kzg_commitment": commitment,
+                    "kzg_proof": proof,
+                    "signed_block_header": {
+                        "message": {
+                            "slot": slot,
+                            "proposer_index": proposer,
+                            "parent_root": parent,
+                            "state_root": state,
+                            "body_root": body,
+                        },
+                        "signature": sig,
+                    },
+                    "kzg_commitment_inclusion_proof": branch,
+                }
+            )
+        return sidecars
+
+
 class BeaconDb:
     def __init__(self, path=None, config=None):
         self.controller = KvController(path)
@@ -72,6 +168,29 @@ class BeaconDb:
             db, Bucket.voluntary_exit, T.SignedVoluntaryExit
         )
         self.backfilled_ranges = Repository(db, Bucket.backfilled_ranges)
+        self.bls_to_execution_change = Repository(
+            db, Bucket.bls_to_execution_change, T.SignedBLSToExecutionChange
+        )
+        # deneb blob sidecars: hot by block root; archive slot-keyed
+        # (reference: db/repositories/blobsSidecar.ts + archive)
+        blob_codec = BlobSidecarListCodec()
+        self.blobs_sidecar = Repository(
+            db, Bucket.blobs_sidecar, blob_codec
+        )
+        self.blobs_sidecar_archive = Repository(
+            db, Bucket.blobs_sidecar_archive, blob_codec
+        )
+        # eth1 follow state (reference: depositEvent.ts,
+        # depositDataRoot.ts, eth1Data.ts) — deposit events keyed by
+        # deposit index, roots likewise, eth1 data by block timestamp
+        self.deposit_event = Repository(db, Bucket.deposit_event)
+        self.deposit_data_root = Repository(db, Bucket.deposit_data_root)
+        self.eth1_data = Repository(db, Bucket.eth1_data)
+        # light-client best update per sync-committee period
+        # (reference: db/repositories/lightclientBestUpdate.ts)
+        self.light_client_best_update = Repository(
+            db, Bucket.light_client_update
+        )
 
     def put_block(self, root: bytes, signed_block: dict) -> None:
         self.block.put(root, signed_block)
@@ -96,6 +215,29 @@ class BeaconDb:
 
     def archive_state(self, slot: int, state_bytes: bytes) -> None:
         self.state_archive.put(_slot_key(slot), state_bytes)
+
+    # -- blob sidecars (deneb) ---------------------------------------------
+
+    def put_blob_sidecars(self, root: bytes, sidecars: list) -> None:
+        self.blobs_sidecar.put(bytes(root), sidecars)
+
+    def get_blob_sidecars(self, root: bytes):
+        """Hot repo first, then the slot-keyed archive via the block
+        root index (same pattern as get_block_anywhere)."""
+        sidecars = self.blobs_sidecar.get(bytes(root))
+        if sidecars is not None:
+            return sidecars
+        slot_key = self.block_archive_root_index.get(bytes(root))
+        if slot_key is None:
+            return None
+        return self.blobs_sidecar_archive.get(slot_key)
+
+    def archive_blob_sidecars(
+        self, slot: int, sidecars: list, root: bytes = None
+    ) -> None:
+        self.blobs_sidecar_archive.put(_slot_key(slot), sidecars)
+        if root is not None:
+            self.blobs_sidecar.delete(bytes(root))
 
     def close(self) -> None:
         self.controller.close()

@@ -33,6 +33,9 @@ class Bucket(enum.IntEnum):
     light_client_update = 10
     backfilled_ranges = 11
     block_archive_root_index = 12
+    blobs_sidecar = 13
+    blobs_sidecar_archive = 14
+    deposit_data_root = 15
 
 
 class Repository(Generic[T]):

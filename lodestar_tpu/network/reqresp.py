@@ -29,6 +29,10 @@ from . import snappy as SN
 
 MAX_REQUEST_BLOCKS = 1024
 MAX_REQUEST_LIGHT_CLIENT_UPDATES = 128
+# p2p spec deneb: by-range requests span at most 128 slots, and the
+# sidecar cap is MAX_REQUEST_BLOCKS_DENEB * MAX_BLOBS_PER_BLOCK(6)
+MAX_REQUEST_BLOCKS_DENEB = 128
+MAX_REQUEST_BLOB_SIDECARS = 768
 
 
 class ReqRespMethod(str, enum.Enum):
@@ -40,6 +44,8 @@ class ReqRespMethod(str, enum.Enum):
     metadata = "metadata"
     beacon_blocks_by_range = "beacon_blocks_by_range"
     beacon_blocks_by_root = "beacon_blocks_by_root"
+    blob_sidecars_by_range = "blob_sidecars_by_range"
+    blob_sidecars_by_root = "blob_sidecars_by_root"
     light_client_bootstrap = "light_client_bootstrap"
     light_client_updates_by_range = "light_client_updates_by_range"
     light_client_finality_update = "light_client_finality_update"
@@ -172,6 +178,16 @@ def default_rate_limits() -> Dict[ReqRespMethod, InboundRateLimitQuota]:
             get_request_count=lambda req: max(1, int(req.get("count", 1))),
         ),
         M.beacon_blocks_by_root: InboundRateLimitQuota(
+            RateLimiterQuota(128, 10_000),
+            total=RateLimiterQuota(4 * 128, 10_000),
+            get_request_count=lambda req: max(1, len(req)),
+        ),
+        M.blob_sidecars_by_range: InboundRateLimitQuota(
+            RateLimiterQuota(MAX_REQUEST_BLOB_SIDECARS, 10_000),
+            total=RateLimiterQuota(4 * MAX_REQUEST_BLOB_SIDECARS, 10_000),
+            get_request_count=lambda req: max(1, int(req.get("count", 1))),
+        ),
+        M.blob_sidecars_by_root: InboundRateLimitQuota(
             RateLimiterQuota(128, 10_000),
             total=RateLimiterQuota(4 * 128, 10_000),
             get_request_count=lambda req: max(1, len(req)),

@@ -16,6 +16,7 @@ from .. import types as T
 from ..ssz import Bytes32, Container, List as SszList, uint64
 from .reqresp import (
     ContextBytes,
+    MAX_REQUEST_BLOB_SIDECARS,
     MAX_REQUEST_BLOCKS,
     MAX_REQUEST_LIGHT_CLIENT_UPDATES,
     Protocol,
@@ -58,6 +59,27 @@ LightClientUpdatesByRangeRequest = Container(
         ("count", uint64),
     ),
     name="LightClientUpdatesByRangeRequest",
+)
+
+# deneb blob transfer (p2p spec: blob_sidecars_by_range/v1, by_root/v1)
+BlobSidecarsByRangeRequest = Container(
+    (
+        ("start_slot", T.Slot),
+        ("count", uint64),
+    ),
+    name="BlobSidecarsByRangeRequest",
+)
+
+BlobIdentifierType = Container(
+    (
+        ("block_root", T.Root),
+        ("index", uint64),
+    ),
+    name="BlobIdentifier",
+)
+
+BlobIdentifiersRequest = SszList(
+    BlobIdentifierType, MAX_REQUEST_BLOB_SIDECARS
 )
 
 # altair light-client wire containers (reference: types/src/altair/
@@ -260,6 +282,47 @@ def blocks_by_root_protocol(config, version: int = 2) -> Protocol:
     )
 
 
+def _blob_sidecar_codec():
+    """Per-sidecar wire codec: spec-shaped content with a
+    length-prefixed blob (self-describing width), shared with the db
+    layer.  The SSZ BlobSidecar container is preset-width; the p2p wire
+    itself is off-scope (SURVEY P9), so the in-memory protocol carries
+    the width-agnostic framing the rest of the framework uses."""
+    from ..db.beacon_db import BlobSidecarListCodec
+
+    codec = BlobSidecarListCodec()
+    return (
+        lambda sc: codec.serialize([sc]),
+        lambda data: codec.deserialize(data)[0],
+    )
+
+
+def blob_sidecars_by_range_protocol(config) -> Protocol:
+    enc, dec = _blob_sidecar_codec()
+    return Protocol(
+        method=ReqRespMethod.blob_sidecars_by_range,
+        version=1,
+        context_bytes=ContextBytes.fork_digest,
+        encode_request=_enc(BlobSidecarsByRangeRequest),
+        decode_request=_dec(BlobSidecarsByRangeRequest),
+        encode_response=enc,
+        decode_response=lambda data, ctx=None: dec(data),
+    )
+
+
+def blob_sidecars_by_root_protocol(config) -> Protocol:
+    enc, dec = _blob_sidecar_codec()
+    return Protocol(
+        method=ReqRespMethod.blob_sidecars_by_root,
+        version=1,
+        context_bytes=ContextBytes.fork_digest,
+        encode_request=_enc(BlobIdentifiersRequest),
+        decode_request=_dec(BlobIdentifiersRequest),
+        encode_response=enc,
+        decode_response=lambda data, ctx=None: dec(data),
+    )
+
+
 def _decode_signed_block(config, data: bytes, ctx: Optional[bytes]):
     """Pick the signed-block container from the chunk's fork digest
     (v2 context bytes).  An unknown digest is a protocol violation —
@@ -329,6 +392,18 @@ class ReqRespBeaconNode:
         r.register_protocol(p["blocks_by_range"], self._handle_blocks_by_range)
         p["blocks_by_root"] = blocks_by_root_protocol(self.config)
         r.register_protocol(p["blocks_by_root"], self._handle_blocks_by_root)
+        p["blob_sidecars_by_range"] = blob_sidecars_by_range_protocol(
+            self.config
+        )
+        r.register_protocol(
+            p["blob_sidecars_by_range"], self._handle_blob_sidecars_by_range
+        )
+        p["blob_sidecars_by_root"] = blob_sidecars_by_root_protocol(
+            self.config
+        )
+        r.register_protocol(
+            p["blob_sidecars_by_root"], self._handle_blob_sidecars_by_root
+        )
         if self.lc is not None:
             self._register_light_client(r, p)
 
@@ -454,6 +529,84 @@ class ReqRespBeaconNode:
             slot = int(signed["message"]["slot"])
             signed_type = self.config.get_fork_types(slot)[1]
             out.append((signed_type.serialize(signed), self._ctx(slot)))
+        return out
+
+    def _sidecars_for_root(self, root: bytes):
+        """Validated sidecars for a block: db first (imported blocks),
+        then the chain's in-memory availability bodies (gossip-window
+        blocks not yet imported)."""
+        if self.db is not None:
+            getter = getattr(self.db, "get_blob_sidecars", None)
+            if getter is not None:
+                sidecars = getter(bytes(root))
+                if sidecars is not None:
+                    return sidecars
+        if self.chain is not None:
+            getter = getattr(self.chain, "get_blob_sidecars", None)
+            if getter is not None:
+                return getter(bytes(root))
+        return None
+
+    def _handle_blob_sidecars_by_range(self, peer_id: str, req: dict):
+        """Slot-ordered sidecars of canonical blocks (p2p spec
+        blob_sidecars_by_range/v1; reference:
+        handlers/blobsSidecarsByRange.ts)."""
+        from .reqresp import (
+            MAX_REQUEST_BLOB_SIDECARS,
+            MAX_REQUEST_BLOCKS_DENEB,
+        )
+
+        start = int(req["start_slot"])
+        # deneb by-range requests are capped at 128 SLOTS (not the
+        # 1024-block cap of blocks_by_range) — the scan itself is the
+        # cost being bounded, not just the response size
+        count = min(int(req["count"]), MAX_REQUEST_BLOCKS_DENEB)
+        if count < 1 or start < 0:
+            raise ReqRespError(RespCode.INVALID_REQUEST, "bad range")
+        enc, _dec = _blob_sidecar_codec()
+        out = []
+        for slot in range(start, start + count):
+            # archived slots serve straight off the slot key — no block
+            # fetch or root recomputation
+            sidecars = None
+            if self.db is not None and hasattr(
+                self.db, "blobs_sidecar_archive"
+            ):
+                sidecars = self.db.blobs_sidecar_archive.get(
+                    slot.to_bytes(8, "big")
+                )
+            if sidecars is None:
+                signed = self._canonical_block_at_slot(slot)
+                if signed is None:
+                    continue
+                slot_ = int(signed["message"]["slot"])
+                root = self.config.get_fork_types(slot_)[0].hash_tree_root(
+                    signed["message"]
+                )
+                sidecars = self._sidecars_for_root(root) or []
+            for sc in sidecars:
+                if len(out) >= MAX_REQUEST_BLOB_SIDECARS:
+                    return out
+                sc_slot = int(sc["signed_block_header"]["message"]["slot"])
+                out.append((enc(sc), self._ctx(sc_slot)))
+        return out
+
+    def _handle_blob_sidecars_by_root(self, peer_id: str, identifiers):
+        from .reqresp import MAX_REQUEST_BLOB_SIDECARS
+
+        enc, _dec = _blob_sidecar_codec()
+        out = []
+        for ident in identifiers[:MAX_REQUEST_BLOB_SIDECARS]:
+            root = bytes(ident["block_root"])
+            want = int(ident["index"])
+            sidecars = self._sidecars_for_root(root) or []
+            for sc in sidecars:
+                if int(sc["index"]) == want:
+                    slot = int(
+                        sc["signed_block_header"]["message"]["slot"]
+                    )
+                    out.append((enc(sc), self._ctx(slot)))
+                    break
         return out
 
     def _handle_lc_bootstrap(self, peer_id: str, root: bytes):

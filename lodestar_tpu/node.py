@@ -339,7 +339,7 @@ class FullBeaconNode:
         )
 
         # sync drivers (sources injected per peer/transport)
-        self.range_sync = RangeSync(self.chain)
+        self.range_sync = RangeSync(self.chain, kzg_setup=opts.kzg_setup)
         self.unknown_block_sync = UnknownBlockSync(self.chain)
         self.backfill = BackfillSync(config, self.db, verifier)
 
@@ -488,6 +488,7 @@ class FullBeaconNode:
                     keymanager_token=opts.keymanager_token,
                     proposer_cache=self.proposer_cache,
                     validator_store=opts.validator_store,
+                    kzg_setup=opts.kzg_setup,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)

@@ -10,8 +10,12 @@ P9); block sources are injected callables with the reqresp shapes
 
 from .backfill import ApiBlockSource, BackfillError, BackfillSync  # noqa: F401
 from .range_sync import (  # noqa: F401
+    Batch,
+    BatchState,
     BlockSource,
     RangeSync,
+    SyncChain,
+    SyncChainError,
     SyncState,
     UnknownBlockSync,
 )

@@ -1,21 +1,36 @@
-"""RangeSync + UnknownBlockSync over injected block sources.
+"""RangeSync: batch state machine, multi-peer download, import overlap.
 
-Reference: packages/beacon-node/src/sync/range/range.ts (SyncChain:
-EPOCHS_PER_BATCH-sized by-range requests, sequential import, peer
-scoring on bad batches) and sync/unknownBlock.ts (UnknownBlockSync:
-fetch unknown parents by root, walk back to a known ancestor, import
-forward).  Import goes through BeaconChain.process_block — the full
-state transition, so a bad batch surfaces as a BlockProcessError the
-same way the reference's processChainSegment rejects.
+Reference: packages/beacon-node/src/sync/range/chain.ts (SyncChain:
+EPOCHS_PER_BATCH-sized batches, batch buffer ahead of processing,
+per-batch download/processing attempt tracking, peer rotation) and
+sync/range/batch.ts (the batch state machine:
+AwaitingDownload -> Downloading -> AwaitingProcessing -> Processing ->
+AwaitingValidation, with maxDownloadAttempts/maxProcessingAttempts and
+a record of which peers served failed attempts), plus
+sync/unknownBlock.ts (UnknownBlockSync: fetch unknown parents by root,
+walk back to a known ancestor, import forward).
+
+Downloads run on worker threads while the caller thread imports
+completed batches strictly in order — the reference's
+download/processing overlap (chain.ts requestBatches vs processBatch)
+without its event-loop framing.  Import goes through
+BeaconChain.process_block — the full state transition, so a bad batch
+surfaces as a BlockProcessError the same way the reference's
+processChainSegment rejects.
+
+Deneb: batches whose blocks carry blob commitments download the
+matching sidecars (blob_sidecars_by_range), verify inclusion + KZG
+proofs, and register availability with the chain before import — the
+import-side DA gate is satisfied by the sync path itself.
 """
 
 from __future__ import annotations
 
 import enum
-from typing import Callable, List, Optional, Protocol, Sequence
+import threading
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
 
 from .. import params
-from ..types import BeaconBlockAltair
 from ..utils.logger import get_logger
 
 P = params.ACTIVE_PRESET
@@ -23,6 +38,12 @@ P = params.ACTIVE_PRESET
 # reference: EPOCHS_PER_BATCH = 1 (range/batch.ts) → one epoch per request
 SLOTS_PER_BATCH = P.SLOTS_PER_EPOCH
 MAX_PARENT_DEPTH = 32  # unknownBlock.ts walk-back bound
+# reference: range/chain.ts BATCH_BUFFER_SIZE = 5 (downloads ahead of
+# the processing cursor) and batch.ts MAX_BATCH_DOWNLOAD_ATTEMPTS = 5,
+# MAX_BATCH_PROCESSING_ATTEMPTS = 3
+BATCH_BUFFER_SIZE = 5
+MAX_DOWNLOAD_ATTEMPTS = 5
+MAX_PROCESSING_ATTEMPTS = 3
 
 
 class BlockSource(Protocol):
@@ -32,6 +53,9 @@ class BlockSource(Protocol):
 
     def get_blocks_by_root(self, roots: Sequence[bytes]) -> List[dict]: ...
 
+    # optional (deneb): sidecars for the same range
+    # def get_blob_sidecars_by_range(self, start_slot, count) -> List[dict]
+
 
 class SyncState(str, enum.Enum):
     stalled = "Stalled"
@@ -39,48 +63,333 @@ class SyncState(str, enum.Enum):
     synced = "Synced"
 
 
-class RangeSync:
-    """Pull batches from a source until the chain reaches target_slot."""
+class BatchState(str, enum.Enum):
+    """reference: batch.ts BatchStatus."""
 
-    def __init__(self, chain, batch_size: int = SLOTS_PER_BATCH):
+    awaiting_download = "AwaitingDownload"
+    downloading = "Downloading"
+    awaiting_processing = "AwaitingProcessing"
+    processing = "Processing"
+    processed = "Processed"
+    failed = "Failed"
+
+
+class Batch:
+    """One EPOCHS_PER_BATCH window of slots with attempt bookkeeping
+    (reference: batch.ts Batch)."""
+
+    def __init__(self, start_slot: int, count: int):
+        self.start_slot = start_slot
+        self.count = count
+        self.state = BatchState.awaiting_download
+        self.blocks: List[dict] = []
+        self.sidecars: List[dict] = []
+        self.download_attempts = 0
+        self.processing_attempts = 0
+        # peers that served attempts, in order — a retry prefers a peer
+        # NOT on this list (batch.ts getFailedPeers)
+        self.peers_tried: List[str] = []
+        self.error: Optional[str] = None
+
+    def failed_peers(self) -> set:
+        return set(self.peers_tried)
+
+
+class SyncChainError(Exception):
+    pass
+
+
+class SyncChain:
+    """Multi-peer batched sync toward a target slot.
+
+    Peers register with their block sources; a downloader pool keeps up
+    to `buffer_size` batches in flight ahead of the import cursor while
+    the caller's thread imports strictly in order.  A failed download or
+    import retries on a different peer; a batch exhausting its attempts
+    fails the chain (reference: chain.ts SyncChain semantics).
+    """
+
+    def __init__(
+        self,
+        chain,
+        start_slot: int,
+        target_slot: int,
+        batch_size: int = SLOTS_PER_BATCH,
+        buffer_size: int = BATCH_BUFFER_SIZE,
+        max_download_attempts: int = MAX_DOWNLOAD_ATTEMPTS,
+        max_processing_attempts: int = MAX_PROCESSING_ATTEMPTS,
+        kzg_setup=None,
+        on_peer_fault: Optional[Callable[[str, str], None]] = None,
+    ):
         self.chain = chain
         self.batch_size = batch_size
+        self.buffer_size = buffer_size
+        self.max_download_attempts = max_download_attempts
+        self.max_processing_attempts = max_processing_attempts
+        self.kzg_setup = kzg_setup
+        self.on_peer_fault = on_peer_fault
+        self.log = get_logger("sync/chain")
+        self.peers: Dict[str, BlockSource] = {}
+        self._peer_rr = 0  # round-robin cursor
+        self.batches: List[Batch] = []
+        slot = start_slot
+        while slot <= target_slot:
+            count = min(batch_size, target_slot - slot + 1)
+            self.batches.append(Batch(slot, count))
+            slot += count
+        self.imported = 0
+        self._lock = threading.Lock()
+
+    # -- peers -------------------------------------------------------------
+
+    def add_peer(self, peer_id: str, source: BlockSource) -> None:
+        self.peers[peer_id] = source
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+
+    def _pick_peer(self, batch: Batch) -> Optional[str]:
+        """Round-robin over registered peers, preferring one that has
+        not failed this batch (reference: chain.ts prefers idle peers
+        not in batch.getFailedPeers)."""
+        with self._lock:
+            ids = list(self.peers)
+            if not ids:
+                return None
+            failed = batch.failed_peers()
+            fresh = [p for p in ids if p not in failed]
+            pool = fresh or ids
+            self._peer_rr += 1
+            return pool[self._peer_rr % len(pool)]
+
+    # -- download ----------------------------------------------------------
+
+    def _download(self, batch: Batch) -> None:
+        """One download attempt; runs on a worker thread."""
+        peer = self._pick_peer(batch)
+        if peer is None:
+            batch.state = BatchState.awaiting_download
+            return
+        source = self.peers.get(peer)
+        if source is None:
+            batch.state = BatchState.awaiting_download
+            return
+        batch.download_attempts += 1
+        batch.peers_tried.append(peer)
+        try:
+            blocks = source.get_blocks_by_range(
+                batch.start_slot, batch.count
+            )
+            sidecars: List[dict] = []
+            if any(
+                b["message"].get("body", {}).get("blob_kzg_commitments")
+                for b in blocks
+            ):
+                fetch = getattr(source, "get_blob_sidecars_by_range", None)
+                if fetch is None:
+                    raise SyncChainError(
+                        f"peer {peer} serves deneb blocks but no blobs"
+                    )
+                sidecars = fetch(batch.start_slot, batch.count)
+            batch.blocks = blocks
+            batch.sidecars = sidecars
+            batch.state = BatchState.awaiting_processing
+        except Exception as e:  # noqa: BLE001 — any download fault rotates
+            self.log.warn(
+                "batch download failed",
+                start=batch.start_slot,
+                peer=peer,
+                error=str(e),
+            )
+            if self.on_peer_fault is not None:
+                self.on_peer_fault(peer, f"download failed: {e}")
+            if batch.download_attempts >= self.max_download_attempts:
+                batch.state = BatchState.failed
+                batch.error = f"download attempts exhausted: {e}"
+            else:
+                batch.state = BatchState.awaiting_download
+
+    def _schedule_downloads(self, cursor: int, threads: List) -> None:
+        """Keep up to buffer_size batches past the cursor in flight."""
+        window = self.batches[cursor : cursor + self.buffer_size]
+        capacity = max(1, len(self.peers))
+        active = sum(
+            1 for b in window if b.state == BatchState.downloading
+        )
+        for batch in window:
+            if active >= capacity:
+                break
+            if batch.state == BatchState.awaiting_download:
+                batch.state = BatchState.downloading
+                t = threading.Thread(
+                    target=self._download, args=(batch,), daemon=True
+                )
+                t.start()
+                threads.append(t)
+                active += 1
+
+    # -- blob verification (deneb sync path) -------------------------------
+
+    def _register_batch_sidecars(self, batch: Batch) -> None:
+        """Verify each downloaded sidecar (inclusion proof + KZG proof)
+        and register availability so the import DA gate passes.  Header
+        signatures are NOT re-checked here — the blocks themselves are
+        fully verified at import, and the inclusion proof binds each
+        sidecar to its block body (reference: sync imports check blob
+        data against the block's own commitments)."""
+        if not batch.sidecars:
+            return
+        from ..chain import blobs as BL
+        from ..crypto import kzg as K
+
+        for sc in batch.sidecars:
+            header = sc["signed_block_header"]["message"]
+            slot = int(header["slot"])
+            body_type = self.chain.config.get_fork_types(slot)[2]
+            if not BL.verify_blob_inclusion(sc, body_type):
+                raise SyncChainError("sidecar inclusion proof invalid")
+            if self.kzg_setup is not None and not K.verify_blob_kzg_proof(
+                bytes(sc["blob"]),
+                bytes(sc["kzg_commitment"]),
+                bytes(sc["kzg_proof"]),
+                self.kzg_setup,
+            ):
+                raise SyncChainError("sidecar KZG proof invalid")
+            from ..types import BeaconBlockHeader
+
+            block_root = BeaconBlockHeader.hash_tree_root(header)
+            self.chain.on_blob_sidecar(
+                block_root,
+                int(sc["index"]),
+                bytes(sc["kzg_commitment"]),
+                slot=slot,
+                sidecar=sc,
+            )
+
+    # -- the drive loop ----------------------------------------------------
+
+    def run(self) -> int:
+        """Download ahead + import in order until every batch lands or
+        one fails permanently.  Returns blocks imported."""
+        imported_before = self.imported
+        threads: List = []
+        cursor = 0  # next batch to import
+        while cursor < len(self.batches):
+            self._schedule_downloads(cursor, threads)
+            batch = self.batches[cursor]
+            if batch.state == BatchState.failed:
+                raise SyncChainError(
+                    f"batch @{batch.start_slot} failed: {batch.error}"
+                )
+            if batch.state != BatchState.awaiting_processing:
+                # wait for the head batch's download to land; prune dead
+                # threads so the list stays O(in-flight), not O(attempts)
+                threads[:] = [t for t in threads if t.is_alive()]
+                if not threads and batch.state in (
+                    BatchState.awaiting_download,
+                    BatchState.downloading,
+                ):
+                    # no worker will advance it: one inline attempt,
+                    # then the loop re-evaluates.  A transient failure
+                    # here is a normal retry (attempt accounting decides
+                    # when to give up) — only a truly peerless chain
+                    # aborts.
+                    if not self.peers:
+                        raise SyncChainError("no peers to sync from")
+                    self._download(batch)
+                else:
+                    for t in threads[:1]:
+                        t.join(timeout=5.0)
+                continue
+            batch.state = BatchState.processing
+            batch.processing_attempts += 1
+            try:
+                self._register_batch_sidecars(batch)
+                for signed in batch.blocks:
+                    self.chain.process_block(signed)
+                    self.imported += 1
+                batch.state = BatchState.processed
+                cursor += 1
+            except Exception as e:  # noqa: BLE001 — a bad segment rotates
+                peer = batch.peers_tried[-1] if batch.peers_tried else "?"
+                self.log.warn(
+                    "batch import failed",
+                    start=batch.start_slot,
+                    peer=peer,
+                    error=str(e),
+                )
+                if self.on_peer_fault is not None:
+                    self.on_peer_fault(peer, f"bad batch: {e}")
+                if (
+                    batch.processing_attempts
+                    >= self.max_processing_attempts
+                ):
+                    batch.state = BatchState.failed
+                    batch.error = f"processing attempts exhausted: {e}"
+                    raise SyncChainError(
+                        f"batch @{batch.start_slot} failed: {batch.error}"
+                    ) from e
+                # re-download from a different peer: the blocks may be
+                # the problem, not just the import
+                batch.blocks = []
+                batch.sidecars = []
+                batch.state = BatchState.awaiting_download
+        return self.imported - imported_before
+
+
+class RangeSync:
+    """The sync facade: drive the chain toward a target via SyncChain.
+
+    Accepts a single source (one implicit peer) or a {peer_id: source}
+    mapping; state reporting matches the node API's syncing shape."""
+
+    def __init__(self, chain, batch_size: int = SLOTS_PER_BATCH, kzg_setup=None):
+        self.chain = chain
+        self.batch_size = batch_size
+        self.kzg_setup = kzg_setup
         self.log = get_logger("sync/range")
         self.state = SyncState.stalled
         self.imported = 0
         self.failed_batches = 0
+        self.on_peer_fault: Optional[Callable[[str, str], None]] = None
 
-    def sync_to(self, source: BlockSource, target_slot: int) -> int:
+    def sync_to(self, source, target_slot: int) -> int:
         """Drive the chain head toward target_slot; returns blocks
         imported.  An empty batch is NOT a stall — it is a window of
         skip slots, and the cursor advances past it (reference
         range/batch.ts treats empty by-range responses as valid)."""
         self.state = SyncState.syncing
-        imported_before = self.imported
-        cursor = self.chain.head_state.slot + 1
+        start = self.chain.head_state.slot + 1
+        if start > target_slot:
+            self.state = SyncState.synced
+            return 0
+        sc = SyncChain(
+            self.chain,
+            start,
+            target_slot,
+            batch_size=self.batch_size,
+            kzg_setup=self.kzg_setup,
+            on_peer_fault=self.on_peer_fault,
+        )
+        if isinstance(source, dict):
+            for peer_id, src in source.items():
+                sc.add_peer(peer_id, src)
+        else:
+            sc.add_peer("peer-0", source)
         try:
-            while cursor <= target_slot:
-                count = min(self.batch_size, target_slot - cursor + 1)
-                batch = source.get_blocks_by_range(cursor, count)
-                for signed in batch:
-                    self.chain.process_block(signed)
-                    self.imported += 1
-                cursor += count
-        except Exception as e:  # bad batch: stop, report (peer scoring
-            # is the transport layer's job in the reference)
+            n = sc.run()
+        except Exception as e:
             self.failed_batches += 1
-            self.log.warn("batch import failed", error=str(e))
+            self.log.warn("range sync failed", error=str(e))
             self.state = SyncState.stalled
             raise
-        # covered the whole range; synced if blocks actually arrived up
-        # to the target's vicinity, stalled if the source was dry
+        self.imported += n
         self.state = (
             SyncState.synced
-            if self.imported > imported_before
-            or self.chain.head_state.slot >= target_slot
+            if n > 0 or self.chain.head_state.slot >= target_slot
             else SyncState.stalled
         )
-        return self.imported - imported_before
+        return n
 
     def status(self) -> dict:
         """The node API's syncing status shape (routes/node.ts)."""
