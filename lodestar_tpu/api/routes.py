@@ -118,6 +118,20 @@ ROUTES: Tuple[Route, ...] = (
         "publish_aggregate_and_proofs",
     ),
     Route("GET", "/eth/v2/validator/blocks/{slot}", "produce_block_v2"),
+    # builder/blinded flow (reference: routes/validator.ts
+    # produceBlindedBlock, routes/beacon/block.ts publishBlindedBlock,
+    # routes/validator.ts registerValidator)
+    Route(
+        "GET",
+        "/eth/v1/validator/blinded_blocks/{slot}",
+        "produce_blinded_block",
+    ),
+    Route("POST", "/eth/v1/beacon/blinded_blocks", "publish_blinded_block"),
+    Route(
+        "POST",
+        "/eth/v1/validator/register_validator",
+        "register_validator",
+    ),
     Route(
         "GET",
         "/eth/v1/validator/sync_committee_contribution",

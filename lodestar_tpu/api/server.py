@@ -321,6 +321,70 @@ class DefaultHandlers:
             "data": to_json(block_type, block),
         }
 
+    def produce_blinded_block(self, params, body):
+        """Builder-flow production (reference:
+        api/impl/validator/index.ts:188-230 produceBlindedBlock)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from .encoding import to_json
+
+        if self.chain.execution_builder is None:
+            return 400, {"message": "execution builder not set"}
+        if not self.chain.execution_builder.status:
+            return 503, {"message": "execution builder disabled"}
+        reveal = bytes.fromhex(params["randao_reveal"][2:])
+        graffiti = (
+            bytes.fromhex(params["graffiti"][2:])
+            if "graffiti" in params
+            else b"\x00" * 32
+        )
+        slot = int(params["slot"])
+        block = self.chain.produce_blinded_block(slot, reveal, graffiti)
+        block_type = self.chain.config.get_blinded_fork_types(slot)[0]
+        return 200, {
+            "version": self.chain.config.get_fork_name(slot).value,
+            "data": to_json(block_type, block),
+        }
+
+    def publish_blinded_block(self, params, body):
+        """Unblind via the builder + import (reference:
+        api/impl/beacon/blocks publishBlindedBlock)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from .encoding import from_json
+
+        slot = int(body["message"]["slot"])
+        signed_type = self.chain.config.get_blinded_fork_types(slot)[1]
+        signed = from_json(signed_type, body)
+        try:
+            self.chain.submit_blinded_block(signed)
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        return 200, None
+
+    def register_validator(self, params, body):
+        """Forward signed registrations to the relay (reference:
+        api/impl/validator registerValidator -> throws when
+        chain.executionBuilder is absent — a silent 200 would let the
+        VC believe its fee recipients reached the relay)."""
+        err = self._need_chain()
+        if err:
+            return err
+        from ..types import SignedValidatorRegistrationV1
+        from .encoding import from_json
+
+        builder = self.chain.execution_builder
+        if builder is None:
+            return 400, {"message": "execution builder not set"}
+        regs = [
+            from_json(SignedValidatorRegistrationV1, r) for r in body or []
+        ]
+        if regs:
+            builder.register_validator(regs)
+        return 200, None
+
     def publish_block(self, params, body):
         err = self._need_chain()
         if err:

@@ -81,6 +81,7 @@ class BeaconChain:
         monitor=None,
         emitter: Optional[ChainEventEmitter] = None,
         proposer_cache=None,
+        kzg_setup=None,
     ):
         self.config = config
         self.log = get_logger("chain")
@@ -92,7 +93,14 @@ class BeaconChain:
         self.bls = bls_verifier  # optional batched signature service
         self.eth1 = eth1  # optional Eth1DepositDataTracker
         self.execution = execution  # optional IExecutionEngine
+        # optional MEV builder (reference: chain.executionBuilder);
+        # wired post-construction by the node when configured
+        self.execution_builder = None
+        # optional Eth1MergeBlockTracker (terminal-PoW-block discovery
+        # for the merge-transition proposal)
+        self.merge_block_tracker = None
         self.monitor = monitor  # optional ValidatorMonitor
+        self.kzg_setup = kzg_setup  # deneb blob verification/production
         # beacon root -> execution block hash (payload-carrying blocks)
         self._execution_block_hash: Dict[str, bytes] = {}
         # roots imported optimistically (EL said SYNCING/ACCEPTED)
@@ -735,9 +743,103 @@ class BeaconChain:
             graffiti=graffiti,
             eth1=self.eth1,
             execution=self.execution,
+            merge_tracker=self.merge_block_tracker,
             fee_recipient_fn=cache.get if cache is not None else None,
         )
         return block
+
+    def produce_blinded_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+    ) -> dict:
+        """Builder-flow production: the body carries the relay's payload
+        HEADER (reference: api/impl/validator/index.ts:188-230
+        produceBlindedBlock -> chain.produceBlindedBlock).  Requires an
+        enabled builder."""
+        if self.execution_builder is None:
+            raise ValueError("execution builder not set")
+        if not self.execution_builder.status:
+            raise ValueError("execution builder disabled")
+        head = self.head_state
+        cache = self.proposer_cache
+        block, _post = produce_block_from_pools(
+            head,
+            slot,
+            randao_reveal,
+            aggregated_attestation_pool=self.aggregated_attestation_pool,
+            op_pool=self.op_pool,
+            contribution_pool=self.sync_contribution_pool,
+            head_root=self.get_head_root(),
+            graffiti=graffiti,
+            eth1=self.eth1,
+            builder=self.execution_builder,
+            fee_recipient_fn=cache.get if cache is not None else None,
+        )
+        return block
+
+    def submit_blinded_block(self, signed_blinded: dict) -> bytes:
+        """Unblind via the builder (submitBlindedBlock reveals the
+        payload after the proposer's signature commits to the header)
+        and import the full block (reference: publishBlindedBlock ->
+        builder.submitBlindedBlock -> importBlock).  A deneb reveal
+        carries the blobs bundle: its sidecars register as available
+        BEFORE the import so the DA gate passes for the proposer's own
+        block."""
+        from ..execution.builder import unblind_signed_block
+
+        if self.execution_builder is None:
+            raise ValueError("execution builder not set")
+        payload, blobs_bundle = self.execution_builder.submit_blinded_block(
+            signed_blinded
+        )
+        signed = unblind_signed_block(signed_blinded, payload)
+        commitments = signed["message"]["body"].get(
+            "blob_kzg_commitments", []
+        )
+        if commitments:
+            self._register_builder_blobs(signed, commitments, blobs_bundle)
+        return self.process_block(signed)
+
+    def _register_builder_blobs(
+        self, signed: dict, commitments, blobs_bundle
+    ) -> None:
+        """Blobs bundle -> validated sidecars in the DA tracker.  The
+        bundle's blobs must commit to exactly the block's commitments
+        (the proposer signed those); sidecars are rebuilt locally so
+        the inclusion proofs bind to the actual body."""
+        if blobs_bundle is None:
+            raise ValueError(
+                "builder revealed a blob block without its blobs bundle"
+            )
+        if self.kzg_setup is None:
+            raise ValueError("no KZG setup loaded for builder blobs")
+        from ..crypto import kzg as K
+        from . import blobs as BL
+
+        blobs = blobs_bundle["blobs"]
+        if len(blobs) != len(commitments):
+            raise ValueError("blobs bundle size != block commitments")
+        for blob, c in zip(blobs, commitments):
+            if bytes(
+                K.blob_to_kzg_commitment(bytes(blob), self.kzg_setup)
+            ) != bytes(c):
+                raise ValueError("bundle blob does not match commitment")
+        slot = int(signed["message"]["slot"])
+        body_type = self.config.get_fork_types(slot)[2]
+        for sc in BL.make_blob_sidecars(
+            signed, body_type, [bytes(b) for b in blobs], self.kzg_setup
+        ):
+            self.on_blob_sidecar(
+                BeaconBlockHeader.hash_tree_root(
+                    sc["signed_block_header"]["message"]
+                ),
+                int(sc["index"]),
+                bytes(sc["kzg_commitment"]),
+                slot=slot,
+                sidecar=sc,
+            )
 
     # -- duties (reference api/impl/validator/duties) ----------------------
 

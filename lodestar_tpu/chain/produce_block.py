@@ -61,7 +61,12 @@ def produce_block_body(
         "sync_aggregate": dict(sync_aggregate or default_sync_aggregate()),
     }
     if execution_payload is not None:
-        body["execution_payload"] = dict(execution_payload)
+        if "transactions" in execution_payload:
+            body["execution_payload"] = dict(execution_payload)
+        else:
+            # builder flow: the body is BLINDED — it carries the payload
+            # header the relay bid (reference: produceBlindedBlockBody)
+            body["execution_payload_header"] = dict(execution_payload)
     if state.fork_at_least(params.ForkName.capella):
         body["bls_to_execution_changes"] = list(bls_to_execution_changes or [])
     if state.fork_at_least(params.ForkName.deneb):
@@ -83,6 +88,8 @@ def produce_block_from_pools(
     deposits: Optional[List[Dict]] = None,
     eth1=None,
     execution=None,
+    builder=None,
+    merge_tracker=None,
     fee_recipient_fn=None,
 ) -> Tuple[Dict, object]:
     """produceBlockBody from the op pools (reference
@@ -126,6 +133,8 @@ def produce_block_from_pools(
         slot,
         randao_reveal,
         execution=execution,
+        builder=builder,
+        merge_tracker=merge_tracker,
         fee_recipient_fn=fee_recipient_fn,
         graffiti=graffiti,
         eth1_data=eth1_data,
@@ -168,18 +177,34 @@ def build_payload_attributes(advanced, slot: int, fee_recipient: bytes):
     )
 
 
-def _fetch_payload(execution, pre, fee_recipient: bytes = b"\x00" * 20) -> Dict:
+def _fetch_payload(
+    execution,
+    pre,
+    fee_recipient: bytes = b"\x00" * 20,
+    merge_tracker=None,
+) -> Dict:
     """engine_forkchoiceUpdated(attributes) + engine_getPayload against
     the state's latest header (reference: produceBlockBody.ts
     prepareExecutionPayload).  `fee_recipient` comes from the proposer's
-    prepare_beacon_proposer registration."""
+    prepare_beacon_proposer registration.  Pre-merge, the payload parent
+    is the TERMINAL PoW block discovered by the Eth1MergeBlockTracker
+    (produceBlockBody.ts prepareExecutionPayload's
+    getTerminalPowBlockHash leg) — producing the transition block."""
     from ..state_transition.block import is_merge_transition_complete
 
-    parent_hash = (
-        bytes(pre.latest_execution_payload_header["block_hash"])
-        if is_merge_transition_complete(pre)
-        else b"\x00" * 32
-    )
+    if is_merge_transition_complete(pre):
+        parent_hash = bytes(pre.latest_execution_payload_header["block_hash"])
+    else:
+        parent_hash = b"\x00" * 32
+        if merge_tracker is not None:
+            try:
+                terminal = merge_tracker.get_terminal_pow_block()
+            except Exception:  # noqa: BLE001 — an eth1 flake must not
+                # kill the proposal; pre-tracker behavior (zero parent)
+                # is the safe fallback
+                terminal = None
+            if terminal is not None:
+                parent_hash = bytes.fromhex(terminal.block_hash)
     r = execution.notify_forkchoice_update(
         parent_hash,
         parent_hash,
@@ -208,6 +233,8 @@ def produce_block(
     slot: int,
     randao_reveal: bytes,
     execution=None,
+    builder=None,  # IExecutionBuilder for the blinded flow
+    merge_tracker=None,  # Eth1MergeBlockTracker for the transition block
     fee_recipient: bytes = b"\x00" * 20,
     fee_recipient_fn=None,  # proposer_index -> bytes|None (the cache)
     **body_kwargs,
@@ -215,7 +242,11 @@ def produce_block(
     """Build an unsigned block at `slot` on top of `state`.
 
     Returns (block_value, post_state); block.state_root is the real
-    post-state root, so signing it yields an importable block."""
+    post-state root, so signing it yields an importable block.  With a
+    `builder`, the body is BLINDED: it carries the relay's payload
+    header instead of a payload (reference: produceBlindedBlock)."""
+    from ..state_transition.block import is_merge_transition_complete
+
     pre = state.clone()
     if pre.slot < slot:
         process_slots(pre, slot)
@@ -231,15 +262,37 @@ def produce_block(
         pre.latest_execution_payload_header is not None
         and body_kwargs.get("execution_payload") is None
     ):
-        # bellatrix proposal: fetch the payload from the EL (reference:
-        # produceBlockBody.ts engine getPayload leg)
-        if execution is None:
-            raise ValueError(
-                "post-bellatrix proposal requires an execution engine"
+        if builder is not None:
+            # builder flow requires a settled parent payload (the relay
+            # bids on top of a known EL block)
+            if not is_merge_transition_complete(pre):
+                raise ValueError("builder flow requires a post-merge head")
+            parent_hash = bytes(
+                pre.latest_execution_payload_header["block_hash"]
             )
-        body_kwargs["execution_payload"] = _fetch_payload(
-            execution, pre, fee_recipient
-        )
+            bid = builder.get_header(
+                slot,
+                parent_hash,
+                bytes(pre.pubkeys[int(proposer_index)]),
+                payload_attributes=build_payload_attributes(
+                    pre, slot, fee_recipient
+                ),
+            )
+            body_kwargs["execution_payload"] = dict(bid.header)
+            if bid.blob_kzg_commitments is not None:
+                body_kwargs.setdefault(
+                    "blob_kzg_commitments", list(bid.blob_kzg_commitments)
+                )
+        else:
+            # bellatrix proposal: fetch the payload from the EL
+            # (reference: produceBlockBody.ts engine getPayload leg)
+            if execution is None:
+                raise ValueError(
+                    "post-bellatrix proposal requires an execution engine"
+                )
+            body_kwargs["execution_payload"] = _fetch_payload(
+                execution, pre, fee_recipient, merge_tracker=merge_tracker
+            )
     body = produce_block_body(pre, randao_reveal, **body_kwargs)
     block = {
         "slot": slot,

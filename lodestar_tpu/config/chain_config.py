@@ -114,6 +114,33 @@ class ChainConfig:
             T.BeaconBlockBodyDeneb,
         )
 
+    def get_blinded_fork_types(self, slot: int):
+        """(blinded_block, signed_blinded_block, blinded_body) for the
+        fork at `slot` (reference: config.getBlindedForkTypes).  Blinded
+        containers exist from bellatrix on."""
+        from .. import types as T
+
+        name = self.get_fork_name(slot)
+        if name in (ForkName.phase0, ForkName.altair):
+            raise ValueError(f"no blinded containers before bellatrix ({name})")
+        if name == ForkName.bellatrix:
+            return (
+                T.BlindedBeaconBlockBellatrix,
+                T.SignedBlindedBeaconBlockBellatrix,
+                T.BlindedBeaconBlockBodyBellatrix,
+            )
+        if name == ForkName.capella:
+            return (
+                T.BlindedBeaconBlockCapella,
+                T.SignedBlindedBeaconBlockCapella,
+                T.BlindedBeaconBlockBodyCapella,
+            )
+        return (
+            T.BlindedBeaconBlockDeneb,
+            T.SignedBlindedBeaconBlockDeneb,
+            T.BlindedBeaconBlockBodyDeneb,
+        )
+
     def get_fork_seq(self, slot: int) -> int:
         return params.FORK_SEQ[self.get_fork_name(slot)]
 

@@ -15,3 +15,8 @@ from .deposit_tracker import (  # noqa: F401
     DepositEvent,
     get_eth1_vote,
 )
+from .merge_block_tracker import (  # noqa: F401
+    Eth1MergeBlockTracker,
+    PowMergeBlock,
+    StatusCode as MergeTrackerStatus,
+)

@@ -19,8 +19,22 @@ from .engine import (
 )
 from .engine_mock import ExecutionEngineMock
 from .engine_http import ExecutionEngineHttp, EngineApiServer
+from .builder import (
+    BuilderBidResult,
+    BuilderError,
+    ExecutionBuilderHttp,
+    ExecutionBuilderMock,
+    unblind_signed_block,
+    verify_revealed_payload,
+)
 
 __all__ = [
+    "BuilderBidResult",
+    "BuilderError",
+    "ExecutionBuilderHttp",
+    "ExecutionBuilderMock",
+    "unblind_signed_block",
+    "verify_revealed_payload",
     "ExecutePayloadStatus",
     "ExecutionEngineUnavailable",
     "ExecutionPayloadStatus",

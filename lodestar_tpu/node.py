@@ -60,6 +60,17 @@ class NodeOptions:
     # subscribe every attestation/sync subnet (reference:
     # --subscribeAllSubnets; sims and aggregator-heavy deployments)
     subscribe_all_subnets: bool = False
+    # MEV builder: a relay URL constructs an ExecutionBuilderHttp, or
+    # inject a builder object directly (tests/dev); enabled explicitly
+    # like the reference's --builder flag (builder/http.ts status=false
+    # until updateStatus)
+    builder_url: Optional[str] = None
+    builder: Optional[object] = None
+    builder_enabled: bool = False
+    # PoW-side provider for the Eth1MergeBlockTracker (objects with
+    # get_pow_block_by_hash/get_pow_block_latest); None = no tracker
+    pow_provider: Optional[object] = None
+    terminal_total_difficulty: Optional[int] = None
 
 
 class BeaconNode:
@@ -255,7 +266,45 @@ class FullBeaconNode:
             execution=opts.execution,
             monitor=self.monitor,
             proposer_cache=self.proposer_cache,
+            kzg_setup=opts.kzg_setup,
         )
+        # MEV builder wiring (reference: chain.ts executionBuilder)
+        builder = opts.builder
+        if builder is None and opts.builder_url:
+            from .execution import ExecutionBuilderHttp
+
+            builder = ExecutionBuilderHttp(opts.builder_url, config)
+        if builder is not None:
+            self.chain.execution_builder = builder
+            if opts.builder_enabled:
+                try:
+                    builder.check_status()
+                    builder.update_status(True)
+                except Exception as e:  # noqa: BLE001 — relay down at
+                    # boot: stay dark, the operator re-enables via API
+                    self.log.warn("builder status check failed", error=str(e))
+            # the circuit breaker sees every slot (builder/http.ts
+            # fault window)
+            self.clock.on_slot(
+                lambda s, b=builder: getattr(b, "on_slot_success", lambda _s: None)(s)
+            )
+        # terminal-PoW-block tracker (reference: eth1MergeBlockTracker
+        # polled at SECONDS_PER_ETH1_BLOCK; here slot-clock driven)
+        if opts.pow_provider is not None:
+            from .eth1 import Eth1MergeBlockTracker
+
+            ttd = (
+                opts.terminal_total_difficulty
+                if opts.terminal_total_difficulty is not None
+                else getattr(config, "TERMINAL_TOTAL_DIFFICULTY", 2**256 - 1)
+            )
+            self.chain.merge_block_tracker = Eth1MergeBlockTracker(
+                opts.pow_provider, ttd
+            )
+            self.chain.merge_block_tracker.start_polling_merge_block()
+            self.clock.on_slot(
+                lambda _s: self.chain.merge_block_tracker.on_tick()
+            )
         self.fork_choice = self.chain.fork_choice
         self.light_client_server = LightClientServer(self.chain)
         self.archiver = Archiver(self.chain)

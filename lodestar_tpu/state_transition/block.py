@@ -86,7 +86,7 @@ def process_block_header(state, block: Dict) -> None:
         "parent root mismatch",
     )
     _require(not bool(state.slashed[proposer]), "proposer is slashed")
-    body_type = _body_type(state, block["slot"])
+    body_type = _body_type(state, block["slot"], block["body"])
     state.latest_block_header = {
         "slot": block["slot"],
         "proposer_index": block["proposer_index"],
@@ -96,7 +96,11 @@ def process_block_header(state, block: Dict) -> None:
     }
 
 
-def _body_type(state, slot: int):
+def _body_type(state, slot: int, body: Dict = None):
+    """Fork body container; the BLINDED variant when the body carries a
+    payload header (builder flow — same hash_tree_root by design)."""
+    if body is not None and "execution_payload_header" in body:
+        return state.config.get_blinded_fork_types(slot)[2]
     return state.config.get_fork_types(slot)[2]
 
 
@@ -631,16 +635,29 @@ def process_withdrawals(state, payload: Dict) -> None:
     cursors advance."""
     from ..types import Withdrawal
 
+    from ..ssz import List as SszList
+
     expected = get_expected_withdrawals(state)
-    got = list(payload["withdrawals"])
-    _require(
-        len(got) == len(expected)
-        and all(
-            Withdrawal.hash_tree_root(a) == Withdrawal.hash_tree_root(e)
-            for a, e in zip(got, expected)
-        ),
-        "payload withdrawals do not match protocol expectation",
-    )
+    if "withdrawals" in payload:
+        got = list(payload["withdrawals"])
+        _require(
+            len(got) == len(expected)
+            and all(
+                Withdrawal.hash_tree_root(a) == Withdrawal.hash_tree_root(e)
+                for a, e in zip(got, expected)
+            ),
+            "payload withdrawals do not match protocol expectation",
+        )
+    else:
+        # blinded body: the header commits to the list by root (spec
+        # blinded process_withdrawals compares hash_tree_root)
+        expected_root = SszList(
+            Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD
+        ).hash_tree_root(expected)
+        _require(
+            bytes(payload["withdrawals_root"]) == bytes(expected_root),
+            "header withdrawals_root does not match protocol expectation",
+        )
     for w in expected:
         state.decrease_balance(w["validator_index"], w["amount"])
     if expected:
@@ -862,12 +879,17 @@ def payload_to_header(payload: Dict) -> Dict:
 
 def _is_nondefault_payload(payload: Dict) -> bool:
     """spec is_merge_transition_block's payload != ExecutionPayload()
-    test (a default payload means execution is not yet enabled)."""
-    from ..types import ExecutionPayload
+    test (a default payload means execution is not yet enabled).
+    Accepts either shape: a full payload or a blinded header (the
+    bellatrix field subset decides default-ness in both cases)."""
+    from ..types import ExecutionPayload, ExecutionPayloadHeader
 
-    return ExecutionPayload.hash_tree_root(
-        payload
-    ) != ExecutionPayload.hash_tree_root(ExecutionPayload.default())
+    t = (
+        ExecutionPayload
+        if "transactions" in payload
+        else ExecutionPayloadHeader
+    )
+    return t.hash_tree_root(payload) != t.hash_tree_root(t.default())
 
 
 def process_execution_payload(state, payload: Dict) -> None:
@@ -899,7 +921,15 @@ def process_execution_payload(state, payload: Dict) -> None:
         int(payload["timestamp"]) == expected_time,
         f"payload timestamp {payload['timestamp']} != slot time {expected_time}",
     )
-    state.latest_execution_payload_header = payload_to_header(payload)
+    # a blinded body carries the HEADER (transactions_root instead of
+    # the transactions list) — same consensus checks, stored verbatim
+    # (spec: process_execution_payload on ExecutionPayloadHeader for
+    # blinded blocks; reference state-transition handles both shapes)
+    state.latest_execution_payload_header = (
+        payload_to_header(payload)
+        if "transactions" in payload
+        else dict(payload)
+    )
 
 
 def process_block(state, block: Dict, verify_signatures: bool = False) -> None:
@@ -908,10 +938,14 @@ def process_block(state, block: Dict, verify_signatures: bool = False) -> None:
     process_block_header(state, block)
     body = block["body"]
     if state.latest_execution_payload_header is not None:
+        # blinded bodies carry the payload HEADER; the consensus checks
+        # are identical (withdrawals verify against withdrawals_root)
+        blinded = "execution_payload_header" in body
         _require(
-            "execution_payload" in body,
+            "execution_payload" in body or blinded,
             "bellatrix block must carry an execution payload",
         )
+        payload = body["execution_payload_header" if blinded else "execution_payload"]
         if state.fork_at_least(params.ForkName.deneb):
             _require(
                 len(body.get("blob_kzg_commitments", ()))
@@ -922,16 +956,16 @@ def process_block(state, block: Dict, verify_signatures: bool = False) -> None:
         # transition is complete OR this block IS the transition block
         # (non-default payload); a pre-merge default payload is skipped.
         if is_merge_transition_complete(state) or _is_nondefault_payload(
-            body["execution_payload"]
+            payload
         ):
             # capella order: withdrawals precede the payload header update
             # (spec capella process_block: process_withdrawals(payload)
             # then process_execution_payload)
             if state.next_withdrawal_index is not None:
-                process_withdrawals(state, body["execution_payload"])
+                process_withdrawals(state, payload)
             # spec order: the payload step precedes randao — its
             # prev_randao check reads the PRE-block mix
-            process_execution_payload(state, body["execution_payload"])
+            process_execution_payload(state, payload)
     process_randao(state, body, verify_signatures)
     process_eth1_data(state, body)
     process_operations(state, body, verify_signatures)

@@ -541,6 +541,109 @@ BeaconBlockDeneb, SignedBeaconBlockDeneb = _block_types(
     BeaconBlockBodyDeneb, "Deneb"
 )
 
+# -- blinded blocks + builder wire types (MEV flow) -------------------------
+# reference: types/src/{bellatrix,capella,deneb}/sszTypes.ts
+# BlindedBeaconBlockBody (execution_payload -> executionPayloadHeader;
+# hash_tree_root is IDENTICAL to the full block's because the payload
+# header's root equals the payload's root) and builder/registration
+# containers (bellatrix/sszTypes.ts ValidatorRegistrationV1, BuilderBid).
+
+BlindedBeaconBlockBodyBellatrix = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload_header", ExecutionPayloadHeader),
+    ),
+    name="BlindedBeaconBlockBodyBellatrix",
+)
+BlindedBeaconBlockBellatrix, SignedBlindedBeaconBlockBellatrix = (
+    _block_types(BlindedBeaconBlockBodyBellatrix, "BlindedBellatrix")
+)
+
+BlindedBeaconBlockBodyCapella = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload_header", ExecutionPayloadHeaderCapella),
+        (
+            "bls_to_execution_changes",
+            List(SignedBLSToExecutionChange, 16),
+        ),
+    ),
+    name="BlindedBeaconBlockBodyCapella",
+)
+BlindedBeaconBlockCapella, SignedBlindedBeaconBlockCapella = _block_types(
+    BlindedBeaconBlockBodyCapella, "BlindedCapella"
+)
+
+BlindedBeaconBlockBodyDeneb = Container(
+    _phase0_body_fields
+    + (
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload_header", ExecutionPayloadHeaderDeneb),
+        (
+            "bls_to_execution_changes",
+            List(SignedBLSToExecutionChange, 16),
+        ),
+        (
+            "blob_kzg_commitments",
+            List(KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK),
+        ),
+    ),
+    name="BlindedBeaconBlockBodyDeneb",
+)
+BlindedBeaconBlockDeneb, SignedBlindedBeaconBlockDeneb = _block_types(
+    BlindedBeaconBlockBodyDeneb, "BlindedDeneb"
+)
+
+ValidatorRegistrationV1 = Container(
+    (
+        ("fee_recipient", ByteVector(20)),
+        ("gas_limit", uint64),
+        ("timestamp", uint64),
+        ("pubkey", BLSPubkey),
+    ),
+    name="ValidatorRegistrationV1",
+)
+
+SignedValidatorRegistrationV1 = Container(
+    (
+        ("message", ValidatorRegistrationV1),
+        ("signature", BLSSignature),
+    ),
+    name="SignedValidatorRegistrationV1",
+)
+
+
+def builder_bid_types(header_type):
+    """BuilderBid/SignedBuilderBid over a fork's payload-header type
+    (reference: builder bids are fork-parameterized)."""
+    bid = Container(
+        (
+            ("header", header_type),
+            ("value", uint256),
+            ("pubkey", BLSPubkey),
+        ),
+        name="BuilderBid",
+    )
+    signed = Container(
+        (("message", bid), ("signature", BLSSignature)),
+        name="SignedBuilderBid",
+    )
+    return bid, signed
+
+
+BuilderBidBellatrix, SignedBuilderBidBellatrix = builder_bid_types(
+    ExecutionPayloadHeader
+)
+BuilderBidCapella, SignedBuilderBidCapella = builder_bid_types(
+    ExecutionPayloadHeaderCapella
+)
+BuilderBidDeneb, SignedBuilderBidDeneb = builder_bid_types(
+    ExecutionPayloadHeaderDeneb
+)
+
+
 # Per-fork namespaces for the later forks (reference: types/src/sszTypes.ts
 # `ssz.bellatrix` / `ssz.capella` / `ssz.deneb`)
 ssz.bellatrix = SimpleNamespace(
@@ -549,6 +652,12 @@ ssz.bellatrix = SimpleNamespace(
     BeaconBlock=BeaconBlockBellatrix,
     SignedBeaconBlock=SignedBeaconBlockBellatrix,
     BeaconBlockBody=BeaconBlockBodyBellatrix,
+    BlindedBeaconBlock=BlindedBeaconBlockBellatrix,
+    SignedBlindedBeaconBlock=SignedBlindedBeaconBlockBellatrix,
+    ValidatorRegistrationV1=ValidatorRegistrationV1,
+    SignedValidatorRegistrationV1=SignedValidatorRegistrationV1,
+    BuilderBid=BuilderBidBellatrix,
+    SignedBuilderBid=SignedBuilderBidBellatrix,
 )
 ssz.capella = SimpleNamespace(
     Withdrawal=Withdrawal,
@@ -560,6 +669,10 @@ ssz.capella = SimpleNamespace(
     BeaconBlock=BeaconBlockCapella,
     SignedBeaconBlock=SignedBeaconBlockCapella,
     BeaconBlockBody=BeaconBlockBodyCapella,
+    BlindedBeaconBlock=BlindedBeaconBlockCapella,
+    SignedBlindedBeaconBlock=SignedBlindedBeaconBlockCapella,
+    BuilderBid=BuilderBidCapella,
+    SignedBuilderBid=SignedBuilderBidCapella,
 )
 ssz.deneb = SimpleNamespace(
     KZGCommitment=KZGCommitment,
@@ -568,6 +681,10 @@ ssz.deneb = SimpleNamespace(
     BeaconBlock=BeaconBlockDeneb,
     SignedBeaconBlock=SignedBeaconBlockDeneb,
     BeaconBlockBody=BeaconBlockBodyDeneb,
+    BlindedBeaconBlock=BlindedBeaconBlockDeneb,
+    SignedBlindedBeaconBlock=SignedBlindedBeaconBlockDeneb,
+    BuilderBid=BuilderBidDeneb,
+    SignedBuilderBid=SignedBuilderBidDeneb,
 )
 
 # deneb blob sidecars (reference carried the earlier

@@ -324,6 +324,56 @@ class ValidatorStore:
         )
         return self._raw_sign(validator_index, root)
 
+    def sign_blinded_block(self, validator_index: int, block: dict) -> bytes:
+        """Sign a BLINDED block (builder flow).  hash_tree_root equals
+        the full block's, so slashing protection sees the identical
+        (slot, root) record either way (reference: validatorStore.ts
+        signBlock handles both via getBlindedForkTypes)."""
+        self._check_doppelganger(validator_index)
+        pk = self.pubkeys[validator_index]
+        self.slashing.check_block(pk, block["slot"])
+        block_type = self.config.get_blinded_fork_types(block["slot"])[0]
+        root = self.config.compute_signing_root(
+            block_type.hash_tree_root(block),
+            self.config.get_domain(
+                block["slot"], params.DOMAIN_BEACON_PROPOSER, block["slot"]
+            ),
+        )
+        return self._raw_sign(validator_index, root)
+
+    def sign_validator_registration(
+        self,
+        validator_index: int,
+        fee_recipient: bytes,
+        gas_limit: int = 30_000_000,
+        timestamp: int = 0,
+    ) -> dict:
+        """SignedValidatorRegistrationV1 for the relay (reference:
+        validatorStore.ts signValidatorRegistration; builder-specs
+        domain 0x00000001 with the GENESIS fork version and a zero
+        genesis_validators_root)."""
+        pk = self.pubkeys[validator_index]
+        message = {
+            "fee_recipient": bytes(fee_recipient),
+            "gas_limit": int(gas_limit),
+            "timestamp": int(timestamp),
+            "pubkey": pk,
+        }
+        # builder domain: compute_domain(DOMAIN_APPLICATION_BUILDER,
+        # GENESIS_FORK_VERSION, Root()) — NOT the beacon fork domain
+        domain = self.config.compute_domain(
+            params.DOMAIN_APPLICATION_BUILDER,
+            self.config.fork_versions[params.ForkName.phase0],
+            b"\x00" * 32,
+        )
+        root = self.config.compute_signing_root(
+            T.ValidatorRegistrationV1.hash_tree_root(message), domain
+        )
+        return {
+            "message": message,
+            "signature": self._raw_sign(validator_index, root),
+        }
+
     # -- further signing entry points (reference validatorStore.ts) --------
 
     def _sign_root(self, validator_index: int, object_root, domain_type, slot):
