@@ -1,0 +1,112 @@
+"""Pre-trace the verify pipeline and persist AOT export artifacts.
+
+Runs the bench's exact job assembly through the verifier, CAPTURES the
+device dispatches (name, fn, arg specs) without executing them, then
+traces each for the requested platform and writes jax.export artifacts
+into the export cache (kernels/export_cache.py).
+
+The point: tracing costs ~10 minutes per process on this 1-core host
+(dev/NOTES.md).  This script pays it once, offline; bench.py and any
+node process then deserializes in milliseconds.  TPU-platform artifacts
+are traced on this CPU host with the real Mosaic lowering forced.
+
+Usage:
+  python dev/export_pipeline.py [tpu|cpu]      (default: tpu)
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")  # tracing host; artifacts target TPU
+
+import bench_configs  # noqa: F401  (shared world shapes if present)
+from lodestar_tpu.kernels import export_cache as EC
+
+PLATFORM = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+
+
+def capture_bench_dispatches():
+    """Build the bench world and record every device dispatch the
+    verifier would make for its job shapes."""
+    import os
+
+    os.environ.setdefault("BENCH_PLATFORM", "cpu")
+    from lodestar_tpu.bls.pubkey_table import PubkeyTable
+    from lodestar_tpu.bls.signature_set import WireSignatureSet
+    from lodestar_tpu.bls.verifier import TpuBlsVerifier
+    from lodestar_tpu.crypto import bls as GTB
+    from lodestar_tpu.crypto import curves as GCC
+
+    BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+    DISTINCT = 32
+    ROOTS = 8
+
+    sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=max(BATCH, DISTINCT))
+    table.register_points_unchecked(pks, tile_to=max(BATCH, DISTINCT))
+    table.device_planes()
+
+    roots = [b"bench root 0 %d" % c for c in range(ROOTS)]
+    sig_cache = {}
+    sets = []
+    for j in range(BATCH):
+        key = j % DISTINCT
+        root = roots[j % ROOTS]
+        if (key, root) not in sig_cache:
+            sig_cache[(key, root)] = GCC.g2_compress(GTB.sign(sks[key], root))
+        sets.append(WireSignatureSet.single(j, root, sig_cache[(key, root)]))
+
+    verifier = TpuBlsVerifier(table, max_job_sets=BATCH)
+    captured = []
+
+    def fake_call(name, fn, args):
+        specs = tuple(
+            jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
+            for a in args
+        )
+        captured.append((name, fn, specs))
+        # shape-compatible dummies so begin_job completes
+        n = args[-1].shape[0]
+        if name.startswith("batch"):
+            return jnp.zeros((), bool), jnp.zeros((n,), bool)
+        return jnp.ones((n,), bool)
+
+    verifier._device_call = fake_call
+    verifier.begin_job(sets, batchable=True)
+
+    # ALSO capture the retry path (each_wire) for the same shapes: a
+    # batch failure on chip must not pay a fresh trace
+    job = verifier.begin_job(sets[: BATCH // 2] + sets[BATCH // 2 :], batchable=False)
+    del job
+    return captured
+
+
+def main():
+    t0 = time.time()
+    captured = capture_bench_dispatches()
+    seen = set()
+    for name, fn, specs in captured:
+        key = EC.artifact_key(name, specs, PLATFORM)
+        if key in seen:
+            continue
+        seen.add(key)
+        if EC.load(name, specs, PLATFORM) is not None:
+            print(f"cached: {name} ({key})")
+            continue
+        t1 = time.time()
+        EC.export_and_save(name, fn, specs, PLATFORM)
+        print(
+            f"exported {name} for {PLATFORM} in {time.time() - t1:.1f}s "
+            f"({key})"
+        )
+    print(f"total {time.time() - t0:.1f}s, {len(seen)} artifacts")
+
+
+if __name__ == "__main__":
+    main()

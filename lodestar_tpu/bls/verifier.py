@@ -26,11 +26,13 @@ point-add on device; randomizers come from the OS CSPRNG.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..crypto import curves as C
@@ -127,6 +129,47 @@ class TpuBlsVerifier:
         # signing-root -> hashed G2 message, device-batched (wire path)
         self.messages = MessageCache()
         self._pending_jobs = 0
+        # AOT export cache: on the TPU backend the top-level pipeline is
+        # traced once per shape EVER (persisted to disk via jax.export)
+        # instead of once per process — the ~10-minute per-process trace
+        # cost on the 1-core driver host becomes a millisecond
+        # deserialize (kernels/export_cache.py).  Off on CPU: the
+        # monolithic graph is XLA:CPU-hostile (dev/NOTES.md).
+        env = os.environ.get("LODESTAR_TPU_EXPORT")
+        if env is not None:
+            self._use_export = env.strip().lower() not in (
+                "0", "false", "no", "off", "",
+            )
+        else:
+            self._use_export = jax.default_backend() == "tpu"
+
+    def _device_call(self, name: str, fn, args):
+        """Dispatch through the AOT export cache when enabled; plain
+        call otherwise.  `name` keys the artifact with the arg shapes."""
+        if not self._use_export:
+            return fn(*args)
+        try:
+            from ..kernels import export_cache as EC
+
+            # read shape/dtype WITHOUT materializing on device: numpy
+            # and jax arrays both carry .dtype; jnp.asarray here would
+            # pay a full H2D transfer per arg just to inspect it
+            specs = [
+                jax.ShapeDtypeStruct(
+                    jnp.shape(a), getattr(a, "dtype", np.asarray(a).dtype)
+                )
+                for a in args
+            ]
+            call = EC.load_or_export(name, fn, specs)
+            return call(*args)
+        except Exception as e:  # noqa: BLE001 — the export layer must
+            # never take down verification; fall back to the direct path
+            import logging
+
+            logging.getLogger("lodestar_tpu").warning(
+                "export-cache dispatch failed (%s); direct call", e
+            )
+            return fn(*args)
 
     # -- backpressure (reference: multithread/index.ts:143-149) -----------
 
@@ -313,8 +356,10 @@ class TpuBlsVerifier:
             grouping = self._grouping(sets, n) if wire else None
             if grouping is not None:
                 group, head_lanes, glive = grouping
-                job.batch_ok, _sub = KV.verify_batch_device_wire_grouped(
-                    *job.args, group, head_lanes, glive, rand, job.valid
+                job.batch_ok, _sub = self._device_call(
+                    "batch_wire_grouped",
+                    KV.verify_batch_device_wire_grouped,
+                    (*job.args, group, head_lanes, glive, rand, job.valid),
                 )
             else:
                 batch_fn = (
@@ -322,7 +367,11 @@ class TpuBlsVerifier:
                     if wire
                     else KV.verify_batch_device
                 )
-                job.batch_ok, _sub = batch_fn(*job.args, rand, job.valid)
+                job.batch_ok, _sub = self._device_call(
+                    "batch_wire" if wire else "batch_decoded",
+                    batch_fn,
+                    (*job.args, rand, job.valid),
+                )
         else:
             if batchable and len(sets) >= 2:
                 # an undecodable signature voids the merged batch: count it
@@ -330,7 +379,11 @@ class TpuBlsVerifier:
                 self.metrics.batchable_sigs.inc(len(sets))
                 self.metrics.batch_retries.inc()
                 job.batch_retries += 1
-            job.per_set = self._each_fn(job)(*job.args, job.valid)
+            job.per_set = self._device_call(
+                "each_wire" if job.wire else "each_decoded",
+                self._each_fn(job),
+                (*job.args, job.valid),
+            )
         return job
 
     def _each_fn(self, job):
@@ -416,7 +469,11 @@ class TpuBlsVerifier:
             # verdict of honest sets (reference: multithread/worker.ts:74-96)
             self.metrics.batch_retries.inc()
             job.batch_retries += 1
-            job.per_set = self._each_fn(job)(*job.args, job.valid)
+            job.per_set = self._device_call(
+                "each_wire" if job.wire else "each_decoded",
+                self._each_fn(job),
+                (*job.args, job.valid),
+            )
         per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
         if job.unsort is not None:
             # planes were sorted by signing root: restore the caller's

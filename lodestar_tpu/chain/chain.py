@@ -764,7 +764,23 @@ class BeaconChain:
             raise ValueError("execution builder disabled")
         head = self.head_state
         cache = self.proposer_cache
-        block, _post = produce_block_from_pools(
+        try:
+            block, _post = self._produce_blinded_inner(
+                head, slot, randao_reveal, graffiti, cache
+            )
+        except Exception:
+            # a relay fault counts against the circuit breaker
+            # (reference: builder/http.ts fault window)
+            fault = getattr(self.execution_builder, "on_slot_fault", None)
+            if fault is not None:
+                fault(int(slot))
+            raise
+        return block
+
+    def _produce_blinded_inner(
+        self, head, slot, randao_reveal, graffiti, cache
+    ):
+        return produce_block_from_pools(
             head,
             slot,
             randao_reveal,
@@ -777,7 +793,6 @@ class BeaconChain:
             builder=self.execution_builder,
             fee_recipient_fn=cache.get if cache is not None else None,
         )
-        return block
 
     def submit_blinded_block(self, signed_blinded: dict) -> bytes:
         """Unblind via the builder (submitBlindedBlock reveals the
@@ -791,9 +806,19 @@ class BeaconChain:
 
         if self.execution_builder is None:
             raise ValueError("execution builder not set")
-        payload, blobs_bundle = self.execution_builder.submit_blinded_block(
-            signed_blinded
-        )
+        slot = int(signed_blinded["message"]["slot"])
+        try:
+            payload, blobs_bundle = (
+                self.execution_builder.submit_blinded_block(signed_blinded)
+            )
+        except Exception:
+            fault = getattr(self.execution_builder, "on_slot_fault", None)
+            if fault is not None:
+                fault(slot)
+            raise
+        ok = getattr(self.execution_builder, "on_slot_success", None)
+        if ok is not None:
+            ok(slot)
         signed = unblind_signed_block(signed_blinded, payload)
         commitments = signed["message"]["body"].get(
             "blob_kzg_commitments", []

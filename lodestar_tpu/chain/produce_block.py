@@ -58,8 +58,11 @@ def produce_block_body(
         "attestations": list(attestations or []),
         "deposits": list(deposits or []),
         "voluntary_exits": list(voluntary_exits or []),
-        "sync_aggregate": dict(sync_aggregate or default_sync_aggregate()),
     }
+    if state.fork_at_least(params.ForkName.altair):
+        body["sync_aggregate"] = dict(
+            sync_aggregate or default_sync_aggregate()
+        )
     if execution_payload is not None:
         if "transactions" in execution_payload:
             body["execution_payload"] = dict(execution_payload)

@@ -60,20 +60,12 @@ def bid_from_json(data: dict):
 def bid_to_json(header: dict, value: int, pubkey: bytes, signature: bytes = b"\x00" * 96) -> dict:
     return {
         "message": {
-            "header": to_json(_header_type_for_value(header), header),
+            "header": to_json(_header_type_for(header), header),
             "value": str(int(value)),
             "pubkey": "0x" + bytes(pubkey).hex(),
         },
         "signature": "0x" + bytes(signature).hex(),
     }
-
-
-def _header_type_for_value(header: dict):
-    if "blob_gas_used" in header:
-        return T.ExecutionPayloadHeaderDeneb
-    if "withdrawals_root" in header:
-        return T.ExecutionPayloadHeaderCapella
-    return T.ExecutionPayloadHeader
 
 
 def _blinded_types_for(body: dict):

@@ -1,0 +1,144 @@
+"""AOT export cache — trace once, reload without re-tracing.
+
+The wire verify pipeline unrolls 33-limb schoolbook arithmetic into a
+~1e5-equation jaxpr; TRACING it costs ~10 minutes per process on the
+1-core driver host (dev/NOTES.md "CPU-host costs") while the actual
+XLA/Mosaic compile is served by the persistent compile cache.  Tracing
+is pure Python work over static shapes, so it can be paid ONCE, the
+result serialized with `jax.export`, and every later process —
+including the driver's bench window — deserializes in milliseconds and
+goes straight to (cached) compilation.
+
+Artifacts are keyed by (entry name, shape/dtype signature, platform,
+jax version, kernels-code fingerprint); a stale fingerprint falls back
+to a fresh trace, so a kernel edit can never run an outdated artifact.
+
+Cross-platform: `platform="tpu"` artifacts are traced on this CPU host
+with the real Mosaic lowering forced (launch.force_mosaic) — export
+runs jax lowering only; the Mosaic->TPU-binary compile still happens
+on-device at first call, hitting the persistent compile cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+DEFAULT_DIR = os.environ.get(
+    "LODESTAR_TPU_EXPORT_CACHE", "/tmp/lodestar_tpu_export_cache"
+)
+
+# in-process cache of deserialized/exported entries
+_LOADED: Dict[str, object] = {}
+
+
+def _code_fingerprint() -> str:
+    """Hash of every kernels/*.py source file: a kernel edit invalidates
+    all artifacts (they embed the traced computation)."""
+    h = hashlib.sha256()
+    pkg = pathlib.Path(__file__).parent
+    for p in sorted(pkg.glob("*.py")):
+        if p.name == "export_cache.py":
+            continue  # this module does not affect traced computations
+        h.update(p.read_bytes())
+    return h.hexdigest()[:16]
+
+
+_FINGERPRINT: Optional[str] = None
+
+
+def code_fingerprint() -> str:
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        _FINGERPRINT = _code_fingerprint()
+    return _FINGERPRINT
+
+
+def artifact_key(
+    name: str, specs: Sequence[jax.ShapeDtypeStruct], platform: str
+) -> str:
+    sig = ";".join(f"{tuple(s.shape)}:{s.dtype}" for s in specs)
+    raw = f"{name}|{sig}|{platform}|{jax.__version__}|{code_fingerprint()}"
+    return (
+        name
+        + "-"
+        + platform
+        + "-"
+        + hashlib.sha256(raw.encode()).hexdigest()[:20]
+    )
+
+
+def _path(key: str, cache_dir: Optional[str]) -> pathlib.Path:
+    d = pathlib.Path(cache_dir or DEFAULT_DIR)
+    d.mkdir(parents=True, exist_ok=True)
+    return d / f"{key}.jaxexport"
+
+
+def load(
+    name: str,
+    specs: Sequence[jax.ShapeDtypeStruct],
+    platform: str,
+    cache_dir: Optional[str] = None,
+) -> Optional[Callable]:
+    """Deserialize a cached artifact; None when absent/stale."""
+    from jax import export as jexport
+
+    key = artifact_key(name, specs, platform)
+    hit = _LOADED.get(key)
+    if hit is not None:
+        return hit.call
+    path = _path(key, cache_dir)
+    if not path.exists():
+        return None
+    try:
+        exp = jexport.deserialize(path.read_bytes())
+    except Exception:  # stale/corrupt artifact: re-trace
+        return None
+    _LOADED[key] = exp
+    return exp.call
+
+
+def export_and_save(
+    name: str,
+    fn: Callable,
+    specs: Sequence[jax.ShapeDtypeStruct],
+    platform: str,
+    cache_dir: Optional[str] = None,
+) -> Callable:
+    """Trace `fn` for `platform` at `specs`, persist, return the call.
+
+    For platform="tpu" on a CPU host the pallas launches are forced
+    through the real Mosaic lowering (launch.force_mosaic)."""
+    from jax import export as jexport
+
+    from . import launch
+
+    key = artifact_key(name, specs, platform)
+    jitted = jax.jit(fn)
+    if platform == "tpu" and jax.default_backend() != "tpu":
+        with launch.force_mosaic():
+            exp = jexport.export(jitted, platforms=[platform])(*specs)
+    else:
+        exp = jexport.export(jitted, platforms=[platform])(*specs)
+    _path(key, cache_dir).write_bytes(exp.serialize())
+    _LOADED[key] = exp
+    return exp.call
+
+
+def load_or_export(
+    name: str,
+    fn: Callable,
+    specs: Sequence[jax.ShapeDtypeStruct],
+    platform: Optional[str] = None,
+    cache_dir: Optional[str] = None,
+) -> Callable:
+    """The main entry: cached call if present, else trace+persist."""
+    platform = platform or jax.default_backend()
+    cached = load(name, specs, platform, cache_dir)
+    if cached is not None:
+        return cached
+    return export_and_save(name, fn, specs, platform, cache_dir)

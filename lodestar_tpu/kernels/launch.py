@@ -18,8 +18,30 @@ from jax.experimental import pallas as pl
 
 _CACHE: Dict[Tuple, Callable] = {}
 
+# When True, launches lower through the REAL Mosaic path regardless of
+# the host backend — the AOT export cache sets this while tracing a
+# TPU-platform artifact on a CPU host (kernels/export_cache.py).
+_FORCE_MOSAIC = False
+
+
+class force_mosaic:
+    """Context manager: lower pallas launches for the real TPU backend
+    even when the process default backend is CPU (cross-platform
+    jax.export)."""
+
+    def __enter__(self):
+        global _FORCE_MOSAIC
+        self._prev = _FORCE_MOSAIC
+        _FORCE_MOSAIC = True
+
+    def __exit__(self, *exc):
+        global _FORCE_MOSAIC
+        _FORCE_MOSAIC = self._prev
+
 
 def interpret() -> bool:
+    if _FORCE_MOSAIC:
+        return False
     return jax.default_backend() != "tpu"
 
 

@@ -283,11 +283,9 @@ class FullBeaconNode:
                 except Exception as e:  # noqa: BLE001 — relay down at
                     # boot: stay dark, the operator re-enables via API
                     self.log.warn("builder status check failed", error=str(e))
-            # the circuit breaker sees every slot (builder/http.ts
-            # fault window)
-            self.clock.on_slot(
-                lambda s, b=builder: getattr(b, "on_slot_success", lambda _s: None)(s)
-            )
+            # fault/success accounting happens at the produce/submit
+            # call sites (chain.produce_blinded_block /
+            # submit_blinded_block), not on a blind slot tick
         # terminal-PoW-block tracker (reference: eth1MergeBlockTracker
         # polled at SECONDS_PER_ETH1_BLOCK; here slot-clock driven)
         if opts.pow_provider is not None:

@@ -184,9 +184,96 @@ def get_attestation_participation_flag_indices(
     return flags
 
 
+def _attestation_sanity_checks(state, attestation: Dict) -> None:
+    """The fork-independent gossip/STF attestation preconditions (spec
+    process_attestation head, shared by the phase0 and altair paths)."""
+    data = attestation["data"]
+    current_epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = max(current_epoch - 1, params.GENESIS_EPOCH)
+    _require(
+        data["target"]["epoch"] in (previous_epoch, current_epoch),
+        "attestation target epoch out of range",
+    )
+    _require(
+        data["target"]["epoch"] == compute_epoch_at_slot(data["slot"]),
+        "target epoch != epoch of slot",
+    )
+    _require(
+        data["slot"] + P.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot,
+        "attestation too new",
+    )
+    _require(
+        state.slot <= data["slot"] + P.SLOTS_PER_EPOCH,
+        "attestation too old",
+    )
+    _require(
+        data["index"]
+        < get_committee_count_per_slot(state, data["target"]["epoch"]),
+        "committee index out of range",
+    )
+    committee = get_beacon_committee(state, data["slot"], data["index"])
+    _require(
+        len(attestation["aggregation_bits"]) == len(committee),
+        "aggregation bits length mismatch",
+    )
+
+
+def process_attestation_phase0(
+    state, attestation: Dict, verify_signatures: bool
+) -> None:
+    """phase0: append a PendingAttestation record; FFG source must match
+    the era's justified checkpoint (reference:
+    state-transition/src/block/processAttestationPhase0.ts:1)."""
+    data = attestation["data"]
+    current_epoch = compute_epoch_at_slot(state.slot)
+    _attestation_sanity_checks(state, attestation)
+    if data["target"]["epoch"] == current_epoch:
+        jcp = state.current_justified_checkpoint
+        book = state.current_epoch_attestations
+    else:
+        jcp = state.previous_justified_checkpoint
+        book = state.previous_epoch_attestations
+    _require(
+        data["source"]["epoch"] == jcp["epoch"]
+        and bytes(data["source"]["root"]) == bytes(jcp["root"]),
+        "attestation source does not match justified",
+    )
+    if verify_signatures:
+        attesting = get_attesting_indices(
+            state, data, attestation["aggregation_bits"]
+        )
+        _require(
+            is_valid_indexed_attestation(
+                state,
+                {
+                    "attesting_indices": attesting,
+                    "data": data,
+                    "signature": attestation["signature"],
+                },
+            ),
+            "invalid attestation signature",
+        )
+    book.append(
+        {
+            "aggregation_bits": list(attestation["aggregation_bits"]),
+            "data": {
+                **dict(data),
+                "source": dict(data["source"]),
+                "target": dict(data["target"]),
+            },
+            "inclusion_delay": int(state.slot) - int(data["slot"]),
+            "proposer_index": get_beacon_proposer_index(state),
+        }
+    )
+
+
 def process_attestation(
     state, attestation: Dict, verify_signatures: bool
 ) -> None:
+    if getattr(state, "previous_epoch_attestations", None) is not None:
+        return process_attestation_phase0(
+            state, attestation, verify_signatures
+        )
     data = attestation["data"]
     current_epoch = compute_epoch_at_slot(state.slot)
     previous_epoch = max(current_epoch - 1, params.GENESIS_EPOCH)
@@ -325,7 +412,11 @@ def is_valid_indexed_attestation(state, indexed: Dict) -> bool:
 def slash_validator(
     state, slashed_index: int, whistleblower_index: int = None
 ) -> None:
-    """Spec slash_validator (altair penalty quotients)."""
+    """Spec slash_validator; penalty quotient and the whistleblower
+    split are fork-scaled (phase0: quotient 128, proposer share
+    whistleblower//PROPOSER_REWARD_QUOTIENT; altair: quotient 64,
+    PROPOSER_WEIGHT/WEIGHT_DENOMINATOR)."""
+    phase0 = getattr(state, "previous_epoch_attestations", None) is not None
     epoch = compute_epoch_at_slot(state.slot)
     initiate_validator_exit(state, slashed_index)
     state.slashed[slashed_index] = True
@@ -335,19 +426,25 @@ def slash_validator(
     )
     eff = int(state.effective_balance[slashed_index])
     state.slashings[epoch % P.EPOCHS_PER_SLASHINGS_VECTOR] += np.uint64(eff)
-    state.decrease_balance(
-        slashed_index, eff // P.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    min_quotient = (
+        2 * P.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR  # phase0 = 128
+        if phase0
+        else P.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
     )
+    state.decrease_balance(slashed_index, eff // min_quotient)
 
     proposer_index = get_beacon_proposer_index(state)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
     whistleblower_reward = eff // P.WHISTLEBLOWER_REWARD_QUOTIENT
-    proposer_reward = (
-        whistleblower_reward
-        * params.PROPOSER_WEIGHT
-        // params.WEIGHT_DENOMINATOR
-    )
+    if phase0:
+        proposer_reward = whistleblower_reward // P.PROPOSER_REWARD_QUOTIENT
+    else:
+        proposer_reward = (
+            whistleblower_reward
+            * params.PROPOSER_WEIGHT
+            // params.WEIGHT_DENOMINATOR
+        )
     state.increase_balance(proposer_index, proposer_reward)
     state.increase_balance(
         whistleblower_index, whistleblower_reward - proposer_reward

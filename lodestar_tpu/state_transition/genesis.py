@@ -115,14 +115,21 @@ def create_genesis_state(
         "current_version": version,
         "epoch": params.GENESIS_EPOCH,
     }
+    phase0_genesis = fork_name == params.ForkName.phase0
+    if phase0_genesis:
+        from ..types import BeaconBlockBody as _BodyPhase0
+
+        body_root = _BodyPhase0.hash_tree_root(_BodyPhase0.default())
+    else:
+        body_root = BeaconBlockBodyAltair.hash_tree_root(
+            BeaconBlockBodyAltair.default()
+        )
     state.latest_block_header = {
         "slot": 0,
         "proposer_index": 0,
         "parent_root": b"\x00" * 32,
         "state_root": b"\x00" * 32,
-        "body_root": BeaconBlockBodyAltair.hash_tree_root(
-            BeaconBlockBodyAltair.default()
-        ),
+        "body_root": body_root,
     }
     state.eth1_data = {
         "deposit_root": b"\x00" * 32,
@@ -166,6 +173,13 @@ def create_genesis_state(
         Validator, P.VALIDATOR_REGISTRY_LIMIT
     ).hash_tree_root(state.validators_value())
 
+    if phase0_genesis:
+        # PendingAttestation era: record lists instead of participation
+        # flags; sync committees do not exist yet (the altair upgrade
+        # computes them)
+        state.previous_epoch_attestations = []
+        state.current_epoch_attestations = []
+        return state
     committee = get_next_sync_committee(state)
     state.current_sync_committee = committee
     state.next_sync_committee = dict(committee)

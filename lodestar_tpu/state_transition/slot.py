@@ -44,7 +44,13 @@ def process_slots(state, slot: int, metrics: Optional[Dict] = None) -> None:
     while state.slot < slot:
         process_slot(state)
         if (state.slot + 1) % P.SLOTS_PER_EPOCH == 0:
-            process_epoch(state)
+            if state.previous_epoch_attestations is not None:
+                # PendingAttestation era (reference: phase0 processEpoch)
+                from .phase0 import process_epoch_phase0
+
+                process_epoch_phase0(state)
+            else:
+                process_epoch(state)
         state.slot += 1
         maybe_upgrade_state(state)
 
@@ -56,6 +62,17 @@ def maybe_upgrade_state(state) -> None:
     if state.slot % P.SLOTS_PER_EPOCH != 0:
         return
     epoch = state.slot // P.SLOTS_PER_EPOCH
+    altair_epoch = state.config.fork_epochs.get(ForkName.altair)
+    if (
+        altair_epoch is not None
+        and epoch == altair_epoch
+        and state.previous_epoch_attestations is not None
+    ):
+        # reference: slot/upgradeStateToAltair.ts (pending attestations
+        # translate into participation flags; sync committees start)
+        from .phase0 import upgrade_to_altair
+
+        upgrade_to_altair(state)
     bellatrix_epoch = state.config.fork_epochs.get(ForkName.bellatrix)
     if (
         bellatrix_epoch is not None

@@ -78,6 +78,29 @@ _altair_state_fields = (
 
 BeaconStateAltair = Container(_altair_state_fields, name="BeaconStateAltair")
 
+# phase0 replaces the participation/inactivity/sync tail with the
+# PendingAttestation record lists (reference: types/src/phase0/sszTypes.ts
+# BeaconState)
+from ..types import PendingAttestation as _PendingAttestation  # noqa: E402
+
+_PENDING_ATT_LIMIT = P.MAX_ATTESTATIONS * P.SLOTS_PER_EPOCH
+
+BeaconStatePhase0 = Container(
+    _altair_state_fields[:15]  # ... through slashings
+    + (
+        (
+            "previous_epoch_attestations",
+            SszList(_PendingAttestation, _PENDING_ATT_LIMIT),
+        ),
+        (
+            "current_epoch_attestations",
+            SszList(_PendingAttestation, _PENDING_ATT_LIMIT),
+        ),
+    )
+    + _altair_state_fields[17:21],  # justification bits + checkpoints
+    name="BeaconStatePhase0",
+)
+
 # bellatrix appends the execution-payload header
 # (reference: types/src/bellatrix/sszTypes.ts BeaconState)
 from ..types import ExecutionPayloadHeader as _ExecutionPayloadHeader  # noqa: E402
@@ -201,6 +224,10 @@ class BeaconState:
     next_sync_committee: Dict = field(
         default_factory=lambda: SyncCommittee.default()
     )
+    # phase0-era pending attestation records; None = altair or later
+    # (the altair upgrade translates them into participation flags)
+    previous_epoch_attestations: Optional[List[Dict]] = None
+    current_epoch_attestations: Optional[List[Dict]] = None
     # None = pre-bellatrix state; set by upgrade_to_bellatrix
     latest_execution_payload_header: Optional[Dict] = None
     # None = pre-capella state; set by upgrade_to_capella
@@ -218,7 +245,11 @@ class BeaconState:
         for name, v in self.config.fork_versions.items():
             if bytes(v) == version:
                 return name
-        return params.ForkName.altair
+        return (
+            params.ForkName.phase0
+            if self.previous_epoch_attestations is not None
+            else params.ForkName.altair
+        )
 
     def fork_at_least(self, fork: params.ForkName) -> bool:
         return params.FORK_SEQ[self.fork_name] >= params.FORK_SEQ[fork]
@@ -338,6 +369,13 @@ class BeaconState:
         out.finalized_checkpoint = dict(self.finalized_checkpoint)
         out.current_sync_committee = copy.deepcopy(self.current_sync_committee)
         out.next_sync_committee = copy.deepcopy(self.next_sync_committee)
+        if self.previous_epoch_attestations is not None:
+            out.previous_epoch_attestations = copy.deepcopy(
+                self.previous_epoch_attestations
+            )
+            out.current_epoch_attestations = copy.deepcopy(
+                self.current_epoch_attestations
+            )
         out.latest_execution_payload_header = copy.deepcopy(
             self.latest_execution_payload_header
         )
@@ -401,6 +439,23 @@ class BeaconState:
             "current_sync_committee": self.current_sync_committee,
             "next_sync_committee": self.next_sync_committee,
         }
+        if self.previous_epoch_attestations is not None:
+            # phase0 view: the pending-attestation lists replace the
+            # participation/inactivity/sync tail
+            out["previous_epoch_attestations"] = [
+                dict(a) for a in self.previous_epoch_attestations
+            ]
+            out["current_epoch_attestations"] = [
+                dict(a) for a in self.current_epoch_attestations
+            ]
+            for k in (
+                "previous_epoch_participation",
+                "current_epoch_participation",
+                "inactivity_scores",
+                "current_sync_committee",
+                "next_sync_committee",
+            ):
+                del out[k]
         if self.latest_execution_payload_header is not None:
             out["latest_execution_payload_header"] = (
                 self.latest_execution_payload_header
@@ -449,12 +504,24 @@ class BeaconState:
         st.balances = np.asarray(value["balances"], _U64)
         st.randao_mixes = list(value["randao_mixes"])
         st.slashings = np.asarray(value["slashings"], _U64)
-        st.previous_epoch_participation = np.asarray(
-            value["previous_epoch_participation"], np.uint8
-        )
-        st.current_epoch_participation = np.asarray(
-            value["current_epoch_participation"], np.uint8
-        )
+        n_val = len(vals)
+        if "previous_epoch_attestations" in value:
+            # phase0 value: pending lists in, flag columns defaulted
+            st.previous_epoch_attestations = [
+                dict(a) for a in value["previous_epoch_attestations"]
+            ]
+            st.current_epoch_attestations = [
+                dict(a) for a in value["current_epoch_attestations"]
+            ]
+            st.previous_epoch_participation = np.zeros(n_val, np.uint8)
+            st.current_epoch_participation = np.zeros(n_val, np.uint8)
+        else:
+            st.previous_epoch_participation = np.asarray(
+                value["previous_epoch_participation"], np.uint8
+            )
+            st.current_epoch_participation = np.asarray(
+                value["current_epoch_participation"], np.uint8
+            )
         st.justification_bits = list(value["justification_bits"])
         st.previous_justified_checkpoint = dict(
             value["previous_justified_checkpoint"]
@@ -463,9 +530,12 @@ class BeaconState:
             value["current_justified_checkpoint"]
         )
         st.finalized_checkpoint = dict(value["finalized_checkpoint"])
-        st.inactivity_scores = np.asarray(value["inactivity_scores"], _U64)
-        st.current_sync_committee = dict(value["current_sync_committee"])
-        st.next_sync_committee = dict(value["next_sync_committee"])
+        if "inactivity_scores" in value:
+            st.inactivity_scores = np.asarray(value["inactivity_scores"], _U64)
+            st.current_sync_committee = dict(value["current_sync_committee"])
+            st.next_sync_committee = dict(value["next_sync_committee"])
+        else:
+            st.inactivity_scores = np.zeros(n_val, _U64)
         if "latest_execution_payload_header" in value:
             st.latest_execution_payload_header = dict(
                 value["latest_execution_payload_header"]
@@ -491,7 +561,9 @@ class BeaconState:
             return BeaconStateCapella
         if seq >= params.FORK_SEQ[params.ForkName.bellatrix]:
             return BeaconStateBellatrix
-        return BeaconStateAltair
+        if seq >= params.FORK_SEQ[params.ForkName.altair]:
+            return BeaconStateAltair
+        return BeaconStatePhase0
 
     def _container(self):
         # Prefer the schema implied by the materialized fields over the
@@ -502,6 +574,8 @@ class BeaconState:
             return c if c in (BeaconStateCapella, BeaconStateDeneb) else BeaconStateCapella
         if self.latest_execution_payload_header is not None:
             return BeaconStateBellatrix
+        if self.previous_epoch_attestations is not None:
+            return BeaconStatePhase0
         return BeaconStateAltair
 
     @staticmethod

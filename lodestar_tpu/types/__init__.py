@@ -102,6 +102,16 @@ IndexedAttestation = Container(
     name="IndexedAttestation",
 )
 
+PendingAttestation = Container(
+    (
+        ("aggregation_bits", Bitlist(P.MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("inclusion_delay", Slot),
+        ("proposer_index", ValidatorIndex),
+    ),
+    name="PendingAttestation",
+)
+
 AggregateAndProof = Container(
     (
         ("aggregator_index", ValidatorIndex),

@@ -9,6 +9,10 @@ duties and produces/signs/submits attestations through the REST client
 """
 
 from .store import SlashingProtection, SlashingError, ValidatorStore  # noqa: F401
+from .proposer_config import (  # noqa: F401
+    ProposerConfig,
+    ProposerSettings,
+)
 from .doppelganger import (  # noqa: F401
     DoppelgangerDetected,
     DoppelgangerService,

@@ -52,6 +52,42 @@ class BlockProposalService:
                     "duty delayed: doppelganger watch", reason=str(e)
                 )
                 continue
+            # builder (blinded) flow when the key's proposer settings
+            # enable it and the node serves it; a builder fault falls
+            # back to local production (reference: block.ts
+            # produceBlockWrapper builder-vs-engine selection)
+            settings = self.store.proposer_settings(vindex)
+            if settings.builder_enabled and hasattr(
+                self.api, "produce_blinded_block"
+            ):
+                try:
+                    if self._propose_blinded(vindex, slot, randao_reveal):
+                        published += 1
+                        self.proposed += 1
+                        continue
+                except DoppelgangerUnverified as e:
+                    self.log.info(
+                        "duty delayed: doppelganger watch", reason=str(e)
+                    )
+                    continue
+                except SlashingError as e:
+                    # NEVER fall back after a slashing refusal — a local
+                    # re-sign for the same slot is the double-proposal
+                    # hazard itself
+                    self.skipped_slashable += 1
+                    self.log.warn(
+                        "refusing slashable proposal",
+                        validator=vindex,
+                        reason=str(e),
+                    )
+                    continue
+                except Exception as e:  # noqa: BLE001 — relay faults
+                    # must not cost the slot
+                    self.log.warn(
+                        "builder flow failed; falling back to local",
+                        validator=vindex,
+                        error=str(e),
+                    )
             block = self.api.produce_block_v2(
                 slot, randao_reveal, self.graffiti
             )
@@ -76,3 +112,17 @@ class BlockProposalService:
             published += 1
             self.proposed += 1
         return published
+
+    def _propose_blinded(self, vindex, slot, randao_reveal) -> bool:
+        """Blinded production + signing + publish; True on success.
+        Raises doppelganger/slashing errors through (they must not
+        trigger the local fallback: signing twice for one slot is the
+        exact hazard slashing protection exists for)."""
+        blinded = self.api.produce_blinded_block(
+            slot, randao_reveal, self.graffiti
+        )
+        signature = self.store.sign_blinded_block(vindex, blinded)
+        self.api.publish_blinded_block(
+            {"message": blinded, "signature": signature}
+        )
+        return True

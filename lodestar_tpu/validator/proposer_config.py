@@ -1,0 +1,95 @@
+"""Proposer settings file: per-key fee recipient / gas limit / builder.
+
+Mirror of the reference's proposerSettingsFile (reference:
+packages/validator/src/services/validatorStore.ts proposer config
+plumbing + cli proposerSettingsFile option).  Shape (YAML or JSON):
+
+    proposer_config:
+      '0x<pubkey>':
+        fee_recipient: '0x<20 bytes>'
+        gas_limit: "30000000"
+        builder:
+          enabled: true
+          gas_limit: "30000000"
+    default_config:
+      fee_recipient: '0x<20 bytes>'
+      builder:
+        enabled: false
+
+Per-key entries override the default; unspecified fields fall through.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+DEFAULT_GAS_LIMIT = 30_000_000
+
+
+@dataclass(frozen=True)
+class ProposerSettings:
+    fee_recipient: bytes = b"\x00" * 20
+    gas_limit: int = DEFAULT_GAS_LIMIT
+    builder_enabled: bool = False
+
+
+def _hex_bytes(v: str, length: int) -> bytes:
+    raw = bytes.fromhex(v[2:] if v.startswith("0x") else v)
+    if len(raw) != length:
+        raise ValueError(f"expected {length} bytes, got {len(raw)}")
+    return raw
+
+
+def _parse_entry(entry: dict, base: ProposerSettings) -> ProposerSettings:
+    fee = base.fee_recipient
+    if "fee_recipient" in entry:
+        fee = _hex_bytes(str(entry["fee_recipient"]), 20)
+    gas = base.gas_limit
+    builder_enabled = base.builder_enabled
+    if "gas_limit" in entry:
+        gas = int(entry["gas_limit"])
+    b = entry.get("builder") or {}
+    if "enabled" in b:
+        builder_enabled = bool(b["enabled"])
+    if "gas_limit" in b:
+        gas = int(b["gas_limit"])
+    return ProposerSettings(fee, gas, builder_enabled)
+
+
+class ProposerConfig:
+    """Resolved settings per pubkey with a default fallback."""
+
+    def __init__(
+        self,
+        default: Optional[ProposerSettings] = None,
+        per_key: Optional[Dict[bytes, ProposerSettings]] = None,
+    ):
+        self.default = default or ProposerSettings()
+        self.per_key = per_key or {}
+
+    def get(self, pubkey: bytes) -> ProposerSettings:
+        return self.per_key.get(bytes(pubkey), self.default)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ProposerConfig":
+        default = _parse_entry(
+            doc.get("default_config") or {}, ProposerSettings()
+        )
+        per_key = {}
+        for key, entry in (doc.get("proposer_config") or {}).items():
+            pk = _hex_bytes(str(key), 48)
+            per_key[pk] = _parse_entry(entry or {}, default)
+        return cls(default, per_key)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ProposerConfig":
+        """YAML or JSON (YAML is a JSON superset; yaml.safe_load reads
+        both — the reference accepts both extensions)."""
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        if not isinstance(doc, dict):
+            raise ValueError("proposer settings file must be a mapping")
+        return cls.from_dict(doc)

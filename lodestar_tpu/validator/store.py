@@ -189,8 +189,12 @@ class ValidatorStore:
         doppelganger=None,
         external_signer=None,
         remote_keys: Optional[Dict[int, bytes]] = None,
+        proposer_config=None,
     ):
         self.config = config
+        # per-key fee recipient / gas limit / builder flags (reference:
+        # validatorStore.ts proposer config; None = all defaults)
+        self.proposer_config = proposer_config
         self.sks = dict(secret_keys)  # validator index -> sk
         self.pubkeys = {
             i: C.g1_compress(B.sk_to_pk(sk)) for i, sk in self.sks.items()
@@ -341,11 +345,22 @@ class ValidatorStore:
         )
         return self._raw_sign(validator_index, root)
 
+    def proposer_settings(self, validator_index: int):
+        """Resolved proposer settings for the validator's pubkey
+        (reference: validatorStore.ts getFeeRecipient/getGasLimit/
+        isBuilderEnabled)."""
+        from .proposer_config import ProposerConfig, ProposerSettings
+
+        pk = self.pubkeys.get(validator_index)
+        if self.proposer_config is None or pk is None:
+            return ProposerSettings()
+        return self.proposer_config.get(pk)
+
     def sign_validator_registration(
         self,
         validator_index: int,
-        fee_recipient: bytes,
-        gas_limit: int = 30_000_000,
+        fee_recipient: Optional[bytes] = None,
+        gas_limit: Optional[int] = None,
         timestamp: int = 0,
     ) -> dict:
         """SignedValidatorRegistrationV1 for the relay (reference:
@@ -353,9 +368,14 @@ class ValidatorStore:
         domain 0x00000001 with the GENESIS fork version and a zero
         genesis_validators_root)."""
         pk = self.pubkeys[validator_index]
+        settings = self.proposer_settings(validator_index)
         message = {
-            "fee_recipient": bytes(fee_recipient),
-            "gas_limit": int(gas_limit),
+            "fee_recipient": bytes(
+                settings.fee_recipient if fee_recipient is None else fee_recipient
+            ),
+            "gas_limit": int(
+                settings.gas_limit if gas_limit is None else gas_limit
+            ),
             "timestamp": int(timestamp),
             "pubkey": pk,
         }

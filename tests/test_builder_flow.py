@@ -172,6 +172,30 @@ def test_builder_disabled_errors(world):
         chain.produce_blinded_block(P.SLOTS_PER_EPOCH + 5, b"\x00" * 96)
 
 
+def test_relay_faults_trip_breaker_through_chain(world):
+    """Repeated produce-time relay faults must disable the builder via
+    the circuit breaker (review r5: on_slot_fault had no callers)."""
+    from lodestar_tpu.execution import ExecutionBuilderHttp
+
+    cfg, sks, chain, store, el, proposer_at = world
+    builder = ExecutionBuilderHttp(
+        "http://127.0.0.1:1",  # nothing listens: every call faults
+        cfg,
+        timeout=0.05,
+        fault_inspection_window=params.SLOTS_PER_EPOCH,
+        allowed_faults=2,
+    )
+    builder.update_status(True)
+    chain.execution_builder = builder
+    base = P.SLOTS_PER_EPOCH + 8
+    for i in range(4):
+        with pytest.raises(Exception):
+            chain.produce_blinded_block(base + i, b"\x00" * 96)
+        if not builder.status:
+            break
+    assert not builder.status, "breaker must trip after allowed faults"
+
+
 def test_fault_window_circuit_breaker():
     w = _FaultWindow(window=params.SLOTS_PER_EPOCH, allowed=2)
     assert not w.record_fault(10)

@@ -1,0 +1,218 @@
+"""phase0 STF: PendingAttestation processing, epoch transition, upgrade.
+
+Reference behaviors: state-transition/src/block/
+processAttestationPhase0.ts (record append + FFG source check),
+epoch/getAttestationDeltas.ts (phase0 reward components), and
+slot/upgradeStateToAltair.ts (participation translation + sync
+committee bootstrap).  The VERDICT done-criterion: a chain started at
+phase0 crosses to altair in-test.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_committee,
+    get_block_root,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+    get_beacon_proposer_index,
+)
+from lodestar_tpu.state_transition.block import (
+    BlockProcessError,
+    process_attestation_phase0,
+)
+from lodestar_tpu.state_transition.phase0 import attesting_mask
+from lodestar_tpu.state_transition.slot import process_slots
+from lodestar_tpu.state_transition.state import BeaconState, BeaconStatePhase0
+from lodestar_tpu.state_transition.transition import state_transition
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+def make_cfg(altair_epoch=2):
+    return create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: altair_epoch}
+    )
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = make_cfg()
+    sks = [B.keygen(b"p0-%d" % i) for i in range(N_KEYS)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=7)
+    return cfg, sks, genesis
+
+
+def _attestations_for_slot(state, att_slot):
+    """Full-participation attestations for `att_slot` built on a state
+    advanced past it."""
+    out = []
+    epoch = att_slot // P.SLOTS_PER_EPOCH
+    current_epoch = state.slot // P.SLOTS_PER_EPOCH
+    source = (
+        state.current_justified_checkpoint
+        if epoch == current_epoch
+        else state.previous_justified_checkpoint
+    )
+    target_root = (
+        get_block_root(state, epoch)
+        if state.slot > epoch * P.SLOTS_PER_EPOCH
+        else get_block_root_at_slot(state, att_slot)
+    )
+    for ci in range(get_committee_count_per_slot(state, epoch)):
+        committee = get_beacon_committee(state, att_slot, ci)
+        out.append(
+            {
+                "aggregation_bits": [True] * len(committee),
+                "data": {
+                    "slot": att_slot,
+                    "index": ci,
+                    "beacon_block_root": get_block_root_at_slot(
+                        state, att_slot
+                    ),
+                    "source": dict(source),
+                    "target": {"epoch": epoch, "root": target_root},
+                },
+                "signature": b"\x00" * 96,
+            }
+        )
+    return out
+
+
+def _advance_with_blocks(cfg, state, to_slot):
+    """Import one (unverified-signature) block per slot, each carrying
+    full attestations for its parent slot."""
+    st = state
+    while st.slot < to_slot:
+        slot = st.slot + 1
+        pre = st.clone()
+        process_slots(pre, slot)
+        atts = (
+            _attestations_for_slot(pre, slot - 1)
+            if slot >= 1 + P.MIN_ATTESTATION_INCLUSION_DELAY
+            else []
+        )
+        from lodestar_tpu.chain.produce_block import produce_block
+
+        block, post = produce_block(
+            st, slot, b"\x00" * 96, attestations=atts
+        )
+        signed = {"message": block, "signature": b"\x00" * 96}
+        st = state_transition(
+            st,
+            signed,
+            verify_state_root=True,
+            verify_proposer=False,
+            verify_signatures=False,
+        )
+    return st
+
+
+def test_phase0_genesis_shape(world):
+    cfg, sks, genesis = world
+    assert genesis.fork_name == ForkName.phase0
+    assert genesis.previous_epoch_attestations == []
+    data = genesis.serialize()
+    back = BeaconState.deserialize(data, cfg)
+    assert back.fork_name == ForkName.phase0
+    assert back.previous_epoch_attestations == []
+    assert back.hash_tree_root() == genesis.hash_tree_root()
+    assert back.serialize() == data
+
+
+def test_pending_attestation_appended_and_source_checked(world):
+    cfg, sks, genesis = world
+    st = genesis.clone()
+    process_slots(st, 2)
+    atts = _attestations_for_slot(st, 1)
+    assert atts
+    process_attestation_phase0(st, atts[0], verify_signatures=False)
+    assert len(st.current_epoch_attestations) == 1
+    rec = st.current_epoch_attestations[0]
+    assert int(rec["inclusion_delay"]) == 1
+    assert int(rec["proposer_index"]) == get_beacon_proposer_index(st)
+    # wrong FFG source -> reject
+    bad = dict(atts[0])
+    bad["data"] = {
+        **atts[0]["data"],
+        "source": {"epoch": 0, "root": b"\x13" * 32},
+    }
+    with pytest.raises(BlockProcessError, match="source"):
+        process_attestation_phase0(st, bad, verify_signatures=False)
+
+
+def test_phase0_chain_justifies_and_crosses_to_altair(world):
+    """Two phase0 epochs of full participation justify epoch 1; the
+    scheduled upgrade translates participation and starts the sync
+    committees; an altair block then imports on top."""
+    cfg, sks, genesis = world
+    st = _advance_with_blocks(cfg, genesis, 2 * P.SLOTS_PER_EPOCH - 1)
+    assert st.fork_name == ForkName.phase0
+    # entering epoch 2 runs the phase0 epoch transition then upgrades
+    last_phase0 = st.clone()
+    process_slots(st, 2 * P.SLOTS_PER_EPOCH)
+    assert st.fork_name == ForkName.altair
+    assert st.previous_epoch_attestations is None
+    # participation translated: epoch-1 attesters carry the target flag
+    mask = attesting_mask(
+        last_phase0, last_phase0.current_epoch_attestations
+    )
+    flags = st.previous_epoch_participation
+    target_bit = 1 << params.TIMELY_TARGET_FLAG_INDEX
+    assert all(
+        (flags[i] & target_bit) != 0 for i in range(N_KEYS) if mask[i]
+    )
+    # sync committees bootstrapped
+    assert any(
+        bytes(pk) != b"\x00" * 48
+        for pk in st.current_sync_committee["pubkeys"]
+    )
+    # the state now serializes as altair
+    back = BeaconState.deserialize(st.serialize(), cfg)
+    assert back.fork_name == ForkName.altair
+    # and an altair block imports on top
+    st2 = _advance_with_blocks(cfg, st, 2 * P.SLOTS_PER_EPOCH + 2)
+    assert st2.slot == 2 * P.SLOTS_PER_EPOCH + 2
+    # the TRANSLATED phase0 participation feeds altair justification:
+    # crossing into epoch 3 weighs epoch-2 (altair) flags, but epoch-1's
+    # justification bit came from the phase0-era translation
+    st3 = _advance_with_blocks(cfg, st2, 3 * P.SLOTS_PER_EPOCH)
+    assert int(st3.current_justified_checkpoint["epoch"]) >= 1
+
+
+def test_phase0_rewards_full_participation_gain(world):
+    """Across an epoch boundary with full attestation coverage, active
+    validators' balances grow (phase0 get_attestation_deltas)."""
+    cfg, sks, genesis = world
+    st = _advance_with_blocks(cfg, genesis, P.SLOTS_PER_EPOCH - 1)
+    before = st.balances.copy()
+    process_slots(st, P.SLOTS_PER_EPOCH + 1)
+    import numpy as np
+
+    assert (st.balances.astype(np.int64) - before.astype(np.int64) >= 0).all()
+
+
+def test_phase0_spec_containers_roundtrip():
+    """PendingAttestation SSZ shape."""
+    rec = {
+        "aggregation_bits": [True, False, True],
+        "data": T.AttestationData.default(),
+        "inclusion_delay": 3,
+        "proposer_index": 7,
+    }
+    data = T.PendingAttestation.serialize(rec)
+    back = T.PendingAttestation.deserialize(data)
+    assert list(back["aggregation_bits"]) == [True, False, True]
+    assert int(back["inclusion_delay"]) == 3
+    assert int(back["proposer_index"]) == 7

@@ -1,0 +1,161 @@
+"""Proposer settings file: parsing, per-key overrides, builder routing.
+
+Reference behaviors: packages/validator/src/services/validatorStore.ts
+(getFeeRecipient/getGasLimit/isBuilderEnabled from the proposer config)
+and cli proposerSettingsFile loading; services/block.ts builder-vs-local
+production selection with safe fallback.
+"""
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.validator import (
+    BlockProposalService,
+    ProposerConfig,
+    ProposerSettings,
+    ValidatorStore,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def keys():
+    sks = [B.keygen(b"pc-%d" % i) for i in range(3)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    return sks, pks
+
+
+def _cfg():
+    return create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+
+
+def test_file_parsing_yaml_and_json(tmp_path, keys):
+    sks, pks = keys
+    yaml_doc = f"""
+proposer_config:
+  '0x{pks[0].hex()}':
+    fee_recipient: '0x{'aa' * 20}'
+    builder:
+      enabled: true
+      gas_limit: "25000000"
+default_config:
+  fee_recipient: '0x{'bb' * 20}'
+  gas_limit: "30000000"
+"""
+    f = tmp_path / "proposer.yaml"
+    f.write_text(yaml_doc)
+    pc = ProposerConfig.from_file(str(f))
+    s0 = pc.get(pks[0])
+    assert s0.fee_recipient == b"\xaa" * 20
+    assert s0.builder_enabled and s0.gas_limit == 25_000_000
+    s1 = pc.get(pks[1])  # falls to default
+    assert s1.fee_recipient == b"\xbb" * 20
+    assert not s1.builder_enabled and s1.gas_limit == 30_000_000
+
+    import json
+
+    jf = tmp_path / "proposer.json"
+    jf.write_text(
+        json.dumps(
+            {
+                "default_config": {"fee_recipient": "0x" + "cc" * 20},
+                "proposer_config": {
+                    "0x" + pks[2].hex(): {"builder": {"enabled": True}}
+                },
+            }
+        )
+    )
+    pc2 = ProposerConfig.from_file(str(jf))
+    assert pc2.get(pks[2]).builder_enabled
+    # per-key entry inherits the default fee recipient
+    assert pc2.get(pks[2]).fee_recipient == b"\xcc" * 20
+
+
+def test_registration_uses_settings(keys):
+    sks, pks = keys
+    pc = ProposerConfig(
+        default=ProposerSettings(b"\xdd" * 20, 20_000_000, True)
+    )
+    store = ValidatorStore(_cfg(), dict(enumerate(sks)), proposer_config=pc)
+    reg = store.sign_validator_registration(0, timestamp=1)
+    assert bytes(reg["message"]["fee_recipient"]) == b"\xdd" * 20
+    assert int(reg["message"]["gas_limit"]) == 20_000_000
+    # explicit args override the config
+    reg2 = store.sign_validator_registration(
+        1, fee_recipient=b"\xee" * 20, gas_limit=1, timestamp=1
+    )
+    assert bytes(reg2["message"]["fee_recipient"]) == b"\xee" * 20
+    assert int(reg2["message"]["gas_limit"]) == 1
+
+
+class _ApiSpy:
+    """A fake node API tracking which production path ran."""
+
+    def __init__(self, duties, blinded_fails=False):
+        self._duties = duties
+        self.blinded_fails = blinded_fails
+        self.blinded_produced = 0
+        self.blinded_published = 0
+        self.full_published = 0
+
+    def get_proposer_duties(self, epoch):
+        return self._duties
+
+    def produce_blinded_block(self, slot, reveal, graffiti):
+        if self.blinded_fails:
+            raise RuntimeError("relay down")
+        self.blinded_produced += 1
+        return {"slot": slot, "proposer_index": self._duties[0]["validator_index"], "body": {}}
+
+    def publish_blinded_block(self, signed):
+        self.blinded_published += 1
+
+    def produce_block_v2(self, slot, reveal, graffiti):
+        return {"slot": slot, "proposer_index": self._duties[0]["validator_index"], "body": {}}
+
+    def publish_block(self, signed):
+        self.full_published += 1
+
+
+def test_builder_enabled_key_routes_blinded(keys, monkeypatch):
+    sks, pks = keys
+    pc = ProposerConfig(default=ProposerSettings(builder_enabled=True))
+    store = ValidatorStore(_cfg(), {0: sks[0]}, proposer_config=pc)
+    # block dicts here are stubs: bypass real signing
+    monkeypatch.setattr(store, "sign_blinded_block", lambda v, b: b"\x01" * 96)
+    monkeypatch.setattr(store, "sign_block", lambda v, b: b"\x02" * 96)
+    api = _ApiSpy([{"validator_index": 0, "slot": 5}])
+    svc = BlockProposalService(store, api)
+    svc.poll_duties(0)
+    assert svc.run_block_tasks(0, 5) == 1
+    assert api.blinded_published == 1 and api.full_published == 0
+
+
+def test_builder_fault_falls_back_to_local(keys, monkeypatch):
+    sks, pks = keys
+    pc = ProposerConfig(default=ProposerSettings(builder_enabled=True))
+    store = ValidatorStore(_cfg(), {0: sks[0]}, proposer_config=pc)
+    monkeypatch.setattr(store, "sign_block", lambda v, b: b"\x02" * 96)
+    api = _ApiSpy([{"validator_index": 0, "slot": 6}], blinded_fails=True)
+    svc = BlockProposalService(store, api)
+    svc.poll_duties(0)
+    assert svc.run_block_tasks(0, 6) == 1
+    assert api.blinded_published == 0 and api.full_published == 1
+
+
+def test_builder_disabled_key_stays_local(keys, monkeypatch):
+    sks, pks = keys
+    store = ValidatorStore(_cfg(), {0: sks[0]})  # no proposer config
+    monkeypatch.setattr(store, "sign_block", lambda v, b: b"\x02" * 96)
+    api = _ApiSpy([{"validator_index": 0, "slot": 7}])
+    svc = BlockProposalService(store, api)
+    svc.poll_duties(0)
+    assert svc.run_block_tasks(0, 7) == 1
+    assert api.blinded_produced == 0 and api.full_published == 1
