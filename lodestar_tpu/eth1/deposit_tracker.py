@@ -180,12 +180,112 @@ class Eth1DepositDataTracker:
     production (reference eth1DepositDataTracker.ts
     getEth1DataAndDeposits)."""
 
-    def __init__(self, provider: Eth1Provider):
+    def __init__(self, provider: Eth1Provider, db=None):
         self.provider = provider
         self.deposits = Eth1DepositsCache()
         self.data_cache = Eth1DataCache()
         self.last_processed_block = -1
         self.log = get_logger("eth1/tracker")
+        # persistence: deposit events / roots / eth1 data survive
+        # restarts through the BeaconDb repositories (reference:
+        # db/repositories/{depositEvent,depositDataRoot,eth1Data}.ts)
+        self.db = db
+        if db is not None:
+            self._restore()
+
+    # -- persistence (reference: eth1DepositDataTracker resumes from db) ---
+
+    @staticmethod
+    def _u64(v: int) -> bytes:
+        return int(v).to_bytes(8, "big")
+
+    def _restore(self) -> None:
+        """Rebuild caches from the db on boot; the provider fills in
+        only what happened after the last persisted block."""
+        import json
+
+        events = []
+        for key in self.db.deposit_event.keys():
+            raw = self.db.deposit_event.get(key)
+            d = json.loads(raw)
+            events.append(
+                DepositEvent(
+                    index=d["index"],
+                    block_number=d["block_number"],
+                    pubkey=bytes.fromhex(d["pubkey"]),
+                    withdrawal_credentials=bytes.fromhex(d["wc"]),
+                    amount=d["amount"],
+                    signature=bytes.fromhex(d["signature"]),
+                )
+            )
+        events.sort(key=lambda e: e.index)
+        if events:
+            self.deposits.add(events)
+            self.last_processed_block = max(e.block_number for e in events)
+        for key in self.db.eth1_data.keys():
+            raw = self.db.eth1_data.get(key)
+            d = json.loads(raw)
+            ts = int.from_bytes(key, "big")
+            self.data_cache.add(
+                ts,
+                {
+                    "deposit_root": bytes.fromhex(d["deposit_root"]),
+                    "deposit_count": d["deposit_count"],
+                    "block_hash": bytes.fromhex(d["block_hash"]),
+                },
+            )
+            self.last_processed_block = max(
+                self.last_processed_block, d.get("block_number", -1)
+            )
+        if events or self.data_cache.by_timestamp:
+            self.log.info(
+                "eth1 state restored",
+                deposits=len(events),
+                last_block=self.last_processed_block,
+            )
+
+    def _persist_events(self, events) -> None:
+        if self.db is None:
+            return
+        import json
+
+        for ev in events:
+            self.db.deposit_event.put(
+                self._u64(ev.index),
+                json.dumps(
+                    {
+                        "index": ev.index,
+                        "block_number": ev.block_number,
+                        "pubkey": ev.pubkey.hex(),
+                        "wc": ev.withdrawal_credentials.hex(),
+                        "amount": ev.amount,
+                        "signature": ev.signature.hex(),
+                    }
+                ).encode(),
+            )
+            from ..types import DepositDataType
+
+            self.db.deposit_data_root.put(
+                self._u64(ev.index),
+                DepositDataType.hash_tree_root(ev.deposit_data()),
+            )
+
+    def _persist_eth1_data(self, timestamp: int, data: dict, block_number: int) -> None:
+        if self.db is None:
+            return
+        import json
+
+        self.db.eth1_data.put(
+            self._u64(timestamp),
+            json.dumps(
+                {
+                    "deposit_root": bytes(data["deposit_root"]).hex(),
+                    "deposit_count": int(data["deposit_count"]),
+                    "block_hash": bytes(data["block_hash"]).hex(),
+                    "block_number": block_number,
+                }
+            ).encode(),
+        )
 
     def update(self) -> int:
         """Ingest new blocks/deposits up to the follow distance.
@@ -208,17 +308,17 @@ class Eth1DepositDataTracker:
         for number in range(self.last_processed_block + 1, target + 1):
             if number in by_block:
                 self.deposits.add(by_block[number])
+                self._persist_events(by_block[number])
             blk = self.provider.get_block_by_number(number)
             if blk is None:
                 continue
-            self.data_cache.add(
-                blk.timestamp,
-                {
-                    "deposit_root": self.deposits.tree.root(),
-                    "deposit_count": len(self.deposits.events),
-                    "block_hash": blk.block_hash,
-                },
-            )
+            data = {
+                "deposit_root": self.deposits.tree.root(),
+                "deposit_count": len(self.deposits.events),
+                "block_hash": blk.block_hash,
+            }
+            self.data_cache.add(blk.timestamp, data)
+            self._persist_eth1_data(blk.timestamp, data, number)
             ingested += 1
         self.last_processed_block = target
         return ingested

@@ -153,3 +153,40 @@ def test_eth1_vote_majority(tracker_world):
     # out-of-range cache: falls back to the state's eth1_data
     empty = Eth1DataCache()
     assert get_eth1_vote(state, empty) == state.eth1_data
+
+
+def test_tracker_persistence_roundtrip(tracker_world):
+    """Deposit events + eth1 data survive a restart through the db
+    repositories; the restored tracker serves identical roots without
+    the provider re-serving history (reference:
+    db/repositories/{depositEvent,depositDataRoot,eth1Data}.ts)."""
+    from lodestar_tpu.db import BeaconDb
+
+    cfg, _tracker, events = tracker_world
+    db = BeaconDb()
+    provider = MockProvider(head=ETH1_FOLLOW_DISTANCE + 100, events=events)
+    t1 = Eth1DepositDataTracker(provider, db=db)
+    assert t1.update() > 0
+    root1 = t1.deposits.tree.root()
+
+    # "restart": a fresh tracker over the same db and a DEAD provider
+    class DeadProvider:
+        def get_block_number(self):
+            return 0  # nothing new
+
+        def get_block_by_number(self, number):
+            raise AssertionError("restore must not hit the provider")
+
+        def get_deposit_events(self, a, b):
+            raise AssertionError("restore must not hit the provider")
+
+    t2 = Eth1DepositDataTracker(DeadProvider(), db=db)
+    assert t2.deposits.highest_index == t1.deposits.highest_index
+    assert t2.deposits.tree.root() == root1
+    assert t2.last_processed_block >= 100
+    assert len(t2.data_cache.by_timestamp) == len(t1.data_cache.by_timestamp)
+    # persisted deposit data roots match the SSZ of the events
+    from lodestar_tpu.types import DepositDataType
+
+    stored = db.deposit_data_root.get((0).to_bytes(8, "big"))
+    assert stored == DepositDataType.hash_tree_root(events[0].deposit_data())
