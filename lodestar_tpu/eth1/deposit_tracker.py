@@ -199,14 +199,19 @@ class Eth1DepositDataTracker:
     def _u64(v: int) -> bytes:
         return int(v).to_bytes(8, "big")
 
+    # the follow cursor is persisted explicitly: deriving it from the
+    # max persisted block would re-scan deposit-less tail ranges on
+    # every restart (review r5)
+    _CURSOR_KEY = b"last_processed_block"
+
     def _restore(self) -> None:
-        """Rebuild caches from the db on boot; the provider fills in
-        only what happened after the last persisted block."""
+        """Rebuild caches from the db on boot (one ordered range scan
+        per repository); the provider fills in only what happened after
+        the persisted cursor."""
         import json
 
         events = []
-        for key in self.db.deposit_event.keys():
-            raw = self.db.deposit_event.get(key)
+        for _key, raw in self.db.deposit_event.entries():
             d = json.loads(raw)
             events.append(
                 DepositEvent(
@@ -222,8 +227,9 @@ class Eth1DepositDataTracker:
         if events:
             self.deposits.add(events)
             self.last_processed_block = max(e.block_number for e in events)
-        for key in self.db.eth1_data.keys():
-            raw = self.db.eth1_data.get(key)
+        for key, raw in self.db.eth1_data.entries():
+            if key == self._CURSOR_KEY:
+                continue
             d = json.loads(raw)
             ts = int.from_bytes(key, "big")
             self.data_cache.add(
@@ -237,6 +243,11 @@ class Eth1DepositDataTracker:
             self.last_processed_block = max(
                 self.last_processed_block, d.get("block_number", -1)
             )
+        cursor = self.db.eth1_data.get(self._CURSOR_KEY)
+        if cursor is not None:
+            self.last_processed_block = max(
+                self.last_processed_block, int(cursor)
+            )
         if events or self.data_cache.by_timestamp:
             self.log.info(
                 "eth1 state restored",
@@ -249,26 +260,35 @@ class Eth1DepositDataTracker:
             return
         import json
 
-        for ev in events:
-            self.db.deposit_event.put(
-                self._u64(ev.index),
-                json.dumps(
-                    {
-                        "index": ev.index,
-                        "block_number": ev.block_number,
-                        "pubkey": ev.pubkey.hex(),
-                        "wc": ev.withdrawal_credentials.hex(),
-                        "amount": ev.amount,
-                        "signature": ev.signature.hex(),
-                    }
-                ).encode(),
-            )
-            from ..types import DepositDataType
+        from ..types import DepositDataType
 
-            self.db.deposit_data_root.put(
-                self._u64(ev.index),
-                DepositDataType.hash_tree_root(ev.deposit_data()),
-            )
+        self.db.deposit_event.batch_put(
+            [
+                (
+                    self._u64(ev.index),
+                    json.dumps(
+                        {
+                            "index": ev.index,
+                            "block_number": ev.block_number,
+                            "pubkey": ev.pubkey.hex(),
+                            "wc": ev.withdrawal_credentials.hex(),
+                            "amount": ev.amount,
+                            "signature": ev.signature.hex(),
+                        }
+                    ).encode(),
+                )
+                for ev in events
+            ]
+        )
+        self.db.deposit_data_root.batch_put(
+            [
+                (
+                    self._u64(ev.index),
+                    DepositDataType.hash_tree_root(ev.deposit_data()),
+                )
+                for ev in events
+            ]
+        )
 
     def _persist_eth1_data(self, timestamp: int, data: dict, block_number: int) -> None:
         if self.db is None:
@@ -321,6 +341,10 @@ class Eth1DepositDataTracker:
             self._persist_eth1_data(blk.timestamp, data, number)
             ingested += 1
         self.last_processed_block = target
+        if self.db is not None:
+            self.db.eth1_data.put(
+                self._CURSOR_KEY, str(target).encode()
+            )
         return ingested
 
     def get_eth1_data_and_deposits(self, state) -> dict:
