@@ -14,10 +14,18 @@ Usage:
   python dev/export_pipeline.py [tpu|cpu]      (default: tpu)
 """
 
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# the sharded export needs 8 virtual devices; must precede backend init
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +41,6 @@ PLATFORM = sys.argv[1] if len(sys.argv) > 1 else "tpu"
 def capture_bench_dispatches():
     """Build the bench world and record every device dispatch the
     verifier would make for its job shapes."""
-    import os
-
     os.environ.setdefault("BENCH_PLATFORM", "cpu")
     from lodestar_tpu.bls.pubkey_table import PubkeyTable
     from lodestar_tpu.bls.signature_set import WireSignatureSet
@@ -87,8 +93,58 @@ def capture_bench_dispatches():
     return captured
 
 
+def export_sharded_program(n_devices: int = 8):
+    """Trace + export the PRODUCTION sharded wire verifier over an
+    n-device mesh for the TPU platform.  The dryrun validates this
+    artifact loads (kernels path certified to trace + Mosaic-lower +
+    SPMD-partition) without paying the XLA:CPU compile pathology."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from lodestar_tpu.kernels import verify as KV
+
+    devices = np.array(jax.devices()[:n_devices])
+    if devices.size < n_devices:
+        raise SystemExit(f"need {n_devices} virtual devices")
+    mesh = Mesh(devices, ("sets",))
+    n = KV.BT * n_devices
+    NL = KV.NL
+    i32 = jnp.int32
+
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, i32)
+
+    # the 13 positional args of make_sharded_wire_verifier (global
+    # shapes; see KV.wire_shard_specs)
+    specs = [
+        sds((NL, n)), sds((NL, n)),          # table planes (capacity=n)
+        jax.ShapeDtypeStruct((n, 1), i32),    # idx
+        jax.ShapeDtypeStruct((n, 1), i32),    # kmask
+        sds((NL, n)), sds((NL, n)), sds((NL, n)), sds((NL, n)),  # msg
+        sds((NL, n)), sds((NL, n)),          # sig_x0/x1
+        sds((2, n)),                          # sig_flags
+        sds((2, n)),                          # rwords
+        jax.ShapeDtypeStruct((n,), i32),      # valid
+    ]
+    sharded = KV.make_sharded_wire_verifier(mesh)
+    t1 = time.time()
+    call = EC.load_or_export(
+        f"sharded_wire_{n_devices}dev", sharded, specs, platform="tpu"
+    )
+    print(
+        f"sharded program ({n_devices} devices) exported for tpu in "
+        f"{time.time() - t1:.1f}s"
+    )
+    return call
+
+
 def main():
     t0 = time.time()
+    if os.environ.get("EXPORT_SHARDED", "1") != "0" and PLATFORM == "tpu":
+        try:
+            export_sharded_program(8)
+        except Exception as e:  # noqa: BLE001
+            print(f"sharded export failed: {type(e).__name__}: {e}")
     captured = capture_bench_dispatches()
     seen = set()
     for name, fn, specs in captured:
