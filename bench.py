@@ -257,6 +257,24 @@ def main_wire():
     verifier = TpuBlsVerifier(table, max_job_sets=BATCH)
     t_build = time.perf_counter() - t_build0
 
+    # AOT export status: pre-traced artifacts collapse the ~10-minute
+    # per-process trace into a millisecond deserialize (export_cache.py)
+    try:
+        import pathlib
+
+        from lodestar_tpu.kernels import export_cache as EC
+
+        n_artifacts = len(
+            list(pathlib.Path(EC.DEFAULT_DIR).glob("*.jaxexport"))
+        )
+        print(
+            f"# export cache: enabled={verifier._use_export} "
+            f"artifacts_on_disk={n_artifacts} dir={EC.DEFAULT_DIR}",
+            file=sys.stderr,
+        )
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
+
     # Warm-up / compile on the throwaway job (its own roots, so the timed
     # region still pays its own hash-to-curve batches).
     t_warm0 = time.perf_counter()

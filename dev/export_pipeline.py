@@ -69,6 +69,12 @@ def capture_bench_dispatches():
         sets.append(WireSignatureSet.single(j, root, sig_cache[(key, root)]))
 
     verifier = TpuBlsVerifier(table, max_job_sets=BATCH)
+    # host-side hashing for the capture: the device hash kernel would
+    # drag XLA:CPU into a pathological compile (measured: >25 min for
+    # jit_hash_to_g2_device on this host) and the capture needs VALUES,
+    # not device performance
+    verifier.messages.use_device = False
+    verifier._use_export = False  # dispatches are captured, not exported
     captured = []
 
     def fake_call(name, fn, args):
