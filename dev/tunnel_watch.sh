@@ -1,9 +1,12 @@
 #!/bin/bash
 # Poll the axon remote-compile endpoint; when it accepts a trivial pallas
-# compile, run the remaining verify-pipeline stage probes (resumable dev
-# tool for the flaky tunnel — execution can be up while compiles are not).
+# compile, use the window in VALUE ORDER: the headline bench first (its
+# host-side trace is now seconds via the AOT export cache — the window
+# only needs to pay the on-chip Mosaic/XLA compiles, which the
+# persistent cache then keeps), then the stage probes, then the int32
+# bisect microbench.  Resumable: finished steps replay from caches.
 LOG=/tmp/tunnel_watch.log
-PROBE_LOG=/tmp/probe_r4b.log
+PROBE_LOG=/tmp/probe_r5.log
 while true; do
   ts=$(date +%H:%M:%S)
   timeout 120 python - <<'EOF' >/dev/null 2>&1
@@ -14,18 +17,16 @@ f = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))
 assert int(f(jnp.zeros((8, 128), jnp.int32))[0, 0]) == 1
 EOF
   if [ $? -eq 0 ]; then
-    echo "$ts COMPILE OK — running stage probes" >> "$LOG"
-    # the cost-anomaly bisect first (small, answers the big question)
-    timeout 1800 python dev/microbench_int32.py > /tmp/microbench_int32.log 2>&1
-    echo "$ts int32 bisect done rc=$?" >> "$LOG"
-    # full stage list: finished stages replay from the persistent cache
-    python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
-    echo "$ts probes done rc=$?" >> "$LOG"
-    # pre-warm the bench's exact compile shapes so the driver-window
-    # bench run hits the persistent cache instead of cold-compiling
+    echo "$ts COMPILE OK — bench first (trace served by export cache)" >> "$LOG"
     BENCH_DEADLINE=3300 timeout 3400 python bench.py \
       > /tmp/bench_warm.json 2>/tmp/bench_warm.log
-    echo "$ts bench warm rc=$? $(cat /tmp/bench_warm.json)" >> "$LOG"
+    echo "$ts bench rc=$? $(cat /tmp/bench_warm.json)" >> "$LOG"
+    # per-stage on-chip timings (finished stages replay from cache)
+    timeout 1800 python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
+    echo "$ts probes done rc=$?" >> "$LOG"
+    # the 30x field-layer anomaly bisect
+    timeout 1800 python dev/microbench_int32.py > /tmp/microbench_int32.log 2>&1
+    echo "$ts int32 bisect done rc=$?" >> "$LOG"
     break
   fi
   echo "$ts compile unavailable" >> "$LOG"
