@@ -183,6 +183,31 @@ ROUTES: Tuple[Route, ...] = (
     Route("DELETE", "/eth/v1/keystores", "delete_keystores", auth=True),
     Route("GET", "/eth/v1/remotekeys", "list_remote_keys", auth=True),
     Route("DELETE", "/eth/v1/remotekeys", "delete_remote_keys", auth=True),
+    # per-key proposer settings (keymanager-API feerecipient/gas_limit)
+    Route(
+        "GET",
+        "/eth/v1/validator/{pubkey}/feerecipient",
+        "get_fee_recipient",
+        auth=True,
+    ),
+    Route(
+        "POST",
+        "/eth/v1/validator/{pubkey}/feerecipient",
+        "set_fee_recipient",
+        auth=True,
+    ),
+    Route(
+        "GET",
+        "/eth/v1/validator/{pubkey}/gas_limit",
+        "get_gas_limit",
+        auth=True,
+    ),
+    Route(
+        "POST",
+        "/eth/v1/validator/{pubkey}/gas_limit",
+        "set_gas_limit",
+        auth=True,
+    ),
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)

@@ -1672,6 +1672,113 @@ class DefaultHandlers:
                 statuses.append({"status": "deleted"})
         return 200, {"data": statuses}
 
+    # -- per-key proposer settings (keymanager-API feerecipient /
+    # gas_limit; reference: keymanager/routes.ts + validatorStore's
+    # runtime overrides over the proposer settings file) ------------------
+
+    def _km_pubkey(self, params):
+        from ..validator.proposer_config import _hex_bytes
+
+        pk = _hex_bytes(params["pubkey"], 48)
+        # the keymanager API answers 404 for keys this client does not
+        # manage — a silent 202 on a typo'd pubkey would let rewards
+        # keep flowing to the old recipient with no error (spec + the
+        # reference's keymanager impl)
+        if pk not in self.validator_store.pubkeys.values():
+            raise KeyError("pubkey not managed by this validator client")
+        return pk
+
+    def _km_settings(self, pk: bytes):
+        from ..validator.proposer_config import ProposerConfig
+
+        store = self.validator_store
+        if store.proposer_config is None:
+            store.proposer_config = ProposerConfig()
+        return store.proposer_config.get(pk)
+
+    def _km_update(self, pk: bytes, **changes):
+        import dataclasses
+
+        store = self.validator_store
+        cur = self._km_settings(pk)
+        store.proposer_config.per_key[bytes(pk)] = dataclasses.replace(
+            cur, **changes
+        )
+
+    def get_fee_recipient(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        try:
+            pk = self._km_pubkey(params)
+        except KeyError as e:
+            return 404, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        s = self._km_settings(pk)
+        return 200, {
+            "data": {
+                "pubkey": "0x" + pk.hex(),
+                "ethaddress": "0x" + s.fee_recipient.hex(),
+            }
+        }
+
+    def set_fee_recipient(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        try:
+            pk = self._km_pubkey(params)
+        except KeyError as e:
+            return 404, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        try:
+            from ..validator.proposer_config import _hex_bytes
+
+            raw = _hex_bytes((body or {})["ethaddress"], 20)
+        except (KeyError, ValueError, AttributeError) as e:
+            return 400, {"message": f"bad request: {e}"}
+        self._km_update(pk, fee_recipient=raw)
+        return 202, None
+
+    def get_gas_limit(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        try:
+            pk = self._km_pubkey(params)
+        except KeyError as e:
+            return 404, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        s = self._km_settings(pk)
+        return 200, {
+            "data": {
+                "pubkey": "0x" + pk.hex(),
+                "gas_limit": str(s.gas_limit),
+            }
+        }
+
+    def set_gas_limit(self, params, body):
+        err = self._need_store()
+        if err:
+            return err
+        try:
+            pk = self._km_pubkey(params)
+        except KeyError as e:
+            return 404, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        try:
+            gas = int((body or {})["gas_limit"])
+            if gas <= 0:
+                raise ValueError("gas_limit must be positive")
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"message": f"bad request: {e}"}
+        self._km_update(pk, gas_limit=gas)
+        return 202, None
+
 
 class BeaconApiServer:
     def __init__(self, handlers, host: str = "127.0.0.1", port: int = 0):

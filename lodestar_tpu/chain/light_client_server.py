@@ -35,14 +35,55 @@ def _block_header_value(block: dict) -> dict:
 
 
 class LightClientServer:
-    def __init__(self, chain):
+    def __init__(self, chain, db=None):
         self.chain = chain
         self.log = get_logger("chain/lightclient")
         self.best_update_by_period: Dict[int, LightClientUpdate] = {}
         self.latest_finality_update: Optional[LightClientUpdate] = None
         self.latest_optimistic_update: Optional[LightClientUpdate] = None
         self.produced = 0
+        # per-period best updates survive restarts (reference:
+        # db/repositories/lightclientBestUpdate.ts)
+        self.db = db if db is not None else getattr(chain, "db", None)
+        if self.db is not None and hasattr(
+            self.db, "light_client_best_update"
+        ):
+            self._restore()
         chain.emitter.on(ChainEvent.block, self.on_imported_block)
+
+    def _restore(self) -> None:
+        from ..network.reqresp_protocols import (
+            LightClientUpdateType,
+            light_client_update_from_value,
+        )
+
+        n = 0
+        for key, raw in self.db.light_client_best_update.entries():
+            period = int.from_bytes(key, "big")
+            value = LightClientUpdateType.deserialize(raw)
+            self.best_update_by_period[period] = (
+                light_client_update_from_value(value)
+            )
+            n += 1
+        if n:
+            self.log.info("light-client best updates restored", periods=n)
+
+    def _persist(self, period: int, update: LightClientUpdate) -> None:
+        if self.db is None or not hasattr(
+            self.db, "light_client_best_update"
+        ):
+            return
+        from ..network.reqresp_protocols import (
+            LightClientUpdateType,
+            light_client_update_to_value,
+        )
+
+        self.db.light_client_best_update.put(
+            int(period).to_bytes(8, "big"),
+            LightClientUpdateType.serialize(
+                light_client_update_to_value(update)
+            ),
+        )
 
     # -- production (reference: lightClient/index.ts onImportBlock) --------
 
@@ -123,6 +164,7 @@ class LightClientServer:
             sum(best.sync_committee_bits),
         ):
             self.best_update_by_period[period] = update
+            self._persist(period, update)
         self.latest_optimistic_update = update
         if finalized_header is not None:
             self.latest_finality_update = update

@@ -229,3 +229,32 @@ def test_transports_bootstrap_and_update(lc_world):
         assert lc2.optimistic_header["slot"] == opt.attested_header["slot"]
     finally:
         api.close()
+
+
+def test_best_updates_persist_across_restart(lc_world):
+    """Per-period best updates restore from the db on boot (reference:
+    db/repositories/lightclientBestUpdate.ts)."""
+    cfg, sks, pks, genesis, chain, server = lc_world
+    if not server.best_update_by_period:
+        # self-contained: produce a sync-aggregate block so an update
+        # exists even when this test runs standalone
+        signers = {pks[i]: sks[i] for i in range(len(sks))}
+        _import_block(
+            chain, cfg, sks, chain.head_state.slot + 1, sync_signers=signers
+        )
+        _import_block(
+            chain, cfg, sks, chain.head_state.slot + 1, sync_signers=signers
+        )
+    assert server.best_update_by_period, "no updates produced"
+    # a fresh server over the same chain/db restores the periods
+    server2 = LightClientServer(chain)
+    assert set(server2.best_update_by_period) == set(
+        server.best_update_by_period
+    )
+    for period, upd in server.best_update_by_period.items():
+        got = server2.get_update(period)
+        assert got is not None
+        assert got.attested_header["slot"] == upd.attested_header["slot"]
+        assert bytes(got.sync_committee_signature) == bytes(
+            upd.sync_committee_signature
+        )

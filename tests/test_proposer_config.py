@@ -159,3 +159,49 @@ def test_builder_disabled_key_stays_local(keys, monkeypatch):
     svc.poll_duties(0)
     assert svc.run_block_tasks(0, 7) == 1
     assert api.blinded_produced == 0 and api.full_published == 1
+
+
+def test_keymanager_feerecipient_gaslimit_routes(keys):
+    """keymanager-API per-key settings: GET/POST feerecipient and
+    gas_limit mutate the store's proposer config at runtime."""
+    from lodestar_tpu.api.server import DefaultHandlers
+
+    sks, pks = keys
+    store = ValidatorStore(_cfg(), {0: sks[0]})
+    h = DefaultHandlers(validator_store=store)
+    pk_hex = "0x" + pks[0].hex()
+
+    code, resp = h.get_fee_recipient({"pubkey": pk_hex}, None)
+    assert code == 200 and resp["data"]["ethaddress"] == "0x" + "00" * 20
+
+    code, _ = h.set_fee_recipient(
+        {"pubkey": pk_hex}, {"ethaddress": "0x" + "ab" * 20}
+    )
+    assert code == 202
+    code, resp = h.get_fee_recipient({"pubkey": pk_hex}, None)
+    assert resp["data"]["ethaddress"] == "0x" + "ab" * 20
+    # the store's signing path sees the runtime override
+    assert store.proposer_settings(0).fee_recipient == b"\xab" * 20
+
+    code, resp = h.get_gas_limit({"pubkey": pk_hex}, None)
+    assert code == 200 and resp["data"]["gas_limit"] == "30000000"
+    code, _ = h.set_gas_limit({"pubkey": pk_hex}, {"gas_limit": "25000000"})
+    assert code == 202
+    assert store.proposer_settings(0).gas_limit == 25_000_000
+
+    # malformed inputs are 400s, not 500s
+    assert h.set_fee_recipient({"pubkey": pk_hex}, {"ethaddress": "0x1"})[0] == 400
+    assert h.set_gas_limit({"pubkey": pk_hex}, {"gas_limit": "-5"})[0] == 400
+    assert h.get_fee_recipient({"pubkey": "0x1234"}, None)[0] == 400
+    # a well-formed but UNMANAGED pubkey is 404, never a silent 202
+    # (rewards must not appear configured for a key this client
+    # does not hold)
+    stranger = "0x" + pks[2].hex()  # not loaded into this store
+    assert h.get_fee_recipient({"pubkey": stranger}, None)[0] == 404
+    assert (
+        h.set_fee_recipient(
+            {"pubkey": stranger}, {"ethaddress": "0x" + "cd" * 20}
+        )[0]
+        == 404
+    )
+    assert h.set_gas_limit({"pubkey": stranger}, {"gas_limit": "1"})[0] == 404
