@@ -648,6 +648,111 @@ def gen_ssz_static(out: str) -> None:
         write_json(os.path.join(case_dir, "roots.json"), {"root": hx(root)})
 
 
+def gen_phase0(out: str) -> None:
+    """phase0 vectors: operations/attestation (PendingAttestation-era)
+    and the fork/upgrade_to_altair transition (participation
+    translation + sync-committee bootstrap)."""
+    from lodestar_tpu.state_transition.block import (
+        process_attestation_phase0,
+    )
+
+    cfg_p0 = dataclasses.replace(
+        create_chain_config(
+            MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 1}
+        ),
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    sks = [B.keygen(b"spec-val-%d" % i) for i in range(N_VAL)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg_p0, pks, genesis_time=2)
+    assert genesis.previous_epoch_attestations is not None
+
+    def make_att(state, slot, index=0):
+        committee = get_beacon_committee(state, slot, index)
+        epoch = slot // P.SLOTS_PER_EPOCH
+        start = epoch * P.SLOTS_PER_EPOCH
+        data = {
+            "slot": slot,
+            "index": index,
+            "beacon_block_root": get_block_root_at_slot(state, slot),
+            "source": dict(state.current_justified_checkpoint),
+            "target": {
+                "epoch": epoch,
+                "root": (
+                    get_block_root_at_slot(state, start)
+                    if start < state.slot
+                    else b"\x00" * 32
+                ),
+            },
+        }
+        root = cfg_p0.compute_signing_root(
+            T.AttestationData.hash_tree_root(data),
+            cfg_p0.get_domain(
+                state.slot, params.DOMAIN_BEACON_ATTESTER, start
+            ),
+        )
+        sigs = [B.sign(sks[int(v)], root) for v in committee]
+        return {
+            "aggregation_bits": [True] * len(committee),
+            "data": data,
+            "signature": C.g2_compress(B.aggregate_signatures(sigs)),
+        }
+
+    base = os.path.join(out, "consensus", "phase0", "operations")
+
+    def case(case_name, att, valid=True):
+        case_dir = os.path.join(base, "attestation", case_name)
+        pre = genesis.clone()
+        process_slots(pre, 2)
+        write_ssz(case_dir, "pre", pre.serialize())
+        write_ssz(case_dir, "attestation", T.Attestation.serialize(att))
+        meta = {
+            "config": {"fork": "phase0", "fork_epochs": {"altair": 1}},
+            "bls_setting": 1,
+        }
+        if valid:
+            process_attestation_phase0(pre, att, True)
+            write_ssz(case_dir, "post", pre.serialize())
+        else:
+            try:
+                process_attestation_phase0(pre, att, True)
+            except Exception:
+                pass
+            else:
+                raise RuntimeError(f"{case_name} unexpectedly valid")
+        write_json(os.path.join(case_dir, "meta.json"), meta)
+
+    st2 = genesis.clone()
+    process_slots(st2, 2)
+    att = make_att(st2, 1)
+    case("valid", att)
+    bad = dict(
+        att,
+        data=dict(att["data"], source={"epoch": 3, "root": b"\x07" * 32}),
+    )
+    case("invalid_source", bad, valid=False)
+
+    # fork/upgrade_to_altair: pre at the last phase0 slot WITH pending
+    # attestations; the runner advances one slot (epoch transition +
+    # scheduled upgrade) and must land byte-exactly on post
+    fork_dir = os.path.join(
+        out, "consensus", "phase0", "fork", "upgrade_to_altair"
+    )
+    st = genesis.clone()
+    process_slots(st, 2)
+    process_attestation_phase0(st, make_att(st, 1), True)
+    process_slots(st, P.SLOTS_PER_EPOCH - 1)
+    write_ssz(fork_dir, "pre", st.serialize())
+    post = st.clone()
+    process_slots(post, P.SLOTS_PER_EPOCH)
+    assert post.previous_epoch_attestations is None  # upgraded
+    write_ssz(fork_dir, "post", post.serialize())
+    write_json(
+        os.path.join(fork_dir, "meta.json"),
+        {"fork": "altair", "fork_epoch": 1},
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -667,6 +772,8 @@ def main():
     gen_hash_to_curve(args.out)
     print("generating operations ...")
     gen_operations(args.out)
+    print("generating phase0 ...")
+    gen_phase0(args.out)
     print("generating capella operations ...")
     gen_capella_operations(args.out)
     print("generating epoch_processing ...")

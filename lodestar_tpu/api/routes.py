@@ -197,6 +197,12 @@ ROUTES: Tuple[Route, ...] = (
         auth=True,
     ),
     Route(
+        "DELETE",
+        "/eth/v1/validator/{pubkey}/feerecipient",
+        "delete_fee_recipient",
+        auth=True,
+    ),
+    Route(
         "GET",
         "/eth/v1/validator/{pubkey}/gas_limit",
         "get_gas_limit",
@@ -206,6 +212,12 @@ ROUTES: Tuple[Route, ...] = (
         "POST",
         "/eth/v1/validator/{pubkey}/gas_limit",
         "set_gas_limit",
+        auth=True,
+    ),
+    Route(
+        "DELETE",
+        "/eth/v1/validator/{pubkey}/gas_limit",
+        "delete_gas_limit",
         auth=True,
     ),
     # events namespace (reference: routes/events.ts — SSE stream)

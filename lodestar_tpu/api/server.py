@@ -1676,45 +1676,70 @@ class DefaultHandlers:
     # gas_limit; reference: keymanager/routes.ts + validatorStore's
     # runtime overrides over the proposer settings file) ------------------
 
-    def _km_pubkey(self, params):
+    def _km_entry(self, params):
+        """Shared preamble: store presence, pubkey parse, managed
+        check.  Returns (pk, None) or (None, error_response).  The
+        managed check answers 404 for keys this client does not hold —
+        a silent 202 on a typo'd pubkey would let rewards keep flowing
+        to the old recipient with no error (keymanager API spec)."""
+        err = self._need_store()
+        if err:
+            return None, err
         from ..validator.proposer_config import _hex_bytes
 
-        pk = _hex_bytes(params["pubkey"], 48)
-        # the keymanager API answers 404 for keys this client does not
-        # manage — a silent 202 on a typo'd pubkey would let rewards
-        # keep flowing to the old recipient with no error (spec + the
-        # reference's keymanager impl)
-        if pk not in self.validator_store.pubkeys.values():
-            raise KeyError("pubkey not managed by this validator client")
-        return pk
+        try:
+            pk = _hex_bytes(params["pubkey"], 48)
+        except (KeyError, ValueError, AttributeError, TypeError) as e:
+            return None, (400, {"message": f"bad pubkey: {e}"})
+        store = self.validator_store
+        with store._keys_lock:
+            managed = pk in store.pubkeys.values()
+        if not managed:
+            return None, (
+                404,
+                {"message": "pubkey not managed by this validator client"},
+            )
+        return pk, None
 
     def _km_settings(self, pk: bytes):
         from ..validator.proposer_config import ProposerConfig
 
         store = self.validator_store
-        if store.proposer_config is None:
-            store.proposer_config = ProposerConfig()
-        return store.proposer_config.get(pk)
+        with store._keys_lock:
+            if store.proposer_config is None:
+                store.proposer_config = ProposerConfig()
+            return store.proposer_config.get(pk)
 
     def _km_update(self, pk: bytes, **changes):
         import dataclasses
 
+        from ..validator.proposer_config import ProposerConfig
+
         store = self.validator_store
-        cur = self._km_settings(pk)
-        store.proposer_config.per_key[bytes(pk)] = dataclasses.replace(
-            cur, **changes
-        )
+        # one lock covers check-create-mutate: concurrent POSTs must
+        # not overwrite each other's fresh ProposerConfig (review r5)
+        with store._keys_lock:
+            if store.proposer_config is None:
+                store.proposer_config = ProposerConfig()
+            cur = store.proposer_config.get(pk)
+            store.proposer_config.per_key[bytes(pk)] = dataclasses.replace(
+                cur, **changes
+            )
+
+    def _km_clear(self, pk: bytes) -> bool:
+        store = self.validator_store
+        with store._keys_lock:
+            if store.proposer_config is None:
+                return False
+            return (
+                store.proposer_config.per_key.pop(bytes(pk), None)
+                is not None
+            )
 
     def get_fee_recipient(self, params, body):
-        err = self._need_store()
+        pk, err = self._km_entry(params)
         if err:
             return err
-        try:
-            pk = self._km_pubkey(params)
-        except KeyError as e:
-            return 404, {"message": str(e)}
-        except ValueError as e:
-            return 400, {"message": str(e)}
         s = self._km_settings(pk)
         return 200, {
             "data": {
@@ -1724,34 +1749,33 @@ class DefaultHandlers:
         }
 
     def set_fee_recipient(self, params, body):
-        err = self._need_store()
+        pk, err = self._km_entry(params)
         if err:
             return err
-        try:
-            pk = self._km_pubkey(params)
-        except KeyError as e:
-            return 404, {"message": str(e)}
-        except ValueError as e:
-            return 400, {"message": str(e)}
         try:
             from ..validator.proposer_config import _hex_bytes
 
             raw = _hex_bytes((body or {})["ethaddress"], 20)
-        except (KeyError, ValueError, AttributeError) as e:
+        except (KeyError, ValueError, AttributeError, TypeError) as e:
             return 400, {"message": f"bad request: {e}"}
         self._km_update(pk, fee_recipient=raw)
         return 202, None
 
-    def get_gas_limit(self, params, body):
-        err = self._need_store()
+    def delete_fee_recipient(self, params, body):
+        """Remove the per-key override; the key falls back to the
+        default config (keymanager API DELETE semantics)."""
+        pk, err = self._km_entry(params)
         if err:
             return err
-        try:
-            pk = self._km_pubkey(params)
-        except KeyError as e:
-            return 404, {"message": str(e)}
-        except ValueError as e:
-            return 400, {"message": str(e)}
+        return (204, None) if self._km_clear(pk) else (
+            404,
+            {"message": "no per-key settings for pubkey"},
+        )
+
+    def get_gas_limit(self, params, body):
+        pk, err = self._km_entry(params)
+        if err:
+            return err
         s = self._km_settings(pk)
         return 200, {
             "data": {
@@ -1761,15 +1785,9 @@ class DefaultHandlers:
         }
 
     def set_gas_limit(self, params, body):
-        err = self._need_store()
+        pk, err = self._km_entry(params)
         if err:
             return err
-        try:
-            pk = self._km_pubkey(params)
-        except KeyError as e:
-            return 404, {"message": str(e)}
-        except ValueError as e:
-            return 400, {"message": str(e)}
         try:
             gas = int((body or {})["gas_limit"])
             if gas <= 0:
@@ -1778,6 +1796,15 @@ class DefaultHandlers:
             return 400, {"message": f"bad request: {e}"}
         self._km_update(pk, gas_limit=gas)
         return 202, None
+
+    def delete_gas_limit(self, params, body):
+        pk, err = self._km_entry(params)
+        if err:
+            return err
+        return (204, None) if self._km_clear(pk) else (
+            404,
+            {"message": "no per-key settings for pubkey"},
+        )
 
 
 class BeaconApiServer:

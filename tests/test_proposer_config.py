@@ -193,6 +193,9 @@ def test_keymanager_feerecipient_gaslimit_routes(keys):
     assert h.set_fee_recipient({"pubkey": pk_hex}, {"ethaddress": "0x1"})[0] == 400
     assert h.set_gas_limit({"pubkey": pk_hex}, {"gas_limit": "-5"})[0] == 400
     assert h.get_fee_recipient({"pubkey": "0x1234"}, None)[0] == 400
+    # non-dict JSON bodies are 400s too (not 500s)
+    assert h.set_fee_recipient({"pubkey": pk_hex}, "0xabc")[0] == 400
+    assert h.set_gas_limit({"pubkey": pk_hex}, [1, 2])[0] == 400
     # a well-formed but UNMANAGED pubkey is 404, never a silent 202
     # (rewards must not appear configured for a key this client
     # does not hold)
@@ -205,3 +208,10 @@ def test_keymanager_feerecipient_gaslimit_routes(keys):
         == 404
     )
     assert h.set_gas_limit({"pubkey": stranger}, {"gas_limit": "1"})[0] == 404
+    # DELETE removes the override: the key falls back to the default
+    assert h.delete_fee_recipient({"pubkey": pk_hex}, None)[0] == 204
+    code, resp = h.get_fee_recipient({"pubkey": pk_hex}, None)
+    assert resp["data"]["ethaddress"] == "0x" + "00" * 20
+    assert store.proposer_settings(0).gas_limit == 30_000_000  # default back
+    # deleting again: nothing to remove
+    assert h.delete_gas_limit({"pubkey": pk_hex}, None)[0] == 404

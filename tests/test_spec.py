@@ -288,3 +288,66 @@ def test_ssz_static_vectors():
                 assert typ.hash_tree_root(value) == root, case_dir
                 assert typ.serialize(value) == data, case_dir
     check_all_consumed(consumed, "consensus", "altair", "ssz_static")
+
+
+# -- consensus: phase0 (PendingAttestation-era operations + the altair
+# upgrade transition) -------------------------------------------------------
+
+CFG_PHASE0 = dataclasses.replace(
+    create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 1}
+    ),
+    SHARD_COMMITTEE_PERIOD=0,
+)
+
+
+def test_phase0_attestation_vectors():
+    from lodestar_tpu.state_transition.block import (
+        BlockProcessError,
+        process_attestation_phase0,
+    )
+
+    consumed = {"attestation": 0}
+    for case_dir in iter_case_dirs(
+        "consensus", "phase0", "operations", "attestation"
+    ):
+        consumed["attestation"] += 1
+        pre = BeaconState.deserialize(
+            read_ssz_snappy(case_dir, "pre"), CFG_PHASE0
+        )
+        assert pre.previous_epoch_attestations is not None
+        att = T.Attestation.deserialize(
+            read_ssz_snappy(case_dir, "attestation")
+        )
+        post_bytes = maybe_read_ssz_snappy(case_dir, "post")
+        if post_bytes is None:
+            with pytest.raises(BlockProcessError):
+                process_attestation_phase0(pre, att, True)
+        else:
+            process_attestation_phase0(pre, att, True)
+            assert pre.serialize() == post_bytes, case_dir
+    check_all_consumed(consumed, "consensus", "phase0", "operations")
+
+
+def test_phase0_fork_upgrade_vectors():
+    """The phase0 epoch transition + scheduled upgrade_to_altair must
+    land byte-exactly on the post state (participation translation,
+    inactivity bootstrap, sync committees)."""
+    from lodestar_tpu.state_transition.slot import process_slots
+
+    consumed = {"upgrade_to_altair": 0}
+    for case_dir in iter_case_dirs("consensus", "phase0", "fork"):
+        consumed["upgrade_to_altair"] += 1
+        pre = BeaconState.deserialize(
+            read_ssz_snappy(case_dir, "pre"), CFG_PHASE0
+        )
+        assert pre.fork_name == ForkName.phase0
+        target = (int(pre.slot) // params.SLOTS_PER_EPOCH + 1) * (
+            params.SLOTS_PER_EPOCH
+        )
+        process_slots(pre, target)
+        assert pre.fork_name == ForkName.altair
+        assert pre.previous_epoch_attestations is None
+        post = read_ssz_snappy(case_dir, "post")
+        assert pre.serialize() == post, case_dir
+    check_all_consumed(consumed, "consensus", "phase0", "fork")
