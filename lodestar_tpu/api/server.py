@@ -1726,15 +1726,31 @@ class DefaultHandlers:
                 cur, **changes
             )
 
-    def _km_clear(self, pk: bytes) -> bool:
+    def _km_clear_field(self, pk: bytes, field: str) -> bool:
+        """Reset ONE overridden field to the default (keymanager DELETE
+        is per-endpoint — removing the gas_limit override must not wipe
+        the fee recipient, review r5).  The entry drops entirely once
+        every field matches the default again."""
+        import dataclasses
+
         store = self.validator_store
         with store._keys_lock:
             if store.proposer_config is None:
                 return False
-            return (
-                store.proposer_config.per_key.pop(bytes(pk), None)
-                is not None
+            cfg = store.proposer_config
+            entry = cfg.per_key.get(bytes(pk))
+            if entry is None or getattr(entry, field) == getattr(
+                cfg.default, field
+            ):
+                return False
+            reset = dataclasses.replace(
+                entry, **{field: getattr(cfg.default, field)}
             )
+            if reset == cfg.default:
+                del cfg.per_key[bytes(pk)]
+            else:
+                cfg.per_key[bytes(pk)] = reset
+            return True
 
     def get_fee_recipient(self, params, body):
         pk, err = self._km_entry(params)
@@ -1767,9 +1783,9 @@ class DefaultHandlers:
         pk, err = self._km_entry(params)
         if err:
             return err
-        return (204, None) if self._km_clear(pk) else (
+        return (204, None) if self._km_clear_field(pk, "fee_recipient") else (
             404,
-            {"message": "no per-key settings for pubkey"},
+            {"message": "no fee recipient override for pubkey"},
         )
 
     def get_gas_limit(self, params, body):
@@ -1801,9 +1817,9 @@ class DefaultHandlers:
         pk, err = self._km_entry(params)
         if err:
             return err
-        return (204, None) if self._km_clear(pk) else (
+        return (204, None) if self._km_clear_field(pk, "gas_limit") else (
             404,
-            {"message": "no per-key settings for pubkey"},
+            {"message": "no gas limit override for pubkey"},
         )
 
 

@@ -46,6 +46,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "--checkpoint-state", default=None,
         help="bootstrap from an SSZ state file",
     )
+    beacon.add_argument(
+        "--builder-url", default=None,
+        help="MEV relay URL (builder-specs REST); enables the blinded "
+        "production/publish endpoints",
+    )
+    beacon.add_argument(
+        "--builder-enabled", action="store_true",
+        help="enable the builder at boot after a successful status check",
+    )
 
     validator = sub.add_parser("validator", help="run a validator client")
     validator.add_argument("--beacon-urls", nargs="+", required=True)
@@ -79,6 +88,12 @@ def _build_parser() -> argparse.ArgumentParser:
     validator.add_argument(
         "--remote-indices", type=int, nargs="*", default=(),
         help="validator indices whose keys live in the external signer",
+    )
+    validator.add_argument(
+        "--proposer-settings-file", default=None,
+        help="YAML/JSON per-key proposer settings (fee recipient, gas "
+        "limit, builder flags); builder-enabled keys propose through "
+        "the blinded flow",
     )
 
     bench = sub.add_parser("bench", help="run the headline TPU benchmark")
@@ -171,6 +186,23 @@ def cmd_beacon(args) -> int:
     cfg, sks, pks, chain = _dev_chain(args)
     Archiver(chain)
     LightClientServer(chain)
+    if getattr(args, "builder_enabled", False) and not getattr(
+        args, "builder_url", None
+    ):
+        print(json.dumps({"error": "--builder-enabled requires --builder-url"}))
+        return 2
+    if getattr(args, "builder_url", None):
+        from .execution import ExecutionBuilderHttp
+
+        builder = ExecutionBuilderHttp(args.builder_url, cfg)
+        chain.execution_builder = builder
+        if getattr(args, "builder_enabled", False):
+            try:
+                builder.check_status()
+                builder.update_status(True)
+            except Exception as e:  # noqa: BLE001 — relay down at boot:
+                # stay dark; re-enable over the API later
+                print(json.dumps({"builder_status_error": str(e)}))
     server = BeaconApiServer(
         DefaultHandlers(
             genesis_time=cfg.genesis_time,
@@ -238,6 +270,20 @@ def cmd_validator(args) -> int:
     if remote and not getattr(args, "external_signer_url", None):
         print(json.dumps({"error": "--remote-indices needs --external-signer-url"}))
         return 2
+    # parse config files BEFORE touching the network: a typo in the
+    # settings file must not hide behind a beacon connection error
+    proposer_config = None
+    if getattr(args, "proposer_settings_file", None):
+        from .validator import ProposerConfig
+
+        try:
+            proposer_config = ProposerConfig.from_file(
+                args.proposer_settings_file
+            )
+        except Exception as e:  # noqa: BLE001 — any parse fault
+            # (YAML syntax, bad types) must exit cleanly, not traceback
+            print(json.dumps({"error": f"proposer settings: {e}"}))
+            return 2
     client = ApiClient(args.beacon_urls, timeout=120)
     genesis = client.get_genesis()
     # ONE derivation covering local + remote indices (keygen per index)
@@ -334,6 +380,7 @@ def cmd_validator(args) -> int:
         doppelganger=doppelganger,
         external_signer=external_signer,
         remote_keys=remote_keys,
+        proposer_config=proposer_config,
     )
     blocks = BlockProposalService(store, client)
     atts = AttestationService(store, client)

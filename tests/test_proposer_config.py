@@ -208,10 +208,15 @@ def test_keymanager_feerecipient_gaslimit_routes(keys):
         == 404
     )
     assert h.set_gas_limit({"pubkey": stranger}, {"gas_limit": "1"})[0] == 404
-    # DELETE removes the override: the key falls back to the default
+    # DELETE is PER-FIELD: removing the fee recipient override must
+    # keep the gas limit override (and vice versa)
     assert h.delete_fee_recipient({"pubkey": pk_hex}, None)[0] == 204
     code, resp = h.get_fee_recipient({"pubkey": pk_hex}, None)
     assert resp["data"]["ethaddress"] == "0x" + "00" * 20
-    assert store.proposer_settings(0).gas_limit == 30_000_000  # default back
-    # deleting again: nothing to remove
+    assert store.proposer_settings(0).gas_limit == 25_000_000  # survives
+    # deleting the fee recipient again: no override left
+    assert h.delete_fee_recipient({"pubkey": pk_hex}, None)[0] == 404
+    # now the gas limit override clears too; entry fully reverts
+    assert h.delete_gas_limit({"pubkey": pk_hex}, None)[0] == 204
+    assert store.proposer_settings(0).gas_limit == 30_000_000
     assert h.delete_gas_limit({"pubkey": pk_hex}, None)[0] == 404
