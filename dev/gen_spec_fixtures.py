@@ -685,9 +685,9 @@ def gen_phase0(out: str) -> None:
                 ),
             },
         }
-        root = cfg_p0.compute_signing_root(
+        root = state.config.compute_signing_root(
             T.AttestationData.hash_tree_root(data),
-            cfg_p0.get_domain(
+            state.config.get_domain(
                 state.slot, params.DOMAIN_BEACON_ATTESTER, start
             ),
         )
@@ -731,6 +731,63 @@ def gen_phase0(out: str) -> None:
         data=dict(att["data"], source={"epoch": 3, "root": b"\x07" * 32}),
     )
     case("invalid_source", bad, valid=False)
+
+    # epoch_processing: the phase0-specific steps over a state carrying
+    # pending attestations (attestation-derived justification balances,
+    # getAttestationDeltas rewards, multiplier-1 slashings, record
+    # rotation)
+    from lodestar_tpu.state_transition.phase0 import (
+        process_justification_and_finalization_phase0,
+        process_participation_record_updates,
+        process_rewards_and_penalties_phase0,
+        process_slashings_phase0,
+    )
+
+    ep_base = os.path.join(out, "consensus", "phase0", "epoch_processing")
+    # a state near the end of epoch 1 with attestations for the first
+    # slots of the epoch (inclusion-delay spread: delays 1..3); altair
+    # sits far away so the whole window stays phase0
+    cfg_ep = dataclasses.replace(
+        create_chain_config(
+            MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 10}
+        ),
+        SHARD_COMMITTEE_PERIOD=0,
+    )
+    genesis_ep = create_genesis_state(cfg_ep, pks, genesis_time=2)
+    st_ep = genesis_ep.clone()
+    process_slots(st_ep, P.SLOTS_PER_EPOCH + 4)
+    for att_slot in (
+        P.SLOTS_PER_EPOCH + 1,
+        P.SLOTS_PER_EPOCH + 2,
+        P.SLOTS_PER_EPOCH + 3,
+    ):
+        process_attestation_phase0(st_ep, make_att(st_ep, att_slot), True)
+    process_slots(st_ep, 2 * P.SLOTS_PER_EPOCH - 1)
+    # one slashed validator so the slashings step has work
+    st_ep.slashed[5] = True
+    st_ep.withdrawable_epoch[5] = 1 + P.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    st_ep.slashings[0] = st_ep.effective_balance[5]
+
+    ep_steps = {
+        "justification_and_finalization": (
+            process_justification_and_finalization_phase0
+        ),
+        "rewards_and_penalties": process_rewards_and_penalties_phase0,
+        "slashings": process_slashings_phase0,
+        "participation_record_updates": (
+            process_participation_record_updates
+        ),
+    }
+    for name, fn in ep_steps.items():
+        case_dir = os.path.join(ep_base, name, "pending_attestations")
+        state = st_ep.clone()
+        write_ssz(case_dir, "pre", state.serialize())
+        fn(state)
+        write_ssz(case_dir, "post", state.serialize())
+        write_json(
+            os.path.join(case_dir, "meta.json"),
+            {"config": {"fork": "phase0", "fork_epochs": {"altair": 10}}},
+        )
 
     # fork/upgrade_to_altair: pre at the last phase0 slot WITH pending
     # attestations; the runner advances one slot (epoch transition +

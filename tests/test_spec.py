@@ -351,3 +351,44 @@ def test_phase0_fork_upgrade_vectors():
         post = read_ssz_snappy(case_dir, "post")
         assert pre.serialize() == post, case_dir
     check_all_consumed(consumed, "consensus", "phase0", "fork")
+
+
+CFG_PHASE0_EP = dataclasses.replace(
+    create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 10}
+    ),
+    SHARD_COMMITTEE_PERIOD=0,
+)
+
+
+def test_phase0_epoch_processing_vectors():
+    """phase0-specific epoch steps over PendingAttestation records:
+    attestation-derived justification, getAttestationDeltas rewards,
+    multiplier-1 slashings, record rotation."""
+    from lodestar_tpu.state_transition import phase0 as P0
+
+    steps = {
+        "justification_and_finalization": (
+            P0.process_justification_and_finalization_phase0
+        ),
+        "rewards_and_penalties": P0.process_rewards_and_penalties_phase0,
+        "slashings": P0.process_slashings_phase0,
+        "participation_record_updates": (
+            P0.process_participation_record_updates
+        ),
+    }
+    consumed = {}
+    for name, fn in steps.items():
+        consumed[name] = 0
+        for case_dir in iter_case_dirs(
+            "consensus", "phase0", "epoch_processing", name
+        ):
+            consumed[name] += 1
+            pre = BeaconState.deserialize(
+                read_ssz_snappy(case_dir, "pre"), CFG_PHASE0_EP
+            )
+            assert pre.previous_epoch_attestations is not None
+            fn(pre)
+            post = read_ssz_snappy(case_dir, "post")
+            assert pre.serialize() == post, case_dir
+    check_all_consumed(consumed, "consensus", "phase0", "epoch_processing")
