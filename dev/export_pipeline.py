@@ -159,6 +159,50 @@ def export_entry():
     print(f"{name} ready in {time.time() - t1:.1f}s")
 
 
+def export_replay_shapes(n_validators: int, batch: int = 512):
+    """Pre-trace the grouped batch + retry paths at replay.py's table
+    capacity (the pubkey planes are [NL, V], so configs 4-5 key
+    different artifacts than the bench's 512-capacity table)."""
+    from lodestar_tpu.kernels import verify as KV
+
+    NL = KV.NL
+    i32 = jnp.int32
+
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, i32)
+
+    common = [
+        sds((NL, n_validators)), sds((NL, n_validators)),  # table planes
+        jax.ShapeDtypeStruct((batch, 1), i32),             # idx
+        jax.ShapeDtypeStruct((batch, 1), i32),             # kmask
+        sds((NL, batch)), sds((NL, batch)),                # msg planes
+        sds((NL, batch)), sds((NL, batch)),
+        sds((NL, batch)), sds((NL, batch)),                # sig_x0/x1
+        sds((2, batch)),                                    # sig_flags
+    ]
+    grouping = [
+        jax.ShapeDtypeStruct((batch,), i32),               # group
+        jax.ShapeDtypeStruct((KV.BT,), i32),               # head_lanes
+        jax.ShapeDtypeStruct((KV.BT,), i32),               # glive
+    ]
+    rwords = sds((2, batch))
+    valid = jax.ShapeDtypeStruct((batch,), i32)
+    t1 = time.time()
+    EC.load_or_export(
+        "batch_wire_grouped",
+        KV.verify_batch_device_wire_grouped,
+        common + grouping + [rwords, valid],
+        "tpu",
+    )
+    EC.load_or_export(
+        "each_wire", KV.verify_each_device_wire, common + [valid], "tpu"
+    )
+    print(
+        f"replay shapes ({n_validators} validators) ready in "
+        f"{time.time() - t1:.1f}s"
+    )
+
+
 def main():
     t0 = time.time()
     if os.environ.get("EXPORT_SHARDED", "1") != "0" and PLATFORM == "tpu":
@@ -171,6 +215,16 @@ def main():
             export_entry()
         except Exception as e:  # noqa: BLE001
             print(f"entry export failed: {type(e).__name__}: {e}")
+        # replay configs 4-5 table capacities (opt-out: EXPORT_REPLAY=0)
+        if os.environ.get("EXPORT_REPLAY", "1") != "0":
+            for v in (500_000, 1_000_000):
+                try:
+                    export_replay_shapes(v)
+                except Exception as e:  # noqa: BLE001
+                    print(
+                        f"replay export ({v}) failed: "
+                        f"{type(e).__name__}: {e}"
+                    )
     captured = capture_bench_dispatches()
     seen = set()
     for name, fn, specs in captured:
