@@ -24,8 +24,14 @@ EOF
     # replay config 4 (the BASELINE headline scenario): artifacts keep
     # its trace cost near zero; record the result in-repo for the judge
     timeout 2700 python replay.py --validators 500000 --slots 2 \
-      > /root/repo/REPLAY_r05.json 2>/tmp/replay_cfg4.log
-    echo "$ts replay cfg4 rc=$? $(tail -1 /root/repo/REPLAY_r05.json)" >> "$LOG"
+      > /tmp/replay_cfg4.json 2>/tmp/replay_cfg4.log
+    rrc=$?
+    if [ $rrc -eq 0 ]; then
+      # commit-into-place only on success: a timeout/crash must not
+      # truncate a previously good recorded result
+      mv /tmp/replay_cfg4.json /root/repo/REPLAY_r05.json
+    fi
+    echo "$ts replay cfg4 rc=$rrc $(tail -1 /tmp/replay_cfg4.log 2>/dev/null | head -c 120)" >> "$LOG"
     # per-stage on-chip timings (finished stages replay from cache)
     timeout 1800 python dev/probe_tpu_kernels.py > "$PROBE_LOG" 2>&1
     echo "$ts probes done rc=$?" >> "$LOG"

@@ -1054,3 +1054,11 @@ class BeaconChain:
             self._sidecar_slots.pop(root, None)
             self._available_sidecars.pop(root, None)
             self._sidecar_bodies.pop(root, None)
+        # parked data-less blocks expire with the window too — stale
+        # entries must not pin the (bounded) parking slots shut
+        for root in [
+            r
+            for r, sb in self._da_pending.items()
+            if int(sb["message"]["slot"]) < horizon
+        ]:
+            del self._da_pending[root]

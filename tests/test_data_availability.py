@@ -69,3 +69,32 @@ def test_availability_pruned_by_clock(chain):
         chain._check_data_availability(
             _block_with_commitments([b"\xaa" * 48]), root
         )
+
+
+def test_parked_blocks_expire_with_the_window(chain):
+    """Stale parked blocks must free their (bounded) parking slots
+    (review r5 follow-up: _da_pending was never pruned)."""
+    chain._da_pending.clear()
+    chain._da_pending["aa" * 32] = {"message": {"slot": 3, "body": {}}}
+    chain._da_pending["bb" * 32] = {
+        "message": {"slot": 3 + 2 * params.SLOTS_PER_EPOCH, "body": {}}
+    }
+    chain.prune_pools(3 + params.SLOTS_PER_EPOCH + 1)
+    assert "aa" * 32 not in chain._da_pending  # expired
+    assert "bb" * 32 in chain._da_pending      # still in the window
+    chain._da_pending.clear()
+
+
+def test_parking_is_bounded(chain, monkeypatch):
+    """The PRODUCTION import path refuses the N+1th park (drives
+    _process_block_inner's guard, not a test-side simulation)."""
+    monkeypatch.setattr(chain, "_da_pending", {})
+    monkeypatch.setattr(chain, "_da_pending_max", 2)
+    for i in range(3):
+        body = {"blob_kzg_commitments": [bytes([i]) * 48]}
+        block = {"slot": 9, "body": body}
+        with pytest.raises(BlobsUnavailableError):
+            chain._process_block_inner(
+                {"message": block}, block, bytes([i]) * 32, timely=False
+            )
+    assert len(chain._da_pending) == 2  # third park refused by the guard
