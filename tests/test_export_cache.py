@@ -163,3 +163,39 @@ def test_verifier_export_dispatch_fallback(monkeypatch):
     # falls back to the direct path and still verifies
     out = v._device_call("x", lambda a, b: a + b, (jnp.ones(2), jnp.ones(2)))
     assert np.allclose(np.asarray(out), 2.0)
+
+
+def test_staged_artifacts_match_verifier_contract():
+    """When the staged TPU artifacts exist (driver host), their input
+    signature must match what the verifier dispatches at bench shapes —
+    a drift between verifier args and artifacts would silently fall
+    back to the ~10-minute trace at bench time.  Skips on hosts
+    without the artifact cache (fresh checkouts)."""
+    import pathlib
+
+    from jax import export as jexport
+
+    hits = list(
+        pathlib.Path(EC.DEFAULT_DIR).glob("batch_wire_grouped-tpu-*.jaxexport")
+    )
+    if not hits:
+        pytest.skip("no staged artifacts on this host")
+    from lodestar_tpu.kernels import verify as KV
+
+    for path in hits:  # one artifact per (job width x table capacity)
+        exp = jexport.deserialize(path.read_bytes())
+        avals = list(exp.in_avals)
+        # 16 positional args; lane width divides the tile; grouping
+        # rows are BT-wide (verify_batch_device_wire_grouped); the
+        # TABLE planes carry the capacity (bench 512, replay 500k/1M)
+        assert len(avals) == 16, path.name
+        n = avals[-1].shape[0]
+        assert n % KV.BT == 0
+        assert avals[0].shape[0] == KV.NL    # table planes [NL, cap]
+        assert avals[1].shape == avals[0].shape
+        assert avals[4].shape == (KV.NL, n)  # msg planes ride the job
+        assert avals[11].shape == (n,)       # group
+        assert avals[12].shape == (KV.BT,)   # head_lanes
+        assert avals[13].shape == (KV.BT,)   # glive
+        assert avals[14].shape == (2, n)     # rwords
+        assert all(str(a.dtype) == "int32" for a in avals)
