@@ -632,3 +632,48 @@ class ReqRespBeaconNode:
                 (LightClientUpdateType.serialize(value), self._ctx(slot))
             )
         return out
+
+
+class ReqRespBlockSource:
+    """A sync BlockSource over one reqresp peer connection: blocks by
+    range/root plus deneb blob sidecars, decoded to the repo-wide value
+    shapes (reference: the sync layer's network.beaconBlocksMaybeBlobsByRange
+    wrapper over ReqRespBeaconNode).
+
+    Plugs straight into sync.SyncChain.add_peer — the batch state
+    machine downloads through this adapter while a second peer's
+    adapter can serve other batches.
+    """
+
+    def __init__(self, reqresp: ReqResp, peer_id: str, config):
+        self.reqresp = reqresp
+        self.peer_id = peer_id
+        self.config = config
+        self._range = blocks_by_range_protocol(config)
+        self._roots = blocks_by_root_protocol(config)
+        self._blob_range = blob_sidecars_by_range_protocol(config)
+
+    def get_blocks_by_range(self, start_slot: int, count: int):
+        chunks = self.reqresp.send_request(
+            self.peer_id,
+            self._range,
+            {"start_slot": start_slot, "count": count, "step": 1},
+        )
+        return decode_block_chunks(self.config, chunks)
+
+    def get_blocks_by_root(self, roots):
+        chunks = self.reqresp.send_request(
+            self.peer_id, self._roots, [bytes(r) for r in roots]
+        )
+        return decode_block_chunks(self.config, chunks)
+
+    def get_blob_sidecars_by_range(self, start_slot: int, count: int):
+        chunks = self.reqresp.send_request(
+            self.peer_id,
+            self._blob_range,
+            {"start_slot": start_slot, "count": count},
+        )
+        return [
+            self._blob_range.decode_response(data, ctx)
+            for data, ctx in chunks
+        ]

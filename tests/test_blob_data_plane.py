@@ -230,3 +230,76 @@ def test_sync_chain_fetches_and_registers_blobs():
     with pytest.raises(SyncChainError):
         sc3.run()
     assert not chain3.imported
+
+
+def test_reqresp_adapter_serves_blob_batches_to_sync():
+    """The wire loop for deneb ranges: server db -> blob chunks ->
+    ReqRespBlockSource.get_blob_sidecars_by_range -> SyncChain verifies
+    + registers + imports (the adapter's blob decode path)."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.network.reqresp import ReqResp, connect_inmemory
+    from lodestar_tpu.network.reqresp_protocols import (
+        ReqRespBeaconNode,
+        ReqRespBlockSource,
+    )
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.sync import SyncChain
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={
+            ForkName.altair: 0,
+            ForkName.bellatrix: 0,
+            ForkName.capella: 0,
+            ForkName.deneb: 0,
+        },
+    )
+    sidecars, root, setup, signed = _mk_sidecars(slot=1)
+    db = BeaconDb(config=cfg)
+    db.archive_block(1, signed, root=root)
+    db.put_blob_sidecars(root, sidecars)
+
+    class ChainStub:
+        config = cfg
+
+        @staticmethod
+        def get_blob_sidecars(r):
+            return None
+
+        class head_state:
+            slot = 1
+            finalized_checkpoint = {"epoch": 0, "root": b"\x00" * 32}
+
+        @staticmethod
+        def get_head_root():
+            return b"\x00" * 32
+
+    server, client = ReqResp(), ReqResp()
+    ReqRespBeaconNode(server, cfg, chain=ChainStub, db=db)
+    connect_inmemory(client, "syncer", server, "server")
+    source = ReqRespBlockSource(client, "server", cfg)
+
+    # the adapter decodes wire chunks back to value-shaped sidecars
+    got = source.get_blob_sidecars_by_range(0, 4)
+    assert [int(s["index"]) for s in got] == [0, 1]
+    assert bytes(got[0]["blob"]) == bytes(sidecars[0]["blob"])
+
+    class FakeChain:
+        config = cfg
+
+        def __init__(self):
+            self.registered = []
+            self.imported = []
+
+        def on_blob_sidecar(self, block_root, index, commitment, slot=None, sidecar=None):
+            self.registered.append((bytes(block_root), int(index)))
+
+        def process_block(self, sb):
+            assert len(self.registered) == 2, "sidecars must register first"
+            self.imported.append(sb)
+
+    chain = FakeChain()
+    sc = SyncChain(chain, 1, 1, kzg_setup=setup)
+    sc.add_peer("server", source)
+    assert sc.run() == 1
+    assert chain.registered == [(root, 0), (root, 1)]

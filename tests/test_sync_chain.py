@@ -198,3 +198,35 @@ def test_range_sync_facade_multi_peer(world):
     )
     assert n == len(blocks)
     assert chain.head_root_hex == donor.head_root_hex
+
+
+def test_sync_through_reqresp_adapter(world):
+    """SyncChain pulls a real chain over the reqresp protocol layer:
+    server (chain+db) -> wire chunks -> ReqRespBlockSource -> batch
+    state machine -> full STF import on the syncing node."""
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.network.reqresp import ReqResp, connect_inmemory
+    from lodestar_tpu.network.reqresp_protocols import (
+        ReqRespBeaconNode,
+        ReqRespBlockSource,
+    )
+
+    cfg, sks, genesis, donor, blocks = world
+    # serve the donor chain from a db (by-range reads the archive/hot set)
+    db = BeaconDb(config=cfg)
+    for signed in blocks:
+        slot = int(signed["message"]["slot"])
+        root = cfg.get_fork_types(slot)[0].hash_tree_root(signed["message"])
+        db.archive_block(slot, signed, root=root)
+
+    server, client = ReqResp(), ReqResp()
+    ReqRespBeaconNode(server, cfg, chain=donor, db=db)
+    connect_inmemory(client, "syncer", server, "server")
+
+    fresh = BeaconChain(cfg, genesis)
+    source = ReqRespBlockSource(client, "server", cfg)
+    sc = SyncChain(fresh, 1, 2 * P.SLOTS_PER_EPOCH + 2)
+    sc.add_peer("server", source)
+    n = sc.run()
+    assert n == len(blocks)
+    assert fresh.head_root_hex == donor.head_root_hex
