@@ -652,6 +652,7 @@ class ReqRespBlockSource:
         self._range = blocks_by_range_protocol(config)
         self._roots = blocks_by_root_protocol(config)
         self._blob_range = blob_sidecars_by_range_protocol(config)
+        self._blob_root = blob_sidecars_by_root_protocol(config)
 
     def get_blocks_by_range(self, start_slot: int, count: int):
         chunks = self.reqresp.send_request(
@@ -675,5 +676,19 @@ class ReqRespBlockSource:
         )
         return [
             self._blob_range.decode_response(data, ctx)
+            for data, ctx in chunks
+        ]
+
+    def get_blob_sidecars_by_root(self, identifiers):
+        """identifiers: [(block_root, index), ...] or dicts."""
+        body = [
+            i
+            if isinstance(i, dict)
+            else {"block_root": bytes(i[0]), "index": int(i[1])}
+            for i in identifiers
+        ]
+        chunks = self.reqresp.send_request(self.peer_id, self._blob_root, body)
+        return [
+            self._blob_root.decode_response(data, ctx)
             for data, ctx in chunks
         ]

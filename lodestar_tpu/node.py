@@ -387,7 +387,7 @@ class FullBeaconNode:
 
         # sync drivers (sources injected per peer/transport)
         self.range_sync = RangeSync(self.chain, kzg_setup=opts.kzg_setup)
-        self.unknown_block_sync = UnknownBlockSync(self.chain)
+        self.unknown_block_sync = UnknownBlockSync(self.chain, kzg_setup=opts.kzg_setup)
         self.backfill = BackfillSync(config, self.db, verifier)
 
         # req/resp: subnet-policy metadata + the full protocol set over

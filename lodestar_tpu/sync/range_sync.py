@@ -99,6 +99,35 @@ class SyncChainError(Exception):
     pass
 
 
+def verify_and_register_sidecar(chain, kzg_setup, sc, slot: int) -> None:
+    """ONE sidecar through the sync-side validation (inclusion proof +
+    optional KZG proof) into the chain's DA tracker — shared by the
+    range and by-root paths so their verification can never diverge."""
+    from ..chain import blobs as BL
+    from ..crypto import kzg as K
+    from ..types import BeaconBlockHeader
+
+    body_type = chain.config.get_fork_types(slot)[2]
+    if not BL.verify_blob_inclusion(sc, body_type):
+        raise SyncChainError("sidecar inclusion proof invalid")
+    if kzg_setup is not None and not K.verify_blob_kzg_proof(
+        bytes(sc["blob"]),
+        bytes(sc["kzg_commitment"]),
+        bytes(sc["kzg_proof"]),
+        kzg_setup,
+    ):
+        raise SyncChainError("sidecar KZG proof invalid")
+    chain.on_blob_sidecar(
+        BeaconBlockHeader.hash_tree_root(
+            sc["signed_block_header"]["message"]
+        ),
+        int(sc["index"]),
+        bytes(sc["kzg_commitment"]),
+        slot=slot,
+        sidecar=sc,
+    )
+
+
 class SyncChain:
     """Multi-peer batched sync toward a target slot.
 
@@ -239,31 +268,10 @@ class SyncChain:
         data against the block's own commitments)."""
         if not batch.sidecars:
             return
-        from ..chain import blobs as BL
-        from ..crypto import kzg as K
-
         for sc in batch.sidecars:
-            header = sc["signed_block_header"]["message"]
-            slot = int(header["slot"])
-            body_type = self.chain.config.get_fork_types(slot)[2]
-            if not BL.verify_blob_inclusion(sc, body_type):
-                raise SyncChainError("sidecar inclusion proof invalid")
-            if self.kzg_setup is not None and not K.verify_blob_kzg_proof(
-                bytes(sc["blob"]),
-                bytes(sc["kzg_commitment"]),
-                bytes(sc["kzg_proof"]),
-                self.kzg_setup,
-            ):
-                raise SyncChainError("sidecar KZG proof invalid")
-            from ..types import BeaconBlockHeader
-
-            block_root = BeaconBlockHeader.hash_tree_root(header)
-            self.chain.on_blob_sidecar(
-                block_root,
-                int(sc["index"]),
-                bytes(sc["kzg_commitment"]),
-                slot=slot,
-                sidecar=sc,
+            slot = int(sc["signed_block_header"]["message"]["slot"])
+            verify_and_register_sidecar(
+                self.chain, self.kzg_setup, sc, slot
             )
 
     # -- the drive loop ----------------------------------------------------
@@ -404,15 +412,66 @@ class RangeSync:
 
 class UnknownBlockSync:
     """Resolve a block whose parent chain is unknown: walk back by root
-    to a known ancestor, then import forward."""
+    to a known ancestor, then import forward.  Deneb blocks in the
+    segment fetch their sidecars by root (verified + registered) so the
+    DA gate passes (reference: unknownBlock.ts fetches block inputs
+    incl. blobs)."""
 
-    def __init__(self, chain):
+    def __init__(self, chain, kzg_setup=None):
         self.chain = chain
+        self.kzg_setup = kzg_setup
         self.log = get_logger("sync/unknown-block")
         self.resolved = 0
 
+    def _fetch_blobs(self, source, signed: dict, root: bytes) -> None:
+        """`root` is the block root on_unknown_block fetched by — no
+        rehash.  Skips the network when gossip already delivered the
+        sidecars (the COMMON case for unknown-parent triggers)."""
+        from ..types import BeaconBlockHeader
+
+        block = signed["message"]
+        commitments = block.get("body", {}).get("blob_kzg_commitments")
+        if not commitments:
+            return
+        local = getattr(self.chain, "get_blob_sidecars", None)
+        if local is not None:
+            have = local(bytes(root))
+            if have is not None and len(have) >= len(commitments):
+                return  # gossip already registered this block's data
+        fetch = getattr(source, "get_blob_sidecars_by_root", None)
+        if fetch is None:
+            raise LookupError(
+                "deneb block needs sidecars but the source has no "
+                "blob_sidecars_by_root"
+            )
+        slot = int(block["slot"])
+        sidecars = fetch(
+            [(bytes(root), i) for i in range(len(commitments))]
+        )
+        # response validation FIRST: a short answer or foreign sidecars
+        # are a misbehaving peer, not a data-availability condition
+        if len(sidecars) != len(commitments):
+            raise LookupError(
+                f"peer served {len(sidecars)}/{len(commitments)} sidecars"
+            )
+        for sc in sidecars:
+            sc_root = BeaconBlockHeader.hash_tree_root(
+                sc["signed_block_header"]["message"]
+            )
+            if bytes(sc_root) != bytes(root):
+                raise LookupError(
+                    "peer served a sidecar for a different block"
+                )
+        for sc in sidecars:
+            try:
+                verify_and_register_sidecar(
+                    self.chain, self.kzg_setup, sc, slot
+                )
+            except SyncChainError as e:
+                raise LookupError(str(e)) from e
+
     def on_unknown_block(self, source: BlockSource, root: bytes) -> int:
-        chain_segment: List[dict] = []
+        chain_segment: List[tuple] = []  # (signed_block, its root)
         next_root = root
         for _ in range(MAX_PARENT_DEPTH):
             if self.chain.fork_choice.has_block(next_root.hex()):
@@ -423,11 +482,12 @@ class UnknownBlockSync:
                     f"source has no block {next_root.hex()[:16]}"
                 )
             signed = blocks[0]
-            chain_segment.append(signed)
+            chain_segment.append((signed, bytes(next_root)))
             next_root = signed["message"]["parent_root"]
         else:
             raise LookupError("parent chain exceeds walk-back bound")
-        for signed in reversed(chain_segment):
+        for signed, blk_root in reversed(chain_segment):
+            self._fetch_blobs(source, signed, blk_root)
             self.chain.process_block(signed)
             self.resolved += 1
         return len(chain_segment)

@@ -303,3 +303,163 @@ def test_reqresp_adapter_serves_blob_batches_to_sync():
     sc.add_peer("server", source)
     assert sc.run() == 1
     assert chain.registered == [(root, 0), (root, 1)]
+
+
+def test_unknown_block_sync_fetches_blobs_by_root():
+    """A by-root resolved deneb block fetches + verifies + registers its
+    sidecars before import (review r5 follow-up: the DA gate otherwise
+    rejects UnknownBlockSync's deneb imports)."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.sync import UnknownBlockSync
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={
+            ForkName.altair: 0,
+            ForkName.bellatrix: 0,
+            ForkName.capella: 0,
+            ForkName.deneb: 0,
+        },
+    )
+    sidecars, root, setup, signed = _mk_sidecars(slot=4)
+    block_root = cfg.get_fork_types(4)[0].hash_tree_root(signed["message"])
+
+    class FakeChain:
+        config = cfg
+
+        def __init__(self):
+            self.registered = []
+            self.imported = []
+
+            class FC:
+                @staticmethod
+                def has_block(h):
+                    # the parent is known; the target block is not
+                    return h == (b"\x01" * 32).hex()
+
+            self.fork_choice = FC()
+
+        def on_blob_sidecar(self, br, i, c, slot=None, sidecar=None):
+            self.registered.append(int(i))
+
+        def process_block(self, sb):
+            assert len(self.registered) == 2, "blobs must register first"
+            self.imported.append(sb)
+
+    class Source:
+        def __init__(self):
+            self.root_queries = []
+
+        def get_blocks_by_root(self, roots):
+            return [signed] if bytes(roots[0]) == bytes(block_root) else []
+
+        def get_blocks_by_range(self, a, b):
+            return []
+
+        def get_blob_sidecars_by_root(self, identifiers):
+            self.root_queries.append(identifiers)
+            return list(sidecars)
+
+    chain = FakeChain()
+    ub = UnknownBlockSync(chain, kzg_setup=setup)
+    n = ub.on_unknown_block(Source(), bytes(block_root))
+    assert n == 1 and chain.registered == [0, 1] and chain.imported
+
+    # a blob-less source cannot serve deneb segments
+    class BloblessSource:
+        def get_blocks_by_root(self, roots):
+            return [signed]
+
+        def get_blocks_by_range(self, a, b):
+            return []
+
+    chain2 = FakeChain()
+    ub2 = UnknownBlockSync(chain2, kzg_setup=setup)
+    with pytest.raises(LookupError, match="blob_sidecars_by_root"):
+        ub2.on_unknown_block(BloblessSource(), bytes(block_root))
+    assert not chain2.imported
+
+
+def test_unknown_block_sync_validates_peer_responses():
+    """Short or foreign by-root answers are PEER faults at fetch time,
+    and locally-available data skips the network entirely (review r5)."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.sync import UnknownBlockSync
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={
+            ForkName.altair: 0,
+            ForkName.bellatrix: 0,
+            ForkName.capella: 0,
+            ForkName.deneb: 0,
+        },
+    )
+    sidecars, root, setup, signed = _mk_sidecars(slot=4)
+    block_root = cfg.get_fork_types(4)[0].hash_tree_root(signed["message"])
+
+    class FakeChain:
+        config = cfg
+
+        def __init__(self, local=None):
+            self.registered = []
+            self.imported = []
+            self._local = local
+
+            class FC:
+                @staticmethod
+                def has_block(h):
+                    return h == (b"\x01" * 32).hex()
+
+            self.fork_choice = FC()
+
+        def get_blob_sidecars(self, r):
+            return self._local
+
+        def on_blob_sidecar(self, br, i, c, slot=None, sidecar=None):
+            self.registered.append(int(i))
+
+        def process_block(self, sb):
+            self.imported.append(sb)
+
+    class Source:
+        def __init__(self, answer):
+            self.answer = answer
+            self.fetches = 0
+
+        def get_blocks_by_root(self, roots):
+            return [signed]
+
+        def get_blocks_by_range(self, a, b):
+            return []
+
+        def get_blob_sidecars_by_root(self, identifiers):
+            self.fetches += 1
+            return self.answer
+
+    # short answer -> peer fault, block NOT imported
+    chain = FakeChain()
+    with pytest.raises(LookupError, match="1/2 sidecars"):
+        UnknownBlockSync(chain, kzg_setup=setup).on_unknown_block(
+            Source(sidecars[:1]), bytes(block_root)
+        )
+    assert not chain.imported
+
+    # a validly-proven sidecar for a DIFFERENT block -> peer fault
+    other_sidecars, _oroot, _s, _osigned = _mk_sidecars(slot=9)
+    chain2 = FakeChain()
+    with pytest.raises(LookupError, match="different block"):
+        UnknownBlockSync(chain2, kzg_setup=setup).on_unknown_block(
+            Source(list(other_sidecars)), bytes(block_root)
+        )
+    assert not chain2.imported
+
+    # gossip already delivered the data: zero network fetches
+    chain3 = FakeChain(local=list(sidecars))
+    src = Source([])
+    n = UnknownBlockSync(chain3, kzg_setup=setup).on_unknown_block(
+        src, bytes(block_root)
+    )
+    assert n == 1 and src.fetches == 0 and chain3.imported
