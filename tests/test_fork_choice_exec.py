@@ -291,3 +291,64 @@ def test_finalized_root_ancestor_check():
     pa.apply_score_changes([0] * 4, justified_epoch=1, finalized_epoch=1)
     assert pa._node_is_viable_for_head(pa.nodes[pa.indices["m"]])
     assert not pa._node_is_viable_for_head(pa.nodes[pa.indices["n"]])
+
+
+def test_prune_after_invalidation():
+    """maybe_prune must survive a tree containing Invalid nodes (their
+    best links are cleared; index remapping must not trip on them)."""
+    pa = ProtoArray("genesis", prune_threshold=2)
+    prev = "genesis"
+    for i in range(6):
+        pa.on_block(
+            i + 1, f"n{i}", prev, 0, 0,
+            execution_status=ExecutionStatus.Syncing,
+            execution_block_hash=("%02x" % i) * 32,
+        )
+        prev = f"n{i}"
+    # invalidate the tail pair
+    pa.validate_latest_hash(
+        ExecutionStatus.Invalid, "03" * 32, invalidate_from_block_root="n5"
+    )
+    assert pa.nodes[pa.indices["n5"]].execution_status == ExecutionStatus.Invalid
+    assert pa.nodes[pa.indices["n4"]].execution_status == ExecutionStatus.Invalid
+    # finalize at n2: nodes before it drop, indices remap, statuses keep
+    removed = pa.maybe_prune("n2")
+    assert [n.root for n in removed] == ["genesis", "n0", "n1"]
+    assert pa.nodes[pa.indices["n4"]].execution_status == ExecutionStatus.Invalid
+    assert pa.nodes[pa.indices["n3"]].execution_status == ExecutionStatus.Syncing
+    # head from the new anchor avoids the invalid tail
+    assert pa.find_head("n2") == "n3"
+
+
+def test_invalidation_emits_head_event():
+    """_after_invalidation announces the replacement head — API event
+    subscribers must see the eviction, not a silent reassignment."""
+    import numpy as np
+
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.emitter import ChainEvent
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B, curves as C
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={params.ForkName.altair: 0}
+    )
+    pks = [C.g1_compress(B.sk_to_pk(B.keygen(b"he-%d" % i))) for i in range(4)]
+    chain = BeaconChain(cfg, create_genesis_state(cfg, pks, genesis_time=2))
+    pa = chain.fork_choice.proto
+    anchor = chain.anchor_root_hex
+    pa.on_block(1, "x1", anchor, 0, 0,
+                execution_status=ExecutionStatus.Syncing,
+                execution_block_hash="aa" * 32)
+    chain.head_root_hex = "x1"
+    chain.optimistic_roots.add("x1")
+    heads = []
+    chain.emitter.on(ChainEvent.head, lambda root, slot: heads.append(root))
+    chain.fork_choice.validate_latest_hash(
+        ExecutionStatus.Invalid, None, invalidate_from_block_root="x1"
+    )
+    chain._after_invalidation(1)
+    assert chain.head_root_hex == anchor
+    assert heads and heads[-1] == bytes.fromhex(anchor)
+    assert "x1" not in chain.optimistic_roots
