@@ -214,3 +214,55 @@ def test_verifier_uses_grouped_path_with_duplicate_roots():
     job = verifier.begin_job(sets_bad, batchable=True)
     assert not verifier.finish_job(job)
     assert list(job.verdicts) == [True, True, True, False, True, True]
+
+
+@pytest.mark.smoke
+def test_group_heads_gather_and_liveness():
+    """_j_group_heads: each group's last-lane total + its message gather
+    onto the BT tile; dead groups (padding or all-dead segments) become
+    generator pairs excluded by the live row."""
+    n = 8
+    ks = [3, 5, 7, 11, 13, 17, 19, 23]
+    pts = [GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, k) for k in ks]
+    px = jnp.asarray(LY.encode_batch([p[0] for p in pts]))
+    py = jnp.asarray(LY.encode_batch([p[1] for p in pts]))
+    pz = jnp.asarray(LY.encode_batch([1] * n))
+    group = np.asarray([0, 0, 1, 1, 2, 2, 3, 3], np.int32)
+    dead = np.zeros(n, bool)
+    dead[4] = dead[5] = True  # group 2 entirely dead
+    seg_pts, seg_inf = KV._j_seg_sum_g1(
+        px, py, pz, jnp.asarray(dead), jnp.asarray(group)
+    )
+
+    # two distinct messages riding lanes (group i uses msg i % 2)
+    msgs = [hash_to_g2(b"gh-%d" % (i % 2)) for i in range(n)]
+    m = [
+        jnp.asarray(LY.encode_batch(v))
+        for v in (
+            [p[0][0] for p in msgs],
+            [p[0][1] for p in msgs],
+            [p[1][0] for p in msgs],
+            [p[1][1] for p in msgs],
+        )
+    ]
+    head_lanes = np.zeros(KV.BT, np.int32)
+    head_lanes[:4] = [1, 3, 5, 7]  # last lane of each group
+    glive = np.zeros(KV.BT, np.int32)
+    glive[:4] = 1
+    gx, gy, gz, qx0, qx1, qy0, qy1, live_row = KV._j_group_heads(
+        seg_pts, seg_inf, *m, jnp.asarray(head_lanes), jnp.asarray(glive)
+    )
+    live = np.asarray(live_row)[0]
+    # groups 0, 1, 3 live; group 2 all-dead; padding lanes dead
+    assert list(live[:4]) == [1, 1, 0, 1]
+    assert not live[4:].any()
+    decoded = _jac_decode((gx, gy, gz))
+    assert decoded[0] == GC.multi_add(GC.FP_OPS, [pts[0], pts[1]])
+    assert decoded[1] == GC.multi_add(GC.FP_OPS, [pts[2], pts[3]])
+    assert decoded[3] == GC.multi_add(GC.FP_OPS, [pts[6], pts[7]])
+    # dead lanes carry the generator (excluded by live anyway)
+    assert decoded[2] == GC.G1_GEN and decoded[4] == GC.G1_GEN
+    # the gathered G2 messages match each group's own message
+    qx0_d = LY.decode_batch(np.asarray(qx0))
+    for g, lane in ((0, 1), (1, 3), (3, 7)):
+        assert qx0_d[g] == msgs[lane][0][0], g
