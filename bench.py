@@ -53,6 +53,10 @@ BENCH_INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
 # windows of life): retry the init probe a few times before giving up
 BENCH_PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
 BENCH_PROBE_RETRY_DELAY_S = float(os.environ.get("BENCH_PROBE_RETRY_DELAY", "60"))
+# hard cap on the probe phase's TOTAL wall-clock (timeouts + retry
+# delays): a flapping tunnel must yield a skip record in bounded time,
+# not eat the run budget retrying
+BENCH_PROBE_WALLCLOCK_S = float(os.environ.get("BENCH_PROBE_WALLCLOCK", "600"))
 # Watchdog default sized to the measured warm-up reality on the driver
 # host (dev/NOTES.md "CPU-host costs": ~700 s of per-process tracing
 # before any compile/run) — the deadline is a last-resort diagnostic,
@@ -68,14 +72,20 @@ def _metric_name() -> str:
 
 def _emit_failure(stage: str, detail: str) -> None:
     """One machine-readable diagnosis line on stdout (the driver parses
-    stdout for the JSON record; a traceback alone parses to nothing)."""
+    stdout for the JSON record; a traceback alone parses to nothing).
+
+    A failed run is SKIPPED, not measured: value is null (round 5
+    published `value: 0.0` for a dead-tunnel probe failure, which reads
+    as a measured zero), and "skipped": true marks the record so
+    BENCH_*.json consumers never average a failure into a trend."""
     print(
         json.dumps(
             {
                 "metric": _metric_name(),
-                "value": 0.0,
+                "value": None,
                 "unit": "sets/s",
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
+                "skipped": True,
                 "error": f"{stage}: {detail}"[-2000:],
             }
         ),
@@ -90,8 +100,24 @@ def _probe_backend() -> None:
     started).  Retries a few times — the tunnel flaps — then exits the
     process with a JSON diagnosis on failure."""
     last = None
+    t0 = time.monotonic()
     for attempt in range(max(1, BENCH_PROBE_RETRIES)):
         if attempt:
+            # total-wall-clock cap, checked BEFORE the retry sleep: the
+            # sleep + next attempt's timeout must both fit the budget —
+            # never sleep toward an attempt that can no longer start
+            if (
+                time.monotonic() - t0
+                + BENCH_PROBE_RETRY_DELAY_S
+                + BENCH_INIT_TIMEOUT_S
+                > BENCH_PROBE_WALLCLOCK_S
+            ):
+                last = (
+                    f"{last} (probe wall-clock budget "
+                    f"{BENCH_PROBE_WALLCLOCK_S:.0f}s exhausted after "
+                    f"{attempt} attempts)"
+                )
+                break
             time.sleep(BENCH_PROBE_RETRY_DELAY_S)
         last, retryable = _probe_backend_once()
         if last is None:

@@ -1,0 +1,109 @@
+"""Slasher span-update throughput microbench (CPU-side, runs anywhere).
+
+Times the vectorized min-max span path end-to-end the way the service
+drives it — grouped AttestationData batches applied across committees of
+validators — and reports attestations/second plus the per-flush latency,
+alongside the per-group kernel cost in validator-epochs/s.  The naive
+O(n²) reference is timed on a scaled-down load for contrast.
+
+Usage: python dev/microbench_slasher.py [n_validators] [n_batches]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lodestar_tpu.slasher.attester import AttesterSlasher, NaiveAttesterSlasher
+
+N_VALIDATORS = int(sys.argv[1]) if len(sys.argv) > 1 else 16_384
+N_BATCHES = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+ATTS_PER_BATCH = 64  # distinct AttestationDatas per flush (~1 slot)
+COMMITTEE = 128  # validators per attestation
+HISTORY = 4096
+WINDOW = 512  # epochs the random sources/targets roam over
+
+
+def _batches(rng):
+    out = []
+    for b in range(N_BATCHES):
+        batch = []
+        for a in range(ATTS_PER_BATCH):
+            t = int(rng.integers(2, WINDOW))
+            s = int(rng.integers(max(0, t - 64), t + 1))
+            rows = np.sort(
+                rng.choice(N_VALIDATORS, size=COMMITTEE, replace=False)
+            )
+            batch.append(
+                {
+                    "attesting_indices": [int(v) for v in rows],
+                    "data": {
+                        "slot": t * 32,
+                        "index": a,
+                        "beacon_block_root": bytes([b % 256, a % 256]) + b"\x00" * 30,
+                        "source": {"epoch": s, "root": b"\x00" * 32},
+                        "target": {"epoch": t, "root": b"\x11" * 32},
+                    },
+                    "signature": b"\x00" * 96,
+                }
+            )
+        out.append(batch)
+    return out
+
+
+def main():
+    rng = np.random.default_rng(7)
+    batches = _batches(rng)
+    n_atts = N_BATCHES * ATTS_PER_BATCH
+
+    slasher = AttesterSlasher(history_length=HISTORY, num_validators=N_VALIDATORS)
+    slasher.process_batch(batches[0])  # warm allocation outside the clock
+    t0 = time.perf_counter()
+    detections = 0
+    flush_times = []
+    for batch in batches:
+        f0 = time.perf_counter()
+        detections += len(slasher.process_batch(batch))
+        flush_times.append(time.perf_counter() - f0)
+    dt = time.perf_counter() - t0
+
+    # validator-epochs touched per attestation ~ COMMITTEE * HISTORY
+    ve_per_s = n_atts * COMMITTEE * HISTORY / dt
+
+    # naive reference on a 1/16 load for a sanity ratio
+    naive = NaiveAttesterSlasher()
+    nb = [b[:: 16] for b in batches[: max(1, N_BATCHES // 4)]]
+    t1 = time.perf_counter()
+    for batch in nb:
+        naive.process_batch(batch)
+    naive_dt = time.perf_counter() - t1
+    naive_atts = sum(len(b) for b in nb)
+
+    print(
+        json.dumps(
+            {
+                "metric": "slasher_span_update_attestations_per_s",
+                "value": round(n_atts / dt, 2),
+                "unit": "atts/s",
+                "validators": N_VALIDATORS,
+                "history_epochs": HISTORY,
+                "committee": COMMITTEE,
+                "detections": detections,
+                "flush_p50_ms": round(
+                    sorted(flush_times)[len(flush_times) // 2] * 1e3, 2
+                ),
+                "validator_epochs_per_s": round(ve_per_s, 0),
+                "naive_atts_per_s": round(naive_atts / naive_dt, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,29 @@ ROUTES: Tuple[Route, ...] = (
     Route(
         "POST", "/eth/v1/beacon/pool/voluntary_exits", "submit_voluntary_exit"
     ),
+    # pool reads (reference: routes/beacon/pool.ts getPool*) — the
+    # slasher's detections surface here alongside API-submitted ops
+    Route("GET", "/eth/v1/beacon/pool/attestations", "get_pool_attestations"),
+    Route(
+        "GET",
+        "/eth/v1/beacon/pool/attester_slashings",
+        "get_pool_attester_slashings",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/pool/proposer_slashings",
+        "get_pool_proposer_slashings",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/pool/voluntary_exits",
+        "get_pool_voluntary_exits",
+    ),
+    Route(
+        "GET",
+        "/eth/v1/beacon/pool/bls_to_execution_changes",
+        "get_pool_bls_to_execution_changes",
+    ),
     Route(
         "GET",
         "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
@@ -223,6 +246,7 @@ ROUTES: Tuple[Route, ...] = (
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)
+    Route("GET", "/eth/v1/lodestar/slasher", "get_slasher_status"),
     Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),
     Route("GET", "/eth/v1/lodestar/bls-metrics", "get_bls_metrics"),
     Route(

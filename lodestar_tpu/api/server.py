@@ -37,6 +37,7 @@ class DefaultHandlers:
         keymanager_token: Optional[str] = None,
         proposer_cache=None,
         kzg_setup=None,
+        slasher=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -57,6 +58,7 @@ class DefaultHandlers:
         self.keymanager_token = keymanager_token
         self.proposer_cache = proposer_cache  # prepare_beacon_proposer
         self.kzg_setup = kzg_setup  # deneb blob verification / publishing
+        self.slasher = slasher  # SlasherService for the status route
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -658,6 +660,13 @@ class DefaultHandlers:
         from .encoding import to_json
 
         return 200, {"data": [to_json(ssz_type, r) for r in records]}
+
+    def get_slasher_status(self, params, body):
+        """GET /eth/v1/lodestar/slasher — detection counters, span
+        window, and queue depth (lodestar-namespace introspection)."""
+        if self.slasher is None:
+            return 501, {"message": "slasher not enabled"}
+        return 200, {"data": self.slasher.status()}
 
     def get_pool_attester_slashings(self, params, body):
         err = self._need_chain()

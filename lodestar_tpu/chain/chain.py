@@ -101,6 +101,9 @@ class BeaconChain:
         self.merge_block_tracker = None
         self.monitor = monitor  # optional ValidatorMonitor
         self.kzg_setup = kzg_setup  # deneb blob verification/production
+        # optional SlasherService (slasher/service.py): fed every
+        # imported block header; pruned on finalization below
+        self.slasher = None
         # beacon root -> execution block hash (payload-carrying blocks)
         self._execution_block_hash: Dict[str, bytes] = {}
         # roots imported optimistically (EL said SYNCING/ACCEPTED)
@@ -319,6 +322,24 @@ class BeaconChain:
                 )
         self.imported_blocks += 1
         self.emitter.emit(ChainEvent.block, signed_block, root)
+        if self.slasher is not None:
+            # ONE ingestion point for imported blocks (gossip, range
+            # sync, and API publishes all funnel through here); the
+            # gossip layer separately feeds never-imported duplicate-
+            # proposer blocks
+            try:
+                # the STF already merkleized the body into the header —
+                # reuse it, the import hot path must not re-hash
+                self.slasher.ingest_block(
+                    signed_block,
+                    body_root=bytes(post.latest_block_header["body_root"]),
+                    # this import VERIFIED the proposer signature —
+                    # trusted headers bypass the forged-duplicate cap
+                    trusted=True,
+                )
+            except Exception as e:  # noqa: BLE001 — detection must not
+                # break the import pipeline
+                self.log.warn("slasher block ingestion failed", error=str(e))
 
         # FFG bookkeeping: move the proto array's justified/finalized
         # filter + justified root as the chain justifies (reference
@@ -339,6 +360,12 @@ class BeaconChain:
             self.fork_choice.proto.finalized_epoch = fin
             self.regen.checkpoint_cache.prune_finalized(fin)
             self.op_pool.prune_all(post)
+            if self.slasher is not None:
+                # epoch-windowed slasher pruning rides finalization
+                try:
+                    self.slasher.on_finalized(fin)
+                except Exception as e:  # noqa: BLE001
+                    self.log.warn("slasher prune failed", error=str(e))
             froot = post.finalized_checkpoint["root"].hex()
             if self.fork_choice.has_block(froot):
                 # spec-form finalized viability: nodes must DESCEND from
@@ -1022,9 +1049,11 @@ class BeaconChain:
         """Zero the equivocating validators' fork-choice influence
         (reference: chain.ts emitter AttesterSlashing ->
         forkChoice.onAttesterSlashing)."""
-        a1 = set(int(i) for i in slashing["attestation_1"]["attesting_indices"])
-        a2 = set(int(i) for i in slashing["attestation_2"]["attesting_indices"])
-        self.fork_choice.on_attester_slashing(sorted(a1 & a2))
+        from .op_pools import attester_slashing_intersection
+
+        self.fork_choice.on_attester_slashing(
+            attester_slashing_intersection(slashing)
+        )
 
     # -- gossip op ingress (reference chain.ts pool adders) ----------------
 

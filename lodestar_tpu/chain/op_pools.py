@@ -47,6 +47,16 @@ def _agg_sigs(sig_a: bytes, sig_b: bytes) -> bytes:
     return C.g2_compress(B.aggregate_signatures([pa, pb]))
 
 
+def attester_slashing_intersection(slashing: dict) -> List[int]:
+    """THE offender set of an AttesterSlashing (spec: indices attesting
+    in both conflicting attestations) — shared by pool keying, fork-
+    choice equivocation zeroing, and the slasher's emission path."""
+    return sorted(
+        set(int(i) for i in slashing["attestation_1"]["attesting_indices"])
+        & set(int(i) for i in slashing["attestation_2"]["attesting_indices"])
+    )
+
+
 class AttestationPool:
     """Unaggregated single-bit attestations, aggregated per data root
     (the aggregator duty's source — reference attestationPool.ts)."""
@@ -194,19 +204,40 @@ class OpPool:
             == params.BLS_WITHDRAWAL_PREFIX
         ][: P.MAX_BLS_TO_EXECUTION_CHANGES]
 
-    def insert_proposer_slashing(self, slashing: dict) -> None:
+    def insert_proposer_slashing(self, slashing: dict) -> bool:
         index = slashing["signed_header_1"]["message"]["proposer_index"]
-        self._proposer_slashings.setdefault(index, slashing)
+        if index in self._proposer_slashings:
+            return False
+        self._proposer_slashings[index] = slashing
+        return True
 
-    def insert_attester_slashing(self, slashing: dict) -> None:
-        key = tuple(
-            sorted(
-                set(slashing["attestation_1"]["attesting_indices"])
-                & set(slashing["attestation_2"]["attesting_indices"])
-            )
-        )
-        if key:
-            self._attester_slashings.setdefault(key, slashing)
+    def insert_attester_slashing(self, slashing: dict) -> bool:
+        """Keyed by offender intersection, deduped PER OFFENDER
+        (reference opPool.ts keys per intersecting index): a slashing
+        whose offenders are all already covered by pooled entries is a
+        no-op, so the slasher can re-submit detections freely without
+        growing the pool."""
+        key = tuple(attester_slashing_intersection(slashing))
+        if not key:
+            return False
+        if set(key) <= self.covered_attester_offenders():
+            return False  # every offender already has a pooled slashing
+        self._attester_slashings[key] = slashing
+        return True
+
+    def covered_attester_offenders(self) -> set:
+        """Offenders with a pooled attester slashing (the dedupe set —
+        also read by the slasher's emission path)."""
+        covered: set = set()
+        for k in self._attester_slashings:
+            covered.update(k)
+        return covered
+
+    def num_attester_slashings(self) -> int:
+        return len(self._attester_slashings)
+
+    def num_proposer_slashings(self) -> int:
+        return len(self._proposer_slashings)
 
     def insert_voluntary_exit(self, signed_exit: dict) -> None:
         self._voluntary_exits.setdefault(

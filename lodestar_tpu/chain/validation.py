@@ -182,6 +182,12 @@ class GossipValidators:
         indexed attestation."""
         data = attestation["data"]
         self._check_slot_window(int(data["slot"]))
+        # p2p spec: attestation.data.target.epoch == epoch of the slot.
+        # Also load-bearing for the slasher: an attacker-chosen far-
+        # future target would otherwise advance its span window past
+        # the live epochs and blind surround detection.
+        if int(data["target"]["epoch"]) != int(data["slot"]) // params.SLOTS_PER_EPOCH:
+            _reject("target epoch does not match attestation slot")
         bits = attestation["aggregation_bits"]
         if sum(1 for b in bits if b) != 1:
             _reject("not exactly one aggregation bit")
@@ -239,6 +245,9 @@ class GossipValidators:
         slot = int(data["slot"])
         aggregator = int(msg["aggregator_index"])
         self._check_slot_window(slot)
+        # p2p spec: target epoch must match the attestation slot's epoch
+        if int(data["target"]["epoch"]) != slot // params.SLOTS_PER_EPOCH:
+            _reject("target epoch does not match attestation slot")
         epoch = int(data["target"]["epoch"])
         if self.seen_aggregators.is_known(epoch, aggregator):
             _ignore(f"aggregator {aggregator} already seen in epoch {epoch}")

@@ -191,6 +191,17 @@ class BeaconDb:
         self.light_client_best_update = Repository(
             db, Bucket.light_client_update
         )
+        # slasher state (slasher/store.py): span-array blobs, indexed-
+        # attestation evidence by hash root, proposer headers by
+        # slot||proposer
+        self.slasher_min_span = Repository(db, Bucket.slasher_min_span)
+        self.slasher_max_span = Repository(db, Bucket.slasher_max_span)
+        self.slasher_attestation = Repository(
+            db, Bucket.slasher_attestation, T.IndexedAttestation
+        )
+        self.slasher_header = Repository(
+            db, Bucket.slasher_header, T.SignedBeaconBlockHeader
+        )
 
     def put_block(self, root: bytes, signed_block: dict) -> None:
         self.block.put(root, signed_block)

@@ -36,6 +36,11 @@ class Bucket(enum.IntEnum):
     blobs_sidecar = 13
     blobs_sidecar_archive = 14
     deposit_data_root = 15
+    # slasher column families (slasher/store.py)
+    slasher_min_span = 16
+    slasher_max_span = 17
+    slasher_attestation = 18
+    slasher_header = 19
 
 
 class Repository(Generic[T]):

@@ -45,6 +45,9 @@ class GossipHandlers:
         )
         self.log = get_logger("network/gossip_handlers")
         self.seen_block_proposers = SeenBlockProposers()
+        # optional SlasherService: every VERIFIED attestation/aggregate/
+        # block is ingested post-validation (slasher/service.py)
+        self.slasher = None
         self.results: Dict[str, Dict[str, int]] = {}
         self._last_pruned_slot = 0
         # deneb blob verification needs a KZG trusted setup; without one
@@ -130,6 +133,72 @@ class GossipHandlers:
         clock-less compositions."""
         self._prune(slot)
 
+    def _slasher_ingest(self, fn, obj) -> None:
+        """An internal slasher/db fault must never become a gossip
+        verdict: the message already VALIDATED, and a raised exception
+        here would REJECT-score the honest forwarding peer."""
+        try:
+            fn(obj)
+        except Exception as e:  # noqa: BLE001
+            self.log.warn("slasher ingestion failed", error=str(e))
+
+    def _ingest_duplicate_proposer_block(self, signed: dict) -> None:
+        """Verify a duplicate-proposer block's signature, then feed the
+        slasher as TRUSTED (the only unverified field left is content
+        the slashing dry-run re-checks anyway)."""
+        from .. import params as _p
+        from ..bls.signature_set import WireSignatureSet
+        from ..bls.verifier import VerifyOptions
+
+        block = signed["message"]
+        slot = int(block["slot"])
+        proposer = int(block["proposer_index"])
+        cfg = self.chain.config
+        root = cfg.compute_signing_root(
+            cfg.get_fork_types(slot)[0].hash_tree_root(block),
+            cfg.get_domain(slot, _p.DOMAIN_BEACON_PROPOSER, slot),
+        )
+        ok = self.validators.verifier.verify_signature_sets(
+            [WireSignatureSet.single(proposer, root, bytes(signed["signature"]))],
+            VerifyOptions(batchable=True),
+        )
+        if ok:
+            self.slasher.ingest_block(signed, trusted=True)
+
+    def _recover_suppressed_double_vote(self, attestation: dict) -> None:
+        """A gossip attestation the seen caches IGNORE can still be the
+        second half of a DOUBLE VOTE (same target epoch => same seen-
+        cache key), exactly like the duplicate-proposer block branch.
+        Pay for a committee lookup, and — only when the slasher already
+        holds a CONFLICTING root for the validator at that target
+        (service gate, attempt-bounded) — one signature verification
+        before ingesting.  Surround votes have distinct target epochs
+        and are never suppressed, so this path is double-vote-only."""
+        from ..bls.verifier import VerifyOptions
+        from ..state_transition.signature_sets import (
+            get_indexed_attestation_signature_set,
+        )
+
+        data = attestation["data"]
+        target = int(data["target"]["epoch"])
+        root = bytes(T.AttestationData.hash_tree_root(data))
+        view = self.validators._view()
+        indexed = view.get_indexed_attestation(attestation)
+        if not any(
+            self.slasher.should_check_equivocation(int(i), target, root)
+            for i in indexed["attesting_indices"]
+        ):
+            return
+        ok = self.validators.verifier.verify_signature_sets(
+            [get_indexed_attestation_signature_set(view, indexed)],
+            VerifyOptions(batchable=True),
+        )
+        self.slasher.record_equivocation_probe(
+            indexed["attesting_indices"], target, root, bool(ok)
+        )
+        if ok:
+            self.slasher.ingest_attestation(indexed)
+
     def _dispatch(self, name: str, payload: bytes, digest: bytes) -> None:
         v = self.validators
         if name == "beacon_block":
@@ -143,6 +212,18 @@ class GossipHandlers:
             # one block per proposer per slot at the gossip layer
             # (reference: validation/block.ts seenBlockProposers check)
             if self.seen_block_proposers.is_known(slot, proposer):
+                # a SECOND block for the same (slot, proposer) is exactly
+                # the equivocation a slasher exists for — ingest the
+                # header before IGNORE-ing (lighthouse ingests on
+                # RepeatProposal too).  The proposer signature is
+                # verified FIRST (one BLS op against the known pubkey):
+                # forged duplicates never reach the slasher, so they can
+                # neither exhaust its rejection cap nor cost STF
+                # dry-runs downstream.
+                if self.slasher is not None:
+                    self._slasher_ingest(
+                        self._ingest_duplicate_proposer_block, signed
+                    )
                 raise GossipValidationError(
                     GossipAction.IGNORE, "proposer already seen this slot"
                 )
@@ -168,15 +249,32 @@ class GossipHandlers:
             self._prune(slot)
             return None
         if name == "beacon_aggregate_and_proof":
-            v.validate_aggregate_and_proof(
-                T.SignedAggregateAndProof.deserialize(payload)
-            )
+            signed_agg = T.SignedAggregateAndProof.deserialize(payload)
+            try:
+                indexed = v.validate_aggregate_and_proof(signed_agg)
+            except GossipValidationError as e:
+                if e.action == GossipAction.IGNORE and self.slasher is not None:
+                    self._slasher_ingest(
+                        self._recover_suppressed_double_vote,
+                        signed_agg["message"]["aggregate"],
+                    )
+                raise
+            if self.slasher is not None:
+                self._slasher_ingest(self.slasher.ingest_attestation, indexed)
             return None
         if name.startswith("beacon_attestation_"):
             subnet = int(name.rsplit("_", 1)[1])
-            v.validate_attestation(
-                T.Attestation.deserialize(payload), subnet=subnet
-            )
+            attestation = T.Attestation.deserialize(payload)
+            try:
+                indexed = v.validate_attestation(attestation, subnet=subnet)
+            except GossipValidationError as e:
+                if e.action == GossipAction.IGNORE and self.slasher is not None:
+                    self._slasher_ingest(
+                        self._recover_suppressed_double_vote, attestation
+                    )
+                raise
+            if self.slasher is not None:
+                self._slasher_ingest(self.slasher.ingest_attestation, indexed)
             return None
         if name == "voluntary_exit":
             v.validate_voluntary_exit_gossip(

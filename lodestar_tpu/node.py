@@ -71,6 +71,12 @@ class NodeOptions:
     # get_pow_block_by_hash/get_pow_block_latest); None = no tracker
     pow_provider: Optional[object] = None
     terminal_total_difficulty: Optional[int] = None
+    # slashing-detection service (slasher/): every production
+    # deployment runs one, so it is on by default; flip off for
+    # minimal compositions
+    run_slasher: bool = True
+    # slasher surround-history window in epochs (Lighthouse default)
+    slasher_history_length: int = 4096
 
 
 class BeaconNode:
@@ -307,6 +313,21 @@ class FullBeaconNode:
         self.light_client_server = LightClientServer(self.chain)
         self.archiver = Archiver(self.chain)
 
+        # slasher: gossip-fed detection -> op pool (reference deploys
+        # run an external slasher; here it is a chain-side service over
+        # the same vectorized array stack as the verifier)
+        self.slasher = None
+        if opts.run_slasher:
+            from .slasher import SlasherService
+
+            self.slasher = SlasherService(
+                self.chain,
+                registry=self.registry,
+                db=self.db,
+                history_length=opts.slasher_history_length,
+            )
+            self.chain.slasher = self.slasher
+
         # next-slot preparation: epoch-state precompute + payload prep
         # for locally-registered proposers (reference: prepareNextSlot.ts)
         from .chain.prepare_next_slot import PrepareNextSlotScheduler
@@ -344,6 +365,9 @@ class FullBeaconNode:
             current_slot_fn=lambda: self.clock.current_slot,
             kzg_setup=opts.kzg_setup,
         )
+        # verified gossip attestations/aggregates + duplicate-proposer
+        # blocks feed the slasher (imported blocks arrive via the chain)
+        self.handlers.slasher = self.slasher
         self.scorer = None
         n_val = opts.active_validator_count_hint or anchor_state.num_validators
         if n_val > 0:
@@ -472,6 +496,9 @@ class FullBeaconNode:
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
         self.clock.on_slot(self.prepare_scheduler.on_slot)
+        if self.slasher is not None:
+            # per-slot batch flush (earlier flushes trigger at max_batch)
+            self.clock.on_slot(self.slasher.on_clock_slot)
         # live subnet churn: duty subscriptions made after init and
         # long-lived rotations must reach the bus (reference:
         # attnetsService.ts slot-driven gossip subscription updates).
@@ -536,6 +563,7 @@ class FullBeaconNode:
                     proposer_cache=self.proposer_cache,
                     validator_store=opts.validator_store,
                     kzg_setup=opts.kzg_setup,
+                    slasher=self.slasher,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)
@@ -548,6 +576,8 @@ class FullBeaconNode:
         self.handlers.handle(msg.topic, msg.data)
 
     def start(self) -> None:
+        if self.slasher is not None:
+            self.slasher.start()
         if self.api:
             self.api.listen()
             self.log.info("rest api listening", port=self.api.port)
@@ -555,5 +585,7 @@ class FullBeaconNode:
     def close(self) -> None:
         if self.api:
             self.api.close()
+        if self.slasher is not None:
+            self.slasher.stop()
         self.bls.close()
         self.db.close()

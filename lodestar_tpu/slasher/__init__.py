@@ -1,0 +1,33 @@
+"""Slasher — vectorized slashing detection (double votes, min-max
+surround, double proposals).
+
+Reference: lighthouse/slasher (chunked min-max span arrays, epoch
+windowed, batched updates) and the reference node's opPool/gossip
+wiring.  The span math lives in `batch.py` as a pure, shape-stable
+array kernel so a later PR can move it onto the TPU path.
+"""
+
+from .attester import (
+    AttesterSlasher,
+    NaiveAttesterSlasher,
+    is_double_vote,
+    is_surround_vote,
+)
+from .batch import SpanState, span_update_rows
+from .metrics import SlasherMetrics
+from .proposer import ProposerSlasher
+from .service import SlasherService
+from .store import SlasherStore
+
+__all__ = [
+    "AttesterSlasher",
+    "NaiveAttesterSlasher",
+    "ProposerSlasher",
+    "SlasherMetrics",
+    "SlasherService",
+    "SlasherStore",
+    "SpanState",
+    "is_double_vote",
+    "is_surround_vote",
+    "span_update_rows",
+]

@@ -60,6 +60,15 @@ class BeaconMetrics:
             "lodestar_oppool_aggregated_attestation_pool_size",
             "Aggregated attestation pool size",
         )
+        # slashing pools — fed by the API AND the slasher's detections
+        self.op_pool_attester_slashings = g(
+            "lodestar_oppool_attester_slashing_pool_size",
+            "Attester slashing pool size",
+        )
+        self.op_pool_proposer_slashings = g(
+            "lodestar_oppool_proposer_slashing_pool_size",
+            "Proposer slashing pool size",
+        )
         # peers (peer manager)
         self.peers_connected = g("libp2p_peers", "Connected peer count")
         self._last_head: str | None = None
@@ -96,6 +105,12 @@ class BeaconMetrics:
                 self.op_pool_attestations.set(chain.attestation_pool.size())
                 self.op_pool_aggregates.set(
                     chain.aggregated_attestation_pool.size()
+                )
+                self.op_pool_attester_slashings.set(
+                    chain.op_pool.num_attester_slashings()
+                )
+                self.op_pool_proposer_slashings.set(
+                    chain.op_pool.num_proposer_slashings()
                 )
             except Exception:  # noqa: BLE001 — sampling is best-effort
                 pass

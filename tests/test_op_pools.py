@@ -182,6 +182,46 @@ def test_op_pool_dedupe_and_selection(world):
     assert not ps3
 
 
+def test_attester_slashing_offender_coverage_dedupe(world):
+    """Regression: attester slashings key by offender intersection, and
+    an offence whose offenders are ALL already covered is a no-op — the
+    slasher re-submitting a detection must not grow the pool."""
+    _cfg, _sks, _pks, _genesis = world
+
+    def slashing(indices_1, indices_2, tag):
+        def indexed(indices, root_byte):
+            return {
+                "attesting_indices": sorted(indices),
+                "data": {
+                    "slot": 0,
+                    "index": 0,
+                    "beacon_block_root": bytes([root_byte]) * 32,
+                    "source": {"epoch": 0, "root": b"\x00" * 32},
+                    "target": {"epoch": 1, "root": b"\x00" * 32},
+                },
+                "signature": b"\x00" * 96,
+            }
+
+        return {
+            "attestation_1": indexed(indices_1, tag),
+            "attestation_2": indexed(indices_2, tag + 1),
+        }
+
+    op = OpPool()
+    assert op.insert_attester_slashing(slashing([1, 2, 3], [2, 3, 4], 1))
+    assert set(op._attester_slashings) == {(2, 3)}
+    # same offenders, different evidence: no-op
+    assert not op.insert_attester_slashing(slashing([2, 3], [2, 3], 5))
+    # a strict subset of covered offenders: no-op
+    assert not op.insert_attester_slashing(slashing([2], [2], 7))
+    assert len(op._attester_slashings) == 1
+    # at least one NEW offender: inserted under its own key
+    assert op.insert_attester_slashing(slashing([3, 9], [3, 9], 9))
+    assert set(op._attester_slashings) == {(2, 3), (3, 9)}
+    # disjoint attestations never insert
+    assert not op.insert_attester_slashing(slashing([5], [6], 11))
+
+
 def test_sync_pools_and_contribution(world):
     cfg, sks, pks, genesis = world
     st = genesis.clone()

@@ -1,0 +1,750 @@
+"""Slasher: golden surround/double-vote cases, vectorized-vs-naive
+cross-checks, persistence, and the service-level gossip -> detection ->
+op-pool -> block-inclusion round trip.
+
+Reference semantics: spec is_slashable_attestation_data (double vote /
+surround vote) and the lighthouse-style min-max span arrays the
+vectorized path implements (lodestar_tpu/slasher/batch.py).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.bls.single_thread import CpuBlsVerifier
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.network.gossip import (
+    GossipTopicName,
+    InMemoryGossipBus,
+    encode_message,
+    topic_string,
+)
+from lodestar_tpu.network.gossip_handlers import GossipHandlers
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.slasher import (
+    AttesterSlasher,
+    NaiveAttesterSlasher,
+    ProposerSlasher,
+    SlasherService,
+    is_double_vote,
+    is_surround_vote,
+)
+from lodestar_tpu.state_transition import create_genesis_state, state_transition
+from lodestar_tpu.state_transition.accessors import get_beacon_committee
+from lodestar_tpu.utils.metrics import Registry
+from lodestar_tpu.validator import ValidatorStore
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+def _data(source, target, root=b"\x07" * 32, slot=0, index=0):
+    return {
+        "slot": slot,
+        "index": index,
+        "beacon_block_root": root,
+        "source": {"epoch": source, "root": b"\x00" * 32},
+        "target": {"epoch": target, "root": b"\x11" * 32},
+    }
+
+
+def _att(validators, source, target, root=b"\x07" * 32, slot=0, index=0):
+    return {
+        "attesting_indices": sorted(int(v) for v in validators),
+        "data": _data(source, target, root=root, slot=slot, index=index),
+        "signature": b"\x00" * 96,
+    }
+
+
+# -- golden cases -----------------------------------------------------------
+
+
+def test_golden_double_vote():
+    a = _data(0, 3, root=b"\x01" * 32)
+    b = _data(0, 3, root=b"\x02" * 32)
+    assert is_double_vote(a, b) and is_double_vote(b, a)
+    # identical data is NOT a double vote
+    assert not is_double_vote(a, _data(0, 3, root=b"\x01" * 32))
+    # same root different target: neither
+    assert not is_double_vote(a, _data(0, 4, root=b"\x01" * 32))
+    # different source, same target, different data -> still double
+    assert is_double_vote(a, _data(1, 3, root=b"\x01" * 32))
+
+
+def test_golden_surround():
+    # strict on both sides
+    assert is_surround_vote(_data(0, 5), _data(1, 4))
+    assert not is_surround_vote(_data(1, 4), _data(0, 5))
+    assert not is_surround_vote(_data(0, 5), _data(0, 4))  # equal sources
+    assert not is_surround_vote(_data(0, 5), _data(1, 5))  # equal targets
+    # distance-1 edges: the tightest possible surround
+    assert is_surround_vote(_data(0, 3), _data(1, 2))
+    assert not is_surround_vote(_data(0, 2), _data(1, 2))
+    assert not is_surround_vote(_data(1, 2), _data(1, 3))
+    # source == target: can be surrounded, can never surround
+    assert is_surround_vote(_data(4, 6), _data(5, 5))
+    assert not is_surround_vote(_data(5, 5), _data(4, 6))
+    assert not is_surround_vote(_data(5, 5), _data(5, 5))
+
+
+def test_span_detector_golden_cases():
+    s = AttesterSlasher(history_length=64, chunk_size=8)
+    assert s.process_batch([_att([1], 1, 4, root=b"\x01" * 32)]) == []
+    # surrounding vote detected, attestation_1 is the surrounding one
+    dets = s.process_batch([_att([1], 0, 5, root=b"\x02" * 32)])
+    assert [k for k, _ in dets] == ["surround"]
+    sl = dets[0][1]
+    assert int(sl["attestation_1"]["data"]["source"]["epoch"]) == 0
+    assert int(sl["attestation_2"]["data"]["source"]["epoch"]) == 1
+    # a vote surrounded by an existing one
+    dets = s.process_batch([_att([1], 2, 3, root=b"\x03" * 32)])
+    assert "surrounded" in [k for k, _ in dets]
+    # double vote at target 4 with a different root
+    dets = s.process_batch([_att([1], 2, 4, root=b"\x04" * 32)])
+    assert "double_vote" in [k for k, _ in dets]
+    # replaying an identical attestation is a no-op
+    assert s.process_batch([_att([1], 1, 4, root=b"\x01" * 32)]) == []
+
+
+def test_span_detector_source_equals_target_edges():
+    s = AttesterSlasher(history_length=64, chunk_size=8)
+    s.process_batch([_att([3], 4, 6, root=b"\x01" * 32)])
+    # (5,5) is surrounded by (4,6)
+    dets = s.process_batch([_att([3], 5, 5, root=b"\x02" * 32)])
+    assert [k for k, _ in dets] == ["surrounded"]
+    # distance-1: (3,7) surrounds (4,6)
+    dets = s.process_batch([_att([3], 3, 7, root=b"\x03" * 32)])
+    assert [k for k, _ in dets] == ["surround"]
+
+
+def test_intra_batch_detection():
+    """Conflicting attestations arriving in the SAME batch detect."""
+    s = AttesterSlasher(history_length=64, chunk_size=8)
+    dets = s.process_batch(
+        [
+            _att([2], 1, 4, root=b"\x01" * 32),
+            _att([2], 0, 5, root=b"\x02" * 32),
+        ]
+    )
+    kinds = {k for k, _ in dets}
+    assert kinds & {"surround", "surrounded"}
+
+
+def test_old_source_surround_still_caught_after_prune():
+    """An attestation whose SOURCE predates the pruned window base must
+    still poison the max-spans inside the window, so a later inner vote
+    is detected (the classic old-source surround attack)."""
+    s = AttesterSlasher(history_length=16, chunk_size=4)
+    s.prune(8)  # window base advances to epoch 8
+    assert s.spans.base_epoch == 8
+    # outer vote with source BELOW the base
+    assert s.process_batch([_att([1], 4, 20, root=b"\x01" * 32)]) == []
+    # inner vote inside the window: surrounded by the outer one
+    dets = s.process_batch([_att([1], 9, 15, root=b"\x02" * 32)])
+    assert [k for k, _ in dets] == ["surrounded"]
+    sl = dets[0][1]
+    assert int(sl["attestation_1"]["data"]["source"]["epoch"]) == 4
+
+
+def test_span_window_advance():
+    s = AttesterSlasher(history_length=16, chunk_size=4)
+    s.process_batch([_att([0], 1, 2)])
+    # a target far past the window forces a chunk-aligned base advance
+    s.process_batch([_att([0], 40, 41, root=b"\x09" * 32)])
+    assert s.spans.base_epoch > 0
+    assert s.spans.base_epoch % 4 == 0
+    assert 41 < s.spans.base_epoch + s.spans.history_length
+    # pruning drops records below the floor
+    s.prune(40)
+    assert all(
+        t >= 40 for recs in s._records.values() for (_s, t) in recs
+    )
+
+
+def _offender_pairs(dets):
+    out = set()
+    for kind, sl in dets:
+        if kind in ("surround", "surrounded"):
+            kind = "surround*"  # intra-batch group order can flip the side
+        inter = set(
+            int(i) for i in sl["attestation_1"]["attesting_indices"]
+        ) & set(int(i) for i in sl["attestation_2"]["attesting_indices"])
+        out.update((kind, v) for v in inter)
+    return out
+
+
+def _random_cross_check(n_validators, n_epochs, n_atts, batch_size, seed):
+    rng = np.random.default_rng(seed)
+    fast = AttesterSlasher(history_length=max(64, n_epochs * 2), chunk_size=16)
+    naive = NaiveAttesterSlasher()
+    atts = []
+    for i in range(n_atts):
+        t = int(rng.integers(1, n_epochs))
+        s = int(rng.integers(0, t + 1))
+        k = int(rng.integers(1, 4))
+        vs = rng.choice(n_validators, size=k, replace=False)
+        # small root space so double votes actually occur
+        root = bytes([int(rng.integers(0, 6))]) * 32
+        atts.append(_att(vs, s, t, root=root))
+    total_fast, total_naive = set(), set()
+    for i in range(0, n_atts, batch_size):
+        batch = atts[i : i + batch_size]
+        total_fast |= _offender_pairs(fast.process_batch(batch))
+        total_naive |= _offender_pairs(naive.process_batch(batch))
+    assert total_fast == total_naive
+    return total_fast
+
+
+def test_randomized_cross_check_small():
+    hits = _random_cross_check(
+        n_validators=64, n_epochs=48, n_atts=300, batch_size=16, seed=11
+    )
+    assert hits  # the load is dense enough that conflicts exist
+
+
+def test_randomized_cross_check_single_steps():
+    """Batch size 1: exact kind agreement (no intra-batch order skew)."""
+
+    def exact_pairs(dets):
+        out = set()
+        for kind, sl in dets:
+            inter = set(
+                int(i) for i in sl["attestation_1"]["attesting_indices"]
+            ) & set(int(i) for i in sl["attestation_2"]["attesting_indices"])
+            out.update((kind, v) for v in inter)
+        return out
+
+    rng = np.random.default_rng(5)
+    fast = AttesterSlasher(history_length=128, chunk_size=8)
+    naive = NaiveAttesterSlasher()
+    for _ in range(250):
+        t = int(rng.integers(1, 40))
+        s = int(rng.integers(0, t + 1))
+        v = int(rng.integers(0, 24))
+        root = bytes([int(rng.integers(0, 5))]) * 32
+        batch = [_att([v], s, t, root=root)]
+        assert exact_pairs(fast.process_batch(batch)) == exact_pairs(
+            naive.process_batch(batch)
+        )
+
+
+@pytest.mark.slow
+def test_randomized_cross_check_1k():
+    """Acceptance-scale cross-check: 1k validators x 1k epochs."""
+    hits = _random_cross_check(
+        n_validators=1000, n_epochs=1000, n_atts=4000, batch_size=64, seed=3
+    )
+    assert hits
+
+
+# -- proposer detection -----------------------------------------------------
+
+
+def _signed_header(slot, proposer, body_root, sig=b"\x00" * 96):
+    return {
+        "message": {
+            "slot": slot,
+            "proposer_index": proposer,
+            "parent_root": b"\x01" * 32,
+            "state_root": b"\x02" * 32,
+            "body_root": body_root,
+        },
+        "signature": sig,
+    }
+
+
+def test_proposer_double_propose_index():
+    p = ProposerSlasher()
+    assert p.process(_signed_header(3, 7, b"\x0a" * 32)) is None
+    # identical header re-observed: no-op
+    assert p.process(_signed_header(3, 7, b"\x0a" * 32)) is None
+    # same slot+proposer, different body: double proposal
+    sl = p.process(_signed_header(3, 7, b"\x0b" * 32))
+    assert sl is not None
+    assert sl["signed_header_1"]["message"]["body_root"] == b"\x0a" * 32
+    # a different proposer at the same slot is clean
+    assert p.process(_signed_header(3, 8, b"\x0c" * 32)) is None
+    p.prune(4)
+    assert p.record_count() == 0
+
+
+# -- persistence ------------------------------------------------------------
+
+
+def _signed_block(slot, proposer, graffiti=b"\x00" * 32):
+    body = _empty_altair_body()
+    body["graffiti"] = graffiti
+    return {
+        "message": {
+            "slot": slot,
+            "proposer_index": proposer,
+            "parent_root": b"\x00" * 32,
+            "state_root": b"\x00" * 32,
+            "body": body,
+        },
+        "signature": b"\x00" * 96,
+    }
+
+
+def test_store_roundtrip_and_restart_detection():
+    from lodestar_tpu.db.beacon_db import BeaconDb
+
+    db = BeaconDb(None)
+    svc = SlasherService(chain=None, db=db, history_length=64, chunk_size=8)
+    svc.start()
+    svc.ingest_attestation(_att([4], 1, 4, root=b"\x01" * 32))
+    svc.flush()
+    svc.stop()
+
+    # a fresh service over the same db replays the evidence and detects
+    # the surround against PRE-RESTART history
+    svc2 = SlasherService(chain=None, db=db, history_length=64, chunk_size=8)
+    svc2.start()
+    assert svc2.attester.record_count() == 1
+    assert svc2.attester.spans.num_validators >= 5
+    svc2.ingest_attestation(_att([4], 0, 5, root=b"\x02" * 32))
+    svc2.flush()
+    assert svc2.detections["surround"] == 1
+
+    # proposer equivocation: BOTH headers persist (root-keyed), and the
+    # double proposal is detected live
+    svc2.ingest_block(_signed_block(9, 2))
+    svc2.ingest_block(_signed_block(9, 2, graffiti=b"\x42" * 32))
+    assert svc2.detections["double_propose"] == 1
+
+    # a restart between detection and block inclusion RE-EMITS both the
+    # attester and the proposer detections from persisted evidence
+    svc3 = SlasherService(chain=None, db=db, history_length=64, chunk_size=8)
+    svc3.start()
+    assert svc3.detections["surround"] == 1
+    assert svc3.detections["double_propose"] == 1
+    assert svc3.proposer.record_count() == 1
+
+
+def test_proposer_rejection_cap_bounds_forged_duplicates():
+    """A flood of forged duplicate headers for one (slot, proposer) is
+    written off after MAX_PROPOSER_REJECTIONS failed dry-runs — the
+    per-candidate head-state clone + BLS cost is bounded."""
+    from lodestar_tpu.slasher.service import MAX_PROPOSER_REJECTIONS
+
+    class RejectingChain:
+        config = None
+
+        def __init__(self):
+            self.calls = 0
+
+        def validate_proposer_slashing(self, _sl):
+            self.calls += 1
+            raise ValueError("forged signature")
+
+    chain = RejectingChain()
+    svc = SlasherService(chain)
+    svc.ingest_block(_signed_block(3, 1), body_root=b"\x00" * 32)
+    for i in range(1, 20):
+        svc.ingest_block(
+            _signed_block(3, 1), body_root=bytes([i]) + b"\x00" * 31
+        )
+    assert chain.calls == MAX_PROPOSER_REJECTIONS
+    assert svc.rejected == MAX_PROPOSER_REJECTIONS
+    # a different (slot, proposer) is unaffected
+    svc.ingest_block(_signed_block(4, 1), body_root=b"\x00" * 32)
+    svc.ingest_block(_signed_block(4, 1), body_root=b"\x01" * 32)
+    assert chain.calls == MAX_PROPOSER_REJECTIONS + 1
+
+
+def test_equivocation_probe_gating():
+    """The suppressed-double-vote probe gate: conflicts are visible in
+    flushed records AND the pending queue; keys are consumed on OUTCOME
+    (a forged failure cannot burn the real vote's key, but failures are
+    bounded per key)."""
+    from lodestar_tpu.slasher.service import MAX_EQUIVOCATION_PROBE_FAILURES
+    from lodestar_tpu.types import AttestationData
+
+    svc = SlasherService(chain=None, history_length=64, chunk_size=8)
+    a = _att([7], 1, 4, root=b"\x01" * 32)
+    root_a = bytes(AttestationData.hash_tree_root(a["data"]))
+    b = _att([7], 1, 4, root=b"\x02" * 32)
+    root_b = bytes(AttestationData.hash_tree_root(b["data"]))
+
+    # nothing known yet: no probe
+    assert not svc.should_check_equivocation(7, 4, root_b)
+    # first vote QUEUED (not yet flushed): the queue scan sees it
+    svc.ingest_attestation(a)
+    assert svc.should_check_equivocation(7, 4, root_b)
+    assert not svc.should_check_equivocation(7, 4, root_a)  # same data
+    # flushed records keep answering
+    svc.flush()
+    assert svc.should_check_equivocation(7, 4, root_b)
+    # failed verifications (forged copies) bound the per-key cost but
+    # do NOT consume the key until the bound is hit
+    for _ in range(MAX_EQUIVOCATION_PROBE_FAILURES - 1):
+        svc.record_equivocation_probe([7], 4, root_b, ok=False)
+        assert svc.should_check_equivocation(7, 4, root_b)
+    svc.record_equivocation_probe([7], 4, root_b, ok=False)
+    assert not svc.should_check_equivocation(7, 4, root_b)
+    # a successful probe marks the key done
+    c = _att([7], 2, 4, root=b"\x03" * 32)
+    root_c = bytes(AttestationData.hash_tree_root(c["data"]))
+    assert svc.should_check_equivocation(7, 4, root_c)
+    svc.record_equivocation_probe([7], 4, root_c, ok=True)
+    assert not svc.should_check_equivocation(7, 4, root_c)
+
+
+def _empty_altair_body():
+    return {
+        "randao_reveal": b"\x00" * 96,
+        "eth1_data": {
+            "deposit_root": b"\x00" * 32,
+            "deposit_count": 0,
+            "block_hash": b"\x00" * 32,
+        },
+        "graffiti": b"\x00" * 32,
+        "proposer_slashings": [],
+        "attester_slashings": [],
+        "attestations": [],
+        "deposits": [],
+        "voluntary_exits": [],
+        "sync_aggregate": {
+            "sync_committee_bits": [False] * P.SYNC_COMMITTEE_SIZE,
+            "sync_committee_signature": bytes([0xC0]) + b"\x00" * 95,
+        },
+    }
+
+
+# -- service level: gossip -> detection -> pool -> API -> block -------------
+
+
+# The chain anchors on a BLOCK at epoch 2's second slot: the gossip
+# clock window (head-32 .. head+1) then spans epoch 1 (slots 33-63) AND
+# epoch 2 (slots 64-66), so one validator can legitimately sign
+# attestations with two different target epochs — required now that
+# gossip enforces the p2p spec rule target.epoch == epoch_of(slot).
+ANCHOR_SLOT = 65
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    cfg = dataclasses.replace(cfg, SHARD_COMMITTEE_PERIOD=0)
+    sks = [B.keygen(b"slash-%d" % i) for i in range(N_KEYS)]
+    pk_points = [B.sk_to_pk(sk) for sk in sks]
+    pks = [C.g1_compress(p) for p in pk_points]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    # anchor on a produced block (its post state), checkpoint-sync style
+    from lodestar_tpu.chain.produce_block import produce_block
+    from lodestar_tpu.ssz import uint64
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+
+    pre = genesis.clone()
+    process_slots(pre, ANCHOR_SLOT)
+    proposer = get_beacon_proposer_index(pre)
+    reveal = B.sign_bytes(
+        sks[proposer],
+        cfg.compute_signing_root(
+            uint64.hash_tree_root(ANCHOR_SLOT // params.SLOTS_PER_EPOCH),
+            cfg.get_domain(ANCHOR_SLOT, params.DOMAIN_RANDAO),
+        ),
+    )
+    _b, anchor = produce_block(genesis, ANCHOR_SLOT, reveal)
+    chain = BeaconChain(cfg, anchor)
+    verifier = CpuBlsVerifier(pubkeys=pk_points)
+    handlers = GossipHandlers(chain, verifier)
+    slasher = SlasherService(
+        chain, registry=Registry(), history_length=64, chunk_size=8
+    )
+    slasher.start()
+    chain.slasher = slasher
+    handlers.slasher = slasher
+    bus = InMemoryGossipBus()
+    digest = cfg.fork_digest(0)
+    handlers.subscribe_all(
+        bus,
+        "b",
+        digest,
+        attnets=tuple(range(params.ATTESTATION_SUBNET_COUNT)),
+        syncnets=(),
+    )
+    return {
+        "cfg": cfg,
+        "sks": sks,
+        "pks": pks,
+        "state": anchor,
+        "chain": chain,
+        "handlers": handlers,
+        "slasher": slasher,
+        "bus": bus,
+        "digest": digest,
+    }
+
+
+def _publish(w, name, sszt, obj, subnet=None):
+    topic = topic_string(w["digest"], name, subnet=subnet)
+    return w["bus"].publish("a", topic, encode_message(sszt.serialize(obj)))
+
+
+def _cps(state, epoch):
+    from lodestar_tpu.state_transition.accessors import (
+        get_committee_count_per_slot,
+    )
+
+    return get_committee_count_per_slot(state, epoch)
+
+
+def _duty(state, v, lo, hi):
+    """(slot, index, committee, pos) of v's committee seat in [lo, hi)."""
+    for slot in range(lo, hi):
+        for index in range(_cps(state, slot // params.SLOTS_PER_EPOCH)):
+            com = get_beacon_committee(state, slot, index)
+            for pos, m in enumerate(com):
+                if int(m) == v:
+                    return slot, index, com, pos
+    return None
+
+
+def _pick_equivocator(state):
+    """A validator with duties in BOTH gossipable epochs: epoch 2 at
+    slots 64..head+1 and epoch 1 inside the window (slots 33-63)."""
+    spe = params.SLOTS_PER_EPOCH
+    for slot2 in range(2 * spe, ANCHOR_SLOT + 2):
+        for index in range(_cps(state, 2)):
+            for v in get_beacon_committee(state, slot2, index):
+                duty1 = _duty(state, int(v), ANCHOR_SLOT - spe + 1, 2 * spe)
+                if duty1 is not None:
+                    duty2 = _duty(state, int(v), slot2, slot2 + 1)
+                    return int(v), duty1, duty2
+    pytest.skip("no validator with duties in both window epochs")
+
+
+def _subnet(state, slot, index):
+    return (
+        (slot % params.SLOTS_PER_EPOCH)
+        * _cps(state, slot // params.SLOTS_PER_EPOCH)
+        + index
+    ) % params.ATTESTATION_SUBNET_COUNT
+
+
+def _gossip_att(w, validator, duty, source, target_root=None):
+    slot, index, committee, pos = duty
+    head_root = w["chain"].get_head_root()
+    data = {
+        "slot": slot,
+        "index": index,
+        "beacon_block_root": head_root,
+        "source": {"epoch": source, "root": b"\x00" * 32},
+        # spec rule: target epoch == the slot's epoch
+        "target": {
+            "epoch": slot // params.SLOTS_PER_EPOCH,
+            "root": target_root or head_root,
+        },
+    }
+    store = ValidatorStore(w["cfg"], dict(enumerate(w["sks"])))
+    sig = store.sign_attestation(validator, data)
+    bits = [i == pos for i in range(len(committee))]
+    return {"aggregation_bits": bits, "data": data, "signature": sig}
+
+
+def test_forged_surround_via_gossip_roundtrip(world):
+    w = world
+    v, duty1, duty2 = _pick_equivocator(w["state"])
+
+    # two individually-valid gossip attestations forming a surround:
+    # (source 1, target 1) in epoch 1, then (source 0, target 2) in
+    # epoch 2 — the second SURROUNDS the first (and the first is the
+    # source==target edge, live); target epochs match their slots
+    att1 = _gossip_att(w, v, duty1, source=1)
+    sub1 = _subnet(w["state"], duty1[0], duty1[1])
+    assert (
+        _publish(w, GossipTopicName.beacon_attestation, T.Attestation, att1, sub1)
+        == 1
+    )
+    att2 = _gossip_att(w, v, duty2, source=0)
+    sub2 = _subnet(w["state"], duty2[0], duty2[1])
+    assert (
+        _publish(w, GossipTopicName.beacon_attestation, T.Attestation, att2, sub2)
+        == 1
+    )
+    results = w["handlers"].results
+    n_accepts = sum(
+        r.get("accept", 0)
+        for t, r in results.items()
+        if t.startswith("beacon_attestation_")
+    )
+    assert n_accepts == 2
+
+    # ONE batch flush detects, validates (full STF dry-run), pools
+    assert w["slasher"].flush() == 1
+    assert w["slasher"].detections["surround"] == 1
+    pool = w["chain"].op_pool
+    assert any(v in key for key in pool._attester_slashings)
+    assert v in w["chain"].fork_choice._equivocating
+
+    # API view: the spec pool route and the slasher status route
+    from lodestar_tpu.api.routes import match
+    from lodestar_tpu.api.server import DefaultHandlers
+
+    api = DefaultHandlers(chain=w["chain"], slasher=w["slasher"])
+    route, _params = match("GET", "/eth/v1/beacon/pool/attester_slashings")
+    code, body = getattr(api, route.handler)({}, None)
+    assert code == 200 and len(body["data"]) == 1
+    route, _params = match("GET", "/eth/v1/lodestar/slasher")
+    code, body = getattr(api, route.handler)({}, None)
+    assert code == 200
+    assert body["data"]["detections"]["surround"] == 1
+
+    # block inclusion round-trip: the pooled slashing lands in a block
+    # and the offender leaves slashed after a FULLY verified transition
+    from lodestar_tpu.chain.op_pools import AggregatedAttestationPool
+    from lodestar_tpu.chain.produce_block import produce_block_from_pools
+    from lodestar_tpu.ssz import uint64
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+
+    slot = ANCHOR_SLOT + 1
+    pre = w["state"].clone()
+    process_slots(pre, slot)
+    proposer = get_beacon_proposer_index(pre)
+    domain = w["cfg"].get_domain(slot, params.DOMAIN_RANDAO)
+    reveal = B.sign_bytes(
+        w["sks"][proposer],
+        w["cfg"].compute_signing_root(
+            uint64.hash_tree_root(slot // params.SLOTS_PER_EPOCH), domain
+        ),
+    )
+    block, _post = produce_block_from_pools(
+        w["state"],
+        slot,
+        reveal,
+        aggregated_attestation_pool=AggregatedAttestationPool(),
+        op_pool=pool,
+        contribution_pool=w["chain"].sync_contribution_pool,
+        head_root=w["chain"].get_head_root(),
+    )
+    assert len(block["body"]["attester_slashings"]) == 1
+    proot = w["cfg"].compute_signing_root(
+        T.BeaconBlockAltair.hash_tree_root(block),
+        w["cfg"].get_domain(slot, params.DOMAIN_BEACON_PROPOSER),
+    )
+    signed = {
+        "message": block,
+        "signature": B.sign_bytes(w["sks"][proposer], proot),
+    }
+    post = state_transition(
+        w["state"],
+        signed,
+        verify_state_root=True,
+        verify_proposer=True,
+        verify_signatures=True,
+    )
+    assert bool(post.slashed[v])
+
+    # the slasher re-submitting the same offence is a pool no-op
+    n = len(pool._attester_slashings)
+    w["slasher"].ingest_attestation(
+        w["chain"].op_pool._attester_slashings[
+            next(iter(pool._attester_slashings))
+        ]["attestation_1"]
+    )
+    w["slasher"].flush()
+    assert len(pool._attester_slashings) == n
+
+
+def test_suppressed_double_vote_recovered_from_seen_cache(world):
+    """A double vote shares its target epoch, so the second gossip
+    attestation IGNOREs at the seen-attester cache — the handler's
+    recovery path must still verify and ingest it (the duplicate IS the
+    equivocation, same as the duplicate-proposer block branch)."""
+    w = world
+    v, duty1, _duty2 = _pick_equivocator(w["state"])
+    subnet = _subnet(w["state"], duty1[0], duty1[1])
+    assert w["slasher"].attester.has_conflicting_target(v, 1, b"\x00" * 32)
+
+    # same slot/target epoch as the recorded vote, different target
+    # root => different data root; the seen cache IGNOREs it
+    # pre-signature
+    att_b = _gossip_att(w, v, duty1, source=1, target_root=b"\x99" * 32)
+    assert (
+        _publish(w, GossipTopicName.beacon_attestation, T.Attestation, att_b, subnet)
+        == 1
+    )
+    assert (
+        w["handlers"].results[f"beacon_attestation_{subnet}"]["ignore"] >= 1
+    )
+    w["slasher"].flush()
+    assert w["slasher"].detections["double_vote"] == 1
+    # v's offence was already covered by the pooled surround slashing:
+    # the pool stays deduped while the detection still counts
+    assert any(v in key for key in w["chain"].op_pool._attester_slashings)
+
+
+def test_forged_double_proposal_via_gossip(world):
+    w = world
+    from lodestar_tpu.chain.produce_block import produce_block
+    from lodestar_tpu.ssz import uint64
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+
+    slot = ANCHOR_SLOT + 1
+    pre = w["state"].clone()
+    process_slots(pre, slot)
+    proposer = get_beacon_proposer_index(pre)
+    domain = w["cfg"].get_domain(slot, params.DOMAIN_RANDAO)
+    reveal = B.sign_bytes(
+        w["sks"][proposer],
+        w["cfg"].compute_signing_root(
+            uint64.hash_tree_root(slot // params.SLOTS_PER_EPOCH), domain
+        ),
+    )
+
+    def sign_block(block):
+        proot = w["cfg"].compute_signing_root(
+            T.BeaconBlockAltair.hash_tree_root(block),
+            w["cfg"].get_domain(slot, params.DOMAIN_BEACON_PROPOSER),
+        )
+        return {
+            "message": block,
+            "signature": B.sign_bytes(w["sks"][proposer], proot),
+        }
+
+    b1, _ = produce_block(w["state"], slot, reveal)
+    b2, _ = produce_block(w["state"], slot, reveal, graffiti=b"\x42" * 32)
+    assert (
+        _publish(
+            w, GossipTopicName.beacon_block, T.SignedBeaconBlockAltair, sign_block(b1)
+        )
+        == 1
+    )
+    assert w["handlers"].results["beacon_block"]["accept"] == 1
+    # the equivocating second block IGNOREs at gossip but STILL reaches
+    # the slasher, which detects within the (immediate) header index
+    assert (
+        _publish(
+            w, GossipTopicName.beacon_block, T.SignedBeaconBlockAltair, sign_block(b2)
+        )
+        == 1
+    )
+    assert w["handlers"].results["beacon_block"]["ignore"] == 1
+    assert w["slasher"].detections["double_propose"] == 1
+    assert int(proposer) in w["chain"].op_pool._proposer_slashings
+
+    from lodestar_tpu.api.routes import match
+    from lodestar_tpu.api.server import DefaultHandlers
+
+    api = DefaultHandlers(chain=w["chain"], slasher=w["slasher"])
+    route, _params = match("GET", "/eth/v1/beacon/pool/proposer_slashings")
+    code, body = getattr(api, route.handler)({}, None)
+    assert code == 200 and len(body["data"]) == 1
