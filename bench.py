@@ -70,20 +70,25 @@ def _metric_name() -> str:
     return "bls_signature_sets_verified_per_s"
 
 
-def _emit_failure(stage: str, detail: str) -> None:
+def _emit_failure(
+    stage: str, detail: str, metric: str = None, unit: str = "sets/s"
+) -> None:
     """One machine-readable diagnosis line on stdout (the driver parses
     stdout for the JSON record; a traceback alone parses to nothing).
 
     A failed run is SKIPPED, not measured: value is null (round 5
     published `value: 0.0` for a dead-tunnel probe failure, which reads
     as a measured zero), and "skipped": true marks the record so
-    BENCH_*.json consumers never average a failure into a trend."""
+    BENCH_*.json consumers never average a failure into a trend.
+    `metric`/`unit` default to the headline BLS metric; secondary probes
+    (state_roots_per_s) pass their own so every skip record shares ONE
+    schema."""
     print(
         json.dumps(
             {
-                "metric": _metric_name(),
+                "metric": metric or _metric_name(),
                 "value": None,
-                "unit": "sets/s",
+                "unit": unit,
                 "vs_baseline": None,
                 "skipped": True,
                 "error": f"{stage}: {detail}"[-2000:],
@@ -210,10 +215,78 @@ def _arm_watchdog() -> None:
     t.start()
 
 
+# state_roots_per_s probe: synthetic large state, mutate-k-per-slot
+# cadence (dev/microbench_htr.py).  Pure-CPU in a subprocess with
+# JAX_PLATFORMS=cpu, run BEFORE the TPU backend probe so the record
+# lands even when the tunnel is dead and the BLS headline skips.
+BENCH_HTR_TIMEOUT_S = float(os.environ.get("BENCH_HTR_TIMEOUT", "420"))
+BENCH_HTR_VALIDATORS = int(os.environ.get("BENCH_HTR_VALIDATORS", "100000"))
+
+
+def _probe_state_roots() -> None:
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dev", "microbench_htr.py"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--json",
+                "--validators",
+                str(BENCH_HTR_VALIDATORS),
+                "--slots",
+                "16",
+                "--full-reps",
+                "2",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=BENCH_HTR_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _emit_failure(
+            "state-roots-probe",
+            f"exceeded {BENCH_HTR_TIMEOUT_S:.0f}s",
+            metric="state_roots_per_s",
+            unit="roots/s",
+        )
+        return
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    if p.returncode != 0 or not lines:
+        detail = (
+            (p.stderr or p.stdout).strip().splitlines()[-1]
+            if (p.stderr or p.stdout).strip()
+            else f"probe exited rc={p.returncode}"
+        )
+        _emit_failure(
+            "state-roots-probe", detail,
+            metric="state_roots_per_s", unit="roots/s",
+        )
+        return
+    try:
+        record = json.loads(lines[-1])
+        # keep the record schema uniform with every other bench emit:
+        # {metric, value, unit, vs_baseline} (no baseline is defined for
+        # state roots — the old full recompute is reported alongside)
+        record.setdefault("vs_baseline", None)
+        print(json.dumps(record), flush=True)
+    except ValueError:
+        _emit_failure(
+            "state-roots-probe", "unparseable probe output",
+            metric="state_roots_per_s", unit="roots/s",
+        )
+
+
 _BENCH_PLATFORM = os.environ.get("BENCH_PLATFORM", "tpu")
 if _BENCH_PLATFORM not in ("tpu", "cpu"):
     _emit_failure("config", f"BENCH_PLATFORM={_BENCH_PLATFORM!r} not in {{tpu,cpu}}")
     sys.exit(2)
+
+if __name__ == "__main__" and os.environ.get("BENCH_HTR", "1") != "0":
+    _probe_state_roots()
 
 if __name__ == "__main__" and _BENCH_PLATFORM == "tpu":
     # The probe is SELF-bounded (subprocess timeouts x retries); the

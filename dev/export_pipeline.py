@@ -225,6 +225,14 @@ def main():
                         f"replay export ({v}) failed: "
                         f"{type(e).__name__}: {e}"
                     )
+    # standalone registry entries (kernels outside the verify pipeline's
+    # dispatch capture — e.g. the slasher's whole-window span update)
+    if os.environ.get("EXPORT_REGISTERED", "1") != "0":
+        try:
+            for name, key in EC.export_registered(PLATFORM).items():
+                print(f"registered entry {name} ready ({key})")
+        except Exception as e:  # noqa: BLE001
+            print(f"registered-entry export failed: {type(e).__name__}: {e}")
     captured = capture_bench_dispatches()
     seen = set()
     for name, fn, specs in captured:

@@ -340,6 +340,37 @@ class BeaconChain:
             except Exception as e:  # noqa: BLE001 — detection must not
                 # break the import pipeline
                 self.log.warn("slasher block ingestion failed", error=str(e))
+            # block-BODY attestations feed surround detection too: an
+            # attacker can route one half of an equivocation only
+            # through a block (it never transits gossip on this node —
+            # range sync, API publish, or a proposer packing its own
+            # vote), and the span window must still see it.  They are
+            # STF-validated (signatures batch-verified at import) and
+            # the per-(validator, data) dedupe makes gossip-seen copies
+            # no-ops.  Committee translation rides the post-state's
+            # per-epoch shuffle memo, so this is index arithmetic, not
+            # a re-shuffle.
+            from ..state_transition.accessors import get_attesting_indices
+
+            for att in block["body"].get("attestations", ()):
+                # per-attestation fault isolation: one untranslatable
+                # attestation must not blind the span window to the
+                # rest of the body
+                try:
+                    self.slasher.ingest_attestation(
+                        {
+                            "attesting_indices": get_attesting_indices(
+                                post, att["data"], att["aggregation_bits"]
+                            ),
+                            "data": att["data"],
+                            "signature": att["signature"],
+                        }
+                    )
+                except Exception as e:  # noqa: BLE001
+                    self.log.warn(
+                        "slasher body-attestation ingestion failed",
+                        error=str(e),
+                    )
 
         # FFG bookkeeping: move the proto array's justified/finalized
         # filter + justified root as the chain justifies (reference

@@ -309,5 +309,7 @@ def produce_block(
         {"message": block, "signature": b"\x00" * 96},
         verify_state_root=False,
     )
+    # the STF clone shared the head state's merkle engine, so the
+    # proposal's state root only re-hashes what this block touched
     block["state_root"] = post.hash_tree_root()
     return block, post

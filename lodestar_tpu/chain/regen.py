@@ -55,6 +55,10 @@ class StateRegenerator:
     # -- bookkeeping (called by the import pipeline) -----------------------
 
     def on_imported_block(self, block_root: bytes, post_state) -> None:
+        # post_state carries a warm incremental-merkleization engine
+        # (BeaconState.clone() shares it copy-on-write), so this root is
+        # a cache compose and replayed/checkpoint states regenerated
+        # from the cached state inherit warm trees
         state_root = post_state.hash_tree_root().hex()
         self.block_state_roots[block_root.hex()] = state_root
         self.state_cache.add_with_root(state_root, post_state)

@@ -36,6 +36,14 @@ DEFAULT_DIR = os.environ.get(
 _LOADED: Dict[str, object] = {}
 
 
+# Kernel sources OUTSIDE kernels/ whose traced computations live in the
+# cache, keyed per entry NAME (standalone registry entries declare
+# theirs at registration).  They fold into THAT entry's artifact key
+# only — an edit to slasher/device.py must invalidate the span-update
+# artifact without staling every verify-pipeline artifact on the host.
+_ENTRY_SOURCES: Dict[str, str] = {}
+
+
 def _code_fingerprint() -> str:
     """Hash of every kernels/*.py source file: a kernel edit invalidates
     all artifacts (they embed the traced computation)."""
@@ -63,6 +71,11 @@ def artifact_key(
 ) -> str:
     sig = ";".join(f"{tuple(s.shape)}:{s.dtype}" for s in specs)
     raw = f"{name}|{sig}|{platform}|{jax.__version__}|{code_fingerprint()}"
+    source = _ENTRY_SOURCES.get(name)
+    if source is not None:
+        path = pathlib.Path(source)
+        if path.exists():
+            raw += "|" + hashlib.sha256(path.read_bytes()).hexdigest()[:16]
     return (
         name
         + "-"
@@ -142,3 +155,57 @@ def load_or_export(
     if cached is not None:
         return cached
     return export_and_save(name, fn, specs, platform, cache_dir)
+
+
+# -- standalone entry registry ----------------------------------------------
+#
+# Entries that don't flow through the verify pipeline's dispatch capture
+# (dev/export_pipeline.py) register a spec builder here so offline
+# pre-tracing covers them too.  A builder returns (fn, specs); it is
+# invoked lazily — registration itself must stay import-cheap.
+
+_ENTRY_BUILDERS: Dict[str, Callable] = {}
+
+
+def register_entry(
+    name: str, builder: Callable, source: Optional[str] = None
+) -> None:
+    _ENTRY_BUILDERS[name] = builder
+    if source is not None:
+        _ENTRY_SOURCES[name] = source
+
+
+def registered_entries() -> Dict[str, Callable]:
+    return dict(_ENTRY_BUILDERS)
+
+
+def export_registered(platform: str, cache_dir: Optional[str] = None) -> Dict[str, str]:
+    """Trace + persist every registered standalone entry; returns
+    name -> artifact key (the export pipeline's pre-trace hook)."""
+    out = {}
+    for name, builder in _ENTRY_BUILDERS.items():
+        fn, specs = builder()
+        load_or_export(name, fn, specs, platform, cache_dir)
+        out[name] = artifact_key(name, specs, platform)
+    return out
+
+
+def _register_builtin_entries() -> None:
+    """Register the subsystem kernels that live outside kernels/ (the
+    slasher's whole-window span update)."""
+
+    def _slasher_span():
+        from ..slasher.device import export_specs
+
+        return export_specs()
+
+    register_entry(
+        "slasher_span_update",
+        _slasher_span,
+        source=str(
+            pathlib.Path(__file__).parent.parent / "slasher" / "device.py"
+        ),
+    )
+
+
+_register_builtin_entries()

@@ -14,6 +14,7 @@ from .attester import (
     is_surround_vote,
 )
 from .batch import SpanState, span_update_rows
+from .device import JaxSpanState, span_update_planes
 from .metrics import SlasherMetrics
 from .proposer import ProposerSlasher
 from .service import SlasherService
@@ -21,6 +22,7 @@ from .store import SlasherStore
 
 __all__ = [
     "AttesterSlasher",
+    "JaxSpanState",
     "NaiveAttesterSlasher",
     "ProposerSlasher",
     "SlasherMetrics",
@@ -29,5 +31,6 @@ __all__ = [
     "SpanState",
     "is_double_vote",
     "is_surround_vote",
+    "span_update_planes",
     "span_update_rows",
 ]

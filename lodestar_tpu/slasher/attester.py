@@ -55,12 +55,24 @@ class AttesterSlasher:
         history_length: int = DEFAULT_HISTORY_LENGTH,
         chunk_size: int = DEFAULT_CHUNK_SIZE,
         num_validators: int = 0,
+        span_backend: str = "numpy",
     ):
-        self.spans = SpanState(
-            num_validators=num_validators,
-            history_length=history_length,
-            chunk_size=chunk_size,
-        )
+        if span_backend == "jax":
+            # device-resident planes + jitted whole-window updates
+            # (slasher/device.py); numpy stays the ground truth
+            from .device import JaxSpanState
+
+            self.spans = JaxSpanState(
+                num_validators=num_validators,
+                history_length=history_length,
+                chunk_size=chunk_size,
+            )
+        else:
+            self.spans = SpanState(
+                num_validators=num_validators,
+                history_length=history_length,
+                chunk_size=chunk_size,
+            )
         # validator -> {(source, target): (data_root, indexed_att)}
         self._records: Dict[int, Dict[Tuple[int, int], Tuple[bytes, dict]]] = {}
         # (validator, target) -> (data_root, indexed_att) — double votes

@@ -1,0 +1,224 @@
+"""Device-resident span planes — the slasher's TPU path.
+
+`batch.py::span_update_rows` was written shape-stable / iota-masked /
+constant-free exactly so it could move onto the accelerator without
+restructuring (the Mosaic constraints the kernels/ package taught).
+This module is that move: `JaxSpanState` keeps the (n_validators,
+history) min/max span planes resident on the device and applies each
+distinct AttestationData as ONE jitted whole-window masked update —
+no per-chunk Python loop, no host round-trip per apply.
+
+The kernel is registered with the AOT export cache
+(kernels/export_cache.py, entry "slasher_span_update") so a TPU
+process deserializes the traced artifact instead of re-tracing; on CPU
+hosts it runs through plain jax.jit.  The numpy `SpanState` remains
+the ground truth — `tests/test_slasher.py` cross-checks the two — and
+is the default; opt in with `LODESTAR_TPU_SLASHER_BACKEND=jax` (or
+`SlasherService(span_backend="jax")`).
+
+Rare window operations (chunk-aligned advance on finalization,
+geometric validator growth) round-trip through numpy: they happen per
+finalized epoch / per registration trickle, not per attestation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .batch import (
+    DEFAULT_CHUNK_SIZE,
+    DEFAULT_HISTORY_LENGTH,
+    MAX_SPAN_SENTINEL,
+    MIN_SPAN_SENTINEL,
+    SpanState,
+)
+
+
+def span_update_planes(min_sp, max_sp, row_mask, s_col, t_col):
+    """Whole-window span update: jnp mirror of span_update_rows with the
+    chunk translation folded away (global column iota, row mask instead
+    of fancy indexing — gathers break the Mosaic export path)."""
+    import jax
+    import jax.numpy as jnp
+
+    cols = jax.lax.broadcasted_iota(jnp.int32, min_sp.shape, 1)
+    dist = t_col - cols
+    upd = row_mask[:, None]
+    new_min = jnp.where(
+        upd & (cols < s_col), jnp.minimum(min_sp, dist), min_sp
+    )
+    new_max = jnp.where(
+        upd & (cols > s_col) & (cols < t_col),
+        jnp.maximum(max_sp, dist),
+        max_sp,
+    )
+    return new_min, new_max
+
+
+_JITTED: Dict[Tuple[int, int, bool], object] = {}
+
+
+def _update_fn(shape: Tuple[int, int], use_export: bool):
+    """Per-plane-shape jitted (or AOT-exported) update callable.
+    Scalars are traced arguments, so one trace serves every (s, t)."""
+    import jax
+
+    key = (shape[0], shape[1], use_export)
+    fn = _JITTED.get(key)
+    if fn is not None:
+        return fn
+    jitted = jax.jit(span_update_planes)
+    if use_export:
+        import jax.numpy as jnp
+
+        from ..kernels import export_cache as EC
+
+        specs = [
+            jax.ShapeDtypeStruct(shape, jnp.int32),
+            jax.ShapeDtypeStruct(shape, jnp.int32),
+            jax.ShapeDtypeStruct((shape[0],), jnp.bool_),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        ]
+        try:
+            jitted = EC.load_or_export(
+                "slasher_span_update", span_update_planes, specs
+            )
+        except Exception:  # noqa: BLE001 — export must never take the
+            # slasher down; the plain jit path is always valid
+            pass
+    _JITTED[key] = jitted
+    return jitted
+
+
+def export_specs(
+    num_validators: int = 4096, history_length: int = DEFAULT_HISTORY_LENGTH
+):
+    """(fn, specs) for the export pipeline's pre-trace registry."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = (num_validators, history_length)
+    return span_update_planes, [
+        jax.ShapeDtypeStruct(shape, jnp.int32),
+        jax.ShapeDtypeStruct(shape, jnp.int32),
+        jax.ShapeDtypeStruct((shape[0],), jnp.bool_),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+    ]
+
+
+class JaxSpanState(SpanState):
+    """SpanState with device-resident planes and a jitted apply.
+
+    `min_spans`/`max_spans` hold jax arrays between applies; the numpy
+    superclass paths (window advance, growth, persistence snapshots)
+    see materialized copies on demand and push the result back.
+    """
+
+    def __init__(
+        self,
+        num_validators: int = 0,
+        history_length: int = DEFAULT_HISTORY_LENGTH,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+        base_epoch: int = 0,
+        use_export: bool = None,
+    ):
+        super().__init__(
+            num_validators=num_validators,
+            history_length=history_length,
+            chunk_size=chunk_size,
+            base_epoch=base_epoch,
+        )
+        if use_export is None:
+            import os
+
+            env = os.environ.get("LODESTAR_TPU_SLASHER_EXPORT")
+            if env is not None:
+                use_export = env.strip().lower() in ("1", "true", "yes", "on")
+            else:
+                import jax
+
+                use_export = jax.default_backend() == "tpu"
+        self.use_export = bool(use_export)
+
+    # -- host <-> device ---------------------------------------------------
+
+    def _to_host(self) -> None:
+        """Materialize the planes as (writable) numpy before a host-side
+        structural operation (advance/growth/snapshot)."""
+        if not isinstance(self.min_spans, np.ndarray):
+            self.min_spans = np.asarray(self.min_spans).copy()
+            self.max_spans = np.asarray(self.max_spans).copy()
+
+    def _to_device(self) -> None:
+        import jax.numpy as jnp
+
+        if isinstance(self.min_spans, np.ndarray):
+            self.min_spans = jnp.asarray(self.min_spans)
+            self.max_spans = jnp.asarray(self.max_spans)
+
+    # -- structural ops run on host (rare: finalization / registration) ----
+
+    def ensure_validators(self, n: int) -> None:
+        if n <= self.num_validators:
+            return
+        self._to_host()
+        super().ensure_validators(n)
+
+    def advance_base(self, new_base: int) -> None:
+        if new_base <= self.base_epoch:
+            return
+        self._to_host()
+        super().advance_base(new_base)
+
+    # -- hot path ----------------------------------------------------------
+
+    def lookup(self, rows: np.ndarray, source_epoch: int):
+        col = source_epoch - self.base_epoch
+        if isinstance(self.min_spans, np.ndarray):
+            return super().lookup(rows, source_epoch)
+        # one device gather per probe column, then a host-side row pick
+        min_col = np.asarray(self.min_spans[:, col])
+        max_col = np.asarray(self.max_spans[:, col])
+        return min_col[rows], max_col[rows]
+
+    def apply(self, rows: np.ndarray, source_epoch: int, target_epoch: int) -> None:
+        if len(rows) == 0:
+            return
+        import jax.numpy as jnp
+
+        self._to_device()
+        mask = np.zeros(self.num_validators, bool)
+        mask[rows] = True
+        fn = _update_fn(tuple(self.min_spans.shape), self.use_export)
+        self.min_spans, self.max_spans = fn(
+            self.min_spans,
+            self.max_spans,
+            jnp.asarray(mask),
+            jnp.int32(source_epoch - self.base_epoch),
+            jnp.int32(target_epoch - self.base_epoch),
+        )
+
+    def snapshot(self) -> SpanState:
+        """Numpy SpanState copy (persistence format compatibility)."""
+        out = SpanState(
+            num_validators=0,
+            history_length=self.history_length,
+            chunk_size=self.chunk_size,
+            base_epoch=self.base_epoch,
+        )
+        out.min_spans = np.asarray(self.min_spans, np.int32).copy()
+        out.max_spans = np.asarray(self.max_spans, np.int32).copy()
+        return out
+
+
+__all__ = [
+    "JaxSpanState",
+    "span_update_planes",
+    "export_specs",
+    "MIN_SPAN_SENTINEL",
+    "MAX_SPAN_SENTINEL",
+]

@@ -60,13 +60,25 @@ class SlasherService:
         history_length: int = DEFAULT_HISTORY_LENGTH,
         chunk_size: int = DEFAULT_CHUNK_SIZE,
         max_batch: int = DEFAULT_MAX_BATCH,
+        span_backend: Optional[str] = None,
     ):
         self.chain = chain
         self.log = get_logger("slasher")
         self.metrics = SlasherMetrics(registry) if registry is not None else None
         self.store = SlasherStore(db)
+        if span_backend is None:
+            # env opt-in for the device-resident span planes
+            # (slasher/device.py); numpy remains the default/ground truth
+            import os
+
+            span_backend = os.environ.get(
+                "LODESTAR_TPU_SLASHER_BACKEND", "numpy"
+            )
+        self.span_backend = span_backend
         self.attester = AttesterSlasher(
-            history_length=history_length, chunk_size=chunk_size
+            history_length=history_length,
+            chunk_size=chunk_size,
+            span_backend=span_backend,
         )
         self.proposer = ProposerSlasher()
         self._att_queue: List[dict] = []
@@ -114,8 +126,13 @@ class SlasherService:
             and snapshot.chunk_size == self.attester.spans.chunk_size
         ):
             # warm-start from the shutdown snapshot; the evidence replay
-            # below re-applies on top (span updates are idempotent)
-            self.attester.spans = snapshot
+            # below re-applies on top (span updates are idempotent).
+            # Planes are copied INTO the live SpanState so a jax-backed
+            # window keeps its device apply path across restarts.
+            spans = self.attester.spans
+            spans.min_spans = snapshot.min_spans
+            spans.max_spans = snapshot.max_spans
+            spans.base_epoch = snapshot.base_epoch
         atts = list(self.store.iter_attestations())
         if atts:
             for kind, slashing in self.attester.process_batch(atts):
@@ -138,7 +155,10 @@ class SlasherService:
         if not self.running:
             return
         self.flush()
-        self.store.save_spans(self.attester.spans)
+        spans = self.attester.spans
+        snapshot = getattr(spans, "snapshot", None)
+        # device-resident planes persist through a numpy materialization
+        self.store.save_spans(snapshot() if snapshot is not None else spans)
         self.running = False
 
     # -- ingestion (gossip pipeline + chain import) ------------------------

@@ -37,3 +37,4 @@ from .core import (  # noqa: F401
     is_valid_merkle_branch,
     merkleize_chunks,
 )
+from .merkle_tree import ChunkTree, hash_pairs_plane  # noqa: F401

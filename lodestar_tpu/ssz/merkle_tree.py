@@ -1,0 +1,216 @@
+"""Incremental chunked merkle tree — the persistent-merkle-tree analog.
+
+The reference pays O(state size) per state root only ONCE: its ViewDU
+states keep a persistent node tree (`@chainsafe/persistent-merkle-tree`)
+and re-hash exactly the dirty paths, level-batched through `as-sha256`
+(SURVEY.md §2.3).  `ChunkTree` is the columnar equivalent: instead of a
+pointer tree it keeps one contiguous (nodes, 32) uint8 plane PER LEVEL,
+a dirty-chunk bitset over the leaves, and re-hashes a whole level's
+dirty parents in one `hash_pairs` call (native/hashlib batched backend,
+ssz/hasher.py) — so a slot that touches k of n chunks costs
+O(k log n) hashes, not O(n).
+
+Shape of the tree: the spec's padded binary tree over 32-byte chunks.
+`limit_chunks` fixes the depth (next_pow2); chunks beyond `count` are
+implicit zeros, folded in through the precomputed zero-hash table — the
+same padding rule as `merkleize_chunks`, so roots are bit-identical.
+
+Sharing: `clone()` is O(levels) — both trees mark their planes shared
+and copy-on-write before the first mutation, which is what lets a
+cloned BeaconState (regen replay, checkpoint states, block production)
+inherit a warm tree for free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core import _ZERO_HASHES, _next_pow2, merkleize_chunks
+from .hasher import hash_pairs
+
+_U8 = np.uint8
+
+
+def _ceil_div2(n: int) -> int:
+    return (n + 1) >> 1
+
+
+def hash_pairs_plane(pairs: np.ndarray) -> np.ndarray:
+    """Batched sibling hashing over a (n, 64) uint8 plane -> (n, 32)."""
+    if pairs.size == 0:
+        return np.zeros((0, 32), _U8)
+    out = hash_pairs(pairs.tobytes())
+    return np.frombuffer(out, _U8).reshape(-1, 32)
+
+
+class ChunkTree:
+    """Dirty-tracked merkle tree over a leaf plane of 32-byte chunks.
+
+    `update(leaves)` takes the CURRENT full leaf plane, diffs it against
+    the stored one to find dirty chunks (vectorized — the conservative
+    dirty tracker: a chunk re-hashes iff its bytes changed), and
+    re-hashes only the dirty paths.  `apply(idx, rows, count)` is the
+    lower-level entry for callers that computed the dirty set
+    themselves (the validators cell, whose leaves are themselves
+    hashes).
+    """
+
+    def __init__(self, limit_chunks: int):
+        if limit_chunks < 1:
+            raise ValueError("limit_chunks must be >= 1")
+        self.limit_chunks = limit_chunks
+        self.depth = _next_pow2(limit_chunks).bit_length() - 1
+        self.count = 0
+        # levels[0] is the leaf plane; levels[k] has ceil(count / 2^k)
+        # live rows (arrays are allocated with slack and never shrink)
+        self._levels: List[np.ndarray] = [
+            np.zeros((0, 32), _U8) for _ in range(self.depth + 1)
+        ]
+        self._shared = False
+
+    # -- sharing -----------------------------------------------------------
+
+    def clone(self) -> "ChunkTree":
+        """O(levels) copy-on-write share of every node plane."""
+        out = ChunkTree.__new__(ChunkTree)
+        out.limit_chunks = self.limit_chunks
+        out.depth = self.depth
+        out.count = self.count
+        out._levels = list(self._levels)
+        out._shared = True
+        self._shared = True
+        return out
+
+    def _own(self) -> None:
+        if self._shared:
+            self._levels = [lvl.copy() for lvl in self._levels]
+            self._shared = False
+
+    # -- geometry ----------------------------------------------------------
+
+    def _rows_at(self, level: int) -> int:
+        """Live node count at `level` for the current leaf count."""
+        return (self.count + (1 << level) - 1) >> level
+
+    def _ensure_capacity(self, level: int, rows: int) -> None:
+        plane = self._levels[level]
+        if plane.shape[0] >= rows:
+            return
+        cap = max(rows, plane.shape[0] * 2, 8)
+        grown = np.zeros((cap, 32), _U8)
+        if plane.shape[0]:
+            grown[: plane.shape[0]] = plane
+        self._levels[level] = grown
+
+    # -- mutation ----------------------------------------------------------
+
+    def update(self, leaves: np.ndarray) -> None:
+        """Diff `leaves` ((n, 32) uint8) against the stored plane and
+        re-hash dirty paths.  Handles growth (appended chunks dirty) and
+        shrink (conservative: full rebuild — shrinks are rare: no state
+        list on the hot path ever shrinks)."""
+        n = leaves.shape[0]
+        if n > self.limit_chunks:
+            raise ValueError(f"chunk count {n} exceeds limit {self.limit_chunks}")
+        old_n = self.count
+        if n < old_n:
+            self.reset(leaves)
+            return
+        m = old_n
+        stored = self._levels[0]
+        if m:
+            diff = (leaves[:m] != stored[:m]).any(axis=1)
+            dirty = np.nonzero(diff)[0]
+        else:
+            dirty = np.zeros(0, np.intp)
+        if n > old_n:
+            dirty = np.concatenate([dirty, np.arange(old_n, n, dtype=np.intp)])
+        if dirty.size == 0 and n == old_n:
+            return
+        self.apply(dirty, leaves[dirty], n)
+
+    def reset(self, leaves: np.ndarray) -> None:
+        """Full rebuild from a fresh leaf plane."""
+        self._shared = False  # planes are reallocated below; never copy
+        self.count = 0
+        self._levels = [np.zeros((0, 32), _U8) for _ in range(self.depth + 1)]
+        if leaves.shape[0]:
+            self.apply(
+                np.arange(leaves.shape[0], dtype=np.intp), leaves, leaves.shape[0]
+            )
+
+    def apply(
+        self, dirty_idx: np.ndarray, rows: np.ndarray, count: int
+    ) -> None:
+        """Scatter `rows` into the leaf plane at `dirty_idx`, set the
+        live count, and re-hash every dirty path bottom-up, one batched
+        `hash_pairs` call per level."""
+        if count > self.limit_chunks:
+            raise ValueError(
+                f"chunk count {count} exceeds limit {self.limit_chunks}"
+            )
+        if count < self.count:
+            # shrink invalidates parents over the vacated range too;
+            # delegate to reset-from-scratch via the caller's full plane
+            raise ValueError("apply() cannot shrink; use reset()/update()")
+        self._own()
+        self.count = count
+        self._ensure_capacity(0, count)
+        # rows align 1:1 with dirty_idx (any order); sort both together
+        # and let the LAST write win on duplicates
+        idx = np.asarray(dirty_idx, np.intp)
+        if rows.shape[0] != idx.shape[0]:
+            raise ValueError("rows must align with dirty_idx")
+        if idx.size:
+            order = np.argsort(idx, kind="stable")
+            idx = idx[order]
+            rows = rows[order]
+            keep = np.ones(idx.shape[0], bool)
+            keep[:-1] = idx[1:] != idx[:-1]
+            idx = idx[keep]
+            rows = rows[keep]
+            self._levels[0][idx] = rows
+        for level in range(self.depth):
+            if idx.size == 0:
+                break
+            live = self._rows_at(level)
+            parents = np.unique(idx >> 1)
+            li = parents << 1
+            ri = li + 1
+            pairs = np.empty((parents.shape[0], 64), _U8)
+            plane = self._levels[level]
+            pairs[:, :32] = plane[li]
+            in_range = ri < live
+            if in_range.any():
+                pairs[in_range, 32:] = plane[ri[in_range]]
+            if (~in_range).any():
+                pairs[~in_range, 32:] = np.frombuffer(
+                    _ZERO_HASHES[level], _U8
+                )
+            parent_rows = hash_pairs_plane(pairs)
+            self._ensure_capacity(level + 1, _ceil_div2(live))
+            self._levels[level + 1][parents] = parent_rows
+            idx = parents
+
+    # -- root --------------------------------------------------------------
+
+    @property
+    def root(self) -> bytes:
+        if self.count == 0:
+            return _ZERO_HASHES[self.depth]
+        return bytes(self._levels[self.depth][0])
+
+    def leaf(self, index: int) -> bytes:
+        if index >= self.count:
+            return bytes(32)
+        return bytes(self._levels[0][index])
+
+    # -- reference check ---------------------------------------------------
+
+    def full_root_reference(self, chunks: Optional[Sequence[bytes]] = None) -> bytes:
+        """Recompute through merkleize_chunks — test oracle only."""
+        if chunks is None:
+            chunks = [bytes(self._levels[0][i]) for i in range(self.count)]
+        return merkleize_chunks(chunks, self.limit_chunks)

@@ -412,6 +412,12 @@ def process_historical_roots_update(
 def process_participation_flag_updates(
     state, cache: EpochTransitionCache
 ) -> None:
+    engine = getattr(state, "_root_engine", None)
+    if engine is not None:
+        # swap the incremental merkle caches with the rotation so the
+        # previous-epoch field diffs clean against what current held; a
+        # missing/wrong hint only costs extra hashing (state_root.py)
+        engine.note_participation_rotation()
     state.previous_epoch_participation = state.current_epoch_participation
     state.current_epoch_participation = np.zeros(
         state.num_validators, np.uint8

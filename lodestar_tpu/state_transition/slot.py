@@ -24,7 +24,12 @@ ZERO_ROOT = b"\x00" * 32
 
 
 def process_slot(state) -> None:
-    """Cache the state/block roots for the slot being closed."""
+    """Cache the state/block roots for the slot being closed.
+
+    The state root here is THE per-slot merkleization hot path; it runs
+    through the incremental engine (state_transition/state_root.py), so
+    a slot that touched k validators re-hashes O(k log n) chunks, not
+    the whole registry."""
     previous_state_root = state.hash_tree_root()
     state.state_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = (
         previous_state_root

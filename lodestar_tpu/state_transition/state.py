@@ -388,6 +388,13 @@ class BeaconState:
             if self.historical_summaries is not None
             else None
         )
+        # share the incremental-merkleization engine copy-on-write: the
+        # clone inherits warm trees (state_transition pre->post, regen
+        # replay, checkpoint states), and either side copies a plane
+        # only when its first dirty path touches it
+        engine = getattr(self, "_root_engine", None)
+        if engine is not None:
+            out._root_engine = engine.clone()
         return out
 
     def validators_value(self) -> List[Dict]:
@@ -591,7 +598,43 @@ class BeaconState:
         return BeaconStateAltair
 
     def hash_tree_root(self) -> bytes:
-        return self._container().hash_tree_root(self.to_value())
+        """State root via the incremental engine (state_root.py): cached
+        per-field roots + dirty-chunk re-hash, O(touched validators) per
+        slot.  `LODESTAR_TPU_HTR=full` restores the full recompute;
+        `=check` runs both and asserts bit-identity.  Any engine fault
+        falls back to the full recompute (and drops the engine, so the
+        next call rebuilds cold)."""
+        import os
+
+        mode = os.environ.get("LODESTAR_TPU_HTR", "incremental")
+        if mode == "full":
+            return self._container().hash_tree_root(self.to_value())
+        from .state_root import StateRootEngine
+
+        engine = getattr(self, "_root_engine", None)
+        if engine is None:
+            engine = self._root_engine = StateRootEngine()
+        try:
+            root = engine.hash_tree_root(self)
+        except Exception:
+            if mode == "check":
+                raise
+            self._root_engine = None
+            return self._container().hash_tree_root(self.to_value())
+        if mode == "check":
+            full = self._container().hash_tree_root(self.to_value())
+            if root != full:  # not an assert: must survive python -O
+                raise RuntimeError(
+                    "incremental state root diverged from full recompute"
+                )
+        return root
+
+    def invalidate_root_cache(self) -> None:
+        """Drop the incremental-merkleization engine; the next
+        hash_tree_root() rebuilds cold.  Correctness never requires
+        this (dirty tracking is diff-based and conservative) — it is an
+        escape hatch for memory pressure or debugging."""
+        self._root_engine = None
 
     def serialize(self) -> bytes:
         return self._container().serialize(self.to_value())

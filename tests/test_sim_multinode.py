@@ -237,3 +237,150 @@ def test_three_node_sim_reaches_justification():
             assert n.score_book.state(peer).value == "Healthy"
     for n in nodes.values():
         n.close()
+
+
+@pytest.mark.slow
+def test_sim_equivocating_node_gets_slashed():
+    """One node's validator double-votes (a second, conflicting
+    attestation for the same duty — the reference's slashable-offence
+    drill): every OTHER node must detect it through the live gossip
+    stack (seen-cache recovery -> slasher batch -> op pool), the next
+    proposer must include the attester slashing in a block, and every
+    node's head state must show the offender slashed."""
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0},
+        genesis_time=10,
+    )
+    sks = [B.keygen(b"sim-%d" % i) for i in range(N_KEYS)]
+    pk_points = [B.sk_to_pk(sk) for sk in sks]
+    pks = [C.g1_compress(p) for p in pk_points]
+    genesis = create_genesis_state(cfg, pks, genesis_time=10)
+    bus = InMemoryGossipBus()
+    digest = cfg.fork_digest(0)
+
+    nodes = {}
+    for i in range(N_NODES):
+        name = f"node-{i}"
+        nodes[name] = FullBeaconNode.init(
+            cfg,
+            genesis,
+            NodeOptions(
+                serve_api=False,
+                verifier=CpuBlsVerifier(pubkeys=pk_points),
+                gossip_bus=bus,
+                node_id=name,
+                active_validator_count_hint=N_KEYS,
+                subscribe_all_subnets=True,
+            ),
+        )
+    names = list(nodes)
+    owners = {i: names[i % N_NODES] for i in range(N_KEYS)}
+    stores = {
+        name: ValidatorStore(
+            cfg, {i: sks[i] for i in range(N_KEYS) if owners[i] == name}
+        )
+        for name in names
+    }
+    ref = nodes[names[0]].chain
+
+    equivocator = None
+    included_at = None
+    for slot in range(1, 17):
+        for n in nodes.values():
+            n.clock.set_time(10 + slot * params.SECONDS_PER_SLOT)
+        st = ref.head_state.clone()
+        if st.slot < slot:
+            process_slots(st, slot)
+        proposer = int(get_beacon_proposer_index(st))
+        if equivocator is not None and bool(st.slashed[equivocator]) and (
+            proposer == equivocator
+        ):
+            continue  # a slashed proposer cannot produce; empty slot
+        owner = stores[owners[proposer]]
+        block = ref.produce_block(slot, owner.sign_randao(proposer, slot))
+        if block["body"]["attester_slashings"]:
+            included_at = slot
+        signed = {
+            "message": block,
+            "signature": owner.sign_block(proposer, block),
+        }
+        assert (
+            bus.publish(
+                "proposer",
+                topic_string(digest, GossipTopicName.beacon_block),
+                encode_message(cfg.get_fork_types(slot)[1].serialize(signed)),
+            )
+            == N_NODES
+        )
+        if included_at is not None:
+            break  # the slashing landed; nothing further to drive
+
+        # every committee member attests; the chosen offender publishes
+        # a SECOND, conflicting vote for the same duty
+        epoch = compute_epoch_at_slot(slot)
+        committees = int(get_committee_count_per_slot(st, epoch))
+        head_after = ref.head_state
+        for ci in range(committees):
+            committee = get_beacon_committee(head_after, slot, ci)
+            if len(committee) == 0:
+                continue
+            data = ref.produce_attestation_data(ci, slot)
+            subnet = compute_subnet_for_attestation(committees, slot, ci)
+            for pos, v in enumerate(committee):
+                v = int(v)
+                if equivocator is not None and v == equivocator:
+                    continue  # the offender goes quiet after the crime
+                bits = [p_ == pos for p_ in range(len(committee))]
+                att = {
+                    "aggregation_bits": bits,
+                    "data": data,
+                    "signature": stores[owners[v]].sign_attestation(v, data),
+                }
+                bus.publish(
+                    f"val-{v}",
+                    topic_string(
+                        digest,
+                        GossipTopicName.beacon_attestation,
+                        subnet=subnet,
+                    ),
+                    encode_message(T.Attestation.serialize(att)),
+                )
+                if equivocator is None and slot >= 2 and owners[v] == names[-1]:
+                    # the equivocation: same duty, different target root,
+                    # signed by a second (protection-less) signer — the
+                    # seen cache suppresses it, the recovery path must
+                    # still convict
+                    rogue = ValidatorStore(cfg, {v: sks[v]})
+                    forged = {
+                        "aggregation_bits": bits,
+                        "data": {
+                            **dict(data),
+                            "source": dict(data["source"]),
+                            "target": {
+                                "epoch": data["target"]["epoch"],
+                                "root": b"\x66" * 32,
+                            },
+                        },
+                    }
+                    forged["signature"] = rogue.sign_attestation(
+                        v, forged["data"]
+                    )
+                    bus.publish(
+                        f"val-{v}-rogue",
+                        topic_string(
+                            digest,
+                            GossipTopicName.beacon_attestation,
+                            subnet=subnet,
+                        ),
+                        encode_message(T.Attestation.serialize(forged)),
+                    )
+                    equivocator = v
+
+    assert equivocator is not None, "no committee seat for the last node"
+    assert included_at is not None, "slashing never included in a block"
+    for name, n in nodes.items():
+        assert n.slasher.detections["double_vote"] >= 1, name
+        assert bool(n.chain.head_state.slashed[equivocator]), name
+    for n in nodes.values():
+        n.close()

@@ -1,0 +1,278 @@
+"""Incremental state-root engine: ChunkTree vs merkleize_chunks, and
+randomized BeaconState equivalence (incremental == full recompute)
+across mutation / clone-on-write / epoch-boundary sequences.
+
+The invariant under test is the engine's one correctness contract
+(state_transition/state_root.py): dirty tracking is conservative — any
+mutation, tracked or not, must surface in the next root, bit-identical
+to the cold full merkleization.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.ssz import ChunkTree, merkleize_chunks
+from lodestar_tpu.state_transition.slot import process_slots
+from lodestar_tpu.state_transition.state import BeaconState
+
+P = params.ACTIVE_PRESET
+FAR_FUTURE = params.FAR_FUTURE_EPOCH
+
+
+# -- ChunkTree vs the batch merkleizer --------------------------------------
+
+
+@pytest.mark.parametrize("limit", [1, 2, 3, 7, 64, 1 << 10, 1 << 16])
+def test_chunk_tree_matches_merkleize_chunks(limit):
+    rng = random.Random(limit)
+    nprng = np.random.default_rng(limit)
+    tree = ChunkTree(limit)
+    n = 0
+    plane = np.zeros((0, 32), np.uint8)
+    for _step in range(25):
+        op = rng.random()
+        if op < 0.4 and n < min(limit, 300):
+            n = min(limit, n + rng.randint(1, 9))
+            grown = nprng.integers(0, 256, (n, 32), dtype=np.uint8)
+            grown[: plane.shape[0]] = plane
+            plane = grown
+        elif op < 0.5 and n > 0:  # shrink: conservative full rebuild
+            n = rng.randint(0, n)
+            plane = plane[:n].copy()
+        elif n > 0:  # mutate k rows
+            idx = nprng.integers(0, n, rng.randint(1, max(1, n // 4)))
+            plane[idx] = nprng.integers(0, 256, (idx.size, 32), dtype=np.uint8)
+        tree.update(plane)
+        ref = merkleize_chunks([bytes(plane[i]) for i in range(n)], limit)
+        assert tree.root == ref
+
+
+def test_chunk_tree_apply_unsorted_and_duplicate_indices():
+    """apply() is the public low-level entry for callers with their own
+    dirty sets: unsorted scatter must land on the right leaves and a
+    duplicated index must take the LAST write."""
+    nprng = np.random.default_rng(3)
+    plane = nprng.integers(0, 256, (6, 32), dtype=np.uint8)
+    tree = ChunkTree(64)
+    tree.update(plane)
+    plane[5] ^= 0x11
+    plane[2] ^= 0x22
+    tree.apply(np.array([5, 2], np.intp), plane[[5, 2]], 6)
+    assert tree.root == merkleize_chunks(
+        [bytes(plane[i]) for i in range(6)], 64
+    )
+    newrow = nprng.integers(0, 256, (1, 32), dtype=np.uint8)[0]
+    plane[3] = newrow
+    tree.apply(np.array([3, 3], np.intp), np.stack([plane[0], newrow]), 6)
+    assert tree.root == merkleize_chunks(
+        [bytes(plane[i]) for i in range(6)], 64
+    )
+    with pytest.raises(ValueError):
+        tree.apply(np.array([1], np.intp), plane[[1, 2]], 6)
+
+
+def test_chunk_tree_clone_is_copy_on_write():
+    nprng = np.random.default_rng(7)
+    plane = nprng.integers(0, 256, (50, 32), dtype=np.uint8)
+    tree = ChunkTree(1 << 12)
+    tree.update(plane)
+    base_root = tree.root
+    clone = tree.clone()
+    mutated = plane.copy()
+    mutated[13] ^= 0xFF
+    clone.update(mutated)
+    assert tree.root == base_root  # original untouched by the clone's write
+    assert clone.root != base_root
+    assert clone.root == merkleize_chunks(
+        [bytes(mutated[i]) for i in range(50)], 1 << 12
+    )
+
+
+# -- synthetic states --------------------------------------------------------
+
+
+def _synthetic_state(n_validators: int, seed: int = 0) -> BeaconState:
+    """Columnar state with random-but-plausible registry content; no BLS
+    validity needed for hashing."""
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    rng = np.random.default_rng(seed)
+    st = BeaconState(config=cfg)
+    raw = rng.integers(0, 256, (n_validators, 48), dtype=np.uint8).tobytes()
+    st.pubkeys = [raw[i * 48 : (i + 1) * 48] for i in range(n_validators)]
+    craw = rng.integers(0, 256, (n_validators, 32), dtype=np.uint8).tobytes()
+    st.withdrawal_credentials = [
+        craw[i * 32 : (i + 1) * 32] for i in range(n_validators)
+    ]
+    st.effective_balance = np.full(
+        n_validators, P.MAX_EFFECTIVE_BALANCE, np.uint64
+    )
+    st.slashed = np.zeros(n_validators, bool)
+    st.activation_eligibility_epoch = np.zeros(n_validators, np.uint64)
+    st.activation_epoch = np.zeros(n_validators, np.uint64)
+    st.exit_epoch = np.full(n_validators, FAR_FUTURE, np.uint64)
+    st.withdrawable_epoch = np.full(n_validators, FAR_FUTURE, np.uint64)
+    st.balances = rng.integers(
+        31_000_000_000, 33_000_000_000, n_validators
+    ).astype(np.uint64)
+    st.previous_epoch_participation = rng.integers(
+        0, 8, n_validators
+    ).astype(np.uint8)
+    st.current_epoch_participation = rng.integers(0, 8, n_validators).astype(
+        np.uint8
+    )
+    st.inactivity_scores = np.zeros(n_validators, np.uint64)
+    return st
+
+
+def _full_root(st: BeaconState) -> bytes:
+    return st._container().hash_tree_root(st.to_value())
+
+
+def _mutate_once(st: BeaconState, rng: random.Random, nprng) -> None:
+    """One randomized mutation drawn from the real mutation surface."""
+    n = st.num_validators
+    op = rng.randrange(10)
+    if op == 0:  # balance deltas (block ops / rewards)
+        idx = nprng.integers(0, n, rng.randint(1, 8))
+        for i in idx:
+            st.increase_balance(int(i), rng.randint(1, 10_000))
+    elif op == 1:  # participation flags (attestation processing)
+        idx = nprng.integers(0, n, rng.randint(1, 8))
+        st.current_epoch_participation[idx] |= np.uint8(
+            1 << rng.randrange(3)
+        )
+    elif op == 2:  # slash (block op touching 4 columns + slashings)
+        i = rng.randrange(n)
+        st.slashed[i] = True
+        st.withdrawable_epoch[i] = rng.randrange(1 << 20)
+        st.slashings[rng.randrange(P.EPOCHS_PER_SLASHINGS_VECTOR)] += np.uint64(
+            32_000_000_000
+        )
+    elif op == 3:  # registry growth
+        st.add_validator(
+            bytes(nprng.integers(0, 256, 48, dtype=np.uint8)),
+            bytes(nprng.integers(0, 256, 32, dtype=np.uint8)),
+            32_000_000_000,
+        )
+    elif op == 4:  # ejection-style exit writes
+        i = rng.randrange(n)
+        st.exit_epoch[i] = rng.randrange(1 << 20)
+        st.withdrawable_epoch[i] = int(st.exit_epoch[i]) + 256
+    elif op == 5:  # credential rotation (process_bls_to_execution_change)
+        i = rng.randrange(n)
+        st.withdrawal_credentials[i] = (
+            b"\x01" + bytes(nprng.integers(0, 256, 31, dtype=np.uint8))
+        )
+    elif op == 6:  # per-slot root vectors + randao mix
+        st.block_roots[rng.randrange(P.SLOTS_PER_HISTORICAL_ROOT)] = bytes(
+            nprng.integers(0, 256, 32, dtype=np.uint8)
+        )
+        st.randao_mixes[rng.randrange(P.EPOCHS_PER_HISTORICAL_VECTOR)] = bytes(
+            nprng.integers(0, 256, 32, dtype=np.uint8)
+        )
+    elif op == 7:  # small-field churn (header / eth1 / checkpoints)
+        st.latest_block_header["state_root"] = bytes(
+            nprng.integers(0, 256, 32, dtype=np.uint8)
+        )
+        st.eth1_data_votes.append(
+            {
+                "deposit_root": bytes(
+                    nprng.integers(0, 256, 32, dtype=np.uint8)
+                ),
+                "deposit_count": rng.randrange(1 << 30),
+                "block_hash": b"\x00" * 32,
+            }
+        )
+        st.current_justified_checkpoint = {
+            "epoch": rng.randrange(1 << 20),
+            "root": bytes(nprng.integers(0, 256, 32, dtype=np.uint8)),
+        }
+    elif op == 8:  # whole-column rewrite (epoch transition's shape)
+        st.balances = (
+            st.balances.astype(np.int64) + rng.randint(0, 1000)
+        ).astype(np.uint64)
+    else:  # inactivity churn
+        idx = nprng.integers(0, n, rng.randint(1, 8))
+        st.inactivity_scores[idx] += np.uint64(4)
+
+
+def _run_equivalence(n_validators: int, steps: int, seed: int) -> None:
+    rng = random.Random(seed)
+    nprng = np.random.default_rng(seed)
+    st = _synthetic_state(n_validators, seed)
+    assert st.hash_tree_root() == _full_root(st)  # cold build
+    states = [st]
+    for step in range(steps):
+        target = rng.choice(states)
+        _mutate_once(target, rng, nprng)
+        if rng.random() < 0.2 and len(states) < 4:
+            # clone-on-write: both sides of the fork must stay correct
+            states.append(target.clone())
+        if rng.random() < 0.15:
+            # empty-slot advance; crossing a boundary runs the real
+            # epoch transition (incl. the participation rotation hint)
+            process_slots(target, target.slot + rng.randint(1, 3))
+        check = rng.sample(states, min(2, len(states)))
+        for s in check:
+            assert s.hash_tree_root() == _full_root(s), (
+                f"divergence at step {step}"
+            )
+    for s in states:
+        assert s.hash_tree_root() == _full_root(s)
+
+
+def test_randomized_equivalence_small():
+    _run_equivalence(n_validators=24, steps=40, seed=3)
+
+
+def test_randomized_equivalence_epoch_boundaries():
+    rng = random.Random(11)
+    nprng = np.random.default_rng(11)
+    st = _synthetic_state(16, 11)
+    for _ in range(3):
+        # drive across whole epochs with interleaved mutations
+        _mutate_once(st, rng, nprng)
+        process_slots(st, st.slot + P.SLOTS_PER_EPOCH)
+        assert st.hash_tree_root() == _full_root(st)
+
+
+def test_clone_shares_engine_copy_on_write():
+    st = _synthetic_state(12, 5)
+    root0 = st.hash_tree_root()
+    c = st.clone()
+    assert c.hash_tree_root() == root0  # warm tree inherited
+    c.increase_balance(0, 1234)
+    assert c.hash_tree_root() != root0
+    assert st.hash_tree_root() == root0  # original cache unpoisoned
+    assert c.hash_tree_root() == _full_root(c)
+
+
+def test_untracked_mutation_is_still_caught():
+    """The conservative-invalidation invariant: mutations that bypass
+    every setter (raw attribute/array writes) must still be reflected —
+    dirty tracking is diff-based, not trust-based."""
+    st = _synthetic_state(10, 9)
+    st.hash_tree_root()
+    st.balances[7] = np.uint64(1)  # raw in-place write, no API
+    st.pubkeys[3] = b"\x42" * 48  # even an "immutable" column edit
+    st.genesis_time = 777
+    assert st.hash_tree_root() == _full_root(st)
+
+
+def test_full_mode_env_switch(monkeypatch):
+    st = _synthetic_state(8, 4)
+    incremental = st.hash_tree_root()
+    monkeypatch.setenv("LODESTAR_TPU_HTR", "full")
+    assert st.hash_tree_root() == incremental
+
+
+@pytest.mark.slow
+def test_randomized_equivalence_large():
+    _run_equivalence(n_validators=2_000, steps=25, seed=21)
