@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# tpulint wrapper — the static invariant gate, outside pytest.
+#
+#   dev/lint.sh              # full lodestar_tpu/ tree (what tier-1 runs)
+#   dev/lint.sh --changed    # only findings in git-touched files (fast
+#                            # local iteration; full tree still parsed
+#                            # so cross-module rules keep context)
+#   dev/lint.sh --json ...   # machine output
+#   dev/lint.sh path ...     # explicit paths (e.g. dev/ tests/)
+#
+# Exit: 0 clean, 1 findings, 2 usage error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+args=("$@")
+have_path=0
+for a in "${args[@]:-}"; do
+  case "$a" in
+    --*) ;;
+    "") ;;
+    *) have_path=1 ;;
+  esac
+done
+if [ "$have_path" -eq 0 ]; then
+  args+=(lodestar_tpu)
+fi
+
+exec python -m lodestar_tpu.analysis "${args[@]}"

@@ -1,0 +1,38 @@
+"""tpulint — AST-based invariant checker for the lodestar-tpu tree.
+
+The kernel surface (15 modules, ~150 kernels, plus standalone export
+entries like slasher/device.py) rests on invariants no general-purpose
+linter knows about: pallas kernel bodies must stay shape-stable,
+gather-free and constant-capture-free or the Mosaic export path breaks
+(dev/NOTES.md "Mosaic failure modes"); export-cache artifacts must
+fingerprint every source module they trace or a stale artifact runs
+silently.  This package encodes those invariants as static rules and
+runs them on every tier-1 pass (tests/test_tpulint.py).
+
+Usage:
+    python -m lodestar_tpu.analysis [--json] [--changed] [paths]
+
+Suppressions are inline, with a mandatory reason:
+    x = TABLE[idx]  # tpulint: disable=gather-hazard -- host-side numpy
+
+Rule catalog: see analysis/rules.py docstrings or --list-rules.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    Project,
+    analyze,
+    render_findings,
+    findings_to_json,
+)
+from .rules import ALL_RULES, RULE_NAMES  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Project",
+    "analyze",
+    "render_findings",
+    "findings_to_json",
+    "ALL_RULES",
+    "RULE_NAMES",
+]

@@ -1,0 +1,91 @@
+"""CLI: python -m lodestar_tpu.analysis [--json] [--changed] [paths]
+
+Exit codes: 0 clean, 1 non-suppressed findings, 2 usage/internal error.
+`--changed` parses the full tree (cross-module rules need it) but only
+reports findings in files touched per git (staged, unstaged, untracked)
+— the fast local-iteration mode behind dev/lint.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+from typing import Optional, Set
+
+from . import ALL_RULES, analyze, findings_to_json, render_findings
+
+
+def _git_changed_files() -> Optional[Set[str]]:
+    # git prints paths relative to the repo TOPLEVEL; anchor there, not
+    # at the process cwd, or a subdirectory run filters everything out
+    cmds = [
+        ["git", "rev-parse", "--show-toplevel"],
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ]
+    results = []
+    for cmd in cmds:
+        try:
+            res = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            return None
+        results.append(res.stdout)
+    top = Path(results[0].strip())
+    out: Set[str] = set()
+    for stdout in results[1:]:
+        for line in stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(str((top / line).resolve()))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m lodestar_tpu.analysis")
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="report only findings in git-changed files",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name} [{rule.severity}]")
+        print("bad-suppression [error]")
+        return 0
+
+    paths = args.paths or ["lodestar_tpu"]
+    only: Optional[Set[str]] = None
+    if args.changed:
+        only = _git_changed_files()
+        if only is None:
+            print(
+                "tpulint: --changed needs a working git; running full",
+                file=sys.stderr,
+            )
+
+    try:
+        findings = analyze(paths, only_files=only)
+    except FileNotFoundError as e:
+        print(f"tpulint: no such path: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(findings_to_json(findings))
+    else:
+        print(render_findings(findings))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,866 @@
+"""tpulint rule engine — project model, reachability, suppressions.
+
+The engine parses every module into an index (imports, module-level
+constants, function defs with lexical nesting), computes two
+reachability tiers over the static call graph, and hands the `Project`
+to each rule:
+
+  - ``mosaic`` tier: functions reachable from a `pl.pallas_call` kernel
+    argument (or a `launch.tiled` kernel argument).  These bodies lower
+    through Mosaic; captured array constants and gathers break the
+    export path there (dev/NOTES.md "Mosaic failure modes").
+  - ``traced`` tier: the mosaic tier plus everything reachable from
+    `jax.jit` roots and export-cache entries.  These bodies run under
+    tracing: host-only operations (`.item()`, `int()` on traced values,
+    Python `if` on traced truthiness) and dtype-sloppy constructors are
+    hazards here.
+
+Resolution is name-based and best-effort — a static tool cannot chase
+every first-class-function indirection — but it is conservative in the
+direction that matters: over-approximating reachability only ever adds
+lint coverage, never unsoundness.
+
+Everything is plain `ast`; the analyzed code is NEVER imported, so
+fixtures and broken modules lint fine.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # as given on the command line (repo-relative in CI)
+    line: int
+    col: int
+    severity: str  # "error" | "warning"
+    message: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+        }
+
+
+# ---------------------------------------------------------------------------
+# suppressions — "tpulint: disable=<rule>[,<rule>] -- <reason>" comments
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*\S))?\s*$"
+)
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: Optional[str]  # None => invalid (mandatory reason missing)
+
+
+def parse_suppressions(source_lines: Sequence[str]) -> Dict[int, Suppression]:
+    out: Dict[int, Suppression] = {}
+    for i, text in enumerate(source_lines, start=1):
+        if "tpulint" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        out[i] = Suppression(line=i, rules=rules, reason=m.group(2))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module index
+# ---------------------------------------------------------------------------
+
+# numpy-ish scalar constructors: capturing these is NOT an array capture
+_SCALAR_FNS = frozenset(
+    {
+        "int8", "int16", "int32", "int64", "intp",
+        "uint8", "uint16", "uint32", "uint64",
+        "float16", "float32", "float64", "bool_",
+        "dtype", "iinfo", "finfo",
+    }
+)
+
+
+@dataclass
+class FunctionInfo:
+    key: str  # "modname:qualname"
+    modname: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    params: Tuple[str, ...]
+    static_params: frozenset  # annotated/defaulted as python scalars
+    parent: Optional[str]  # enclosing function key
+    children: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ExportEntry:
+    """A `register_entry(name, builder, sources=...)` call site."""
+
+    name: Optional[str]  # None when not a string literal
+    modname: str
+    line: int
+    col: int
+    sources: Tuple[str, ...]  # statically-resolved dotted module names
+    unresolved_sources: bool  # a source expr we could not read statically
+    traced_fn: Optional[str]  # FunctionInfo key of the traced computation
+
+
+class Module:
+    def __init__(self, modname: str, path: Path, display_path: str):
+        self.modname = modname
+        self.path = path
+        self.display_path = display_path
+        self.source = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.suppressions = parse_suppressions(self.lines)
+        # alias -> dotted module (both `import x as a` and resolvable
+        # `from pkg import submodule as a`); module- and function-level
+        # imports are merged into one namespace (good enough for lint)
+        self.module_aliases: Dict[str, str] = {}
+        # name -> (dotted module, original name) for `from mod import name`
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # module-level names whose value expression builds an np/jnp array
+        self.array_consts: Set[str] = set()
+        # alias -> "numpy" | "jax.numpy"
+        self.np_aliases: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
+
+
+def _rel_module(base: str, level: int, name: Optional[str]) -> Optional[str]:
+    """Resolve a relative import against a dotted module name."""
+    if level == 0:
+        return name
+    parts = base.split(".")
+    # level 1 = current package; the module's own name is the last part
+    if len(parts) < level:
+        return None
+    prefix = parts[: len(parts) - level]
+    if name:
+        prefix = prefix + name.split(".")
+    return ".".join(prefix) if prefix else None
+
+
+class Project:
+    """Every analyzed module plus the cross-module resolution tables."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, Module] = {}
+        self.mosaic: Set[str] = set()  # FunctionInfo keys
+        self.traced: Set[str] = set()
+        self.export_entries: List[ExportEntry] = []
+        # unparseable files become findings, never a crashed run (one
+        # half-saved file must not abort linting everything else)
+        self.parse_errors: List[Finding] = []
+
+    # -- loading -----------------------------------------------------------
+
+    @staticmethod
+    def _iter_py(path: Path) -> Iterable[Path]:
+        if path.is_file():
+            if path.suffix == ".py":
+                yield path
+            return
+        for p in sorted(path.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            yield p
+
+    @staticmethod
+    def _module_root(path: Path) -> Path:
+        """Directory that dotted module names are computed from: walk up
+        while the directory is a package (has __init__.py)."""
+        d = path if path.is_dir() else path.parent
+        while (d / "__init__.py").exists() and d.parent != d:
+            d = d.parent
+        return d
+
+    def load_paths(self, paths: Sequence[str]) -> None:
+        for raw in paths:
+            p = Path(raw)
+            if not p.exists():
+                raise FileNotFoundError(raw)
+            root = self._module_root(p)
+            for f in self._iter_py(p):
+                rel = f.relative_to(root)
+                parts = list(rel.with_suffix("").parts)
+                if parts[-1] == "__init__":
+                    parts = parts[:-1]
+                modname = ".".join(parts) if parts else f.stem
+                if modname in self.modules:
+                    continue
+                try:
+                    display = str(f.relative_to(Path.cwd()))
+                except ValueError:
+                    display = str(f)
+                try:
+                    self.modules[modname] = Module(modname, f, display)
+                except SyntaxError as e:
+                    self.parse_errors.append(
+                        Finding(
+                            rule="parse-error",
+                            path=display,
+                            line=e.lineno or 1,
+                            col=(e.offset or 1) - 1,
+                            severity="error",
+                            message=f"file does not parse: {e.msg}",
+                        )
+                    )
+        for mod in self.modules.values():
+            self._index_module(mod)
+        self._compute_reachability()
+        self._collect_export_entries()
+
+    # -- per-module indexing ------------------------------------------------
+
+    def _index_module(self, mod: Module) -> None:
+        self._collect_imports(mod, mod.tree)
+        self._collect_functions(mod, mod.tree, parent=None, prefix="")
+        self._collect_array_consts(mod)
+
+    def _collect_imports(self, mod: Module, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    mod.module_aliases[alias] = target
+                    if a.name == "numpy":
+                        mod.np_aliases[alias] = "numpy"
+                    elif a.name == "jax.numpy":
+                        mod.np_aliases[alias] = "jax.numpy"
+            elif isinstance(node, ast.ImportFrom):
+                base = _rel_module(mod.modname, node.level, node.module)
+                if base is None:
+                    continue
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if a.name == "*":
+                        continue
+                    sub = f"{base}.{a.name}"
+                    if base == "jax" and a.name == "numpy":
+                        mod.np_aliases[alias] = "jax.numpy"
+                    # `from pkg import submodule` binds a module object
+                    mod.module_aliases.setdefault(alias, sub)
+                    mod.from_imports[alias] = (base, a.name)
+
+    def _collect_functions(
+        self, mod: Module, tree: ast.AST, parent: Optional[str], prefix: str
+    ) -> None:
+        body = getattr(tree, "body", [])
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + node.name
+                key = f"{mod.modname}:{qual}"
+                args = node.args
+                names = [
+                    a.arg
+                    for a in (
+                        args.posonlyargs + args.args + args.kwonlyargs
+                    )
+                ]
+                static = set()
+                for a in args.posonlyargs + args.args + args.kwonlyargs:
+                    ann = a.annotation
+                    if (
+                        isinstance(ann, ast.Name)
+                        and ann.id in ("int", "bool", "float", "str", "bytes")
+                    ):
+                        static.add(a.arg)
+                defaults = list(args.defaults)
+                pos = args.posonlyargs + args.args
+                for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                    if isinstance(d, ast.Constant) and isinstance(
+                        d.value, (bool, int, float, str, bytes, type(None))
+                    ):
+                        static.add(a.arg)
+                info = FunctionInfo(
+                    key=key,
+                    modname=mod.modname,
+                    qualname=qual,
+                    node=node,
+                    params=tuple(names),
+                    static_params=frozenset(static),
+                    parent=parent,
+                )
+                mod.functions[qual] = info
+                if parent is not None:
+                    pmod, pqual = parent.split(":", 1)
+                    self.modules[pmod].functions[pqual].children.append(key)
+                self._collect_functions(
+                    mod, node, parent=key, prefix=qual + "."
+                )
+            elif isinstance(node, ast.ClassDef):
+                self._collect_functions(
+                    mod, node, parent=parent, prefix=prefix + node.name + "."
+                )
+
+    def _expr_builds_array(self, mod: Module, expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in mod.np_aliases
+                and fn.attr not in _SCALAR_FNS
+            ):
+                return True
+        return False
+
+    def _collect_array_consts(self, mod: Module) -> None:
+        for node in mod.tree.body:
+            targets: List[ast.expr] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not self._expr_builds_array(mod, value):
+                continue
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        mod.array_consts.add(e.id)
+
+    # -- resolution ---------------------------------------------------------
+
+    def function(self, key: str) -> Optional[FunctionInfo]:
+        modname, qual = key.split(":", 1)
+        mod = self.modules.get(modname)
+        return mod.functions.get(qual) if mod else None
+
+    def resolve_name(
+        self, mod: Module, scope: Optional[FunctionInfo], name: str
+    ) -> Optional[str]:
+        """Resolve a bare name reference to a FunctionInfo key."""
+        # lexically enclosing nested defs
+        s = scope
+        while s is not None:
+            cand = f"{s.qualname}.{name}"
+            if cand in mod.functions:
+                return f"{mod.modname}:{cand}"
+            s = self.function(s.parent) if s.parent else None
+        if name in mod.functions:
+            return f"{mod.modname}:{name}"
+        fi = mod.from_imports.get(name)
+        if fi is not None:
+            src_mod, orig = fi
+            target = self.modules.get(src_mod)
+            if target and orig in target.functions:
+                return f"{src_mod}:{orig}"
+        return None
+
+    def resolve_callee(
+        self, mod: Module, scope: Optional[FunctionInfo], func: ast.AST
+    ) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return self.resolve_name(mod, scope, func.id)
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            target_mod = mod.module_aliases.get(func.value.id)
+            if target_mod is not None:
+                tm = self.modules.get(target_mod)
+                if tm and func.attr in tm.functions:
+                    return f"{target_mod}:{func.attr}"
+        return None
+
+    def is_array_const_ref(
+        self, mod: Module, scope_locals: Set[str], node: ast.AST
+    ) -> Optional[str]:
+        """Is `node` a read of a module-level np/jnp array constant?
+        Returns a description of the constant, or None."""
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in scope_locals:
+                return None
+            if node.id in mod.array_consts:
+                return f"{mod.modname}.{node.id}"
+            fi = mod.from_imports.get(node.id)
+            if fi is not None:
+                src_mod, orig = fi
+                target = self.modules.get(src_mod)
+                if target and orig in target.array_consts:
+                    return f"{src_mod}.{orig}"
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            target_mod = mod.module_aliases.get(node.value.id)
+            if target_mod is not None:
+                tm = self.modules.get(target_mod)
+                if tm and node.attr in tm.array_consts:
+                    return f"{target_mod}.{node.attr}"
+        return None
+
+    # -- reachability -------------------------------------------------------
+
+    @staticmethod
+    def _is_jit_expr(node: ast.AST) -> bool:
+        """`jax.jit`, `jit`, or `partial(jax.jit, ...)`."""
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_partial = (
+                isinstance(fn, ast.Name) and fn.id == "partial"
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+            if is_partial and node.args:
+                return Project._is_jit_expr(node.args[0])
+        return False
+
+    def _fn_ref_arg(
+        self, mod: Module, scope: Optional[FunctionInfo], arg: ast.AST
+    ) -> Optional[str]:
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            return self.resolve_callee(mod, scope, arg) or (
+                self.resolve_name(mod, scope, arg.id)
+                if isinstance(arg, ast.Name)
+                else None
+            )
+        return None
+
+    def _walk_scoped(self, mod: Module):
+        """Yield (scope FunctionInfo | None, node, prefix) over the whole
+        module: scope is the innermost enclosing FUNCTION; prefix is the
+        full qualname prefix (classes included) at this point, so a
+        def's qualname is prefix + node.name."""
+
+        def rec(tree: ast.AST, scope, prefix: str):
+            for node in ast.iter_child_nodes(tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = prefix + node.name
+                    info = mod.functions.get(qual)
+                    yield (scope, node, prefix)
+                    yield from rec(node, info or scope, qual + ".")
+                elif isinstance(node, ast.ClassDef):
+                    yield (scope, node, prefix)
+                    yield from rec(node, scope, prefix + node.name + ".")
+                else:
+                    yield (scope, node, prefix)
+                    yield from rec(node, scope, prefix)
+
+        yield from rec(mod.tree, None, "")
+
+    def _builder_traced_fn(
+        self, builder_key: str, depth: int = 0
+    ) -> Optional[str]:
+        """Chase a spec-builder's returns to the traced function: a
+        builder returns `(fn, specs)` or delegates to another builder."""
+        if depth > 3:
+            return None
+        info = self.function(builder_key)
+        if info is None:
+            return None
+        mod = self.modules[info.modname]
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Tuple) and v.elts:
+                ref = self._fn_ref_arg(mod, info, v.elts[0])
+                if ref:
+                    return ref
+            elif isinstance(v, ast.Call):
+                target = self.resolve_callee(mod, info, v.func)
+                if target:
+                    found = self._builder_traced_fn(target, depth + 1)
+                    if found:
+                        return found
+        return None
+
+    def _compute_reachability(self) -> None:
+        mosaic_roots: Set[str] = set()
+        traced_roots: Set[str] = set()
+        for mod in self.modules.values():
+            for scope, node, prefix in self._walk_scoped(mod):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    info = mod.functions.get(prefix + node.name)
+                    if info and any(
+                        self._is_jit_expr(d) for d in node.decorator_list
+                    ):
+                        traced_roots.add(info.key)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                callee = (
+                    fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else fn.id
+                    if isinstance(fn, ast.Name)
+                    else None
+                )
+                if callee == "pallas_call" and node.args:
+                    ref = self._fn_ref_arg(mod, scope, node.args[0])
+                    if ref:
+                        mosaic_roots.add(ref)
+                elif callee == "tiled" and node.args:
+                    ref = self._fn_ref_arg(mod, scope, node.args[0])
+                    if ref:
+                        mosaic_roots.add(ref)
+                elif callee in ("load_or_export", "export_and_save") and len(
+                    node.args
+                ) >= 2:
+                    ref = self._fn_ref_arg(mod, scope, node.args[1])
+                    if ref:
+                        traced_roots.add(ref)
+                elif callee == "register_entry" and len(node.args) >= 2:
+                    ref = self._fn_ref_arg(mod, scope, node.args[1])
+                    if ref:
+                        traced = self._builder_traced_fn(ref)
+                        if traced:
+                            traced_roots.add(traced)
+                elif self._is_jit_expr(fn) and node.args:
+                    ref = self._fn_ref_arg(mod, scope, node.args[0])
+                    if ref:
+                        traced_roots.add(ref)
+        self.mosaic = self._closure(mosaic_roots)
+        self.traced = self._closure(traced_roots | mosaic_roots)
+
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        work = list(roots)
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            info = self.function(key)
+            if info is None:
+                continue
+            seen.add(key)
+            # nested defs are the kernel bodies/closures of their parent
+            work.extend(info.children)
+            mod = self.modules[info.modname]
+            for node in self._fn_body_nodes(info):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_callee(mod, info, node.func)
+                    if target:
+                        work.append(target)
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    target = self.resolve_name(mod, info, node.id)
+                    if target and target != key:
+                        work.append(target)
+        return seen
+
+    @staticmethod
+    def _fn_body_nodes(info: FunctionInfo) -> Iterable[ast.AST]:
+        """Walk a function body, excluding nested def bodies (they are
+        separate FunctionInfos) but including lambdas."""
+
+        def rec(tree: ast.AST):
+            for node in ast.iter_child_nodes(tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    # decorators/defaults evaluate in this scope
+                    for d in node.decorator_list:
+                        yield d
+                        yield from rec(d)
+                    continue
+                yield node
+                yield from rec(node)
+
+        yield from rec(info.node)
+
+    @staticmethod
+    def local_binds(info: FunctionInfo) -> Set[str]:
+        """Names bound inside the function (params, assigns, loops,
+        comprehensions, withs, walrus) — these shadow module constants."""
+        out: Set[str] = set(info.params)
+        for node in Project._fn_body_nodes(info):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                out.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    out.add((a.asname or a.name).split(".")[0])
+        return out
+
+    # -- export entries (fingerprint-completeness inputs) -------------------
+
+    def _collect_export_entries(self) -> None:
+        for mod in self.modules.values():
+            for scope, node, _prefix in self._walk_scoped(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                callee = (
+                    fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else fn.id
+                    if isinstance(fn, ast.Name)
+                    else None
+                )
+                if callee != "register_entry" or len(node.args) < 2:
+                    continue
+                name = (
+                    node.args[0].value
+                    if isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    else None
+                )
+                sources: List[str] = []
+                unresolved = False
+                for kw in node.keywords:
+                    if kw.arg not in ("source", "sources"):
+                        continue
+                    exprs = (
+                        list(kw.value.elts)
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value]
+                    )
+                    for e in exprs:
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str
+                        ):
+                            sources.append(e.value)
+                        else:
+                            unresolved = True
+                builder = self._fn_ref_arg(mod, scope, node.args[1])
+                traced = (
+                    self._builder_traced_fn(builder) if builder else None
+                )
+                self.export_entries.append(
+                    ExportEntry(
+                        name=name,
+                        modname=mod.modname,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        sources=tuple(sources),
+                        unresolved_sources=unresolved,
+                        traced_fn=traced,
+                    )
+                )
+
+    def transitive_imports(
+        self, modname: str, expand=None
+    ) -> Set[str]:
+        """Project modules transitively imported by `modname` (AST
+        imports at any nesting, skipping TYPE_CHECKING blocks).
+        `expand(modname) -> bool` gates which discovered modules have
+        their OWN imports walked (the fingerprint rule stops at
+        kernels/ modules: the kernels package is fingerprinted
+        wholesale, so its internal deps are a global concern, not a
+        per-entry one).  Package `__init__` side effects are NOT
+        chased — the fingerprint contract covers modules whose CODE
+        the traced function can reach, which explicit imports name."""
+        seen: Set[str] = set()
+        work = [modname]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            if expand is not None and cur != modname and not expand(cur):
+                continue
+            mod = self.modules.get(cur)
+            if mod is None:
+                continue
+            for node in self._walk_no_type_checking(mod.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name in self.modules:
+                            work.append(a.name)
+                elif isinstance(node, ast.ImportFrom):
+                    base = _rel_module(cur, node.level, node.module)
+                    if base is None:
+                        continue
+                    if base in self.modules:
+                        work.append(base)
+                    for a in node.names:
+                        sub = f"{base}.{a.name}"
+                        if sub in self.modules:
+                            work.append(sub)
+        seen.discard(modname)
+        return {m for m in seen if m in self.modules}
+
+    @staticmethod
+    def _walk_no_type_checking(tree: ast.AST) -> Iterable[ast.AST]:
+        def guarded(node: ast.If) -> bool:
+            t = node.test
+            return (
+                isinstance(t, ast.Name) and t.id == "TYPE_CHECKING"
+            ) or (
+                isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+            )
+
+        def rec(n: ast.AST):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, ast.If) and guarded(child):
+                    for e in child.orelse:
+                        yield e
+                        yield from rec(e)
+                    continue
+                yield child
+                yield from rec(child)
+
+        yield from rec(tree)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _apply_suppressions(
+    mod: Module, findings: List[Finding]
+) -> List[Finding]:
+    """Mark findings suppressed; emit bad-suppression findings for
+    reason-less or unknown-rule suppressions."""
+    from .rules import RULE_NAMES
+
+    out: List[Finding] = []
+    for f in findings:
+        sup = mod.suppressions.get(f.line)
+        if sup is None:
+            prev = mod.suppressions.get(f.line - 1)
+            if prev is not None and f.line - 1 >= 1:
+                prev_text = mod.lines[f.line - 2]
+                if _COMMENT_ONLY_RE.match(prev_text):
+                    sup = prev
+        if sup is not None and f.rule in sup.rules and sup.reason:
+            f.suppressed = True
+            f.suppress_reason = sup.reason
+        out.append(f)
+    for sup in mod.suppressions.values():
+        if not sup.reason:
+            out.append(
+                Finding(
+                    rule="bad-suppression",
+                    path=mod.display_path,
+                    line=sup.line,
+                    col=0,
+                    severity="error",
+                    message=(
+                        "suppression without a reason — write "
+                        "'# tpulint: disable=<rule> -- <why>'"
+                    ),
+                )
+            )
+            continue
+        for r in sup.rules:
+            if r not in RULE_NAMES:
+                out.append(
+                    Finding(
+                        rule="bad-suppression",
+                        path=mod.display_path,
+                        line=sup.line,
+                        col=0,
+                        severity="error",
+                        message=f"unknown rule in suppression: {r!r}",
+                    )
+                )
+    return out
+
+
+def analyze(
+    paths: Sequence[str],
+    only_files: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run every rule over `paths`.  `only_files` (resolved-path strings)
+    restricts REPORTING to those files; the whole tree is still parsed
+    so cross-module rules keep full context (--changed mode)."""
+    from .rules import ALL_RULES
+
+    project = Project()
+    project.load_paths(paths)
+    display_to_mod = {
+        m.display_path: m for m in project.modules.values()
+    }
+    findings: List[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule.run(project))
+    out: List[Finding] = list(project.parse_errors)
+    grouped: Dict[str, List[Finding]] = {}
+    for f in findings:
+        grouped.setdefault(f.path, []).append(f)
+    for path, fs in grouped.items():
+        mod = display_to_mod.get(path)
+        out.extend(_apply_suppressions(mod, fs) if mod else fs)
+    # modules with no rule findings can still hold bad suppressions
+    for mod in project.modules.values():
+        if mod.display_path not in grouped:
+            out.extend(_apply_suppressions(mod, []))
+    if only_files is not None:
+        out = [
+            f
+            for f in out
+            if str(Path(f.path).resolve()) in only_files
+        ]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def render_findings(findings: List[Finding]) -> str:
+    lines = []
+    active = [f for f in findings if not f.suppressed]
+    for f in active:
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {f.rule} {f.severity}: {f.message}"
+        )
+    n_sup = sum(1 for f in findings if f.suppressed)
+    lines.append(
+        f"tpulint: {len(active)} finding(s), {n_sup} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def findings_to_json(findings: List[Finding]) -> str:
+    active = [f for f in findings if not f.suppressed]
+    return json.dumps(
+        {
+            "version": 1,
+            "findings": [f.to_dict() for f in findings],
+            "counts": {
+                "active": len(active),
+                "suppressed": len(findings) - len(active),
+                "errors": sum(
+                    1 for f in active if f.severity == "error"
+                ),
+                "warnings": sum(
+                    1 for f in active if f.severity == "warning"
+                ),
+            },
+        },
+        indent=2,
+    )

@@ -1,0 +1,580 @@
+"""tpulint rule set — this repo's real failure modes, as AST checks.
+
+Severities: "error" rules encode invariants whose violation breaks the
+TPU path outright (Mosaic export failure, stale export artifact);
+"warning" rules encode hazards that bite later (silent f32 weak types,
+event-loop stalls).  The tier-1 gate (tests/test_tpulint.py) fails on
+ANY non-suppressed finding, so the distinction is informational.
+
+Rule catalog:
+
+kernel-purity (error)
+    Mosaic-tier functions (pallas kernel bodies and their callees) must
+    not read module-level np/jnp ARRAY constants — a pallas kernel that
+    closes over a device/host array constant fails Mosaic lowering
+    (dev/NOTES.md; kernels/core.py const_plane exists exactly to splat
+    constants from python-int scalars instead).  Traced-tier functions
+    must not call `.item()`, apply `int()`/`bool()`/`float()` to traced
+    parameters, or branch a Python `if` on a traced parameter's
+    truthiness — all host-only operations that fail or silently
+    constant-fold under tracing.
+
+gather-hazard (error)
+    Mosaic-tier functions must not use boolean-mask indexing or >=2-D
+    advanced indexing: both lower to gather, which the Mosaic export
+    path rejects.  Route through kernels/core.rows / row (contiguous
+    sublane slices) or a broadcasted-iota mask compare
+    (slasher/device.py::span_update_planes is the worked example).
+
+fingerprint-completeness (error)
+    Every export-cache entry must fingerprint each project module its
+    traced function transitively imports from OUTSIDE kernels/ (the
+    kernels/ package is fingerprinted wholesale).  A missing source
+    means an edit to that module silently runs a stale AOT artifact.
+    Declare sources as dotted module names:
+    `register_entry(name, builder, sources=("lodestar_tpu.slasher.device", ...))`.
+
+dtype-discipline (warning)
+    Traced-tier code must pass an explicit dtype to
+    `jnp.zeros/ones/empty/full/arange` (x64 is disabled; the implicit
+    weak type changes with jax config) and must not embed int literals
+    >= 2**31 (they overflow the int32 world the kernels run in).
+
+node-hygiene (warning; bare except is error)
+    Bare `except:` swallows KeyboardInterrupt/SystemExit — name the
+    exception (the repo idiom is `except Exception:  # noqa: BLE001`
+    with a reason).  Under network/, chain/, sync/: no blocking calls
+    (`time.sleep`, `jax.device_get`, `.block_until_ready()`) inside
+    `async def` bodies — they stall the event loop for every peer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Finding, FunctionInfo, Module, Project
+
+_KERNELS_SEG = "kernels"
+
+
+def _in_kernels(modname: str) -> bool:
+    return _KERNELS_SEG in modname.split(".")
+
+
+class Rule:
+    name = "rule"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(
+        self, mod: Module, node: ast.AST, message: str, severity=None
+    ) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=mod.display_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            severity=severity or self.severity,
+            message=message,
+        )
+
+
+# ---------------------------------------------------------------------------
+
+
+class KernelPurityRule(Rule):
+    name = "kernel-purity"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for key in project.traced:
+            info = project.function(key)
+            if info is None:
+                continue
+            mod = project.modules[info.modname]
+            locals_ = project.local_binds(info)
+            in_mosaic = key in project.mosaic
+            for node in project._fn_body_nodes(info):
+                if in_mosaic:
+                    const = project.is_array_const_ref(mod, locals_, node)
+                    if const is not None:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"pallas-reachable `{info.qualname}` "
+                                f"captures module-level array constant "
+                                f"`{const}` — captured array constants "
+                                f"break Mosaic export; splat from python "
+                                f"ints (kernels/core.const_plane) or pass "
+                                f"it as a kernel operand",
+                            )
+                        )
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    if (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == "item"
+                        and not node.args
+                    ):
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"`.item()` in traced `{info.qualname}` "
+                                f"forces a host sync and fails under "
+                                f"jit/export",
+                            )
+                        )
+                    elif (
+                        isinstance(fn, ast.Name)
+                        and fn.id in ("int", "bool", "float")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in info.params
+                        and node.args[0].id not in info.static_params
+                    ):
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"`{fn.id}({node.args[0].id})` on a traced "
+                                f"parameter of `{info.qualname}` — "
+                                f"concretizes a tracer; use jnp casts or "
+                                f"annotate the parameter as a static "
+                                f"python scalar",
+                            )
+                        )
+                if isinstance(node, ast.If):
+                    bad = self._traced_truthiness(node.test, info)
+                    if bad is not None:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"Python `if` on traced parameter "
+                                f"`{bad}` in `{info.qualname}` — use "
+                                f"jnp.where / lax.cond",
+                            )
+                        )
+        return out
+
+    @staticmethod
+    def _traced_truthiness(
+        test: ast.AST, info: FunctionInfo
+    ) -> Optional[str]:
+        def is_traced_param(n: ast.AST) -> Optional[str]:
+            if (
+                isinstance(n, ast.Name)
+                and n.id in info.params
+                and n.id not in info.static_params
+            ):
+                return n.id
+            return None
+
+        hit = is_traced_param(test)
+        if hit:
+            return hit
+        if (
+            isinstance(test, ast.Call)
+            and isinstance(test.func, ast.Attribute)
+            and test.func.attr in ("any", "all")
+        ):
+            return is_traced_param(test.func.value)
+        return None
+
+
+# ---------------------------------------------------------------------------
+
+
+class GatherHazardRule(Rule):
+    name = "gather-hazard"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for key in project.mosaic:
+            info = project.function(key)
+            if info is None:
+                continue
+            mod = project.modules[info.modname]
+            static_names = self._static_int_names(info)
+            for node in project._fn_body_nodes(info):
+                if not isinstance(node, ast.Subscript):
+                    continue
+                idx = node.slice
+                if isinstance(idx, ast.Compare):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"boolean-mask indexing in pallas-reachable "
+                            f"`{info.qualname}` lowers to gather and "
+                            f"breaks Mosaic export — use jnp.where with "
+                            f"a broadcast mask",
+                        )
+                    )
+                    continue
+                if isinstance(idx, ast.Tuple):
+                    advanced = [
+                        e
+                        for e in idx.elts
+                        if self._is_advanced(e, info, static_names)
+                    ]
+                    if len(advanced) >= 2:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"2-D advanced indexing in "
+                                f"pallas-reachable `{info.qualname}` "
+                                f"lowers to gather and breaks Mosaic "
+                                f"export — route through "
+                                f"kernels/core.rows / row",
+                            )
+                        )
+        return out
+
+    @staticmethod
+    def _static_int_names(info: FunctionInfo) -> Set[str]:
+        """Names that are static python ints in this function: loop
+        targets over range()/enumerate() and int-annotated params."""
+        names = set(info.static_params)
+        for node in Project._fn_body_nodes(info):
+            if isinstance(node, ast.For) and isinstance(
+                node.iter, ast.Call
+            ):
+                fn = node.iter.func
+                fname = (
+                    fn.id
+                    if isinstance(fn, ast.Name)
+                    else fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if fname in ("range", "enumerate"):
+                    targets = (
+                        node.target.elts
+                        if isinstance(node.target, ast.Tuple)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            elif isinstance(node, ast.comprehension) and isinstance(
+                node.iter, ast.Call
+            ):
+                fn = node.iter.func
+                fname = (
+                    fn.id
+                    if isinstance(fn, ast.Name)
+                    else fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if fname in ("range", "enumerate"):
+                    targets = (
+                        node.target.elts
+                        if isinstance(node.target, ast.Tuple)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+        return names
+
+    @staticmethod
+    def _is_advanced(
+        e: ast.AST, info: FunctionInfo, static_names: Set[str]
+    ) -> bool:
+        """An index-tuple element that selects data-dependently (an
+        array index), as opposed to slices / static ints / Ellipsis."""
+        if isinstance(e, (ast.Slice, ast.Constant)):
+            return False
+        if isinstance(e, ast.UnaryOp) and isinstance(
+            e.operand, ast.Constant
+        ):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id not in static_names
+        if isinstance(e, ast.BinOp):
+            # j + 1 style arithmetic over static ints stays static
+            names = [
+                n.id
+                for n in ast.walk(e)
+                if isinstance(n, ast.Name)
+            ]
+            return not all(n in static_names for n in names)
+        return True  # Call/Attribute/Subscript — array-valued
+
+
+# ---------------------------------------------------------------------------
+
+
+class FingerprintCompletenessRule(Rule):
+    name = "fingerprint-completeness"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for entry in project.export_entries:
+            # test modules register throwaway entries around test-local
+            # functions; the contract they exercise is checked via the
+            # fixture package (tests/fixtures/tpulint), not here
+            if entry.modname.split(".")[-1].startswith("test_"):
+                continue
+            mod = project.modules[entry.modname]
+            anchor = ast.Module(body=[], type_ignores=[])
+            anchor.lineno = entry.line  # type: ignore[attr-defined]
+            anchor.col_offset = entry.col  # type: ignore[attr-defined]
+            ename = entry.name or "<dynamic>"
+            if entry.traced_fn is None:
+                out.append(
+                    self.finding(
+                        mod,
+                        anchor,
+                        f"export-cache entry {ename!r}: could not "
+                        f"statically resolve the traced function from "
+                        f"its builder — return `(fn, specs)` with a "
+                        f"direct function reference",
+                        severity="warning",
+                    )
+                )
+                continue
+            if entry.unresolved_sources:
+                out.append(
+                    self.finding(
+                        mod,
+                        anchor,
+                        f"export-cache entry {ename!r}: a registered "
+                        f"source is not a string literal — declare "
+                        f"sources as dotted module names so the "
+                        f"fingerprint is statically checkable",
+                        severity="warning",
+                    )
+                )
+            traced_info = project.function(entry.traced_fn)
+            root_mod = traced_info.modname if traced_info else None
+            if root_mod is None:
+                continue
+            declared = set(entry.sources)
+            required: Set[str] = set()
+            if not _in_kernels(root_mod):
+                required.add(root_mod)
+            for dep in project.transitive_imports(
+                root_mod, expand=lambda m: not _in_kernels(m)
+            ):
+                # package __init__ modules are namespace plumbing; the
+                # code the traced fn can reach lives in the named
+                # submodules, which the walk already covers
+                if _in_kernels(dep) or self._is_package(project, dep):
+                    continue
+                required.add(dep)
+            missing_mods = {
+                r
+                for r in required
+                if not any(self._covers(d, r) for d in declared)
+            }
+            for missing in sorted(missing_mods):
+                out.append(
+                    self.finding(
+                        mod,
+                        anchor,
+                        f"export-cache entry {ename!r} traces "
+                        f"`{missing}` (outside kernels/) but does not "
+                        f"register it in _ENTRY_SOURCES — an edit to "
+                        f"that module would silently run a stale "
+                        f"artifact; add it to `sources=`",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _covers(declared: str, required: str) -> bool:
+        """Does declaration `declared` cover required module `required`?
+        Exact match, or a DOTTED suffix/superset (analysis roots can
+        shallow or deepen the computed name, e.g. `pkg.extmod` vs
+        `fixtures.tpulint.pkg.extmod`).  A bare last segment does NOT
+        cover: `batch` would satisfy nothing export_cache._source_path
+        can resolve, which is exactly the stale-artifact hole."""
+        if declared == required:
+            return True
+        if declared.endswith("." + required):
+            return True
+        return "." in declared and required.endswith("." + declared)
+
+    @staticmethod
+    def _is_package(project: Project, modname: str) -> bool:
+        mod = project.modules.get(modname)
+        return mod is not None and mod.path.name == "__init__.py"
+
+
+# ---------------------------------------------------------------------------
+
+_DTYPELESS_MIN_POS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3, "arange": 4}
+_INT32_MAX = 2**31
+
+
+class DtypeDisciplineRule(Rule):
+    name = "dtype-discipline"
+    severity = "warning"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for key in project.traced:
+            info = project.function(key)
+            if info is None:
+                continue
+            mod = project.modules[info.modname]
+            static = GatherHazardRule._static_int_names(info)
+            for node in project._fn_body_nodes(info):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    is_jnp = (
+                        isinstance(fn, ast.Attribute)
+                        and isinstance(fn.value, ast.Name)
+                        and mod.np_aliases.get(fn.value.id) == "jax.numpy"
+                    )
+                    if (
+                        is_jnp
+                        and fn.attr in _DTYPELESS_MIN_POS
+                        and len(node.args) < _DTYPELESS_MIN_POS[fn.attr]
+                        and not any(
+                            kw.arg == "dtype" for kw in node.keywords
+                        )
+                    ):
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"dtype-less `jnp.{fn.attr}` in traced "
+                                f"`{info.qualname}` — x64 is disabled; "
+                                f"pass an explicit dtype",
+                            )
+                        )
+                    if is_jnp:
+                        for arg in node.args:
+                            lit = self._big_literal(arg)
+                            if lit is not None:
+                                out.append(
+                                    self._lit_finding(mod, arg, info, lit)
+                                )
+                elif isinstance(node, ast.BinOp):
+                    # mask/shift arithmetic: a 64-bit literal only bites
+                    # when a TRACED value is in the expression — python
+                    # ints (static params, range vars) compute host-side
+                    lit = self._big_literal(
+                        node.left
+                    ) or self._big_literal(node.right)
+                    if lit is None:
+                        continue
+                    names = {
+                        n.id
+                        for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                    }
+                    if names and not names.issubset(static):
+                        out.append(self._lit_finding(mod, node, info, lit))
+        return out
+
+    @staticmethod
+    def _big_literal(node: ast.AST) -> Optional[int]:
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, int)
+            and not isinstance(node.value, bool)
+            and abs(node.value) >= _INT32_MAX
+        ):
+            return node.value
+        return None
+
+    def _lit_finding(self, mod, node, info, lit: int) -> Finding:
+        return self.finding(
+            mod,
+            node,
+            f"64-bit int literal {lit:#x} in traced "
+            f"`{info.qualname}` overflows the int32 kernel world "
+            f"(x64 disabled) — split into limbs or keep it host-side",
+        )
+
+
+# ---------------------------------------------------------------------------
+
+_ASYNC_DIRS = {"network", "chain", "sync"}
+_BLOCKING_ATTRS = {"block_until_ready"}
+
+
+class NodeHygieneRule(Rule):
+    name = "node-hygiene"
+    severity = "warning"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.ExceptHandler)
+                    and node.type is None
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            "bare `except:` swallows KeyboardInterrupt/"
+                            "SystemExit — name the exception",
+                            severity="error",
+                        )
+                    )
+            if not (set(mod.modname.split(".")) & _ASYNC_DIRS):
+                continue
+            for info in mod.functions.values():
+                if not isinstance(info.node, ast.AsyncFunctionDef):
+                    continue
+                for node in project._fn_body_nodes(info):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    label = self._blocking_call(node)
+                    if label:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"blocking `{label}` inside async "
+                                f"`{info.qualname}` stalls the event "
+                                f"loop — await asyncio.sleep / move to "
+                                f"a thread",
+                            )
+                        )
+        return out
+
+    @staticmethod
+    def _blocking_call(node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name):
+                pair = f"{fn.value.id}.{fn.attr}"
+                if pair in ("time.sleep", "jax.device_get"):
+                    return pair
+            if fn.attr in _BLOCKING_ATTRS:
+                return f".{fn.attr}()"
+        return None
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES = [
+    KernelPurityRule(),
+    GatherHazardRule(),
+    FingerprintCompletenessRule(),
+    DtypeDisciplineRule(),
+    NodeHygieneRule(),
+]
+
+RULE_NAMES = frozenset(r.name for r in ALL_RULES) | {
+    "bad-suppression",
+    "parse-error",
+}

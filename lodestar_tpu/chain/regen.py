@@ -63,6 +63,20 @@ class StateRegenerator:
         self.block_state_roots[block_root.hex()] = state_root
         self.state_cache.add_with_root(state_root, post_state)
 
+    def live_states(self):
+        """Every state currently held by the caches (LRU + checkpoint)
+        — the residency set the engine-bytes metric walks."""
+        yield from self.state_cache.states()
+        yield from self.checkpoint_cache.states()
+
+    def engine_bytes(self) -> int:
+        """Live incremental-merkleization plane bytes across the cached
+        states, COW-shared planes counted once (ROADMAP: first step to
+        bounding warm-engine memory)."""
+        from ..state_transition.state_root import state_root_engine_bytes
+
+        return state_root_engine_bytes(self.live_states())
+
     # -- public API (reference regen.ts) -----------------------------------
 
     def get_state(self, state_root: str):

@@ -65,6 +65,10 @@ class StateContextCache:
     def clear(self) -> None:
         self._map.clear()
 
+    def states(self):
+        """Live cached states (no LRU touch)."""
+        return self._map.values()
+
     def __len__(self) -> int:
         return len(self._map)
 
@@ -124,6 +128,10 @@ class CheckpointStateCache:
     def prune_finalized(self, finalized_epoch: int) -> None:
         for e in [e for e in self._epochs if e < finalized_epoch]:
             self.prune_epoch(e)
+
+    def states(self):
+        """Live cached states."""
+        return self._map.values()
 
     def __len__(self) -> int:
         return len(self._map)

@@ -196,6 +196,7 @@ def redc(tcols, matmul_mode: str = "f32", toeplitz=None):
     `toeplitz`: (T_NPRIME, T_P) operands.  Inside pallas kernels the
     matrices MUST be threaded as kernel inputs (pallas rejects captured
     array constants); under plain jit the module constants serve."""
+    # tpulint: disable=kernel-purity -- guarded fallback: pallas callers thread (T_NPRIME, T_P) via `toeplitz`; the captured constants only serve the plain-jit path
     t_np, t_p = toeplitz if toeplitz is not None else (T_NPRIME, T_P)
     t = fold3(tcols)
     # m = (t mod R) * NPRIME mod R — strictly-8-bit limbs feed the

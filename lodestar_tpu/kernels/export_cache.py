@@ -41,7 +41,27 @@ _LOADED: Dict[str, object] = {}
 # theirs at registration).  They fold into THAT entry's artifact key
 # only — an edit to slasher/device.py must invalidate the span-update
 # artifact without staling every verify-pipeline artifact on the host.
-_ENTRY_SOURCES: Dict[str, str] = {}
+# Values are tuples of dotted module names (preferred: statically
+# checkable by tpulint's fingerprint-completeness rule) or file paths.
+_ENTRY_SOURCES: Dict[str, Tuple[str, ...]] = {}
+
+
+def _source_path(src: str) -> Optional[pathlib.Path]:
+    """Resolve a declared source (dotted module name or path) to a file."""
+    if "/" in src or src.endswith(".py"):
+        return pathlib.Path(src)
+    parts = src.split(".")
+    pkg_root = pathlib.Path(__file__).parent.parent  # lodestar_tpu/
+    if parts and parts[0] == pkg_root.name:
+        parts = parts[1:]
+    if not parts:
+        return None
+    base = pkg_root.joinpath(*parts)
+    if base.with_suffix(".py").exists():
+        return base.with_suffix(".py")
+    if (base / "__init__.py").exists():
+        return base / "__init__.py"
+    return None
 
 
 def _code_fingerprint() -> str:
@@ -71,10 +91,9 @@ def artifact_key(
 ) -> str:
     sig = ";".join(f"{tuple(s.shape)}:{s.dtype}" for s in specs)
     raw = f"{name}|{sig}|{platform}|{jax.__version__}|{code_fingerprint()}"
-    source = _ENTRY_SOURCES.get(name)
-    if source is not None:
-        path = pathlib.Path(source)
-        if path.exists():
+    for source in sorted(_ENTRY_SOURCES.get(name, ())):
+        path = _source_path(source)
+        if path is not None and path.exists():
             raw += "|" + hashlib.sha256(path.read_bytes()).hexdigest()[:16]
     return (
         name
@@ -167,12 +186,65 @@ def load_or_export(
 _ENTRY_BUILDERS: Dict[str, Callable] = {}
 
 
+def _check_entry_sources(name: str, fn: Callable) -> None:
+    """Runtime backstop for tpulint's fingerprint-completeness rule:
+    warn when a standalone entry's traced function lives outside
+    kernels/ but is not covered by _ENTRY_SOURCES — an edit to its
+    module would then silently run a stale artifact."""
+    fn_mod = getattr(fn, "__module__", "") or ""
+    if not fn_mod or "kernels" in fn_mod.split("."):
+        return
+    declared = _ENTRY_SOURCES.get(name, ())
+    if fn_mod in declared:
+        return
+    import sys
+
+    fn_file = getattr(sys.modules.get(fn_mod), "__file__", None)
+    if fn_file is not None:
+        for src in declared:
+            p = _source_path(src)
+            if p is not None and str(p) == str(fn_file):
+                return
+    from ..utils.logger import get_logger
+
+    get_logger("kernels/export_cache").warn(
+        f"export entry {name!r} traces {fn_mod} (outside kernels/) "
+        f"without registering it in _ENTRY_SOURCES — edits to that "
+        f"module will NOT invalidate the cached artifact; pass "
+        f"sources=({fn_mod!r},) to register_entry"
+    )
+
+
 def register_entry(
-    name: str, builder: Callable, source: Optional[str] = None
+    name: str,
+    builder: Callable,
+    source: Optional[str] = None,
+    sources: Optional[Sequence[str]] = None,
 ) -> None:
-    _ENTRY_BUILDERS[name] = builder
+    """Register a standalone entry.  `sources` declares every module
+    OUTSIDE kernels/ whose code the traced computation reaches, as
+    dotted module names — they fold into this entry's artifact key.
+    The declaration is verified statically by tpulint
+    (fingerprint-completeness) and dynamically when the builder runs."""
+    declared = []
     if source is not None:
-        _ENTRY_SOURCES[name] = source
+        declared.append(source)
+    if sources is not None:
+        declared.extend(sources)
+    if declared:
+        _ENTRY_SOURCES[name] = tuple(declared)
+    else:
+        # re-registration without sources must not inherit a stale
+        # declaration (it would fold unrelated hashes into the key and
+        # pacify the runtime backstop)
+        _ENTRY_SOURCES.pop(name, None)
+
+    def checked_builder():
+        fn, specs = builder()
+        _check_entry_sources(name, fn)
+        return fn, specs
+
+    _ENTRY_BUILDERS[name] = checked_builder
 
 
 def registered_entries() -> Dict[str, Callable]:
@@ -202,8 +274,9 @@ def _register_builtin_entries() -> None:
     register_entry(
         "slasher_span_update",
         _slasher_span,
-        source=str(
-            pathlib.Path(__file__).parent.parent / "slasher" / "device.py"
+        sources=(
+            "lodestar_tpu.slasher.device",
+            "lodestar_tpu.slasher.batch",
         ),
     )
 

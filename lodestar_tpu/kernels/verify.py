@@ -649,7 +649,7 @@ def _j_seg_sum_g1(px, py, pz, dead, group):
     n = group.shape[0]
     pts = (px, py, pz)
     inf = dead
-    lane = jnp.arange(n)
+    lane = jnp.arange(n, dtype=jnp.int32)
     s = 1
     while s < n:
         prev = jax.tree_util.tree_map(

@@ -88,6 +88,19 @@ class ChunkTree:
             self._levels = [lvl.copy() for lvl in self._levels]
             self._shared = False
 
+    def plane_bytes(self, seen: Optional[set] = None) -> int:
+        """Allocated node-plane bytes.  With `seen` (a set of array
+        id()s threaded across trees), COW-shared planes are counted
+        once — the regen-LRU-wide live-bytes metric."""
+        total = 0
+        for lvl in self._levels:
+            if seen is not None:
+                if id(lvl) in seen:
+                    continue
+                seen.add(id(lvl))
+            total += lvl.nbytes
+        return total
+
     # -- geometry ----------------------------------------------------------
 
     def _rows_at(self, level: int) -> int:

@@ -100,6 +100,9 @@ class _PackedCell:
         out.mixin = self.mixin
         return out
 
+    def plane_bytes(self, seen: set) -> int:
+        return self.tree.plane_bytes(seen)
+
 
 class _ValidatorsCell:
     """Per-validator container roots, batch-hashed for dirty rows only.
@@ -143,6 +146,15 @@ class _ValidatorsCell:
             self.creds = list(self.creds)
             self.pk_roots = self.pk_roots.copy()
             self._shared = False
+
+    def plane_bytes(self, seen: set) -> int:
+        """Tree node planes + the cached pubkey-root plane (it shares
+        COW across clones like the trees do)."""
+        total = self.tree.plane_bytes(seen)
+        if id(self.pk_roots) not in seen:
+            seen.add(id(self.pk_roots))
+            total += self.pk_roots.nbytes
+        return total
 
     @staticmethod
     def _list_mismatches(cached: List[bytes], current: List[bytes], m: int):
@@ -335,3 +347,28 @@ class StateRootEngine:
             for fname, ftype in container.fields
         ]
         return merkleize_chunks(chunks)
+
+    def engine_bytes(self, seen: Optional[set] = None) -> int:
+        """Live ChunkTree plane bytes held by this engine.  Thread one
+        `seen` set across engines to count COW-shared planes once."""
+        if seen is None:
+            seen = set()
+        total = self.validators.plane_bytes(seen)
+        for cell in self.cells.values():
+            total += cell.plane_bytes(seen)
+        return total
+
+
+def state_root_engine_bytes(states) -> int:
+    """Aggregate live engine plane bytes across `states` (e.g. the regen
+    state-cache LRU + checkpoint cache): COW-shared planes — the normal
+    case right after clone() — are counted ONCE, so the number tracks
+    real residency, not per-state virtual size.  The first step toward
+    bounding warm-engine memory (ROADMAP)."""
+    seen: set = set()
+    total = 0
+    for st in states:
+        engine = getattr(st, "_root_engine", None)
+        if engine is not None:
+            total += engine.engine_bytes(seen)
+    return total

@@ -69,6 +69,12 @@ class BeaconMetrics:
             "lodestar_oppool_proposer_slashing_pool_size",
             "Proposer slashing pool size",
         )
+        # incremental state-root engine residency (regen LRU +
+        # checkpoint cache; COW-shared planes counted once)
+        self.state_root_engine_bytes = g(
+            "lodestar_state_root_engine_bytes",
+            "Live ChunkTree plane bytes across cached states' engines",
+        )
         # peers (peer manager)
         self.peers_connected = g("libp2p_peers", "Connected peer count")
         self._last_head: str | None = None
@@ -112,6 +118,7 @@ class BeaconMetrics:
                 self.op_pool_proposer_slashings.set(
                     chain.op_pool.num_proposer_slashings()
                 )
+                self.state_root_engine_bytes.set(chain.regen.engine_bytes())
             except Exception:  # noqa: BLE001 — sampling is best-effort
                 pass
 

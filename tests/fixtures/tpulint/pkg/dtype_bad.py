@@ -1,0 +1,16 @@
+"""dtype-discipline positives."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sloppy_ctor(x):
+    pad = jnp.zeros((4, 4))  # BAD: dtype-less constructor
+    lane = jnp.arange(4)  # BAD: dtype-less arange
+    return x + pad + lane
+
+
+@jax.jit
+def wide_mask(x):
+    return x & 0xFFFFFFFFFFFFFFFF  # BAD: 64-bit literal on traced value

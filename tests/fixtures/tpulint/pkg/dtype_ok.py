@@ -1,0 +1,23 @@
+"""dtype-discipline negatives."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def explicit_ctor(x):
+    pad = jnp.zeros((4, 4), jnp.int32)
+    lane = jnp.arange(4, dtype=jnp.int32)
+    return x + pad + lane
+
+
+@jax.jit
+def static_mask(x, e: int):
+    # python-int math on a static param stays host-side: fine
+    word = (e >> 32) & 0xFFFFFFFF
+    return x * jnp.int32(word & 0x7FFF)
+
+
+def host_ctor():
+    # not traced: implicit dtypes are numpy's problem, not Mosaic's
+    return jnp.zeros((4, 4))

@@ -1,0 +1,17 @@
+"""fingerprint-completeness positive: an export-cache entry whose
+traced function lives outside kernels/ with NO registered sources —
+an edit to pkg/extmod.py or pkg/extdep.py would silently run a stale
+artifact."""
+
+
+def register_entry(name, builder, source=None, sources=None):
+    """Stand-in registry (the rule matches the call by name)."""
+
+
+def _builder():
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+register_entry("fixture_span_update", _builder)  # BAD: no sources

@@ -1,0 +1,3 @@
+"""Transitive dependency of extmod — must also be fingerprinted."""
+
+SENTINEL = 32767

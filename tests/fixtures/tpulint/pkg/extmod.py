@@ -1,0 +1,19 @@
+"""Out-of-kernels traced module used by the entries_* fixtures."""
+
+import jax.numpy as jnp
+
+from .extdep import SENTINEL
+
+
+def span_fn(mins, maxs):
+    return jnp.minimum(mins, jnp.int32(SENTINEL)), maxs
+
+
+def span_specs():
+    import jax
+
+    shape = (16, 16)
+    return span_fn, [
+        jax.ShapeDtypeStruct(shape, jnp.int32),
+        jax.ShapeDtypeStruct(shape, jnp.int32),
+    ]

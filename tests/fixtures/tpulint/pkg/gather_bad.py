@@ -1,0 +1,18 @@
+"""gather-hazard positives."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, idx_ref, o_ref):
+    x = x_ref[...]
+    rows = idx_ref[...]
+    o_ref[...] = x[x > 0]  # BAD: boolean-mask indexing
+    o_ref[...] = x[rows, rows]  # BAD: 2-D advanced indexing
+
+
+def launch(x, idx):
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    )(x, idx)

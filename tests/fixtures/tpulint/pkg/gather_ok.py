@@ -1,0 +1,25 @@
+"""gather-hazard negatives: slices, static indices, iota masks."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    x = x_ref[...]
+    acc = x[..., 0:1, :] * 2  # slices: fine
+    for j in range(1, 4):
+        acc = acc + x[..., j : j + 1, :]  # static loop index: fine
+    mask = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) < 3
+    o_ref[...] = jnp.where(mask, acc, x)  # iota mask compare: fine
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    )(x)
+
+
+def host_gather(table, order):
+    # not pallas-reachable: host-side numpy gathers are fine
+    return table[order, order]

@@ -1,0 +1,13 @@
+"""An in-kernels traced module: entries tracing it need no sources."""
+
+import jax.numpy as jnp
+
+
+def kernel_entry_fn(x):
+    return x * jnp.int32(2)
+
+
+def kernel_entry_specs():
+    import jax
+
+    return kernel_entry_fn, [jax.ShapeDtypeStruct((8,), jnp.int32)]

@@ -1,0 +1,25 @@
+"""node-hygiene positives (module lives under a network/ segment)."""
+
+import time
+
+import jax
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:  # BAD: bare except
+        return None
+
+
+async def poll_peer(peer):
+    time.sleep(0.1)  # BAD: blocking sleep in async body
+    planes = jax.device_get(peer.planes)  # BAD: blocking transfer
+    await peer.send(planes)
+
+
+async def drain(queue):
+    while True:
+        item = queue.get()
+        item.result().block_until_ready()  # BAD: device sync in async
+        await queue.ack(item)

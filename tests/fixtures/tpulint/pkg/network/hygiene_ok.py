@@ -1,0 +1,20 @@
+"""node-hygiene negatives."""
+
+import asyncio
+import time
+
+
+def retry(fn):
+    try:
+        return fn()
+    except Exception:  # named: fine
+        return None
+
+
+async def poll_peer(peer):
+    await asyncio.sleep(0.1)
+    await peer.send(b"ping")
+
+
+def sync_helper():
+    time.sleep(0.01)  # blocking in a SYNC function: fine

@@ -1,0 +1,35 @@
+"""kernel-purity positives: every pattern here must be flagged."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_TABLE = np.arange(64).reshape(8, 8)  # module-level array constant
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + _TABLE  # BAD: captured array constant
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    )(x)
+
+
+@jax.jit
+def scalarize(x):
+    return x.item()  # BAD: host sync under trace
+
+
+@jax.jit
+def concretize(x):
+    return int(x) + 1  # BAD: int() on a traced parameter
+
+
+@jax.jit
+def branchy(x):
+    if x:  # BAD: Python if on traced truthiness
+        return x + 1
+    return x

@@ -1,0 +1,41 @@
+"""kernel-purity negatives: nothing here may be flagged.
+
+Host-side helpers may do anything; traced code using the sanctioned
+patterns (scalar np casts, static params, jnp.where) is clean.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_HOST_TABLE = np.arange(64).reshape(8, 8)
+_SCALE = np.int32(3)  # scalar constant: capturing is fine
+
+
+def host_pack(vals):
+    # not kernel-reachable: array constants / .item() are host business
+    acc = (_HOST_TABLE * 2).sum()
+    return int(acc) + vals[0].item() if hasattr(vals[0], "item") else acc
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * _SCALE  # scalar capture: allowed
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    )(x)
+
+
+@jax.jit
+def select(x):
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def static_branch(x, flip: bool = False):
+    if flip:  # static python param: fine
+        return -x
+    return x

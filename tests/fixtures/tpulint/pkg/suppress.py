@@ -1,0 +1,23 @@
+"""Suppression parsing: valid (with reason), missing reason, unknown rule."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def justified(x):
+    pad = jnp.zeros((4, 4))  # tpulint: disable=dtype-discipline -- fixture: proves suppression works
+    return x + pad
+
+
+@jax.jit
+def reasonless(x):
+    pad = jnp.zeros((4, 4))  # tpulint: disable=dtype-discipline
+    return x + pad
+
+
+@jax.jit
+def unknown_rule(x):
+    # tpulint: disable=made-up-rule -- reason text present
+    lane = jnp.arange(4, dtype=jnp.int32)
+    return x + lane

@@ -199,3 +199,116 @@ def test_staged_artifacts_match_verifier_contract():
         assert avals[13].shape == (KV.BT,)   # glive
         assert avals[14].shape == (2, n)     # rwords
         assert all(str(a.dtype) == "int32" for a in avals)
+
+
+# ---------------------------------------------------------------------------
+# standalone-entry source fingerprinting (tpulint fingerprint-completeness
+# runtime backstop)
+# ---------------------------------------------------------------------------
+
+
+def _entry_cleanup(*names):
+    for n in names:
+        EC._ENTRY_BUILDERS.pop(n, None)
+        EC._ENTRY_SOURCES.pop(n, None)
+
+
+def _toy_specs():
+    def fn(x):
+        return x + 1
+
+    return fn, [jax.ShapeDtypeStruct((4,), jnp.int32)]
+
+
+def test_uncovered_entry_warns_at_registration(caplog):
+    """An entry tracing a function outside kernels/ with no registered
+    source must warn when its builder runs — the module's edits would
+    otherwise never invalidate the cached artifact."""
+    import logging
+
+    EC.register_entry("fx-uncovered", _toy_specs)
+    try:
+        with caplog.at_level(logging.WARNING, logger="lodestar_tpu"):
+            fn, specs = EC.registered_entries()["fx-uncovered"]()
+        assert any(
+            "fx-uncovered" in r.message and "_ENTRY_SOURCES" in r.message
+            for r in caplog.records
+        ), [r.message for r in caplog.records]
+        assert fn(jnp.zeros((4,), jnp.int32)) is not None
+    finally:
+        _entry_cleanup("fx-uncovered")
+
+
+def test_covered_entry_does_not_warn(caplog):
+    import logging
+
+    EC.register_entry(
+        "fx-covered", _toy_specs, sources=(_toy_specs.__module__,)
+    )
+    try:
+        with caplog.at_level(logging.WARNING, logger="lodestar_tpu"):
+            EC.registered_entries()["fx-covered"]()
+        assert not any(
+            "fx-covered" in r.message for r in caplog.records
+        ), [r.message for r in caplog.records]
+    finally:
+        _entry_cleanup("fx-covered")
+
+
+def test_builtin_slasher_entry_declares_its_import_graph(caplog):
+    """The shipped slasher entry must cover device.py AND batch.py (the
+    module device.py imports) so an edit to either invalidates the span
+    artifact — and must therefore pass the runtime backstop silently."""
+    import logging
+
+    declared = EC._ENTRY_SOURCES["slasher_span_update"]
+    assert "lodestar_tpu.slasher.device" in declared
+    assert "lodestar_tpu.slasher.batch" in declared
+    for src in declared:
+        p = EC._source_path(src)
+        assert p is not None and p.exists(), src
+    with caplog.at_level(logging.WARNING, logger="lodestar_tpu"):
+        EC.registered_entries()["slasher_span_update"]()
+    assert not any(
+        "slasher_span_update" in r.message for r in caplog.records
+    )
+
+
+def test_artifact_key_tracks_every_declared_source(tmp_path):
+    """Editing ANY registered source must change the entry's artifact
+    key (multi-source entries: device.py edit AND batch.py edit both
+    invalidate)."""
+    a = tmp_path / "dep_a.py"
+    b = tmp_path / "dep_b.py"
+    a.write_text("A = 1\n")
+    b.write_text("B = 1\n")
+    specs = [jax.ShapeDtypeStruct((4,), jnp.int32)]
+    EC.register_entry("fx-multi", _toy_specs, sources=(str(a), str(b)))
+    try:
+        k0 = EC.artifact_key("fx-multi", specs, "cpu")
+        a.write_text("A = 2\n")
+        k1 = EC.artifact_key("fx-multi", specs, "cpu")
+        assert k1 != k0
+        b.write_text("B = 2\n")
+        k2 = EC.artifact_key("fx-multi", specs, "cpu")
+        assert k2 != k1
+    finally:
+        _entry_cleanup("fx-multi")
+
+
+def test_module_name_sources_resolve_without_import():
+    p = EC._source_path("lodestar_tpu.slasher.batch")
+    assert p is not None and p.name == "batch.py" and p.exists()
+    p = EC._source_path("lodestar_tpu.slasher")
+    assert p is not None and p.name == "__init__.py"
+    assert EC._source_path("lodestar_tpu.no.such.module") is None
+
+
+def test_reregistration_without_sources_drops_stale_declaration():
+    EC.register_entry("fx-restale", _toy_specs, sources=("lodestar_tpu.slasher.batch",))
+    try:
+        assert "fx-restale" in EC._ENTRY_SOURCES
+        EC.register_entry("fx-restale", _toy_specs)  # no sources now
+        assert "fx-restale" not in EC._ENTRY_SOURCES
+    finally:
+        _entry_cleanup("fx-restale")

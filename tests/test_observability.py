@@ -190,6 +190,8 @@ def test_beacon_metrics_family():
     assert m.block_import_time.count == 1
     assert m.reorg_count.value == 0  # linear advance is not a reorg
     assert m.op_pool_attestations.value == 0
+    # engine residency sampled from the regen caches on head update
+    assert m.state_root_engine_bytes.value > 0
 
     # gossip verdicts count AT the handler
     from lodestar_tpu.bls.single_thread import CpuBlsVerifier

@@ -276,3 +276,44 @@ def test_full_mode_env_switch(monkeypatch):
 @pytest.mark.slow
 def test_randomized_equivalence_large():
     _run_equivalence(n_validators=2_000, steps=25, seed=21)
+
+
+def test_engine_bytes_counts_cow_shared_planes_once():
+    """state_root_engine_bytes is a RESIDENCY metric: a freshly cloned
+    state shares every plane copy-on-write and must cost ~nothing; a
+    diverged clone pays only for the planes it owned."""
+    from lodestar_tpu.state_transition.state_root import (
+        state_root_engine_bytes,
+    )
+
+    st = _synthetic_state(16, 7)
+    st.hash_tree_root()
+    alone = state_root_engine_bytes([st])
+    assert alone > 0
+    assert state_root_engine_bytes([st]) == alone  # walking is read-only
+
+    c = st.clone()
+    both = state_root_engine_bytes([st, c])
+    assert both == alone  # fully COW-shared: counted once
+
+    c.increase_balance(0, 999)
+    c.hash_tree_root()  # diverge: balances planes (and tree) now owned
+    diverged = state_root_engine_bytes([st, c])
+    assert alone < diverged < 2 * alone
+
+    # per-engine accounting still reports full (virtual) size
+    assert c._root_engine.engine_bytes() >= alone // 2
+
+
+def test_regen_engine_bytes_walks_the_lru():
+    from lodestar_tpu.chain.regen import StateRegenerator
+
+    st = _synthetic_state(12, 3)
+    regen = StateRegenerator(fork_choice=object(), db=None)
+    regen.on_imported_block(b"\x11" * 32, st)
+    assert any(s is st for s in regen.live_states())
+    first = regen.engine_bytes()
+    assert first > 0
+    # clones cached under other roots share planes COW: no double count
+    regen.state_cache.add_with_root("ff" * 32, st.clone())
+    assert regen.engine_bytes() == first

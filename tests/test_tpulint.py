@@ -1,0 +1,287 @@
+"""tpulint: the tier-1 static-analysis gate + analyzer goldens.
+
+Two jobs: (1) the REPO gate — `lodestar_tpu/` must produce zero
+non-suppressed findings, in bounded wall-clock, so every tier-1 pass
+re-proves the kernel invariants (Mosaic purity, gather-freedom,
+export-cache fingerprint completeness); (2) analyzer correctness —
+each rule fires on its known-bad fixture and stays silent on the
+known-clean twin (tests/fixtures/tpulint/), suppressions parse with
+mandatory reasons, JSON output keeps its shape.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from lodestar_tpu.analysis import analyze, findings_to_json
+
+pytestmark = pytest.mark.smoke
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "tpulint"
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return analyze([str(FIXTURES)])
+
+
+def _by_file(findings, name):
+    return [f for f in findings if Path(f.path).name == name]
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_is_clean_and_fast():
+    t0 = time.monotonic()
+    findings = analyze([str(REPO / "lodestar_tpu")])
+    elapsed = time.monotonic() - t0
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "tpulint findings in lodestar_tpu/:\n" + "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in active
+    )
+    assert elapsed < 10.0, f"tpulint full-tree pass took {elapsed:.1f}s"
+
+
+def test_cli_exits_zero_on_repo_and_nonzero_on_fixtures():
+    ok = subprocess.run(
+        [sys.executable, "-m", "lodestar_tpu.analysis", "lodestar_tpu"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "lodestar_tpu.analysis",
+            "--json",
+            str(FIXTURES),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    payload = json.loads(bad.stdout)
+    assert payload["counts"]["active"] > 0
+
+
+# ---------------------------------------------------------------------------
+# per-rule goldens (positive + negative per rule)
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_purity_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "purity_bad.py")
+    msgs = [f.message for f in hits if f.rule == "kernel-purity"]
+    assert any("array constant" in m for m in msgs), msgs
+    assert any(".item()" in m for m in msgs), msgs
+    assert any("int(x)" in m for m in msgs), msgs
+    assert any("Python `if`" in m for m in msgs), msgs
+
+
+def test_kernel_purity_negative(fixture_findings):
+    assert not _by_file(fixture_findings, "purity_ok.py")
+
+
+def test_gather_hazard_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "gather_bad.py")
+    msgs = [f.message for f in hits if f.rule == "gather-hazard"]
+    assert any("boolean-mask" in m for m in msgs), msgs
+    assert any("2-D advanced" in m for m in msgs), msgs
+
+
+def test_gather_hazard_negative(fixture_findings):
+    assert not _by_file(fixture_findings, "gather_ok.py")
+
+
+def test_dtype_discipline_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "dtype_bad.py")
+    msgs = [f.message for f in hits if f.rule == "dtype-discipline"]
+    assert any("jnp.zeros" in m for m in msgs), msgs
+    assert any("jnp.arange" in m for m in msgs), msgs
+    assert any("64-bit int literal" in m for m in msgs), msgs
+
+
+def test_dtype_discipline_negative(fixture_findings):
+    assert not _by_file(fixture_findings, "dtype_ok.py")
+
+
+def test_node_hygiene_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "hygiene_bad.py")
+    msgs = [f.message for f in hits if f.rule == "node-hygiene"]
+    assert any("bare `except:`" in m for m in msgs), msgs
+    assert any("time.sleep" in m for m in msgs), msgs
+    assert any("jax.device_get" in m for m in msgs), msgs
+    assert any("block_until_ready" in m for m in msgs), msgs
+
+
+def test_node_hygiene_negative(fixture_findings):
+    assert not _by_file(fixture_findings, "hygiene_ok.py")
+
+
+def test_fingerprint_completeness_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "entries_bad.py")
+    msgs = [
+        f.message for f in hits if f.rule == "fingerprint-completeness"
+    ]
+    # the seeded violation: BOTH the traced module and its transitive
+    # dep must be reported missing
+    assert any("pkg.extmod" in m for m in msgs), msgs
+    assert any("pkg.extdep" in m for m in msgs), msgs
+
+
+def test_fingerprint_completeness_negative(fixture_findings):
+    # registering the traced modules clears the finding; in-kernels
+    # traced functions need no registration
+    assert not _by_file(fixture_findings, "entries_ok.py")
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_reason_suppresses(fixture_findings):
+    hits = _by_file(fixture_findings, "suppress.py")
+    sup = [f for f in hits if f.suppressed]
+    assert len(sup) == 1
+    assert sup[0].rule == "dtype-discipline"
+    assert "proves suppression works" in sup[0].suppress_reason
+
+
+def test_suppression_without_reason_is_a_finding(fixture_findings):
+    hits = _by_file(fixture_findings, "suppress.py")
+    bad = [
+        f
+        for f in hits
+        if f.rule == "bad-suppression" and "without a reason" in f.message
+    ]
+    assert len(bad) == 1
+    # ... and the underlying finding stays ACTIVE
+    active_dtype = [
+        f
+        for f in hits
+        if f.rule == "dtype-discipline" and not f.suppressed
+    ]
+    assert len(active_dtype) == 1
+
+
+def test_unknown_rule_suppression_is_a_finding(fixture_findings):
+    hits = _by_file(fixture_findings, "suppress.py")
+    assert any(
+        f.rule == "bad-suppression" and "made-up-rule" in f.message
+        for f in hits
+    )
+
+
+# ---------------------------------------------------------------------------
+# output shapes
+# ---------------------------------------------------------------------------
+
+
+def test_json_output_shape(fixture_findings):
+    payload = json.loads(findings_to_json(fixture_findings))
+    assert payload["version"] == 1
+    assert set(payload["counts"]) == {
+        "active",
+        "suppressed",
+        "errors",
+        "warnings",
+    }
+    for f in payload["findings"]:
+        assert set(f) == {
+            "rule",
+            "path",
+            "line",
+            "col",
+            "severity",
+            "message",
+            "suppressed",
+            "suppress_reason",
+        }
+        assert f["severity"] in ("error", "warning")
+        assert f["line"] >= 1
+    assert payload["counts"]["active"] == sum(
+        1 for f in payload["findings"] if not f["suppressed"]
+    )
+
+
+def test_findings_are_sorted_and_deduped(fixture_findings):
+    keys = [
+        (f.path, f.line, f.col, f.rule, f.message)
+        for f in fixture_findings
+    ]
+    assert keys == sorted(keys)
+    assert len(keys) == len(set(keys)), "duplicate findings emitted"
+
+
+# ---------------------------------------------------------------------------
+# engine robustness (review regressions)
+# ---------------------------------------------------------------------------
+
+
+def test_broken_file_is_a_finding_not_a_crash(tmp_path):
+    (tmp_path / "ok.py").write_text("X = 1\n")
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    findings = analyze([str(tmp_path)])
+    pe = [f for f in findings if f.rule == "parse-error"]
+    assert len(pe) == 1 and "broken.py" in pe[0].path
+    assert pe[0].severity == "error"
+
+
+def test_jit_decorated_methods_are_traced(tmp_path):
+    (tmp_path / "meth.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n\n\n"
+        "class Stepper:\n"
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        "        return x + jnp.zeros((4,))\n"
+    )
+    findings = analyze([str(tmp_path)])
+    assert any(
+        f.rule == "dtype-discipline" and "Stepper.step" in f.message
+        for f in findings
+    ), [f.message for f in findings]
+
+
+def test_changed_mode_paths_are_repo_root_anchored():
+    from lodestar_tpu.analysis.__main__ import _git_changed_files
+
+    changed = _git_changed_files()
+    assert changed is not None
+    # this test file is modified/untracked in the working tree of this
+    # PR; regardless, every returned path must exist (the subdir-cwd
+    # bug produced phantom cwd-relative paths)
+    for p in changed:
+        assert Path(p).is_absolute()
+        assert Path(p).exists(), p
+
+
+def test_bare_source_suffix_does_not_cover(tmp_path):
+    """Declaring a bare final segment ('batch') must NOT satisfy the
+    fingerprint rule — export_cache could not resolve it to a file."""
+    from lodestar_tpu.analysis.rules import FingerprintCompletenessRule
+
+    covers = FingerprintCompletenessRule._covers
+    assert covers("lodestar_tpu.slasher.batch", "lodestar_tpu.slasher.batch")
+    assert covers("pkg.extmod", "fixtures.tpulint.pkg.extmod")
+    assert covers("lodestar_tpu.slasher.batch", "slasher.batch")
+    assert not covers("batch", "lodestar_tpu.slasher.batch")
+    assert not covers("extmod", "pkg.extmod")
