@@ -70,6 +70,43 @@ def _metric_name() -> str:
     return "bls_signature_sets_verified_per_s"
 
 
+# -- phase-timing snapshot (ISSUE 8) ----------------------------------------
+# Every emitted record — measured AND skipped/null — carries a "phases"
+# dict: per-stage wall-clock (backend-init probe, world build, warmup,
+# timed region) with start offsets, plus the in-process kernel
+# compile/cache tallies from the observability registry.  Rounds r03-r05
+# died as bare `"skipped": true` lines; with this, a dead TPU tunnel is
+# diagnosable from the BENCH json alone (which stage ate the budget, how
+# many probe attempts, whether any compile happened before death).
+_PHASES = {"t_start": time.time(), "stages": {}}
+
+
+def _phase_mark(stage: str, seconds: float, **extra) -> None:
+    rec = {
+        "seconds": round(seconds, 3),
+        "t_offset_s": round(time.time() - _PHASES["t_start"], 3),
+    }
+    rec.update(extra)
+    _PHASES["stages"][stage] = rec
+
+
+def _phase_snapshot() -> dict:
+    snap = {
+        "t_start_unix": round(_PHASES["t_start"], 3),
+        "t_emit_offset_s": round(time.time() - _PHASES["t_start"], 3),
+        "stages": dict(_PHASES["stages"]),
+    }
+    try:
+        # compile-vs-cache tallies (kernels/export_cache.py counters);
+        # import stays lazy so the pre-jax probe stages can emit too
+        from lodestar_tpu.observability import kernel_compile_snapshot
+
+        snap["kernels"] = kernel_compile_snapshot()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not fail a run
+        snap["kernels"] = {"error": str(e)[:200]}
+    return snap
+
+
 def _emit_failure(
     stage: str, detail: str, metric: str = None, unit: str = "sets/s"
 ) -> None:
@@ -92,6 +129,7 @@ def _emit_failure(
                 "vs_baseline": None,
                 "skipped": True,
                 "error": f"{stage}: {detail}"[-2000:],
+                "phases": _phase_snapshot(),
             }
         ),
         flush=True,
@@ -105,6 +143,7 @@ def _probe_backend() -> None:
     started).  Retries a few times — the tunnel flaps — then exits the
     process with a JSON diagnosis on failure."""
     last = None
+    attempts = 0
     t0 = time.monotonic()
     for attempt in range(max(1, BENCH_PROBE_RETRIES)):
         if attempt:
@@ -124,12 +163,25 @@ def _probe_backend() -> None:
                 )
                 break
             time.sleep(BENCH_PROBE_RETRY_DELAY_S)
+        attempts = attempt + 1
         last, retryable = _probe_backend_once()
         if last is None:
+            _phase_mark(
+                "backend_init_probe",
+                time.monotonic() - t0,
+                attempts=attempts,
+                ok=True,
+            )
             return
         print(f"# probe attempt {attempt + 1} failed: {last}", file=sys.stderr)
         if not retryable:
             break  # cpu fallback / missing plugin: waiting cannot help
+    _phase_mark(
+        "backend_init_probe",
+        time.monotonic() - t0,
+        attempts=attempts,
+        ok=False,
+    )
     _emit_failure("backend-init-probe", last or "probe failed")
     sys.exit(1)
 
@@ -228,6 +280,7 @@ def _probe_state_roots() -> None:
         os.path.dirname(os.path.abspath(__file__)), "dev", "microbench_htr.py"
     )
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
     try:
         p = subprocess.run(
             [
@@ -247,6 +300,9 @@ def _probe_state_roots() -> None:
             env=env,
         )
     except subprocess.TimeoutExpired:
+        _phase_mark(
+            "state_roots_probe", time.monotonic() - t0, ok=False
+        )
         _emit_failure(
             "state-roots-probe",
             f"exceeded {BENCH_HTR_TIMEOUT_S:.0f}s",
@@ -254,6 +310,12 @@ def _probe_state_roots() -> None:
             unit="roots/s",
         )
         return
+    _phase_mark(
+        "state_roots_probe",
+        time.monotonic() - t0,
+        ok=p.returncode == 0,
+        rc=p.returncode,
+    )
     lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
     if p.returncode != 0 or not lines:
         detail = (
@@ -269,9 +331,11 @@ def _probe_state_roots() -> None:
     try:
         record = json.loads(lines[-1])
         # keep the record schema uniform with every other bench emit:
-        # {metric, value, unit, vs_baseline} (no baseline is defined for
-        # state roots — the old full recompute is reported alongside)
+        # {metric, value, unit, vs_baseline, phases} (no baseline is
+        # defined for state roots — the old full recompute is reported
+        # alongside)
         record.setdefault("vs_baseline", None)
+        record["phases"] = _phase_snapshot()
         print(json.dumps(record), flush=True)
     except ValueError:
         _emit_failure(
@@ -376,10 +440,12 @@ def main_wire():
 
     # Warm-up / compile on the throwaway job (its own roots, so the timed
     # region still pays its own hash-to-curve batches).
+    _phase_mark("world_build", t_build)
     t_warm0 = time.perf_counter()
     warm = verifier.begin_job(jobs[0], batchable=True)
     assert verifier.finish_job(warm), "bench warmup failed verification"
     t_warm = time.perf_counter() - t_warm0
+    _phase_mark("warmup", t_warm)
     print(
         f"# breakdown: world-build {t_build:.1f}s, warmup (trace+compile+run) "
         f"{t_warm:.1f}s",
@@ -397,6 +463,7 @@ def main_wire():
         ok_all &= verifier.finish_job(h)
     dt = time.perf_counter() - t0
     assert ok_all, "bench jobs failed verification"
+    _phase_mark("timed_region", dt, jobs=REPEATS, sets=BATCH * REPEATS)
 
     sets_per_s = BATCH * REPEATS / dt
     print(
@@ -406,6 +473,7 @@ def main_wire():
                 "value": round(sets_per_s, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
+                "phases": _phase_snapshot(),
             }
         )
     )
@@ -439,12 +507,16 @@ def build_decoded_inputs():
 
 
 def main_decoded():
+    t_build0 = time.perf_counter()
     args, valid = build_decoded_inputs()
     fn = KV.verify_batch_device
+    _phase_mark("world_build", time.perf_counter() - t_build0)
 
+    t_warm0 = time.perf_counter()
     rand = jnp.asarray(BK.make_rand_words(BATCH))
     ok, _ = fn(*args, rand, valid)
     assert bool(ok), "bench inputs failed verification"
+    _phase_mark("warmup", time.perf_counter() - t_warm0)
 
     t0 = time.perf_counter()
     ok_list = []
@@ -456,6 +528,7 @@ def main_decoded():
         ok.block_until_ready()
     dt = time.perf_counter() - t0
     assert all(bool(o) for o in ok_list)
+    _phase_mark("timed_region", dt, jobs=REPEATS, sets=BATCH * REPEATS)
 
     sets_per_s = BATCH * REPEATS / dt
     print(
@@ -465,6 +538,7 @@ def main_decoded():
                 "value": round(sets_per_s, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
+                "phases": _phase_snapshot(),
             }
         )
     )

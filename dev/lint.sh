@@ -1,12 +1,16 @@
 #!/usr/bin/env bash
 # tpulint wrapper — the static invariant gate, outside pytest.
 #
-#   dev/lint.sh              # full lodestar_tpu/ tree (what tier-1 runs)
+#   dev/lint.sh              # full lodestar_tpu/ tree
+#   dev/lint.sh dev tests    # the dev/test trees (tier-1 gates BOTH:
+#                            # lodestar_tpu/ plus dev/+tests/, with
+#                            # tests/fixtures/tpulint exempt — it holds
+#                            # the intentional rule violations)
 #   dev/lint.sh --changed    # only findings in git-touched files (fast
 #                            # local iteration; full tree still parsed
 #                            # so cross-module rules keep context)
 #   dev/lint.sh --json ...   # machine output
-#   dev/lint.sh path ...     # explicit paths (e.g. dev/ tests/)
+#   dev/lint.sh path ...     # explicit paths
 #
 # Exit: 0 clean, 1 findings, 2 usage error.
 set -euo pipefail

@@ -46,6 +46,10 @@ node-hygiene (warning; bare except is error)
     with a reason).  Under network/, chain/, sync/: no blocking calls
     (`time.sleep`, `jax.device_get`, `.block_until_ready()`) inside
     `async def` bodies — they stall the event loop for every peer.
+    The observability BLOCKING SINK APIs (`write_chrome_trace`,
+    `dump_chrome_trace`, `trace_summary`) count too: opening
+    `trace_span` in async code is fine (cheap, O(1)), but draining or
+    serializing the trace ring inline is file IO + an O(ring) walk.
 """
 
 from __future__ import annotations
@@ -506,6 +510,10 @@ class DtypeDisciplineRule(Rule):
 
 _ASYNC_DIRS = {"network", "chain", "sync"}
 _BLOCKING_ATTRS = {"block_until_ready"}
+# observability's blocking sink APIs: they walk/serialize the whole
+# trace ring (file IO, O(ring) aggregation) — span BODIES in async code
+# may open trace_span freely, but must never drain the ring inline
+_BLOCKING_SINKS = {"write_chrome_trace", "dump_chrome_trace", "trace_summary"}
 
 
 class NodeHygieneRule(Rule):
@@ -561,6 +569,12 @@ class NodeHygieneRule(Rule):
                     return pair
             if fn.attr in _BLOCKING_ATTRS:
                 return f".{fn.attr}()"
+            if fn.attr in _BLOCKING_SINKS:
+                return f"{fn.attr}()"
+        # observability sinks are commonly imported bare
+        # (`from ..observability import write_chrome_trace`)
+        if isinstance(fn, ast.Name) and fn.id in _BLOCKING_SINKS:
+            return f"{fn.id}()"
         return None
 
 

@@ -30,6 +30,8 @@ from concurrent.futures import Future
 from queue import SimpleQueue
 from typing import List, Optional, Sequence
 
+from ..observability import current_id as _trace_current_id
+from ..observability import trace_span as _trace_span
 from .signature_set import SignatureSet
 from .verifier import MAX_PENDING_JOBS, TpuBlsVerifier, VerifyOptions
 
@@ -43,7 +45,8 @@ MAX_INFLIGHT_JOBS = 4
 
 
 class _Job:
-    __slots__ = ("sets", "opts", "future", "t_submit", "t_submit_ns")
+    __slots__ = ("sets", "opts", "future", "t_submit", "t_submit_ns",
+                 "trace_parent")
 
     def __init__(self, sets, opts):
         self.sets = sets
@@ -51,6 +54,10 @@ class _Job:
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.t_submit_ns = time.time_ns()
+        # submitting context's span id: the dispatcher/resolver threads
+        # do NOT inherit contextvars, so the device-side spans link back
+        # to the gossip/import span that queued the work explicitly
+        self.trace_parent = _trace_current_id()
 
 
 class BlsVerifierService:
@@ -244,6 +251,16 @@ class BlsVerifierService:
             self._inflight_slots.release()
             self.metrics.workers_busy.set(1)
             worker_end_ns = None
+            # explicit enter/exit (not `with`): the span must close at
+            # the TOP of the finally so it brackets only the device
+            # resolution, parented to the submitting context's span
+            span = _trace_span(
+                "bls.job",
+                parent_id=group[0].trace_parent if group else None,
+                jobs=len(group),
+                sets=sum(len(j.sets) for j in group),
+            )
+            span.__enter__()
             try:
                 if isinstance(handles, tuple):
                     merged, batchable = handles
@@ -307,6 +324,7 @@ class BlsVerifierService:
                         j.future.set_exception(e)
                 self.metrics.error_jobs.inc(len(group))
             finally:
+                span.__exit__(None, None, None)
                 self.metrics.workers_busy.set(0)
                 settled_ns = time.time_ns()
                 if worker_end_ns is not None:

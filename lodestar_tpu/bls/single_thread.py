@@ -55,9 +55,14 @@ class CpuBlsVerifier:
     def verify_signature_sets(self, sets, opts=None) -> bool:
         import time as _time
 
+        from ..observability import trace_span
+
         t0 = _time.perf_counter()
-        verdicts = [self._verify_one(s) for s in sets]
+        with trace_span("bls.verify", batch_size=len(sets), backend="cpu"):
+            verdicts = [self._verify_one(s) for s in sets]
         dt = _time.perf_counter() - t0
+        self.metrics.batch_size.observe(len(sets))
+        self.metrics.verify_seconds.observe("total", dt)
         if self.observe_single_thread:
             self.single_thread_metrics.duration.observe(dt)
             if sets:

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from ..crypto import curves as C
 from ..kernels import layout as LY
 from ..kernels import verify as KV
+from ..observability import enabled as _trace_enabled
+from ..observability import trace_span as _trace_span
 from ..ops import bls_kernels as BK
 from ..utils.metrics import BlsPoolMetrics
 from .ingest import MessageCache, encode_wire_planes
@@ -187,35 +189,43 @@ class TpuBlsVerifier:
         t_start = time.perf_counter()
         self._pending_jobs += 1
         try:
-            if opts.verify_on_main_thread:
-                verdicts = [
-                    self._verify_set_cpu(
-                        s.decode() if isinstance(s, WireSignatureSet) else s
+            with _trace_span(
+                "bls.verify",
+                batch_size=len(sets),
+                batchable=opts.batchable,
+                main_thread=opts.verify_on_main_thread,
+            ):
+                if opts.verify_on_main_thread:
+                    verdicts = [
+                        self._verify_set_cpu(
+                            s.decode() if isinstance(s, WireSignatureSet) else s
+                        )
+                        for s in sets
+                    ]
+                    good = sum(verdicts)
+                    self.metrics.success_jobs.inc(good)
+                    self.metrics.invalid_sets.inc(len(sets) - good)
+                    return all(verdicts)
+                # Dispatch every chunk before syncing any: chunks
+                # pipeline on the device stream instead of paying the
+                # tunnel round-trip serially per chunk.
+                jobs = [
+                    self.begin_job(
+                        list(sets[i : i + self.max_job_sets]), opts.batchable
                     )
-                    for s in sets
+                    for i in range(0, len(sets), self.max_job_sets)
                 ]
-                good = sum(verdicts)
-                self.metrics.success_jobs.inc(good)
-                self.metrics.invalid_sets.inc(len(sets) - good)
-                return all(verdicts)
-            # Dispatch every chunk before syncing any: chunks pipeline on
-            # the device stream instead of paying the tunnel round-trip
-            # serially per chunk.
-            jobs = [
-                self.begin_job(
-                    list(sets[i : i + self.max_job_sets]), opts.batchable
-                )
-                for i in range(0, len(sets), self.max_job_sets)
-            ]
-            ok = True
-            for job in jobs:
-                ok &= self.finish_job(job)
-            return ok
+                ok = True
+                for job in jobs:
+                    ok &= self.finish_job(job)
+                return ok
         finally:
             self._pending_jobs -= 1
             dt = time.perf_counter() - t_start
             self.metrics.job_time.observe(dt)
             self.metrics.time_per_sig_set.observe(dt / len(sets))
+            self.metrics.batch_size.observe(len(sets))
+            self.metrics.verify_seconds.observe("total", dt)
 
     # -- job execution ----------------------------------------------------
 
@@ -296,7 +306,24 @@ class TpuBlsVerifier:
         JAX dispatch is asynchronous: several begun jobs queue on the
         device stream and overlap the ~65 ms host<->device tunnel latency
         (dev/NOTES.md); `finish_job` syncs verdicts in order.
+
+        Everything in here is HOST work (plane encoding, padding,
+        dispatch) — it feeds the `lodestar_bls_verify_seconds{phase="host"}`
+        series; `finish_job` owns the device-sync side.
         """
+        t0 = time.perf_counter()
+        with _trace_span(
+            "bls.begin_job", sets=len(sets), batchable=batchable
+        ) as span:
+            job = self._begin_job(sets, batchable, span)
+        self.metrics.verify_seconds.observe(
+            "host", time.perf_counter() - t0
+        )
+        return job
+
+    def _begin_job(
+        self, sets: List[SignatureSet], batchable: bool, span=None
+    ) -> "_DeviceJob":
         assert len(sets) <= self.max_job_sets
         wire = bool(sets) and isinstance(sets[0], WireSignatureSet)
         assert all(
@@ -349,6 +376,16 @@ class TpuBlsVerifier:
         else:
             job.args, job.valid, n = self._prepare(sets)
             job.decodable = np.array([s.signature is not None for s in sets])
+        if span is not None and _trace_enabled():
+            # the (N, K) shape bucket names which compiled pipeline this
+            # job rides — the export-cache-bucketing ROADMAP item's unit
+            span.set(
+                wire=wire,
+                n_bucket=n,
+                k_bucket=_bucket(
+                    max(len(s.indices) for s in sets), K_BUCKETS
+                ),
+            )
         if batchable and len(sets) >= 2 and job.decodable.all():
             # reference: maybeBatch.ts:16 (batch iff >= 2 sets)
             self.metrics.batchable_sigs.inc(len(sets))
@@ -454,7 +491,19 @@ class TpuBlsVerifier:
         return args, jnp.asarray(valid), n, host_bad
 
     def finish_job(self, job: "_DeviceJob") -> bool:
-        """Sync a begun job's device results and produce the verdict."""
+        """Sync a begun job's device results and produce the verdict.
+
+        This is the device-sync leg (plus any per-set retry dispatch) —
+        it feeds `lodestar_bls_verify_seconds{phase="device"}`."""
+        t0 = time.perf_counter()
+        with _trace_span("bls.finish_job", sets=len(job.sets)):
+            ok = self._finish_job(job)
+        self.metrics.verify_seconds.observe(
+            "device", time.perf_counter() - t0
+        )
+        return ok
+
+    def _finish_job(self, job: "_DeviceJob") -> bool:
         sets = job.sets
         if not sets:
             return job.ok_big

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+from ..observability import trace_span as _trace_span
 from ..state_transition.signature_sets import (
     BeaconStateView,
     get_block_signature_sets,
@@ -61,6 +62,7 @@ class BlockProcessor:
 
     # -- the pipeline (reference: blocks/index.ts processBlocks) -----------
 
+    @_trace_span("blocks.process_segment")
     def _process_blocks(self, signed_blocks: List[dict]) -> List[bytes]:
         self._sanity_checks(signed_blocks)
         # signatures: one verify job per block, ALL dispatched before any

@@ -36,6 +36,49 @@ DEFAULT_DIR = os.environ.get(
 _LOADED: Dict[str, object] = {}
 
 
+class _Metrics:
+    """Per-entry compile-vs-cache instrumentation (process-global
+    registry — a cold Mosaic trace shows up as a NAMED number on
+    /metrics and in bench.py's phase snapshot, not as a CI timeout)."""
+
+    def __init__(self):
+        from ..utils.metrics import global_registry
+
+        r = global_registry()
+        self.hits = r.labeled_counter(
+            "lodestar_tpu_export_cache_hits_total",
+            "Export-cache lookups served from memory or disk, per entry",
+            "entry",
+        )
+        self.misses = r.labeled_counter(
+            "lodestar_tpu_export_cache_misses_total",
+            "Export-cache lookups that required a fresh trace, per entry",
+            "entry",
+        )
+        self.trace_seconds = r.labeled_histogram(
+            "lodestar_tpu_export_trace_seconds",
+            "Wall seconds tracing+serializing an export artifact, per entry",
+            "entry",
+            (0.1, 1, 5, 30, 60, 120, 300, 600, 1200),
+        )
+        self.load_seconds = r.labeled_histogram(
+            "lodestar_tpu_export_load_seconds",
+            "Wall seconds deserializing a cached artifact, per entry",
+            "entry",
+            (0.001, 0.01, 0.1, 1, 5, 30),
+        )
+
+
+_METRICS: Optional[_Metrics] = None
+
+
+def metrics() -> _Metrics:
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = _Metrics()
+    return _METRICS
+
+
 # Kernel sources OUTSIDE kernels/ whose traced computations live in the
 # cache, keyed per entry NAME (standalone registry entries declare
 # theirs at registration).  They fold into THAT entry's artifact key
@@ -119,17 +162,26 @@ def load(
     """Deserialize a cached artifact; None when absent/stale."""
     from jax import export as jexport
 
+    import time
+
     key = artifact_key(name, specs, platform)
     hit = _LOADED.get(key)
     if hit is not None:
+        metrics().hits.inc(name, 1.0)
         return hit.call
     path = _path(key, cache_dir)
     if not path.exists():
         return None
-    try:
-        exp = jexport.deserialize(path.read_bytes())
-    except Exception:  # stale/corrupt artifact: re-trace
-        return None
+    from ..observability import trace_span
+
+    t0 = time.perf_counter()
+    with trace_span("kernels.export_load", entry=name, platform=platform):
+        try:
+            exp = jexport.deserialize(path.read_bytes())
+        except Exception:  # stale/corrupt artifact: re-trace
+            return None
+    metrics().load_seconds.observe(name, time.perf_counter() - t0)
+    metrics().hits.inc(name, 1.0)
     _LOADED[key] = exp
     return exp.call
 
@@ -145,18 +197,24 @@ def export_and_save(
 
     For platform="tpu" on a CPU host the pallas launches are forced
     through the real Mosaic lowering (launch.force_mosaic)."""
+    import time
+
     from jax import export as jexport
 
+    from ..observability import trace_span
     from . import launch
 
     key = artifact_key(name, specs, platform)
     jitted = jax.jit(fn)
-    if platform == "tpu" and jax.default_backend() != "tpu":
-        with launch.force_mosaic():
+    t0 = time.perf_counter()
+    with trace_span("kernels.export_trace", entry=name, platform=platform):
+        if platform == "tpu" and jax.default_backend() != "tpu":
+            with launch.force_mosaic():
+                exp = jexport.export(jitted, platforms=[platform])(*specs)
+        else:
             exp = jexport.export(jitted, platforms=[platform])(*specs)
-    else:
-        exp = jexport.export(jitted, platforms=[platform])(*specs)
-    _path(key, cache_dir).write_bytes(exp.serialize())
+        _path(key, cache_dir).write_bytes(exp.serialize())
+    metrics().trace_seconds.observe(name, time.perf_counter() - t0)
     _LOADED[key] = exp
     return exp.call
 
@@ -173,6 +231,7 @@ def load_or_export(
     cached = load(name, specs, platform, cache_dir)
     if cached is not None:
         return cached
+    metrics().misses.inc(name, 1.0)
     return export_and_save(name, fn, specs, platform, cache_dir)
 
 

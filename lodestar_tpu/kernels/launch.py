@@ -16,6 +16,9 @@ from typing import Callable, Dict, Tuple
 import jax
 from jax.experimental import pallas as pl
 
+from ..observability import enabled as _trace_enabled
+from ..observability import trace_span as _trace_span
+
 _CACHE: Dict[Tuple, Callable] = {}
 
 # When True, launches lower through the REAL Mosaic path regardless of
@@ -51,6 +54,20 @@ def _sds(shape):
     return jax.ShapeDtypeStruct(shape, jnp.int32)
 
 
+def _count_build(kind: str) -> None:
+    """Wrapper-construction tally (one per (kernel, shape) signature):
+    a rebuilt wrapper means a kernel RE-TRACE, so a climbing counter is
+    the named symptom of the per-job re-tracing this module exists to
+    prevent.  Process-global registry — lands on /metrics."""
+    from ..utils.metrics import global_registry
+
+    global_registry().labeled_counter(
+        "lodestar_tpu_pallas_builds_total",
+        "pallas_call wrapper constructions (each implies a kernel trace)",
+        "kind",
+    ).inc(kind, 1.0)
+
+
 def tiled(kernel, ins, in_rows, out_rows, n: int, bt: int):
     """Lane-tiled launch: operands [rows, n] blocked to [rows, bt]."""
     assert n % bt == 0, n
@@ -58,6 +75,7 @@ def tiled(kernel, ins, in_rows, out_rows, n: int, bt: int):
     key = ("tiled", kernel, tuple(in_rows), tuple(out_rows), n, bt, interp)
     fn = _CACHE.get(key)
     if fn is None:
+        _count_build("tiled")
         fn = pl.pallas_call(
             kernel,
             out_shape=[_sds((r, n)) for r in out_rows],
@@ -71,6 +89,14 @@ def tiled(kernel, ins, in_rows, out_rows, n: int, bt: int):
             interpret=interp,
         )
         _CACHE[key] = fn
+    if _trace_enabled():
+        # dispatch only — JAX execution is async, so this span measures
+        # trace/lower/launch overhead on the host, not device runtime
+        with _trace_span(
+            "kernels.dispatch", kind="tiled",
+            kernel=getattr(kernel, "__name__", "?"), n=n,
+        ):
+            return fn(*ins)
     return fn(*ins)
 
 
@@ -81,6 +107,7 @@ def cached(key: Tuple, builder: Callable[[], Callable]) -> Callable:
     full = key + (interpret(),)
     fn = _CACHE.get(full)
     if fn is None:
+        _count_build("cached")
         fn = builder()
         _CACHE[full] = fn
     return fn

@@ -27,6 +27,8 @@ class MonitoringService:
         *,
         chain=None,
         bls_metrics=None,
+        beacon_metrics=None,
+        validator_monitor=None,
         interval_s: float = 60.0,
         collect_system: bool = True,
         timeout_s: float = 10.0,
@@ -34,6 +36,10 @@ class MonitoringService:
         self.endpoint = endpoint
         self.chain = chain
         self.bls_metrics = bls_metrics
+        # utils/beacon_metrics.BeaconMetrics: import-phase breakdown
+        self.beacon_metrics = beacon_metrics
+        # utils/validator_monitor.ValidatorMonitor: duty performance
+        self.validator_monitor = validator_monitor
         self.interval_s = interval_s
         self.collect_system = collect_system
         self.timeout_s = timeout_s
@@ -70,10 +76,41 @@ class MonitoringService:
             except Exception:  # noqa: BLE001 - stats are best-effort
                 pass
         if self.bls_metrics is not None:
-            beacon["bls_success_jobs"] = int(
-                self.bls_metrics.success_jobs.value
+            m = self.bls_metrics
+            beacon["bls_success_jobs"] = int(m.success_jobs.value)
+            # hot-path shape observability (ISSUE 8): remote collectors
+            # see the same lodestar_bls_batch_size/verify_seconds series
+            # /metrics exposes, reduced to sums/counts
+            beacon["bls_batch_size_count"] = int(m.batch_size.count)
+            beacon["bls_batch_size_sum"] = float(m.batch_size.sum)
+            beacon["bls_verify_seconds"] = {
+                phase: float(m.verify_seconds.sum(phase))
+                for phase in m.verify_seconds.label_values()
+            }
+        if self.beacon_metrics is not None:
+            bm = self.beacon_metrics
+            beacon["block_import_seconds_total"] = float(
+                bm.block_import_time.sum
             )
+            # the per-phase import breakdown, phase -> wall seconds
+            beacon["block_import_phase_seconds"] = {
+                phase: float(bm.block_import_phase.sum(phase))
+                for phase in bm.block_import_phase.label_values()
+            }
         stats = [beacon]
+        if self.validator_monitor is not None:
+            vm = self.validator_monitor
+            stats.append(
+                dict(
+                    common,
+                    process="validator",
+                    validators=len(vm.tracked_indices),
+                    attestations_included=int(vm.m_attestations.value),
+                    blocks_proposed=int(vm.m_blocks.value),
+                    sync_signals_included=int(vm.m_sync_signals.value),
+                    attestations_missed=int(vm.m_missed.value),
+                )
+            )
         if self.collect_system:
             import resource
 

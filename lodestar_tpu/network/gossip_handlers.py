@@ -98,20 +98,29 @@ class GossipHandlers:
 
     def handle(self, topic: str, data: bytes) -> GossipAction | None:
         """Returns None on ACCEPT, else the failure action."""
+        from ..observability import trace_span
+
         digest, name = parse_topic(topic)
-        try:
-            payload = decode_message(data)
-            action = self._dispatch(name, payload, digest)
-        except GossipValidationError as e:
-            self._count(name, e.action.value)
-            self.log.debug("gossip rejected", topic=name, reason=e.reason)
-            return e.action
-        except Exception as e:  # undecodable payload or import failure
-            self._count(name, "reject")
-            self.log.debug("gossip undecodable", topic=name, error=str(e))
-            return GossipAction.REJECT
-        self._count(name, "accept")
-        return action
+        # the ROOT of the gossip->verify->import span tree: everything
+        # a message costs (decode, validation, BLS, a block's full
+        # import) nests under this span in the Chrome trace
+        with trace_span("gossip.handle", topic=name) as span:
+            try:
+                payload = decode_message(data)
+                action = self._dispatch(name, payload, digest)
+            except GossipValidationError as e:
+                span.set(verdict=e.action.value)
+                self._count(name, e.action.value)
+                self.log.debug("gossip rejected", topic=name, reason=e.reason)
+                return e.action
+            except Exception as e:  # undecodable payload or import failure
+                span.set(verdict="reject")
+                self._count(name, "reject")
+                self.log.debug("gossip undecodable", topic=name, error=str(e))
+                return GossipAction.REJECT
+            span.set(verdict="accept")
+            self._count(name, "accept")
+            return action
 
     def _count(self, name: str, verdict: str) -> None:
         self.results.setdefault(name, {}).setdefault(verdict, 0)

@@ -18,6 +18,7 @@ never touches the device itself.
 from __future__ import annotations
 
 import enum
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, Generic, List, Optional, TypeVar
@@ -25,6 +26,38 @@ from typing import Deque, Dict, Generic, List, Optional, TypeVar
 T = TypeVar("T")
 
 MAX_DROP_RATIO = 0.95
+
+_LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30)
+
+
+class GossipQueueMetrics:
+    """Per-topic queue observability: enqueue->dequeue latency, live
+    depth, drop counts (ISSUE 8 — the series the async
+    verification-pipeline ROADMAP item needs to size its flush
+    deadlines).  One instance per Registry; shared across topics via
+    the `topic` label."""
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from ..utils.metrics import global_registry
+
+            registry = global_registry()
+        self.latency = registry.labeled_histogram(
+            "lodestar_gossip_queue_latency_seconds",
+            "Enqueue-to-dequeue wait per gossip message",
+            "topic",
+            _LATENCY_BUCKETS,
+        )
+        self.depth = registry.labeled_gauge(
+            "lodestar_gossip_queue_length",
+            "Live gossip queue depth per topic",
+            "topic",
+        )
+        self.dropped = registry.labeled_counter(
+            "lodestar_gossip_queue_dropped_total",
+            "Messages shed by overflow policy per topic",
+            "topic",
+        )
 
 
 class QueueType(enum.Enum):
@@ -98,11 +131,24 @@ GOSSIP_QUEUE_OPTS: Dict[GossipType, GossipQueueOpts] = {
 
 
 class GossipQueue(Generic[T]):
-    """One topic's queue.  `add` returns the number of items dropped."""
+    """One topic's queue.  `add` returns the number of items dropped.
 
-    def __init__(self, opts: GossipQueueOpts):
+    When constructed with a `topic` + `metrics`, every add/next pair
+    feeds the enqueue->dequeue latency histogram and the depth gauge —
+    `_t` mirrors `_q`'s order exactly (same ends pushed/popped), so the
+    timestamp popped with an item is always that item's."""
+
+    def __init__(
+        self,
+        opts: GossipQueueOpts,
+        topic: Optional[str] = None,
+        metrics: Optional[GossipQueueMetrics] = None,
+    ):
         self.opts = opts
+        self.topic = topic
+        self.metrics = metrics if topic is not None else None
         self._q: Deque[T] = deque()
+        self._t: Deque[float] = deque()  # per-item enqueue perf_counter
         self._drop_ratio = 0.0
         if isinstance(opts.drop, DropByRatio):
             if not (0.0 < opts.drop.start <= 1.0):
@@ -124,25 +170,45 @@ class GossipQueue(Generic[T]):
 
     def clear(self) -> None:
         self._q.clear()
+        self._t.clear()
+        self._set_depth()
+
+    def _set_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.depth.set(self.topic, float(len(self._q)))
 
     def add(self, item: T) -> int:
         drop = self.opts.drop
         if isinstance(drop, DropByRatio) and not self._recent_drop and not self._q:
             self._drop_ratio = drop.start  # node looks healthy: retest start
         self._q.append(item)
+        self._t.append(time.perf_counter())
         if len(self._q) <= self.opts.max_length:
+            self._set_depth()
             return 0
         if isinstance(drop, DropByCount):
-            return self._drop_by_count(drop.count)
-        self._recent_drop = True
-        dropped = self._drop_by_count(int(len(self._q) * self._drop_ratio))
-        self._drop_ratio = min(MAX_DROP_RATIO, self._drop_ratio + drop.step)
+            dropped = self._drop_by_count(drop.count)
+        else:
+            self._recent_drop = True
+            dropped = self._drop_by_count(int(len(self._q) * self._drop_ratio))
+            self._drop_ratio = min(MAX_DROP_RATIO, self._drop_ratio + drop.step)
+        if dropped and self.metrics is not None:
+            self.metrics.dropped.inc(self.topic, float(dropped))
+        self._set_depth()
         return dropped
 
     def next(self) -> Optional[T]:
         if not self._q:
             return None
-        item = self._q.pop() if self.opts.type is QueueType.LIFO else self._q.popleft()
+        if self.opts.type is QueueType.LIFO:
+            item, t_in = self._q.pop(), self._t.pop()
+        else:
+            item, t_in = self._q.popleft(), self._t.popleft()
+        if self.metrics is not None:
+            self.metrics.latency.observe(
+                self.topic, time.perf_counter() - t_in
+            )
+            self._set_depth()
         if isinstance(self.opts.drop, DropByRatio) and self._recent_drop:
             self._processed_since_drop += 1
             if self._processed_since_drop >= self.opts.max_length:
@@ -159,16 +225,23 @@ class GossipQueue(Generic[T]):
         if count >= len(self._q):
             n = len(self._q)
             self._q.clear()
+            self._t.clear()
             return n
         # LIFO keeps the newest (drop from the left/oldest); FIFO keeps
         # the oldest (drop from the right/newest).
         for _ in range(count):
             if self.opts.type is QueueType.LIFO:
                 self._q.popleft()
+                self._t.popleft()
             else:
                 self._q.pop()
+                self._t.pop()
         return count
 
 
-def create_gossip_queues() -> Dict[GossipType, GossipQueue]:
-    return {t: GossipQueue(o) for t, o in GOSSIP_QUEUE_OPTS.items()}
+def create_gossip_queues(registry=None) -> Dict[GossipType, GossipQueue]:
+    metrics = GossipQueueMetrics(registry)
+    return {
+        t: GossipQueue(o, topic=t.value, metrics=metrics)
+        for t, o in GOSSIP_QUEUE_OPTS.items()
+    }

@@ -92,8 +92,13 @@ class NetworkProcessor:
         can_accept_work_fns: List[Callable[[], bool]],
         has_block_root: Optional[Callable[[str], bool]] = None,
         max_jobs_per_tick: int = MAX_JOBS_SUBMITTED_PER_TICK,
+        registry=None,
     ):
-        self.queues: Dict[GossipType, GossipQueue] = create_gossip_queues()
+        # registry: where queue latency/depth series land (node passes
+        # its own; None = the process-global observability registry)
+        self.queues: Dict[GossipType, GossipQueue] = create_gossip_queues(
+            registry
+        )
         self.worker = worker
         self.can_accept_work_fns = can_accept_work_fns
         self.has_block_root = has_block_root

@@ -110,6 +110,7 @@ class BeaconNode:
             self._validate_gossip_message,
             [self.bls.can_accept_work],
             has_block_root=self.fork_choice.has_block,
+            registry=self.registry,
         )
         self.clock.on_slot(self.processor.on_clock_slot)
         # proposer boost is strictly per-slot (reference: forkChoice.ts
@@ -402,11 +403,13 @@ class FullBeaconNode:
                     scorer=self.scorer,
                 )
 
-        # network processor over the validators' backpressure
+        # network processor over the validators' backpressure (queue
+        # latency/depth series land in this node's registry)
         self.processor = NetworkProcessor(
             self._process_gossip_message,
             [self.bls.can_accept_work],
             has_block_root=self.fork_choice.has_block,
+            registry=self.registry,
         )
 
         # sync drivers (sources injected per peer/transport)

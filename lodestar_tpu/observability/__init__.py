@@ -1,0 +1,50 @@
+"""lodestar_tpu.observability — hot-path tracing + derived metrics.
+
+Public surface:
+
+  - ``trace_span(name, **attrs)`` — context manager AND decorator;
+    near-zero when disabled (``LODESTAR_TPU_TRACE`` unset/0).
+  - ``enabled()`` / ``configure(enabled=, capacity=)`` / ``get_tracer()``
+  - ``current_id()`` — explicit parent linking across threads.
+  - ``dump_chrome_trace()`` / ``write_chrome_trace(path)`` /
+    ``trace_summary()`` — blocking sinks (never call in async bodies
+    under network/chain/sync; tpulint enforces this).
+
+``python -m lodestar_tpu.observability`` summarizes or dumps a trace
+(from a file, a live node's GET /trace, or this process's ring).
+"""
+
+from .tracer import (  # noqa: F401
+    SpanRecord,
+    Tracer,
+    configure,
+    current_id,
+    enabled,
+    get_tracer,
+    trace_span,
+)
+from .sinks import (  # noqa: F401
+    dump_chrome_trace,
+    install_registry_sink,
+    kernel_compile_snapshot,
+    trace_summary,
+    write_chrome_trace,
+)
+
+# every process with tracing gets the /metrics derivation for free
+install_registry_sink()
+
+__all__ = [
+    "SpanRecord",
+    "Tracer",
+    "configure",
+    "current_id",
+    "enabled",
+    "get_tracer",
+    "trace_span",
+    "dump_chrome_trace",
+    "install_registry_sink",
+    "kernel_compile_snapshot",
+    "trace_summary",
+    "write_chrome_trace",
+]

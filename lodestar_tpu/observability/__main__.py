@@ -1,0 +1,115 @@
+"""CLI: inspect traces — `python -m lodestar_tpu.observability`.
+
+    python -m lodestar_tpu.observability summary trace.json
+    python -m lodestar_tpu.observability summary --url http://127.0.0.1:9100
+    python -m lodestar_tpu.observability dump --url http://127.0.0.1:9100 --out trace.json
+
+`summary` prints top spans by SELF time plus kernel compile totals;
+`dump` writes a loadable Chrome trace JSON.  Sources, in precedence
+order: an explicit file, `--url` (a metrics server's GET /trace), or
+this process's own ring (empty unless something traced in-process).
+Exit 0 on success, 2 on usage/load errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .sinks import dump_chrome_trace, trace_summary
+from .tracer import SpanRecord
+
+
+def _records_from_chrome(doc: dict) -> List[SpanRecord]:
+    """Rebuild SpanRecords from a Chrome trace document (args carry
+    span_id/parent_id, so summaries work on dumped files too)."""
+    out = []
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        span_id = args.pop("span_id", None)
+        parent_id = args.pop("parent_id", None)
+        out.append(
+            SpanRecord(
+                ev.get("name", "?"),
+                span_id if span_id is not None else id(ev),
+                parent_id,
+                ev.get("tid", 0),
+                int(ev.get("ts", 0)),
+                int(ev.get("dur", 0)),
+                args,
+            )
+        )
+    return out
+
+
+def _load(path: Optional[str], url: Optional[str]) -> List[SpanRecord]:
+    if path:
+        with open(path) as f:
+            return _records_from_chrome(json.load(f))
+    if url:
+        import urllib.request
+
+        endpoint = url.rstrip("/")
+        if not endpoint.endswith("/trace"):
+            endpoint += "/trace"
+        with urllib.request.urlopen(endpoint, timeout=30) as resp:
+            return _records_from_chrome(json.loads(resp.read()))
+    from .tracer import get_tracer
+
+    return get_tracer().snapshot()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m lodestar_tpu.observability")
+    ap.add_argument("command", choices=("summary", "dump"))
+    ap.add_argument("file", nargs="?", help="Chrome trace JSON to read")
+    ap.add_argument("--url", help="live node metrics server (GET /trace)")
+    ap.add_argument("--out", help="dump: write here instead of stdout")
+    ap.add_argument("--top", type=int, default=20, help="summary rows")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    try:
+        records = _load(args.file, args.url)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: could not load trace: {e}", file=sys.stderr)
+        return 2
+
+    if args.command == "dump":
+        doc = dump_chrome_trace(records)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote {len(doc['traceEvents'])} events to {args.out}")
+        else:
+            json.dump(doc, sys.stdout)
+        return 0
+
+    summary = trace_summary(records, top=args.top)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2)
+        print()
+        return 0
+    k = summary["kernels"]
+    print(
+        f"{summary['records']} spans, {summary['span_names']} names | "
+        f"export traces: {k['export_traces']} "
+        f"({k['export_trace_seconds']:.1f}s), cache "
+        f"{k['export_cache_hits']:.0f} hit / "
+        f"{k['export_cache_misses']:.0f} miss"
+    )
+    print(f"{'span':<40} {'count':>7} {'self s':>10} {'total s':>10} {'max s':>8}")
+    for row in summary["spans"]:
+        print(
+            f"{row['name']:<40} {row['count']:>7} {row['self_s']:>10.3f} "
+            f"{row['total_s']:>10.3f} {row['max_s']:>8.3f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Trace sinks: Chrome trace_event JSON, registry histograms, summaries.
+
+Two consumers of the tracer ring (observability/tracer.py):
+
+  - ``dump_chrome_trace()`` / ``write_chrome_trace(path)`` render the
+    ring as a Chrome ``trace_event`` document (load it at
+    chrome://tracing or https://ui.perfetto.dev) — spans nest visually
+    by timestamp containment per thread, and each event carries its
+    ``span_id``/``parent_id`` in ``args`` so tooling can rebuild the
+    exact tree even across threads;
+  - ``install_registry_sink()`` derives a per-span-name seconds
+    histogram (``lodestar_tpu_span_seconds{span=...}``) in the
+    process-global utils/metrics.py Registry, so every span family
+    appears on /metrics with zero extra instrumentation.
+
+``dump_chrome_trace``/``write_chrome_trace``/``trace_summary`` walk or
+serialize the whole ring — they are the BLOCKING SINK APIs, and
+tpulint's node-hygiene rule rejects them inside ``async def`` bodies
+under network/chain/sync (serialize off the event loop instead).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..utils.metrics import Registry, global_registry
+from .tracer import SpanRecord, get_tracer
+
+_SPAN_BUCKETS = (
+    1e-5, 1e-4, 1e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0,
+)
+
+_PID = 0  # single-process traces; the driver merges files if needed
+
+
+def install_registry_sink(registry: Optional[Registry] = None) -> None:
+    """Derive `lodestar_tpu_span_seconds{span}` histograms from finished
+    spans.  Idempotent; defaults to the process-global registry."""
+    reg = registry or global_registry()
+    hist = reg.labeled_histogram(
+        "lodestar_tpu_span_seconds",
+        "Tracer span durations by span name",
+        "span",
+        _SPAN_BUCKETS,
+    )
+
+    def _sink(rec: SpanRecord) -> None:
+        hist.observe(rec.name, rec.dur_us / 1e6)
+
+    # marker attr so repeat installs (tests reconfiguring the tracer)
+    # don't stack duplicate observers on the same histogram
+    _sink.__name__ = "lodestar_tpu_span_seconds_sink"
+    tracer = get_tracer()
+    tracer._sinks = [
+        s for s in tracer._sinks
+        if getattr(s, "__name__", "") != _sink.__name__
+    ]
+    tracer.add_sink(_sink)
+
+
+def chrome_events(records: List[SpanRecord]) -> List[dict]:
+    return [
+        {
+            "name": r.name,
+            "ph": "X",  # complete event: ts + dur
+            "ts": r.ts_us,
+            "dur": max(r.dur_us, 1),
+            "pid": _PID,
+            "tid": r.tid % 1_000_000,  # thread idents are long; fold
+            "args": dict(
+                r.attrs, span_id=r.span_id, parent_id=r.parent_id
+            ),
+        }
+        for r in records
+    ]
+
+
+def dump_chrome_trace(records: Optional[List[SpanRecord]] = None) -> dict:
+    """The full ring as a loadable Chrome trace document (BLOCKING)."""
+    recs = records if records is not None else get_tracer().snapshot()
+    return {
+        "traceEvents": chrome_events(recs),
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "lodestar_tpu.observability"},
+    }
+
+
+def write_chrome_trace(
+    path: str, records: Optional[List[SpanRecord]] = None
+) -> str:
+    """Serialize the ring to `path` (BLOCKING file IO)."""
+    doc = dump_chrome_trace(records)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _self_times_us(records: List[SpanRecord]) -> Dict[int, int]:
+    """span_id -> dur minus the sum of direct children's durs."""
+    self_us = {r.span_id: r.dur_us for r in records}
+    for r in records:
+        if r.parent_id is not None and r.parent_id in self_us:
+            self_us[r.parent_id] -= r.dur_us
+    return self_us
+
+
+def trace_summary(
+    records: Optional[List[SpanRecord]] = None, top: int = 20
+) -> dict:
+    """Aggregate the ring per span name (BLOCKING): call count, total
+    and SELF wall time (total minus children — the flamegraph's "where
+    does the time actually go" number), plus kernel compile/cache
+    totals so a tier-1 stall diagnosis is one call."""
+    recs = records if records is not None else get_tracer().snapshot()
+    self_us = _self_times_us(recs)
+    agg: Dict[str, dict] = {}
+    for r in recs:
+        a = agg.setdefault(
+            r.name,
+            {"name": r.name, "count": 0, "total_s": 0.0, "self_s": 0.0,
+             "max_s": 0.0},
+        )
+        a["count"] += 1
+        a["total_s"] += r.dur_us / 1e6
+        a["self_s"] += self_us.get(r.span_id, r.dur_us) / 1e6
+        a["max_s"] = max(a["max_s"], r.dur_us / 1e6)
+    spans = sorted(agg.values(), key=lambda a: a["self_s"], reverse=True)
+    return {
+        "spans": spans[:top],
+        "span_names": len(agg),
+        "records": len(recs),
+        "kernels": kernel_compile_snapshot(),
+    }
+
+
+def kernel_compile_snapshot() -> dict:
+    """Compile-vs-cache tallies from the kernel instrumentation
+    (kernels/export_cache.py writes these to the global registry) —
+    the numbers bench.py attaches to every probe record."""
+    reg = global_registry()
+    hits = reg.get("lodestar_tpu_export_cache_hits_total")
+    misses = reg.get("lodestar_tpu_export_cache_misses_total")
+    trace_s = reg.get("lodestar_tpu_export_trace_seconds")
+
+    def _label_total(metric) -> float:
+        if metric is None:
+            return 0.0
+        return float(
+            sum(metric.get(lv) for lv in metric.label_values())
+        )
+
+    out = {
+        "export_cache_hits": _label_total(hits),
+        "export_cache_misses": _label_total(misses),
+        "export_trace_seconds": 0.0,
+        "export_traces": 0,
+    }
+    if trace_s is not None:
+        for entry in trace_s.label_values():
+            out["export_trace_seconds"] += trace_s.sum(entry)
+            out["export_traces"] += trace_s.count(entry)
+    return out

@@ -41,6 +41,16 @@ class BeaconMetrics:
             "Full import pipeline time per block",
             _IMPORT_BUCKETS,
         )
+        # per-phase import breakdown (ISSUE 8): validation / signature
+        # verify / STF / state-root / fork-choice, mirroring the
+        # reference's epoch-transition timing metrics — the series that
+        # names WHERE a slow import spends its slot
+        self.block_import_phase = registry.labeled_histogram(
+            "lodestar_block_import_phase_seconds",
+            "Block import wall time per pipeline phase",
+            "phase",
+            _IMPORT_BUCKETS,
+        )
         # gossip verdicts per topic — real counters, incremented at the
         # handler the moment the verdict lands
         self.gossip_verdicts = {
@@ -124,8 +134,9 @@ class BeaconMetrics:
 
         chain.emitter.on(ChainEvent.block, on_block)
         chain.emitter.on(ChainEvent.head, on_head)
-        # the import pipeline observes into this histogram when present
+        # the import pipeline observes into these when present
         chain.import_timer = self.block_import_time
+        chain.phase_timer = self.block_import_phase
 
     def observe_gossip(self, handlers) -> None:
         """Count verdicts at the source (the handler ledger increments

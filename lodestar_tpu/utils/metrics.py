@@ -86,12 +86,18 @@ class LabeledGauge:
         with self._lock:
             self._v[label_value] = v
 
+    def label_values(self) -> List[str]:
+        with self._lock:
+            return sorted(self._v)
+
     def expose(self) -> List[str]:
         out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} {self.TYPE}",
         ]
-        for lv, v in sorted(self._v.items()):
+        with self._lock:  # hot paths insert labels concurrently
+            items = sorted(self._v.items())
+        for lv, v in items:
             out.append(f'{self.name}{{{self.label}="{lv}"}} {v}')
         return out
 
@@ -103,10 +109,24 @@ class LabeledCounter(LabeledGauge):
     TYPE = "counter"
 
 
+def _fmt_le(bound: float) -> str:
+    """Prometheus-text-format `le` label value, matching the official
+    python client's floatToGoString style: `+Inf` for the terminal
+    bucket, else the float repr (`1.0`, `0.005`, `1e-05`) — NOT the
+    raw python value (`le="1"` for an int bucket is what made the old
+    exposition non-conformant across clients)."""
+    if bound == float("inf"):
+        return "+Inf"
+    return repr(float(bound))
+
+
 class Histogram:
     def __init__(self, name: str, help_: str, buckets: Sequence[float]):
         self.name, self.help = name, help_
-        self.buckets = sorted(buckets)
+        # finite, deduplicated bounds; +Inf is always emitted explicitly
+        self.buckets = sorted(
+            {float(b) for b in buckets if float(b) != float("inf")}
+        )
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
@@ -130,25 +150,90 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def _sample_lines(self, label_prefix: str = "") -> List[str]:
+        """The `_bucket`/`_sum`/`_count` sample lines; `label_prefix`
+        holds extra `k="v",` pairs to merge ahead of `le` (the
+        LabeledHistogram path)."""
+        out: List[str] = []
+        cum = 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append(
+                f'{self.name}_bucket{{{label_prefix}le="{_fmt_le(b)}"}} {cum}'
+            )
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{{label_prefix}le="+Inf"}} {cum}')
+        if label_prefix:
+            bare = label_prefix.rstrip(",")
+            out.append(f"{self.name}_sum{{{bare}}} {self._sum}")
+            out.append(f"{self.name}_count{{{bare}}} {self._n}")
+        else:
+            out.append(f"{self.name}_sum {self._sum}")
+            out.append(f"{self.name}_count {self._n}")
+        return out
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ] + self._sample_lines()
+
+
+class LabeledHistogram:
+    """Histogram with one label dimension (the import-phase breakdown's
+    `phase`, the gossip queues' `topic`).  Exposition emits ONE
+    HELP/TYPE pair and per-label-value bucket/sum/count series with
+    the extra label merged ahead of `le` — conformant text format."""
+
+    def __init__(self, name: str, help_: str, label: str, buckets: Sequence[float]):
+        self.name, self.help, self.label = name, help_, label
+        self._buckets = list(buckets)
+        self._children: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def child(self, label_value: str) -> Histogram:
+        h = self._children.get(label_value)
+        if h is None:
+            with self._lock:
+                h = self._children.setdefault(
+                    label_value, Histogram(self.name, self.help, self._buckets)
+                )
+        return h
+
+    def observe(self, label_value: str, v: float) -> None:
+        self.child(label_value).observe(v)
+
+    def count(self, label_value: str) -> int:
+        c = self._children.get(label_value)
+        return c.count if c is not None else 0
+
+    def sum(self, label_value: str) -> float:
+        c = self._children.get(label_value)
+        return c.sum if c is not None else 0.0
+
+    def label_values(self) -> List[str]:
+        with self._lock:
+            return sorted(self._children)
+
     def expose(self) -> List[str]:
         out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
-        cum = 0
-        for b, c in zip(self.buckets, self._counts):
-            cum += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        cum += self._counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._n}")
+        with self._lock:  # hot paths insert children concurrently
+            children = sorted(self._children.items())
+        for lv, child in children:
+            out.extend(child._sample_lines(f'{self.label}="{lv}",'))
         return out
 
 
 class Registry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
+        # the process-global instance is registered into from hot-path
+        # threads (kernel builds, export-cache lookups): creation must
+        # be atomic or a racing first registration loses its counts
+        self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str) -> Counter:
         return self._get(name, lambda: Counter(name, help_))
@@ -165,16 +250,43 @@ class Registry:
     def labeled_counter(self, name: str, help_: str, label: str) -> "LabeledCounter":
         return self._get(name, lambda: LabeledCounter(name, help_, label))
 
+    def labeled_histogram(
+        self, name: str, help_: str, label: str, buckets
+    ) -> LabeledHistogram:
+        return self._get(
+            name, lambda: LabeledHistogram(name, help_, label, buckets)
+        )
+
+    def get(self, name: str) -> Optional[object]:
+        """Registered metric by name (None when absent) — the public
+        read path for snapshot consumers (observability/sinks.py)."""
+        return self._metrics.get(name)
+
     def _get(self, name, factory):
-        if name not in self._metrics:
-            self._metrics[name] = factory()
-        return self._metrics[name]
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
 
     def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
         lines: List[str] = []
-        for m in self._metrics.values():
+        for m in metrics:
             lines.extend(m.expose())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
+
+
+# Process-global registry: instrumentation that is inherently
+# per-PROCESS — kernel compiles, export-cache hits, tracer-derived span
+# histograms — lands here so it reaches /metrics without threading a
+# per-node Registry through the kernel layers.  utils/metrics_server.py
+# merges it into every exposition.
+_GLOBAL_REGISTRY = Registry()
+
+
+def global_registry() -> Registry:
+    return _GLOBAL_REGISTRY
 
 
 _SECONDS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5]
@@ -252,6 +364,20 @@ class BlsPoolMetrics:
         )
         self.invalid_sets = r.counter(
             p + "invalid_sig_sets_count", "Sig sets that failed verification"
+        )
+        # hot-path shape observability (ISSUE 8): per-call batch size and
+        # host-vs-device wall time — the series the batching ROADMAP
+        # items need to prove their wins
+        self.batch_size = r.histogram(
+            "lodestar_bls_batch_size",
+            "Signature sets per verify_signature_sets call",
+            [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+        )
+        self.verify_seconds = r.labeled_histogram(
+            "lodestar_bls_verify_seconds",
+            "Wall time per verify call by phase (host prep, device sync, total)",
+            "phase",
+            _SECONDS,
         )
 
 

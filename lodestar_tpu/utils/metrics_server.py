@@ -1,24 +1,42 @@
-"""HTTP metrics server — Prometheus scrape endpoint.
+"""HTTP metrics server — Prometheus scrape endpoint + trace export.
 
 Mirror of the reference's HttpMetricsServer (reference:
 packages/beacon-node/src/metrics/server/http.ts): GET /metrics returns
 the registry's text exposition; scrape duration is itself observed.
+Two lodestar-tpu extensions:
+
+  - the process-global registry (utils/metrics.py global_registry —
+    kernel compile/cache counters, tracer-derived span histograms) is
+    merged into every scrape, so per-process instrumentation reaches
+    Prometheus without per-node plumbing;
+  - GET /trace serves the observability ring as Chrome trace_event
+    JSON (load at chrome://tracing / ui.perfetto.dev), empty when
+    LODESTAR_TPU_TRACE is off.
+
 Stdlib http.server in a daemon thread — no external dependency.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .metrics import Registry
+from .metrics import Registry, global_registry
 
 
 class HttpMetricsServer:
-    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        registry: Registry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        include_global: bool = True,
+    ):
         self.registry = registry
+        self.include_global = include_global
         self.scrape_time = registry.histogram(
             "lodestar_metrics_scrape_seconds",
             "Time to collect the metrics exposition",
@@ -28,15 +46,22 @@ class HttpMetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if path == "/trace":
+                    self._reply(200, outer._trace_body(), "application/json")
+                    return
+                if path not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
                     return
                 t0 = time.perf_counter()
-                body = outer.registry.expose().encode()
+                body = outer.exposition().encode()
                 outer.scrape_time.observe(time.perf_counter() - t0)
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self._reply(200, body, "text/plain; version=0.0.4")
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -47,6 +72,20 @@ class HttpMetricsServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def exposition(self) -> str:
+        """This registry's text, plus the process-global registry's
+        (unless they ARE the same object, or opted out)."""
+        text = self.registry.expose()
+        g = global_registry()
+        if self.include_global and g is not self.registry:
+            text += g.expose()
+        return text
+
+    def _trace_body(self) -> bytes:
+        from ..observability import dump_chrome_trace
+
+        return json.dumps(dump_chrome_trace()).encode()
 
     def start(self) -> None:
         self._thread = threading.Thread(

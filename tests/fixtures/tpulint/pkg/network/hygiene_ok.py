@@ -18,3 +18,14 @@ async def poll_peer(peer):
 
 def sync_helper():
     time.sleep(0.01)  # blocking in a SYNC function: fine
+
+
+def dump_traces_sync(obs, path):
+    # blocking sinks in a SYNC function: fine
+    return obs.dump_chrome_trace()
+
+
+async def traced_poll(peer, trace_span):
+    # opening a span in async code is fine — only the SINKS block
+    with trace_span("network.poll", peer=str(peer)):
+        await peer.send(b"ping")

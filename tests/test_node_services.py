@@ -190,6 +190,72 @@ def test_monitoring_service(world):
     assert svc2.failures == 1
 
 
+def test_monitoring_payload_schema_valid(world):
+    """ISSUE 8 satellite: the pushed metric set includes the new bls +
+    import-phase series, and the payload stays schema-valid — every
+    stat entry carries the clientStats envelope, process names come
+    from the known set, values are JSON-numeric (a collector rejecting
+    one malformed entry drops the whole POST)."""
+    from lodestar_tpu.utils.beacon_metrics import BeaconMetrics
+    from lodestar_tpu.utils.metrics import BlsPoolMetrics, Registry
+    from lodestar_tpu.utils.validator_monitor import ValidatorMonitor
+
+    cfg, sks, pks, genesis = world
+    chain = BeaconChain(cfg, genesis)
+    reg = Registry()
+    beacon_metrics = BeaconMetrics(reg)
+    beacon_metrics.observe_chain(chain)
+    bls_metrics = BlsPoolMetrics(reg)
+    bls_metrics.batch_size.observe(4)
+    bls_metrics.verify_seconds.observe("total", 0.01)
+    monitor = ValidatorMonitor(reg)
+    monitor.register_local_validator(0)
+    # drive a REAL import so the phase sums are non-trivial
+    from lodestar_tpu.validator import ValidatorStore
+
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+    st = genesis.clone()
+    process_slots(st, 1)
+    proposer = int(get_beacon_proposer_index(st))
+    block = chain.produce_block(1, store.sign_randao(proposer, 1))
+    chain.process_block(
+        {"message": block, "signature": store.sign_block(proposer, block)}
+    )
+
+    svc = MonitoringService(
+        "http://127.0.0.1:1/api",
+        chain=chain,
+        bls_metrics=bls_metrics,
+        beacon_metrics=beacon_metrics,
+        validator_monitor=monitor,
+    )
+    stats = svc.collect()
+    json.dumps(stats)  # wire-serializable, or the POST cannot happen
+    envelope = {"version", "timestamp", "client_name", "client_version",
+                "process"}
+    known_processes = {"beaconnode", "system", "validator"}
+    for entry in stats:
+        assert envelope <= set(entry), entry
+        assert entry["process"] in known_processes
+        assert entry["version"] == 1
+        assert isinstance(entry["timestamp"], int)
+    beacon = next(s for s in stats if s["process"] == "beaconnode")
+    # the new series, numerically typed
+    assert beacon["bls_batch_size_count"] == 1
+    assert beacon["bls_batch_size_sum"] == 4.0
+    assert beacon["bls_verify_seconds"]["total"] > 0
+    assert beacon["block_import_seconds_total"] > 0
+    phase_seconds = beacon["block_import_phase_seconds"]
+    assert set(phase_seconds) == {
+        "validation", "signature_verify", "stf", "state_root",
+        "fork_choice",
+    }
+    assert all(isinstance(v, float) for v in phase_seconds.values())
+    validator = next(s for s in stats if s["process"] == "validator")
+    assert validator["validators"] == 1
+    assert isinstance(validator["attestations_included"], int)
+
+
 def test_cli_beacon_dev_mode(capsys):
     from lodestar_tpu.cli import main
 

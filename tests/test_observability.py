@@ -215,3 +215,463 @@ def test_beacon_metrics_family():
     assert "# TYPE lodestar_gossip_accept_total counter" in text
     assert 'lodestar_gossip_accept_total{topic="beacon_block"} 2.0' in text
     assert "libp2p_peers 2" in text
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: hot-path tracing + conformant exposition
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tracing():
+    """Enable the process tracer for one test, restore disabled+empty."""
+    from lodestar_tpu import observability as OB
+
+    tracer = OB.configure(enabled=True, capacity=OB.get_tracer().capacity)
+    tracer.clear()
+    try:
+        yield OB
+    finally:
+        OB.configure(enabled=False)
+        OB.get_tracer().clear()
+
+
+def test_histogram_exposition_is_prometheus_conformant():
+    """Golden format: `le` rendered float-style incl. +Inf, cumulative
+    bucket counts, `_sum`/`_count` lines — the text any Prometheus
+    client parses identically (satellite: exposition conformance)."""
+    reg = Registry()
+    h = reg.histogram("x_seconds", "An example timing", [0.005, 1, 2.5])
+    h.observe(0.001)
+    h.observe(2.0)
+    h.observe(30.0)
+    assert reg.expose() == (
+        "# HELP x_seconds An example timing\n"
+        "# TYPE x_seconds histogram\n"
+        'x_seconds_bucket{le="0.005"} 1\n'
+        'x_seconds_bucket{le="1.0"} 1\n'
+        'x_seconds_bucket{le="2.5"} 2\n'
+        'x_seconds_bucket{le="+Inf"} 3\n'
+        "x_seconds_sum 32.001\n"
+        "x_seconds_count 3\n"
+    )
+
+
+def test_labeled_histogram_exposition_merges_labels():
+    reg = Registry()
+    h = reg.labeled_histogram(
+        "phase_seconds", "Per-phase timing", "phase", [1]
+    )
+    h.observe("stf", 0.5)
+    h.observe("stf", 3.0)
+    h.observe("state_root", 0.1)
+    text = reg.expose()
+    assert 'phase_seconds_bucket{phase="stf",le="1.0"} 1' in text
+    assert 'phase_seconds_bucket{phase="stf",le="+Inf"} 2' in text
+    assert 'phase_seconds_sum{phase="stf"} 3.5' in text
+    assert 'phase_seconds_count{phase="state_root"} 1' in text
+    # ONE metadata pair for the whole family
+    assert text.count("# TYPE phase_seconds histogram") == 1
+    assert h.sum("stf") == 3.5 and h.count("stf") == 2
+    assert h.label_values() == ["state_root", "stf"]
+
+
+def test_tracer_nesting_and_parenting(tracing):
+    OB = tracing
+    with OB.trace_span("outer", layer="test"):
+        with OB.trace_span("mid"):
+            with OB.trace_span("leaf"):
+                pass
+        with OB.trace_span("mid2"):
+            pass
+    recs = {r.name: r for r in OB.get_tracer().snapshot()}
+    assert recs["leaf"].parent_id == recs["mid"].span_id
+    assert recs["mid"].parent_id == recs["outer"].span_id
+    assert recs["mid2"].parent_id == recs["outer"].span_id
+    assert recs["outer"].parent_id is None
+    assert recs["outer"].attrs["layer"] == "test"
+    # durations contain the children
+    assert recs["outer"].dur_us >= recs["mid"].dur_us
+
+
+def test_tracer_parenting_across_asyncio_tasks(tracing):
+    """contextvars propagate into tasks at creation: every task's spans
+    parent to the creating span, and interleaved awaits in sibling
+    tasks cannot corrupt each other's lineage."""
+    import asyncio
+
+    OB = tracing
+
+    async def worker(i):
+        with OB.trace_span(f"task-{i}"):
+            await asyncio.sleep(0.001)
+            with OB.trace_span(f"task-{i}-inner"):
+                await asyncio.sleep(0.001)
+
+    async def main():
+        with OB.trace_span("root"):
+            await asyncio.gather(*[worker(i) for i in range(4)])
+
+    asyncio.run(main())
+    recs = {r.name: r for r in OB.get_tracer().snapshot()}
+    root = recs["root"]
+    for i in range(4):
+        assert recs[f"task-{i}"].parent_id == root.span_id
+        assert recs[f"task-{i}-inner"].parent_id == recs[f"task-{i}"].span_id
+
+
+def test_tracer_ring_is_bounded(tracing):
+    OB = tracing
+    OB.configure(capacity=16)
+    try:
+        for i in range(200):
+            with OB.trace_span("spam", i=i):
+                pass
+        recs = OB.get_tracer().snapshot()
+        assert len(recs) == 16
+        # the ring keeps the MOST RECENT spans
+        assert [r.attrs["i"] for r in recs] == list(range(184, 200))
+    finally:
+        OB.configure(capacity=65536)
+
+
+def test_tracer_thread_safety(tracing):
+    import threading
+
+    OB = tracing
+    OB.configure(capacity=100_000)
+    errors = []
+
+    def hammer(tid):
+        try:
+            for i in range(300):
+                with OB.trace_span(f"thread-{tid}"):
+                    with OB.trace_span(f"thread-{tid}-inner"):
+                        pass
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    recs = OB.get_tracer().snapshot()
+    assert len(recs) == 8 * 300 * 2
+    # per-thread lineage stays intact: every inner span's parent is a
+    # span of the SAME thread (contextvars are per-thread roots)
+    by_id = {r.span_id: r for r in recs}
+    for r in recs:
+        if r.name.endswith("-inner"):
+            assert by_id[r.parent_id].name == r.name[: -len("-inner")]
+    OB.configure(capacity=65536)
+
+
+def test_disabled_tracer_overhead_bound():
+    """The asserted cost contract: with tracing DISABLED, a trace_span
+    on the verify hot path is bounded below 25 us/call (it measures
+    ~0.5 us — one allocation + one flag check; the bound is slack for
+    CI noise)."""
+    import time as _time
+
+    from lodestar_tpu import observability as OB
+
+    assert not OB.enabled()
+    n = 20_000
+    t0 = _time.perf_counter()
+    for i in range(n):
+        with OB.trace_span("hot", batch_size=512):
+            pass
+    per_call = (_time.perf_counter() - t0) / n
+    assert per_call < 25e-6, f"disabled trace_span costs {per_call*1e6:.2f}us"
+    # near-zero check: nothing recorded, no contextvar residue
+    assert OB.current_id() is None
+    assert len(OB.get_tracer()) == 0
+
+
+def test_trace_span_decorator_respects_runtime_toggle(tracing):
+    OB = tracing
+    OB.configure(enabled=False)
+
+    @OB.trace_span("decorated.fn", kind="test")
+    def fn(x):
+        return x * 2
+
+    assert fn(2) == 4
+    assert len(OB.get_tracer()) == 0  # disabled at call time: no record
+    OB.configure(enabled=True)
+    assert fn(3) == 6
+    recs = OB.get_tracer().snapshot()
+    assert recs[-1].name == "decorated.fn"
+    assert recs[-1].attrs["kind"] == "test"
+
+
+def test_chrome_trace_export_loadable_and_summary(tracing):
+    import json
+
+    OB = tracing
+    with OB.trace_span("parent"):
+        with OB.trace_span("child"):
+            pass
+    doc = json.loads(json.dumps(OB.dump_chrome_trace()))
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"parent", "child"}
+    child = next(e for e in events if e["name"] == "child")
+    parent = next(e for e in events if e["name"] == "parent")
+    assert child["ph"] == "X" and parent["ph"] == "X"
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    # timestamp containment (what the flamegraph renders as nesting)
+    assert parent["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1
+    summary = OB.trace_summary()
+    names = {row["name"]: row for row in summary["spans"]}
+    assert names["parent"]["count"] == 1
+    # self-time excludes the child's duration
+    assert names["parent"]["self_s"] <= names["parent"]["total_s"]
+
+
+def test_observability_cli_summary_and_dump(tracing, tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    OB = tracing
+    with OB.trace_span("cli.span"):
+        pass
+    path = tmp_path / "trace.json"
+    OB.write_chrome_trace(str(path))
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "lodestar_tpu.observability",
+            "summary", str(path), "--json",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert any(r["name"] == "cli.span" for r in summary["spans"])
+    dumped = tmp_path / "out.json"
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "lodestar_tpu.observability",
+            "dump", str(path), "--out", str(dumped),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(dumped.read_text())["traceEvents"]
+
+
+def test_metrics_server_trace_endpoint_and_global_merge(tracing, tmp_path):
+    """Acceptance slice: /metrics exposes the compile/cache and
+    gossip-queue series (process-global registry merged into the node
+    registry's exposition) and GET /trace serves a loadable Chrome
+    trace."""
+    import json
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from lodestar_tpu.kernels import export_cache as EC
+    from lodestar_tpu.network.gossip_queues import (
+        GOSSIP_QUEUE_OPTS, GossipType, create_gossip_queues,
+    )
+    from lodestar_tpu.utils.metrics_server import HttpMetricsServer
+
+    OB = tracing
+    # one fresh export (compile) + one cache hit, against a tmp dir
+    specs = [jax.ShapeDtypeStruct((4,), jnp.int32)]
+    EC.load_or_export(
+        "obs_endpoint_test", lambda x: x * 2, specs, "cpu", str(tmp_path)
+    )
+    EC._LOADED.clear()
+    EC.load_or_export(
+        "obs_endpoint_test", lambda x: x * 2, specs, "cpu", str(tmp_path)
+    )
+    # queue traffic -> latency/depth series (global registry default)
+    queues = create_gossip_queues()
+    q = queues[GossipType.beacon_attestation]
+    q.add("a")
+    q.add("b")
+    assert q.next() == "b"  # LIFO
+
+    reg = Registry()
+    reg.counter("node_local_total", "node-registry metric").inc()
+    srv = HttpMetricsServer(reg, port=0)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ).read().decode()
+        # node-local AND process-global series in one exposition
+        assert "node_local_total 1.0" in body
+        assert (
+            'lodestar_tpu_export_cache_misses_total{entry="obs_endpoint_test"}'
+            in body
+        )
+        assert (
+            'lodestar_tpu_export_cache_hits_total{entry="obs_endpoint_test"}'
+            in body
+        )
+        assert 'lodestar_tpu_export_trace_seconds_count{entry="obs_endpoint_test"} 1' in body
+        assert (
+            'lodestar_gossip_queue_latency_seconds_count{topic="beacon_attestation"} 1'
+            in body
+        )
+        assert 'lodestar_gossip_queue_length{topic="beacon_attestation"} 1.0' in body
+        trace = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/trace", timeout=30
+            ).read()
+        )
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "kernels.export_trace" in names
+        assert "kernels.export_load" in names
+    finally:
+        srv.close()
+
+
+def test_gossip_queue_drop_accounting():
+    from lodestar_tpu.network.gossip_queues import (
+        DropByCount, GossipQueue, GossipQueueMetrics, GossipQueueOpts,
+        QueueType,
+    )
+
+    reg = Registry()
+    metrics = GossipQueueMetrics(reg)
+    q = GossipQueue(
+        GossipQueueOpts(QueueType.FIFO, 4, DropByCount(1)),
+        topic="t", metrics=metrics,
+    )
+    for i in range(6):
+        q.add(i)
+    # FIFO drops newest on overflow; timestamps stay aligned with items
+    assert len(q) == 4
+    assert q.next() == 0
+    assert metrics.dropped.get("t") == 2.0
+    assert metrics.latency.count("t") == 1
+    assert metrics.depth.get("t") == 3.0
+
+
+def test_gossip_verify_import_nested_span_tree(tracing):
+    """The acceptance trace shape on the REAL pipeline: a gossip block
+    handled end-to-end produces gossip.handle -> chain.import ->
+    {validation, signature_verify, stf, state_root, fork_choice} spans,
+    with the device-side bls.job span linked across threads to the
+    signature_verify span, and the phase histogram filled for every
+    phase."""
+    from lodestar_tpu.bls.service import BlsVerifierService
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.network.gossip import encode_message, topic_string
+    from lodestar_tpu.network.gossip import GossipTopicName
+    from lodestar_tpu.network.gossip_handlers import GossipHandlers
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+    from lodestar_tpu.state_transition.accessors import (
+        get_beacon_proposer_index,
+    )
+    from lodestar_tpu.state_transition.slot import process_slots
+    from lodestar_tpu.utils.beacon_metrics import BeaconMetrics
+    from lodestar_tpu.validator import ValidatorStore
+
+    OB = tracing
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    sks = [B.keygen(b"obs-trace-%d" % i) for i in range(4)]
+    pk_points = [B.sk_to_pk(sk) for sk in sks]
+    pks = [C.g1_compress(p) for p in pk_points]
+    genesis = create_genesis_state(cfg, pks, genesis_time=2)
+    service = BlsVerifierService(CpuBlsVerifier(pubkeys=pk_points))
+    chain = BeaconChain(cfg, genesis, bls_verifier=service)
+    reg = Registry()
+    bm = BeaconMetrics(reg)
+    bm.observe_chain(chain)
+    handlers = GossipHandlers(chain, service.verifier)
+    store = ValidatorStore(cfg, dict(enumerate(sks)))
+    try:
+        st = genesis.clone()
+        process_slots(st, 1)
+        proposer = int(get_beacon_proposer_index(st))
+        block = chain.produce_block(1, store.sign_randao(proposer, 1))
+        signed = {
+            "message": block,
+            "signature": store.sign_block(proposer, block),
+        }
+        digest = cfg.fork_digest(0)
+        action = handlers.handle(
+            topic_string(digest, GossipTopicName.beacon_block),
+            encode_message(cfg.get_fork_types(1)[1].serialize(signed)),
+        )
+        assert action is None  # ACCEPT
+    finally:
+        service.close()
+
+    recs = OB.get_tracer().snapshot()
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r.name, []).append(r)
+    gossip = by_name["gossip.handle"][0]
+    assert gossip.attrs["topic"] == "beacon_block"
+    assert gossip.attrs["verdict"] == "accept"
+    imp = by_name["chain.import"][0]
+    assert imp.parent_id == gossip.span_id
+    for phase in (
+        "validation", "signature_verify", "stf", "state_root",
+        "fork_choice",
+    ):
+        span = by_name["import." + phase][0]
+        assert span.parent_id == imp.span_id, phase
+    # cross-thread link: the resolver thread's bls.job span parents to
+    # the signature_verify span that queued the work
+    sig = by_name["import.signature_verify"][0]
+    job = by_name["bls.job"][0]
+    assert job.parent_id == sig.span_id
+    assert job.tid != sig.tid  # genuinely another thread
+    # cpu verifier's own span nests under the job via explicit parent?
+    # (no — it runs in the resolver thread's context) — it must at
+    # least exist with the batch size attribute
+    bls_spans = by_name["bls.verify"]
+    assert any(s.attrs.get("batch_size", 0) >= 1 for s in bls_spans)
+
+    # every phase landed in the labeled histogram, and the whole import
+    # equals roughly the sum of its phases (no unaccounted 2x)
+    phases = bm.block_import_phase
+    for phase in (
+        "validation", "signature_verify", "stf", "state_root",
+        "fork_choice",
+    ):
+        assert phases.count(phase) == 1, phase
+    assert bm.block_import_time.count == 1
+    phase_sum = sum(phases.sum(p) for p in phases.label_values())
+    assert phase_sum <= bm.block_import_time.sum * 1.05
+    text = reg.expose()
+    assert 'lodestar_block_import_phase_seconds_count{phase="stf"} 1' in text
+
+    # the Chrome document for this run is loadable and keeps the tree
+    import json as _json
+
+    doc = _json.loads(_json.dumps(OB.dump_chrome_trace()))
+    ids = {
+        e["args"]["span_id"]: e for e in doc["traceEvents"]
+    }
+    child = ids[imp.span_id]
+    assert ids[child["args"]["parent_id"]]["name"] == "gossip.handle"
+
+
+def test_bls_batch_size_and_verify_seconds_series(world):
+    sks, pks, sets = world
+    registry = Registry()
+    verifier = CpuBlsVerifier(pubkeys=pks, metrics=BlsPoolMetrics(registry))
+    assert verifier.verify_signature_sets(sets)
+    m = verifier.metrics
+    assert m.batch_size.count == 1
+    assert m.verify_seconds.count("total") == 1
+    text = registry.expose()
+    assert 'lodestar_bls_batch_size_bucket{le="4.0"} 1' in text
+    assert 'lodestar_bls_verify_seconds_count{phase="total"} 1' in text

@@ -54,6 +54,23 @@ def test_repo_tree_is_clean_and_fast():
     assert elapsed < 10.0, f"tpulint full-tree pass took {elapsed:.1f}s"
 
 
+def test_dev_and_tests_trees_are_clean():
+    """ROADMAP follow-up (ISSUE 8): the tier-1 gate lints dev/ and
+    tests/ alongside lodestar_tpu/ (dev/lint.sh dev tests).  The
+    tpulint fixture package is the ONE tree allowed findings — it
+    exists to contain them."""
+    findings = analyze([str(REPO / "dev"), str(REPO / "tests")])
+    active = [
+        f
+        for f in findings
+        if not f.suppressed
+        and not f.path.startswith("tests/fixtures/tpulint")
+    ]
+    assert not active, "tpulint findings in dev//tests/:\n" + "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in active
+    )
+
+
 def test_cli_exits_zero_on_repo_and_nonzero_on_fixtures():
     ok = subprocess.run(
         [sys.executable, "-m", "lodestar_tpu.analysis", "lodestar_tpu"],
@@ -129,6 +146,10 @@ def test_node_hygiene_positive(fixture_findings):
     assert any("time.sleep" in m for m in msgs), msgs
     assert any("jax.device_get" in m for m in msgs), msgs
     assert any("block_until_ready" in m for m in msgs), msgs
+    # blocking observability sinks in async bodies — both the
+    # attribute form and the bare-imported form
+    assert any("dump_chrome_trace()" in m for m in msgs), msgs
+    assert any("write_chrome_trace()" in m for m in msgs), msgs
 
 
 def test_node_hygiene_negative(fixture_findings):
