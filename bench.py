@@ -136,6 +136,17 @@ def _emit_failure(
     )
 
 
+def _emit_rlc_skip(stage: str, detail: str) -> None:
+    """A failure before the RLC probes ran skips BOTH rlc metrics — a
+    missing record reads as "old bench without the probe", a skip
+    record reads as "probe present, run unusable".  (Defined before
+    _probe_backend's module-level call site so that path can use it.)"""
+    _emit_failure(
+        stage, detail, metric="bls_rlc_signature_sets_verified_per_s"
+    )
+    _emit_failure(stage, detail, metric="bls_rlc_bisect_seconds", unit="s")
+
+
 def _probe_backend() -> None:
     """Initialize the TPU backend in THROWAWAY subprocesses with hard
     timeouts, so an unresponsive axon tunnel is diagnosed instead of
@@ -183,6 +194,15 @@ def _probe_backend() -> None:
         ok=False,
     )
     _emit_failure("backend-init-probe", last or "probe failed")
+    # the RLC probes ride the same process; emit their skip records too
+    # so BENCH_r06+ consumers see "skipped" rather than a missing metric
+    # (wire mode only — a healthy decoded run emits no RLC records, so a
+    # skip record there would claim a probe that never runs)
+    if (
+        os.environ.get("BENCH_RLC", "1") != "0"
+        and os.environ.get("BENCH_MODE", "wire") != "decoded"
+    ):
+        _emit_rlc_skip("backend-init-probe", last or "probe failed")
     sys.exit(1)
 
 
@@ -477,6 +497,133 @@ def main_wire():
             }
         )
     )
+    if os.environ.get("BENCH_RLC", "1") != "0":
+        _probe_rlc(verifier, jobs)
+
+
+# -- RLC amortization + adversarial-floor probes (ISSUE 10) -----------------
+# Two secondary records with the headline's skip/null semantics:
+#   bls_rlc_signature_sets_verified_per_s — all-valid jobs resolved by the
+#     ONE-multi-pairing batch check (the amortization the tentpole buys),
+#   bls_rlc_bisect_seconds — wall-clock to resolve a job with tampered
+#     sets via the bisection fallback (the adversarial floor: a flood of
+#     bad signatures degrades throughput to ~this per poisoned job, it
+#     does not reject honest sets).
+BENCH_RLC_REPEATS = int(os.environ.get("BENCH_RLC_REPEATS", "4"))
+
+
+def _probe_rlc(verifier, jobs) -> None:
+    t0 = time.monotonic()
+    try:
+        # the metrics claim RLC throughput — never publish the per-set
+        # path under that name (escape hatch set, or 1-set jobs that are
+        # never batchable under BENCH_BATCH=1)
+        if not getattr(verifier, "_use_rlc", True):
+            _emit_rlc_skip("rlc-probe", "LODESTAR_TPU_BLS_RLC=0: RLC disabled")
+            return
+        reps = jobs[1 : 1 + max(1, min(BENCH_RLC_REPEATS, len(jobs) - 1))]
+        if not reps:  # BENCH_REPEATS=0: only the warmup job exists
+            _emit_rlc_skip("rlc-probe", "no post-warmup jobs to measure")
+            return
+        if min(len(j) for j in reps) < 2:
+            _emit_rlc_skip("rlc-probe", "jobs too small to batch (BENCH_BATCH<2)")
+            return
+        t1 = time.perf_counter()
+        handles = [verifier.begin_job(list(job), batchable=True) for job in reps]
+        ok = all(verifier.finish_job(h) for h in handles)
+        dt = time.perf_counter() - t1
+        n_sets = sum(len(j) for j in reps)
+        _phase_mark("rlc_probe", time.monotonic() - t0, ok=ok)
+        if not ok:
+            _emit_rlc_skip("rlc-probe", "valid RLC jobs failed verification")
+            return
+        sets_per_s = n_sets / dt
+        print(
+            json.dumps(
+                {
+                    "metric": "bls_rlc_signature_sets_verified_per_s",
+                    "value": round(sets_per_s, 2),
+                    "unit": "sets/s",
+                    "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
+                    "phases": _phase_snapshot(),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 — probe failures emit a skip record
+        _emit_rlc_skip("rlc-probe", f"{type(e).__name__}: {e}")
+        return
+    try:
+        # adversarial floor: swap the signatures of two sets with
+        # different signing roots — both stay decodable and in-subgroup,
+        # both are WRONG, so the batch check fails and the verifier
+        # bisects down to per-set verdicts for the poisoned leaf
+        bad_job = list(jobs[1])
+        i, j = 0, 1
+        while (
+            j < len(bad_job)
+            and bad_job[i].signing_root == bad_job[j].signing_root
+        ):
+            j += 1
+        if j >= len(bad_job):
+            _emit_failure(
+                "rlc-bisect-probe",
+                "job has no two sets with distinct signing roots to swap",
+                metric="bls_rlc_bisect_seconds", unit="s",
+            )
+            return
+        a, b = bad_job[i], bad_job[j]
+        bad_job[i] = WireSignatureSet.single(
+            a.indices[0], a.signing_root, b.signature
+        )
+        bad_job[j] = WireSignatureSet.single(
+            b.indices[0], b.signing_root, a.signature
+        )
+        # warmup (untimed): bisection halves dispatch the INTERMEDIATE
+        # N-bucket pipelines (e.g. 256 — neither the registered 128
+        # bucket nor the replay-captured 512), so the first run pays
+        # their trace/compile; the timed run below must measure the
+        # adversarial floor, not compilation — same reason the headline
+        # probe warms the batch pipeline before timing.
+        if verifier.finish_job(verifier.begin_job(bad_job, batchable=True)):
+            _emit_failure(
+                "rlc-bisect-probe", "tampered job verified as valid",
+                metric="bls_rlc_bisect_seconds", unit="s",
+            )
+            return
+        t1 = time.perf_counter()
+        h = verifier.begin_job(bad_job, batchable=True)
+        ok = verifier.finish_job(h)
+        dt = time.perf_counter() - t1
+        _phase_mark(
+            "rlc_bisect_probe",
+            time.monotonic() - t0,
+            ok=not ok,
+            batch_retries=getattr(h, "batch_retries", None),
+        )
+        if ok:
+            _emit_failure(
+                "rlc-bisect-probe", "tampered job verified as valid",
+                metric="bls_rlc_bisect_seconds", unit="s",
+            )
+            return
+        print(
+            json.dumps(
+                {
+                    "metric": "bls_rlc_bisect_seconds",
+                    "value": round(dt, 4),
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "phases": _phase_snapshot(),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        _emit_failure(
+            "rlc-bisect-probe", f"{type(e).__name__}: {e}",
+            metric="bls_rlc_bisect_seconds", unit="s",
+        )
 
 
 def build_decoded_inputs():

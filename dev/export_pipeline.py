@@ -129,7 +129,7 @@ def export_sharded_program(n_devices: int = 8):
         sds((NL, n)), sds((NL, n)), sds((NL, n)), sds((NL, n)),  # msg
         sds((NL, n)), sds((NL, n)),          # sig_x0/x1
         sds((2, n)),                          # sig_flags
-        sds((2, n)),                          # rwords
+        sds((KV.RAND_WORDS, n)),              # rwords
         jax.ShapeDtypeStruct((n,), i32),      # valid
     ]
     sharded = KV.make_sharded_wire_verifier(mesh)
@@ -185,7 +185,7 @@ def export_replay_shapes(n_validators: int, batch: int = 512):
         jax.ShapeDtypeStruct((KV.BT,), i32),               # head_lanes
         jax.ShapeDtypeStruct((KV.BT,), i32),               # glive
     ]
-    rwords = sds((2, batch))
+    rwords = sds((KV.RAND_WORDS, batch))
     valid = jax.ShapeDtypeStruct((batch,), i32)
     t1 = time.time()
     EC.load_or_export(

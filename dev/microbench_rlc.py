@@ -1,0 +1,180 @@
+"""Per-set vs RLC pairing cost per (N, K) bucket.
+
+Two modes:
+
+  counts (default, runs in seconds — the tier-1-budget mode):
+      For each bucket, dispatch NOTHING; report the pairing-op budget
+      both verification modes would pay, from the same accounting the
+      pipeline tallies at dispatch time (kernels/verify.py
+      PIPELINE_TALLY):
+          RLC batch:  N+1 Miller-loop lanes, 1 final exponentiation,
+                      2N scalar muls (the blinding r_i*pk_i, r_i*sig_i)
+          per-set:    2N Miller-loop lanes, N final exponentiations
+      The final-exp amortization N -> 1 is the headline; the table
+      makes the crossover and the scalar-mul overhead explicit.
+
+  --measure: actually run verify_batch_device / verify_each_device on a
+      synthetic valid world per bucket on the CPU backend (interpret
+      mode — minutes per bucket; debugging/on-device use only), assert
+      the measured PIPELINE_TALLY deltas match the analytic budget, and
+      report wall-clock.
+
+Usage:
+  python dev/microbench_rlc.py [--json] [--buckets 128x1,512x1] [--measure]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def analytic_budget(n: int, k: int) -> dict:
+    """The pairing-op budget per job at the (n, k) bucket."""
+    return {
+        "n": n,
+        "k": k,
+        "rlc": {
+            "miller_pairs": n + 1,
+            "final_exps": 1,
+            "scalar_muls": 2 * n,
+        },
+        "per_set": {
+            "miller_pairs": 2 * n,
+            "final_exps": n,
+            "scalar_muls": 0,
+        },
+        # final exps amortized per set — the tentpole's headline ratio
+        "final_exp_amortization": n,
+        "miller_ratio": round(2 * n / (n + 1), 4),
+    }
+
+
+def _measure_bucket(n: int, k: int) -> dict:
+    """Run both modes once at (n, k) on the current backend; returns
+    wall-clock + measured tally deltas (must match the analytic)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from lodestar_tpu.crypto import bls as GB
+    from lodestar_tpu.crypto import curves as GC
+    from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+    from lodestar_tpu.kernels import layout as LY
+    from lodestar_tpu.kernels import verify as KV
+    from lodestar_tpu.ops import bls_kernels as BK
+
+    v = max(k, 4)
+    sks = [GB.keygen(b"rlc-%d" % i) for i in range(v)]
+    pks = [GB.sk_to_pk(sk) for sk in sks]
+    tx = jnp.asarray(LY.encode_batch([p[0] for p in pks]))
+    ty = jnp.asarray(LY.encode_batch([p[1] for p in pks]))
+
+    msg = b"rlc bucket root"
+    hm = hash_to_g2(msg)
+    ids = list(range(k))
+    sig = GB.aggregate_signatures([GB.sign(sks[i], msg) for i in ids])
+
+    idx = np.zeros((n, k), np.int32)
+    idx[:] = np.asarray(ids, np.int32)[None, :]
+    kmask = np.ones((n, k), np.int32)
+    valid = np.ones((n,), np.int32)
+    sig_inf = np.zeros((n,), np.int32)
+
+    def enc(vals):
+        return jnp.asarray(np.tile(LY.encode_plain_batch(vals), (1, n)))
+
+    args = (
+        tx, ty, jnp.asarray(idx), jnp.asarray(kmask),
+        enc([hm[0][0]]), enc([hm[0][1]]), enc([hm[1][0]]), enc([hm[1][1]]),
+        enc([sig[0][0]]), enc([sig[0][1]]), enc([sig[1][0]]), enc([sig[1][1]]),
+        jnp.asarray(sig_inf),
+    )
+    valid_j = jnp.asarray(valid)
+    rand = jnp.asarray(BK.make_rand_words(n, np.random.default_rng(1)))
+
+    out = {}
+    KV.PIPELINE_TALLY.clear()
+    t0 = time.perf_counter()
+    ok, _sub = KV.verify_batch_device(*args, rand, valid_j)
+    assert bool(ok), "valid bucket failed RLC batch verification"
+    out["rlc"] = {
+        "seconds": round(time.perf_counter() - t0, 3),
+        "tally": KV.pipeline_tally_snapshot(),
+    }
+    KV.PIPELINE_TALLY.clear()
+    t0 = time.perf_counter()
+    each = np.asarray(KV.verify_each_device(*args, valid_j))
+    assert bool(each.all()), "valid bucket failed per-set verification"
+    out["per_set"] = {
+        "seconds": round(time.perf_counter() - t0, 3),
+        "tally": KV.pipeline_tally_snapshot(),
+    }
+    budget = analytic_budget(n, k)
+    assert out["rlc"]["tally"]["miller_pair"] == budget["rlc"]["miller_pairs"]
+    assert out["rlc"]["tally"]["final_exp"] == budget["rlc"]["final_exps"]
+    assert (
+        out["per_set"]["tally"]["miller_pair"]
+        == budget["per_set"]["miller_pairs"]
+    )
+    assert out["per_set"]["tally"]["final_exp"] == budget["per_set"]["final_exps"]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--buckets",
+        default="128x1,256x1,512x1,1024x4,2048x1",
+        help="comma-separated NxK bucket list",
+    )
+    ap.add_argument(
+        "--measure",
+        action="store_true",
+        help="run the kernels (interpret mode on CPU: minutes per bucket)",
+    )
+    args = ap.parse_args()
+
+    buckets = []
+    for tok in args.buckets.split(","):
+        n, _, k = tok.strip().partition("x")
+        buckets.append((int(n), int(k or "1")))
+
+    records = []
+    for n, k in buckets:
+        rec = analytic_budget(n, k)
+        if args.measure:
+            rec["measured"] = _measure_bucket(n, k)
+        records.append(rec)
+
+    if args.json:
+        print(json.dumps({"metric": "rlc_pairing_budget", "buckets": records}))
+        return 0
+    print(f"{'bucket':>10} {'RLC miller':>11} {'RLC fexp':>9} "
+          f"{'each miller':>12} {'each fexp':>10} {'fexp amort':>11}")
+    for rec in records:
+        extra = ""
+        if "measured" in rec:
+            extra = (
+                f"   rlc {rec['measured']['rlc']['seconds']}s"
+                f" / each {rec['measured']['per_set']['seconds']}s"
+            )
+        print(
+            f"{rec['n']:>7}x{rec['k']:<2} {rec['rlc']['miller_pairs']:>11} "
+            f"{rec['rlc']['final_exps']:>9} {rec['per_set']['miller_pairs']:>12} "
+            f"{rec['per_set']['final_exps']:>10} {rec['final_exp_amortization']:>10}x"
+            f"{extra}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,7 +99,7 @@ def main():
             "rpk",
             jax.jit(lambda px, py, pz, b: KV._tiled(
                 KV._k_g1_rpk, (px, py, pz, zero_row, b),
-                [KV.NL] * 3 + [1, 2], [KV.NL] * 3 + [1], N)),
+                [KV.NL] * 3 + [1, KV.RAND_WORDS], [KV.NL] * 3 + [1], N)),
             px, py, pz, a["bits"],
         )
         rx, ry, rz = rpk[0], rpk[1], rpk[2]
@@ -111,7 +111,7 @@ def main():
             "rsig",
             jax.jit(lambda x0, x1, y0, y1, b: KV._tiled(
                 KV._k_g2_rsig_sub, (x0, x1, y0, y1, zero_row, b),
-                [KV.NL] * 4 + [1, 2], [KV.NL] * 6 + [1, 1], N)),
+                [KV.NL] * 4 + [1, KV.RAND_WORDS], [KV.NL] * 6 + [1, 1], N)),
             sx0, sx1, sy0, sy1, a["bits"],
         )
     else:

@@ -32,10 +32,21 @@ from typing import List, Optional, Sequence
 
 from ..observability import current_id as _trace_current_id
 from ..observability import trace_span as _trace_span
-from .signature_set import SignatureSet
-from .verifier import MAX_PENDING_JOBS, TpuBlsVerifier, VerifyOptions
+from .signature_set import SignatureSet, WireSignatureSet
+from .verifier import (
+    MAX_PENDING_JOBS,
+    N_BUCKETS,
+    TpuBlsVerifier,
+    VerifyOptions,
+)
 
-MAX_BUFFERED_SIGS = 32      # reference: multithread/index.ts:49
+# Raised from the reference's 32 to one full kernel lane tile: RLC batch
+# verification amortizes ONE final exponentiation over the whole device
+# job, so a coalescing window that stops at 32 sets leaves 3/4 of the
+# smallest (128-lane) N-bucket as padding.  Latency stays bounded by
+# MAX_BUFFER_WAIT_MS, and an exact bucket fill flushes immediately
+# (_maybe_flush_buffer_locked).
+MAX_BUFFERED_SIGS = 128
 MAX_BUFFER_WAIT_MS = 100    # reference: multithread/index.ts:57
 # Device jobs dispatched but not yet resolved.  JAX dispatch is async, so
 # in-flight jobs overlap the ~65 ms host<->device tunnel latency
@@ -81,6 +92,20 @@ class BlsVerifierService:
         self._queue: List[List[_Job]] = []
         self._buffer: List[_Job] = []
         self._buffer_deadline: Optional[float] = None
+        # exact N-bucket fills flush immediately (no padding to gain by
+        # waiting); stubs without a device job cap use every bucket
+        self._bucket_fills = frozenset(
+            b
+            for b in N_BUCKETS
+            if b <= getattr(verifier, "max_job_sets", N_BUCKETS[-1])
+        )
+        # trailing dispatch-run tracker for the exact-fill trigger (the
+        # buffer is append-only between flushes, so O(new sets) updates
+        # in _buffer_append_locked replace an O(buffer) rescan per
+        # submission under the lock)
+        self._buffered_sets = 0
+        self._tail_run_len = 0
+        self._tail_run_wire: Optional[bool] = None
         self._pending = 0  # queued + buffered + in-flight jobs
         self._closed = False
         # dispatcher begins device jobs; resolver syncs them in order.
@@ -136,11 +161,10 @@ class BlsVerifierService:
                 return job.future
             self._pending += 1
             if opts.batchable and len(job.sets) < self._max_buffered:
-                self._buffer.append(job)
+                self._buffer_append_locked(job)
                 if self._buffer_deadline is None:
                     self._buffer_deadline = time.perf_counter() + self._buffer_wait
-                if sum(len(j.sets) for j in self._buffer) >= self._max_buffered:
-                    self._flush_buffer_locked()
+                self._maybe_flush_buffer_locked()
             else:
                 self._queue.append([job])
             self.metrics.queue_length.set(self._pending)
@@ -153,10 +177,44 @@ class BlsVerifierService:
         """Synchronous wrapper (blocks on the service future)."""
         return self.verify_signature_sets_async(sets, opts).result()
 
+    def _buffer_append_locked(self, job: _Job) -> None:
+        """Append to the buffer, advancing the trailing-run tracker with
+        the same rules as _dispatch's run split (contiguous same-kind
+        runs, wire vs decoded, capped at max_job_sets)."""
+        self._buffer.append(job)
+        self._buffered_sets += len(job.sets)
+        cap = getattr(self.verifier, "max_job_sets", N_BUCKETS[-1])
+        for s in job.sets:
+            is_wire = isinstance(s, WireSignatureSet)
+            if is_wire == self._tail_run_wire and self._tail_run_len < cap:
+                self._tail_run_len += 1
+            else:
+                self._tail_run_len, self._tail_run_wire = 1, is_wire
+
+    def _maybe_flush_buffer_locked(self) -> None:
+        """Flush on a full window OR an exact N-bucket fill.
+
+        The RLC device job pads its sets up to a fixed N-bucket
+        (verifier.N_BUCKETS); when the bucket is exactly filled, more
+        waiting can only (a) burn the remaining `_buffer_deadline`
+        latency and (b) spill the job into the next, twice-as-large
+        bucket — so flush immediately.  The fill test keys on the
+        TRAILING dispatch run: only it can still grow — earlier runs'
+        padding is locked in however long we wait.  For the common
+        homogeneous buffer this is just "total sets == a bucket".
+        """
+        if (
+            self._buffered_sets >= self._max_buffered
+            or self._tail_run_len in self._bucket_fills
+        ):
+            self._flush_buffer_locked()
+
     def _flush_buffer_locked(self) -> None:
         if self._buffer:
             self._queue.append(self._buffer)
             self._buffer = []
+        self._buffered_sets = 0
+        self._tail_run_len, self._tail_run_wire = 0, None
         self._buffer_deadline = None
 
     # -- dispatcher -------------------------------------------------------
@@ -213,8 +271,6 @@ class BlsVerifierService:
             else:
                 # device jobs must be homogeneous (wire vs decoded sets);
                 # a buffer window can legally mix submitters of both kinds
-                from .signature_set import WireSignatureSet
-
                 cap = self.verifier.max_job_sets
                 runs: List[List] = []
                 for s in merged:

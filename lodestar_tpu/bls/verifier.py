@@ -10,7 +10,9 @@ Semantics reproduced from the reference (packages/beacon-node/src/chain/bls):
     re-verified individually so one bad signature cannot poison honest
     peers' messages (multithread/worker.ts:74-96), with
     `batch_retries`/`batch_sigs_success` accounted identically.
-  - Jobs are chunked to <= MAX_JOB_SETS sets (multithread/index.ts:39).
+  - Jobs are chunked to <= MAX_JOB_SETS sets (the reference caps at 128,
+    multithread/index.ts:39; the device path raises it to 512 so RLC
+    batches amortize further and the bisection fallback is reachable).
   - `can_accept_work()` mirrors the 512-pending-job backpressure bound
     consumed by the gossip NetworkProcessor (multithread/index.ts:143-149,
     processor/index.ts:357-371).
@@ -46,7 +48,15 @@ from .ingest import MessageCache, encode_wire_planes
 from .pubkey_table import PubkeyTable
 from .signature_set import SignatureSet, WireSignatureSet
 
-MAX_JOB_SETS = 128          # reference: chain/bls/multithread/index.ts:39
+# Raised from the reference's 128 (chain/bls/multithread/index.ts:39):
+# that cap keeps CPU worker-pool jobs small for scheduling fairness,
+# which doesn't apply to one async device stream — and RLC batch
+# verification WANTS jobs past one 128-lane tile, both for the final-exp
+# amortization and because the bisection fallback only sheds work above
+# the one-tile leaf.  Directly-submitted large batches (range sync,
+# backfill) now ride 512-set RLC jobs; gossip latency is governed by the
+# service coalescing window (bls/service.py), not this cap.
+MAX_JOB_SETS = 512
 MAX_PENDING_JOBS = 512      # reference: chain/bls/multithread/index.ts:64
 # N buckets are multiples of the kernel lane tile (kernels/verify.py BT):
 # a smaller job pads to one 128-lane tile, which costs the same wall time
@@ -68,7 +78,7 @@ class _DeviceJob:
     """An in-flight device job: lazy result handles + host-side context."""
 
     __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
-                 "batch_ok", "per_set", "wire", "verdicts",
+                 "batch_ok", "per_set", "wire", "verdicts", "n_bucket",
                  "batch_retries", "batch_sigs_success", "unsort")
 
     def __init__(self, sets, batchable, ok_big, wire=False):
@@ -76,6 +86,7 @@ class _DeviceJob:
         self.batchable = batchable
         self.ok_big = ok_big
         self.wire = wire
+        self.n_bucket = 0  # padded N of the dispatched device job
         self.args = None
         self.valid = None
         self.decodable = None
@@ -118,6 +129,7 @@ class TpuBlsVerifier:
         metrics: Optional[BlsPoolMetrics] = None,
         rng: Optional[np.random.Generator] = None,
         max_job_sets: int = MAX_JOB_SETS,
+        bisect_leaf: Optional[int] = None,
     ):
         self.table = table
         self.metrics = metrics or BlsPoolMetrics()
@@ -144,6 +156,18 @@ class TpuBlsVerifier:
             )
         else:
             self._use_export = jax.default_backend() == "tpu"
+        # RLC batch-verification escape hatch: LODESTAR_TPU_BLS_RLC=0
+        # forces per-set device verdicts for every job (the pre-RLC
+        # behavior) — soundness of the batch check rests on the 128-bit
+        # randomizers, so operators get a kill switch.  Default on.
+        rlc_env = os.environ.get("LODESTAR_TPU_BLS_RLC", "1")
+        self._use_rlc = rlc_env.strip().lower() not in (
+            "0", "false", "no", "off",
+        )
+        # Bisection stops splitting at one lane tile: below KV.BT every
+        # sub-job pads to the same 128-lane bucket, so halving further
+        # cannot shed device work and the leaf runs per-set verdicts.
+        self.bisect_leaf = KV.BT if bisect_leaf is None else bisect_leaf
 
     def _device_call(self, name: str, fn, args):
         """Dispatch through the AOT export cache when enabled; plain
@@ -376,6 +400,7 @@ class TpuBlsVerifier:
         else:
             job.args, job.valid, n = self._prepare(sets)
             job.decodable = np.array([s.signature is not None for s in sets])
+        job.n_bucket = n
         if span is not None and _trace_enabled():
             # the (N, K) shape bucket names which compiled pipeline this
             # job rides — the export-cache-bucketing ROADMAP item's unit
@@ -386,34 +411,19 @@ class TpuBlsVerifier:
                     max(len(s.indices) for s in sets), K_BUCKETS
                 ),
             )
-        if batchable and len(sets) >= 2 and job.decodable.all():
+        batchable_job = batchable and len(sets) >= 2
+        if batchable_job:
             # reference: maybeBatch.ts:16 (batch iff >= 2 sets)
             self.metrics.batchable_sigs.inc(len(sets))
-            rand = jnp.asarray(BK.make_rand_words(n, self.rng))
-            grouping = self._grouping(sets, n) if wire else None
-            if grouping is not None:
-                group, head_lanes, glive = grouping
-                job.batch_ok, _sub = self._device_call(
-                    "batch_wire_grouped",
-                    KV.verify_batch_device_wire_grouped,
-                    (*job.args, group, head_lanes, glive, rand, job.valid),
-                )
-            else:
-                batch_fn = (
-                    KV.verify_batch_device_wire
-                    if wire
-                    else KV.verify_batch_device
-                )
-                job.batch_ok, _sub = self._device_call(
-                    "batch_wire" if wire else "batch_decoded",
-                    batch_fn,
-                    (*job.args, rand, job.valid),
-                )
+        if batchable_job and self._use_rlc and job.decodable.all():
+            job.batch_ok = self._dispatch_rlc_batch(
+                sets, job.args, job.valid, n, wire
+            )
         else:
-            if batchable and len(sets) >= 2:
+            if batchable_job and self._use_rlc:
                 # an undecodable signature voids the merged batch: count it
                 # as a batch retry and go straight to per-set verdicts
-                self.metrics.batchable_sigs.inc(len(sets))
+                # (with RLC disabled nothing was batched, so no retry)
                 self.metrics.batch_retries.inc()
                 job.batch_retries += 1
             job.per_set = self._device_call(
@@ -508,22 +518,11 @@ class TpuBlsVerifier:
         if not sets:
             return job.ok_big
         if job.batch_ok is not None:
-            if bool(job.batch_ok):  # device sync point
-                self.metrics.batch_sigs_success.inc(len(sets))
-                job.batch_sigs_success += len(sets)
-                self.metrics.success_jobs.inc(len(sets))
-                return job.ok_big
-            # batch failed (or contained an undecodable signature): retry
-            # each set individually so one bad signature cannot poison the
-            # verdict of honest sets (reference: multithread/worker.ts:74-96)
-            self.metrics.batch_retries.inc()
-            job.batch_retries += 1
-            job.per_set = self._device_call(
-                "each_wire" if job.wire else "each_decoded",
-                self._each_fn(job),
-                (*job.args, job.valid),
-            )
-        per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+            per_set = self._resolve_rlc_batch(job)
+            if per_set is None:
+                return job.ok_big  # batch verdict accepted every set
+        else:
+            per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
         if job.unsort is not None:
             # planes were sorted by signing root: restore the caller's
             # submission order (the service maps verdicts positionally)
@@ -533,6 +532,141 @@ class TpuBlsVerifier:
         self.metrics.success_jobs.inc(good)
         self.metrics.invalid_sets.inc(len(sets) - good)
         return job.ok_big and bool(per_set.all())
+
+    # -- RLC batch resolution + bisection fallback ------------------------
+
+    def _resolve_rlc_batch(self, job: "_DeviceJob"):
+        """Sync a dispatched RLC batch verdict.  Returns None when the
+        batch accepted (all sets verified by the one multi-pairing
+        check) or the per-set verdict array (job.sets order) after the
+        fallback.  The `bls.rlc_batch` span brackets the device sync
+        plus any fallback work; bisect_depth=0 means the plain per-set
+        retry (job at or under the one-tile bisection leaf)."""
+        sets = job.sets
+        with _trace_span(
+            "bls.rlc_batch", sets=len(sets), n_bucket=job.n_bucket
+        ) as span:
+            if bool(job.batch_ok):  # device sync point
+                if _trace_enabled():
+                    span.set(accepted=True, bisect_depth=0)
+                self.metrics.batch_sigs_success.inc(len(sets))
+                job.batch_sigs_success += len(sets)
+                self.metrics.success_jobs.inc(len(sets))
+                return None
+            # batch failed (only fully-decodable jobs are dispatched as
+            # batches — _begin_job routes undecodables straight to
+            # per-set): find the bad sets without poisoning honest ones
+            # (reference: multithread/worker.ts:74-96).  Above the one-tile
+            # leaf the
+            # job bisects — halves re-verify as smaller RLC batches
+            # (reusing the smaller N-bucket artifacts) so one bad set in
+            # a big job costs O(log N) batch checks instead of a full
+            # per-set sweep; at or under the leaf it goes straight to
+            # per-set verdicts.
+            self.metrics.batch_retries.inc()
+            job.batch_retries += 1
+            self.metrics.rlc_fallback.inc()
+            if len(sets) > self.bisect_leaf:
+                per_set, depth = self._bisect(sets, job.wire, 1, job)
+                self.metrics.rlc_bisect_depth.observe(depth)
+                if _trace_enabled():
+                    span.set(accepted=False, bisect_depth=depth)
+            else:
+                if _trace_enabled():
+                    span.set(accepted=False, bisect_depth=0)
+                job.per_set = self._device_call(
+                    "each_wire" if job.wire else "each_decoded",
+                    self._each_fn(job),
+                    (*job.args, job.valid),
+                )
+                per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+        return per_set
+
+    def _bisect(self, sets, wire: bool, depth: int, job=None):
+        """Verdicts for a failed RLC batch by recursive halving.
+
+        Both halves are DISPATCHED before either is synced so they
+        pipeline on the device stream; a half that passes its batch
+        check clears all its sets at once, a half that fails recurses,
+        and leaves (<= bisect_leaf sets) fall back to per-set verdicts.
+        Returns (bool ndarray in `sets` order, max recursion depth)."""
+        if len(sets) <= self.bisect_leaf or len(sets) < 2:
+            return self._per_set_verdicts(sets, wire), depth
+        mid = (len(sets) + 1) // 2
+        halves = [sets[:mid], sets[mid:]]
+        handles = [self._dispatch_batch(h, wire) for h in halves]
+        parts: List[np.ndarray] = []
+        max_depth = depth
+        for half, handle in zip(halves, handles):
+            if self._batch_verdict(handle):
+                if job is not None:
+                    job.batch_sigs_success += len(half)
+                self.metrics.batch_sigs_success.inc(len(half))
+                parts.append(np.ones(len(half), bool))
+            else:
+                v, d = self._bisect(half, wire, depth + 1, job)
+                parts.append(v)
+                max_depth = max(max_depth, d)
+        return np.concatenate(parts), max_depth
+
+    def _dispatch_rlc_batch(self, sets, args, valid, n, wire: bool):
+        """ONE RLC multi-pairing dispatch (no blocking): fresh
+        randomizers + entry-name choice, shared by the primary job path
+        (_begin_job) and the bisection recursion (_dispatch_batch) so
+        the two can never diverge.  Wire sets MUST be sorted by signing
+        root (bisection halves of a sorted job are sorted contiguous
+        runs, so the grouped entry — one message-side Miller tile per
+        distinct root — stays available on the adversarial path)."""
+        rand = jnp.asarray(BK.make_rand_words(n, self.rng))
+        grouping = self._grouping(sets, n) if wire else None
+        if grouping is not None:
+            group, head_lanes, glive = grouping
+            batch_ok, _sub = self._device_call(
+                "batch_wire_grouped",
+                KV.verify_batch_device_wire_grouped,
+                (*args, group, head_lanes, glive, rand, valid),
+            )
+            return batch_ok
+        batch_fn = (
+            KV.verify_batch_device_wire if wire else KV.verify_batch_device
+        )
+        batch_ok, _sub = self._device_call(
+            "batch_wire" if wire else "batch_decoded",
+            batch_fn,
+            (*args, rand, valid),
+        )
+        return batch_ok
+
+    def _dispatch_batch(self, sets, wire: bool):
+        """Dispatch one RLC sub-batch WITHOUT blocking; returns the lazy
+        device batch_ok scalar (the bisection recursion's handle)."""
+        if wire:
+            args, valid, n, _host_bad = self._prepare_wire(sets)
+        else:
+            args, valid, n = self._prepare(sets)
+        return self._dispatch_rlc_batch(sets, args, valid, n, wire)
+
+    def _batch_verdict(self, handle) -> bool:
+        """Sync one sub-batch handle to a host bool (test seam)."""
+        return bool(handle)
+
+    def _per_set_verdicts(self, sets, wire: bool) -> np.ndarray:
+        """Independent device verdicts for `sets` (the bisection leaf)."""
+        if wire:
+            args, valid, _n, host_bad = self._prepare_wire(sets)
+            v = np.asarray(
+                self._device_call(
+                    "each_wire", KV.verify_each_device_wire, (*args, valid)
+                )
+            )[: len(sets)]
+            return v & ~host_bad[: len(sets)]
+        args, valid, _n = self._prepare(sets)
+        v = np.asarray(
+            self._device_call(
+                "each_decoded", KV.verify_each_device, (*args, valid)
+            )
+        )[: len(sets)]
+        return v & np.array([s.signature is not None for s in sets])
 
     def verify_signature_sets_individually(
         self, sets: Sequence[SignatureSet]

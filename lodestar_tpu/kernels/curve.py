@@ -8,7 +8,9 @@ zero-tests in the hot loops — masks propagate through selects).
 The scalar multiplies implement the reference pool's per-job work
 (random-linear-combination scalars on pubkeys/signatures, reference:
 packages/beacon-node/src/chain/bls/multithread/worker.ts:52-87) as shared
-64-iteration double-and-add loops with per-lane bit selects.
+windowed double-and-add loops with per-lane digit selects:
+scalar_mul_bits_jac (2-bit windows, the legacy 64-bit randomizer path)
+and scalar_mul_window_jac (w-bit windows, the 128-bit RLC path).
 """
 
 from __future__ import annotations
@@ -230,6 +232,73 @@ def scalar_mul_bits_jac(fo: FieldOps, q, q_inf, get_bit, nbits: int):
     inf0 = jnp.ones(q_inf.shape, jnp.int32)
     T, t_inf = lax.fori_loop(0, nbits // 2, body, (t0, inf0))
     # k*O = O for infinity bases; k = 0 (all-zero bits) stays infinity.
+    return T, (t_inf != 0) | q_inf
+
+
+def scalar_mul_window_jac(
+    fo: FieldOps, q, q_inf, get_digit, nbits: int, w: int = 4
+):
+    """k*Q for per-lane scalars read as MSB-first w-bit window digits.
+
+    Generalizes scalar_mul_bits_jac to wider windows for the 128-bit
+    RLC randomizers: nbits/w iterations of (w doublings + ONE
+    always-computed-then-selected addition) against a precomputed
+    multiple table {Q .. (2^w-1)Q}.  At w=4 a 128-bit scalar costs
+    128 doublings + 32 window adds + 14 table adds — the add count of
+    the old 64-bit path at twice the soundness (doublings are the
+    cheap half: 2M+5S vs 11M+5S).
+
+    get_digit(t) -> int32[..., B] window digit for window index t
+    (MSB-first); the caller owns extraction — in-kernel that must be a
+    traced shift over packed scalar words, never a dynamic sublane
+    slice (dev/NOTES.md round-3 Mosaic rules).  The table is built with
+    masked selects only (no gathers) and the accumulator-infinity mask
+    is carried as int32, not bool (i1 fori_loop carries fail Mosaic
+    legalization).
+
+    Collision safety at the window add: after the leading doublings the
+    accumulator is a·Q with a an even multiple >= 2^w > any digit d, and
+    a < 2^nbits << r, so T == ±(d·Q) is impossible while the
+    accumulator is live; the still-infinity case is handled by the
+    t_inf mask (the digit's multiple is assigned directly).
+    """
+    assert nbits % w == 0, (nbits, w)
+    assert w >= 1
+    # multiple table: tbl[m-1] = m*Q for m in 1..2^w-1.  Even entries
+    # double the half entry; odd entries add Q to the previous entry
+    # (m*Q == ±Q needs m ≡ ±1 mod r — impossible for 2 <= m < 2^w).
+    tbl = [q]
+    for m in range(2, 1 << w):
+        if m % 2 == 0:
+            tbl.append(jac_dbl(fo, tbl[m // 2 - 1]))
+        else:
+            tbl.append(jac_add_mixed_or_full(fo, tbl[m - 2], q))
+
+    def digit_multiple(d):
+        """tbl[d] for d in 1..2^w-1 as a masked-select chain (d == 0
+        keeps the accumulator via the outer nz select)."""
+        m = tbl[0]
+        for v in range(2, 1 << w):
+            m = select_pt(fo, d == v, tbl[v - 1], m)
+        return m
+
+    def body(t, st):
+        (T, t_inf) = st
+        for _ in range(w):
+            T = jac_dbl(fo, T)
+        d = get_digit(t)
+        add = digit_multiple(d)
+        cand = jac_add_mixed_or_full(fo, T, add)
+        cand = select_pt(fo, t_inf != 0, add, cand)
+        nz = d != 0
+        T = select_pt(fo, nz, cand, T)
+        t_inf = t_inf & (~nz).astype(jnp.int32)
+        return (T, t_inf)
+
+    t0 = q  # placeholder value; masked by t_inf
+    inf0 = jnp.ones(q_inf.shape, jnp.int32)
+    T, t_inf = lax.fori_loop(0, nbits // w, body, (t0, inf0))
+    # k*O = O for infinity bases; k = 0 (all-zero digits) stays infinity.
     return T, (t_inf != 0) | q_inf
 
 

@@ -1,0 +1,133 @@
+"""Export-cache spec builders for the RLC verification entry points.
+
+The verify pipeline's device entries (`batch_wire`, `each_wire`, ...)
+used to exist only implicitly — as dispatch names inside
+`bls/verifier._device_call`, pre-traced by dev/export_pipeline.py's
+dispatch CAPTURE of one bench-shaped job.  This module makes them
+first-class registry entries (kernels/export_cache.py
+`register_entry`), which buys two things:
+
+  - `export_registered()` pre-traces every RLC entry point at the
+    default service bucket without replaying the bench world, and
+  - the entries' `sources=` declarations (registered in export_cache)
+    fold the out-of-kernels modules the traced computations reach —
+    crypto/curves.py and crypto/fields.py constants bake into the
+    kernels as Montgomery-encoded planes — into each artifact key, so
+    a curve-constant edit can no longer run a stale artifact.  tpulint's
+    fingerprint-completeness rule checks the declarations statically.
+
+Spec shapes follow the gossip coalescing bucket: N = 128 sets (the
+bls/service.py window — the latency-critical shape a node's first
+seconds of gossip traffic dispatch), K = 1 (single-key gossip sets), a
+512-row pubkey table (the bench world).  The 512 bucket that chunked
+direct submissions (range sync; verifier.MAX_JOB_SETS) and bench ride
+is pre-traced by dev/export_pipeline.py's bench-replay dispatch
+capture; any other (N, K) bucket still traces on first use and lands
+in the same cache under the same names.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import verify as KV
+from .verify import (
+    verify_batch_device,
+    verify_batch_device_wire,
+    verify_batch_device_wire_grouped,
+    verify_each_device,
+    verify_each_device_wire,
+)
+
+# default bucket: one service coalescing window, single-key sets
+DEF_N = 128
+DEF_K = 1
+DEF_TABLE = 512
+
+
+def _sds(shape) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _wire_common(n: int, k: int, table: int) -> List[jax.ShapeDtypeStruct]:
+    """The 11 leading args of the wire-path entries (see
+    bls/verifier._prepare_wire): table planes, index/mask, hashed
+    message planes, compressed signature limbs + flag bits."""
+    nl = KV.NL
+    return [
+        _sds((nl, table)), _sds((nl, table)),   # pubkey table planes
+        _sds((n, k)), _sds((n, k)),             # idx, kmask
+        _sds((nl, n)), _sds((nl, n)),           # msg x planes
+        _sds((nl, n)), _sds((nl, n)),           # msg y planes
+        _sds((nl, n)), _sds((nl, n)),           # sig_x0, sig_x1
+        _sds((2, n)),                           # sig (sign, inf) flags
+    ]
+
+
+def _decoded_common(n: int, k: int, table: int) -> List[jax.ShapeDtypeStruct]:
+    """The 13 leading args of the decoded-path entries (see
+    bls/verifier._prepare): affine G2 planes for message AND signature
+    plus the explicit infinity row."""
+    nl = KV.NL
+    return [
+        _sds((nl, table)), _sds((nl, table)),   # pubkey table planes
+        _sds((n, k)), _sds((n, k)),             # idx, kmask
+        _sds((nl, n)), _sds((nl, n)),           # msg x planes
+        _sds((nl, n)), _sds((nl, n)),           # msg y planes
+        _sds((nl, n)), _sds((nl, n)),           # sig x planes
+        _sds((nl, n)), _sds((nl, n)),           # sig y planes
+        _sds((n,)),                             # sig_inf
+    ]
+
+
+def _rand_valid(n: int) -> List[jax.ShapeDtypeStruct]:
+    return [_sds((KV.RAND_WORDS, n)), _sds((n,))]
+
+
+def export_specs_batch_wire(
+    n: int = DEF_N, k: int = DEF_K, table: int = DEF_TABLE
+) -> Tuple:
+    return (
+        verify_batch_device_wire,
+        _wire_common(n, k, table) + _rand_valid(n),
+    )
+
+
+def export_specs_batch_wire_grouped(
+    n: int = DEF_N, k: int = DEF_K, table: int = DEF_TABLE
+) -> Tuple:
+    grouping = [_sds((n,)), _sds((KV.BT,)), _sds((KV.BT,))]
+    return (
+        verify_batch_device_wire_grouped,
+        _wire_common(n, k, table) + grouping + _rand_valid(n),
+    )
+
+
+def export_specs_each_wire(
+    n: int = DEF_N, k: int = DEF_K, table: int = DEF_TABLE
+) -> Tuple:
+    return (
+        verify_each_device_wire,
+        _wire_common(n, k, table) + [_sds((n,))],
+    )
+
+
+def export_specs_batch_decoded(
+    n: int = DEF_N, k: int = DEF_K, table: int = DEF_TABLE
+) -> Tuple:
+    return (
+        verify_batch_device,
+        _decoded_common(n, k, table) + _rand_valid(n),
+    )
+
+
+def export_specs_each_decoded(
+    n: int = DEF_N, k: int = DEF_K, table: int = DEF_TABLE
+) -> Tuple:
+    return (
+        verify_each_device,
+        _decoded_common(n, k, table) + [_sds((n,))],
+    )

@@ -282,19 +282,44 @@ def make_rand_bits(
     return out
 
 
+# Randomizer width of the pallas RLC batch pipeline (kernels/verify.py).
+# 128-bit scalars bound the forgery probability of a random-linear-
+# combination batch at ~2^-127 (odd scalars halve the space) instead of
+# the 64-bit einsum path's 2^-63 — the windowed scalar-mul kernels keep
+# the add count flat (kernels/curve.py scalar_mul_window_jac).
+RLC_RAND_BITS = 128
+RLC_RAND_WORDS = RLC_RAND_BITS // 32
+
+
+def _rand_scalars128(
+    n: int, rng: "np.random.Generator | None"
+) -> np.ndarray:
+    """Odd 128-bit randomizer scalars as uint32[4, n] big-endian words.
+
+    CSPRNG contract identical to _rand_scalars: rng=None (production)
+    draws from the OS CSPRNG; a seeded Generator is for tests only.
+    """
+    if rng is None:
+        raw = np.frombuffer(os.urandom(4 * RLC_RAND_WORDS * n), np.uint32)
+        words = raw.reshape(RLC_RAND_WORDS, n).copy()
+    else:
+        words = rng.integers(
+            0, 1 << 32, size=(RLC_RAND_WORDS, n), dtype=np.uint64
+        ).astype(np.uint32)
+    words[-1] |= np.uint32(1)  # odd => nonzero, unit mod 2^128
+    return words
+
+
 def make_rand_words(
     n: int, rng: "np.random.Generator | None" = None
 ) -> np.ndarray:
-    """Random odd 64-bit scalars packed as int32[2, n] = (hi, lo) words.
+    """Random odd 128-bit scalars packed as int32[4, n] big-endian words
+    (row 0 = most-significant 32 bits).
 
     The packed form the pallas pipeline consumes (kernels/verify.py):
-    per-lane bit i is extracted in-kernel with a traced shift — dynamic
-    sublane indexing of a [64, n] bit-plane array does not lower through
+    per-lane window digits are extracted in-kernel with a traced shift —
+    dynamic sublane indexing of a bit-plane array does not lower through
     Mosaic (layout-mismatched rotate/select chains), packed words do.
-    CSPRNG contract: _rand_scalars.
+    CSPRNG contract: _rand_scalars128.
     """
-    scalars = _rand_scalars(n, rng)
-    out = np.zeros((2, n), dtype=np.uint32)
-    out[0] = (scalars >> np.uint64(32)).astype(np.uint32)
-    out[1] = (scalars & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    return out.view(np.int32)
+    return _rand_scalars128(n, rng).view(np.int32)

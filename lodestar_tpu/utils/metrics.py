@@ -362,6 +362,19 @@ class BlsPoolMetrics:
         self.batchable_sigs = r.counter(
             p + "batchable_sigs_count", "Sig sets submitted as batchable"
         )
+        # RLC batch-mode observability (ISSUE 10): how often the one-
+        # multi-pairing fast path fails and what the bisection fallback
+        # costs when it does
+        self.rlc_fallback = r.counter(
+            "lodestar_bls_rlc_fallback_total",
+            "RLC batch checks that failed and fell back to bisection "
+            "or per-set retry",
+        )
+        self.rlc_bisect_depth = r.histogram(
+            "lodestar_bls_rlc_bisect_depth",
+            "Halving depth needed to isolate bad sets in a failed RLC batch",
+            [1, 2, 3, 4, 5, 6, 8, 11],
+        )
         self.invalid_sets = r.counter(
             p + "invalid_sig_sets_count", "Sig sets that failed verification"
         )

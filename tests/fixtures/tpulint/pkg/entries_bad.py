@@ -15,3 +15,31 @@ def _builder():
 
 
 register_entry("fixture_span_update", _builder)  # BAD: no sources
+
+
+# RLC-style multi-entry-point case: sibling entries share one traced
+# module graph; the batch entry declares the COMPLETE set, the per-set
+# entry forgets the transitive dep — only the incomplete sibling may be
+# reported (a complete sibling must not mask it).
+def _rlc_batch_builder():
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+def _rlc_each_builder():
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+register_entry(
+    "fixture_rlc_batch",
+    _rlc_batch_builder,
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+register_entry(
+    "fixture_rlc_each",
+    _rlc_each_builder,
+    sources=("pkg.extmod",),  # BAD: pkg.extdep missing
+)

@@ -26,3 +26,30 @@ register_entry(
 )
 
 register_entry("fixture_kernels_entry", _kernels_builder)
+
+
+# RLC-style multi-entry-point registration: SEVERAL entries (batch +
+# per-set retry of one pipeline) tracing the same out-of-kernels module
+# graph, each declaring the complete source set independently.
+def _rlc_batch_builder():
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+def _rlc_each_builder():
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+register_entry(
+    "fixture_rlc_batch_ok",
+    _rlc_batch_builder,
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+register_entry(
+    "fixture_rlc_each_ok",
+    _rlc_each_builder,
+    sources=("pkg.extmod", "pkg.extdep"),
+)

@@ -89,3 +89,212 @@ def test_windowed_scalar_mul_infinity_base():
         CV.FP_OPS, (px, py, pz), q_inf, lambda i: bits[i], RAND_BITS
     )
     assert bool(jnp.all(inf))
+
+
+# -- 128-bit, 4-bit-window path (the RLC randomizer scalar mul) -------------
+
+RLC_BITS, W = 128, 4
+
+
+def _digit_planes(scalars, nbits=RLC_BITS, w=W):
+    """MSB-first w-bit window digits int32[nbits/w, B]."""
+    out = np.zeros((nbits // w, len(scalars)), np.int32)
+    for j, k in enumerate(scalars):
+        for t in range(nbits // w):
+            out[t, j] = (k >> (nbits - w * (t + 1))) & ((1 << w) - 1)
+    return jnp.asarray(out)
+
+
+def _edge_scalars_128(rng, n_random):
+    # 0 (stays infinity), the window-table entries 1..15 boundary cases,
+    # a single-bit-above-a-word scalar, and all-ones (every add taken)
+    edges = [0, 1, 2, 15, 16, 1 << 64, (1 << 128) - 1]
+    return edges + [
+        int.from_bytes(rng.bytes(16), "big") | 1 for _ in range(n_random)
+    ]
+
+
+def test_windowed_scalar_mul_narrow_window_matches_oracle_g1():
+    """scalar_mul_window_jac at w=2, nbits=32: the same table-build
+    recurrence (even entries double, odd entries add Q), digit select
+    chain, and int32 infinity carry as the production w=4/128-bit RLC
+    configuration, on a traced graph small enough for the fast tier —
+    trace+lower cost scales with the 2^w-1 multiple table, so the w=4
+    full-width runs (~3 min/core each) live in the slow tier below."""
+    rng = np.random.default_rng(0xD0CE)
+    nbits, w = 32, 2
+    # 0 (stays infinity), every table entry as a leading digit, all-ones
+    # (every window add taken, digit 3)
+    scalars = [0, 1, 2, 3, (1 << 32) - 1] + [
+        int(k) for k in rng.integers(1, 1 << 32, B - 5, dtype=np.uint64)
+    ]
+    pts = [
+        GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, int(k))
+        for k in rng.integers(2, 1 << 30, B)
+    ]
+    px = jnp.asarray(LY.encode_batch([p[0] for p in pts]))
+    py = jnp.asarray(LY.encode_batch([p[1] for p in pts]))
+    pz = jnp.asarray(LY.encode_batch([1] * B))
+    digits = _digit_planes(scalars, nbits=nbits, w=w)
+    q_inf = jnp.zeros((B,), bool)
+
+    @jax.jit
+    def run(px, py, pz, digits, q_inf):
+        (X, Y, Z), inf = CV.scalar_mul_window_jac(
+            CV.FP_OPS, (px, py, pz), q_inf, lambda t: digits[t], nbits, w
+        )
+        return X, Y, Z, inf.astype(jnp.int32)
+
+    X, Y, Z, inf = run(px, py, pz, digits, q_inf)
+    got = _decode_g1((X, Y, Z), inf)
+    for pt, k, g in zip(pts, scalars, got):
+        want = GC.scalar_mul(GC.FP_OPS, pt, k % GF.R)
+        assert g == want, f"k={k:#x}"
+
+
+def test_word_digit_extraction_matches_python():
+    """kernels/verify._word_digit (the in-kernel traced-shift digit
+    extraction over packed big-endian scalar words) against the python
+    ground truth, eager mode — the trickiest indexing in the RLC path."""
+    from lodestar_tpu.kernels import verify as KV
+    from lodestar_tpu.ops import bls_kernels as BK
+
+    rng = np.random.default_rng(0xD16)
+    rwords = BK.make_rand_words(B, rng)
+    assert rwords.shape == (KV.RAND_WORDS, B)
+    words = np.asarray(rwords).view(np.uint32)  # [RAND_WORDS, B] big-endian
+    scalars = [
+        sum(
+            int(words[i, j]) << (32 * (KV.RAND_WORDS - 1 - i))
+            for i in range(KV.RAND_WORDS)
+        )
+        for j in range(B)
+    ]
+    w = KV.WINDOW
+    for t in range(KV.RAND_BITS // w):
+        got = np.asarray(
+            KV._word_digit(jnp.asarray(rwords), jnp.int32(t))
+        )
+        want = [
+            (k >> (KV.RAND_BITS - w * (t + 1))) & ((1 << w) - 1)
+            for k in scalars
+        ]
+        assert got.tolist() == want, f"t={t}"
+
+
+@pytest.mark.slow
+def test_windowed128_scalar_mul_matches_oracle_g1():
+    rng = np.random.default_rng(0xD1CE)
+    scalars = _edge_scalars_128(rng, B - 7)
+    pts = [
+        GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, int(k))
+        for k in rng.integers(2, 1 << 30, B)
+    ]
+    px = jnp.asarray(LY.encode_batch([p[0] for p in pts]))
+    py = jnp.asarray(LY.encode_batch([p[1] for p in pts]))
+    pz = jnp.asarray(LY.encode_batch([1] * B))
+    digits = _digit_planes(scalars)
+    q_inf = jnp.zeros((B,), bool)
+
+    @jax.jit
+    def run(px, py, pz, digits, q_inf):
+        (X, Y, Z), inf = CV.scalar_mul_window_jac(
+            CV.FP_OPS, (px, py, pz), q_inf, lambda t: digits[t], RLC_BITS, W
+        )
+        return X, Y, Z, inf.astype(jnp.int32)
+
+    X, Y, Z, inf = run(px, py, pz, digits, q_inf)
+    got = _decode_g1((X, Y, Z), inf)
+    for pt, k, g in zip(pts, scalars, got):
+        want = GC.scalar_mul(GC.FP_OPS, pt, k % GF.R)
+        assert g == want, f"k={k:#x}"
+
+
+def _decode_g2(planes, inf):
+    x0 = LY.decode_batch(np.asarray(planes[0][0]))
+    x1 = LY.decode_batch(np.asarray(planes[0][1]))
+    y0 = LY.decode_batch(np.asarray(planes[1][0]))
+    y1 = LY.decode_batch(np.asarray(planes[1][1]))
+    z0 = LY.decode_batch(np.asarray(planes[2][0]))
+    z1 = LY.decode_batch(np.asarray(planes[2][1]))
+    out = []
+    for a0, a1, b0, b1, c0, c1, i in zip(x0, x1, y0, y1, z0, z1, np.asarray(inf)):
+        if i:
+            out.append(None)
+            continue
+        zi = GF.fp2_inv((c0, c1))
+        zi2 = GF.fp2_mul(zi, zi)
+        out.append(
+            (
+                GF.fp2_mul((a0, a1), zi2),
+                GF.fp2_mul((b0, b1), GF.fp2_mul(zi2, zi)),
+            )
+        )
+    return out
+
+
+@pytest.mark.slow
+def test_windowed128_scalar_mul_matches_oracle_g2():
+    rng = np.random.default_rng(0xD2CE)
+    scalars = _edge_scalars_128(rng, B - 7)
+    pts = [
+        GC.scalar_mul(GC.FP2_OPS, GC.G2_GEN, int(k))
+        for k in rng.integers(2, 1 << 30, B)
+    ]
+    qx = (
+        jnp.asarray(LY.encode_batch([p[0][0] for p in pts])),
+        jnp.asarray(LY.encode_batch([p[0][1] for p in pts])),
+    )
+    qy = (
+        jnp.asarray(LY.encode_batch([p[1][0] for p in pts])),
+        jnp.asarray(LY.encode_batch([p[1][1] for p in pts])),
+    )
+    one2 = (
+        jnp.asarray(LY.encode_batch([1] * B)),
+        jnp.asarray(LY.encode_batch([0] * B)),
+    )
+    digits = _digit_planes(scalars)
+    q_inf = jnp.zeros((B,), bool)
+
+    @jax.jit
+    def run(digits, q_inf):
+        (X, Y, Z), inf = CV.scalar_mul_window_jac(
+            CV.FP2_OPS, (qx, qy, one2), q_inf, lambda t: digits[t], RLC_BITS, W
+        )
+        return (X, Y, Z), inf.astype(jnp.int32)
+
+    planes, inf = run(digits, q_inf)
+    got = _decode_g2(planes, inf)
+    for pt, k, g in zip(pts, scalars, got):
+        want = GC.scalar_mul(GC.FP2_OPS, pt, k % GF.R)
+        assert g == want, f"k={k:#x}"
+
+
+@pytest.mark.slow
+def test_windowed128_scalar_mul_large_lane_width_g1():
+    """Full lane-tile width (the shape the pipeline kernels run at)
+    against the numpy/bigint ground truth."""
+    n = 128
+    rng = np.random.default_rng(0xD3CE)
+    pts = [
+        GC.scalar_mul(GC.FP_OPS, GC.G1_GEN, int(k))
+        for k in rng.integers(2, 1 << 62, n, dtype=np.uint64)
+    ]
+    scalars = [int.from_bytes(rng.bytes(16), "big") | 1 for _ in range(n)]
+    px = jnp.asarray(LY.encode_batch([p[0] for p in pts]))
+    py = jnp.asarray(LY.encode_batch([p[1] for p in pts]))
+    pz = jnp.asarray(LY.encode_batch([1] * n))
+    digits = _digit_planes(scalars)
+    q_inf = jnp.zeros((n,), bool)
+
+    @jax.jit
+    def run(px, py, pz, digits, q_inf):
+        (X, Y, Z), inf = CV.scalar_mul_window_jac(
+            CV.FP_OPS, (px, py, pz), q_inf, lambda t: digits[t], RLC_BITS, W
+        )
+        return X, Y, Z, inf.astype(jnp.int32)
+
+    X, Y, Z, inf = run(px, py, pz, digits, q_inf)
+    got = _decode_g1((X, Y, Z), inf)
+    for pt, k, g in zip(pts, scalars, got):
+        assert g == GC.scalar_mul(GC.FP_OPS, pt, k % GF.R), f"k={k:#x}"

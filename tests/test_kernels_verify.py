@@ -165,6 +165,58 @@ def test_out_of_subgroup_signature_rejected():
     assert each[:2] == [True, False] and all(each[2:])
 
 
+def test_rlc_pairing_budget_is_one_final_exp_per_job():
+    """The RLC acceptance invariant (ISSUE 10): an N-set batch job
+    dispatches exactly N+1 Miller-loop lanes of real pairing work and
+    ONE final exponentiation; the per-set path pays 2N and N.  Asserted
+    via the pipeline's explicit kernel-call tally (kernels/verify.py
+    PIPELINE_TALLY), which ticks at dispatch time on the direct path."""
+    sks, pks, tx, ty = world()
+    sets = [
+        ((i,), hash_to_g2(b"budget-%d" % i), GB.sign(sks[i], b"budget-%d" % i))
+        for i in range(4)
+    ]
+    planes = encode_sets(sets, N, 1)
+
+    KV.PIPELINE_TALLY.clear()
+    ok, _ = run_batch(tx, ty, planes, bits_for(N, 11))
+    assert ok
+    assert KV.PIPELINE_TALLY["miller_pair"] == N + 1
+    assert KV.PIPELINE_TALLY["final_exp"] == 1
+
+    KV.PIPELINE_TALLY.clear()
+    assert all(run_each(tx, ty, planes))
+    assert KV.PIPELINE_TALLY["miller_pair"] == 2 * N
+    assert KV.PIPELINE_TALLY["final_exp"] == N
+
+
+def test_rlc_verdict_matches_per_set_randomized():
+    """Randomized cross-check over mixed valid/invalid jobs: the RLC
+    batch verdict equals the conjunction of per-set verdicts, and the
+    per-set verdicts flag exactly the tampered sets — including the
+    all-invalid job."""
+    sks, pks, tx, ty = world()
+    rng = np.random.default_rng(0x51C)
+    scenarios = [rng.random(5) < 0.4 for _ in range(2)]
+    scenarios.append(np.ones(5, bool))  # all-invalid
+    for round_i, bad_mask in enumerate(scenarios):
+        sets = []
+        for i in range(5):
+            msg = b"rlc-eq-%d-%d" % (round_i, i)
+            sig = GB.sign(sks[i], msg)
+            if bad_mask[i]:
+                sig = GC.scalar_mul(GC.FP2_OPS, sig, 2)  # wrong, in-subgroup
+            sets.append(((i,), hash_to_g2(msg), sig))
+        planes = encode_sets(sets, N, 1)
+        ok, sub = run_batch(tx, ty, planes, bits_for(N, 100 + round_i))
+        each = run_each(tx, ty, planes)
+        assert all(sub), "tampered-by-doubling sigs stay in-subgroup"
+        assert ok == all(each[:5]), (round_i, bad_mask, each[:5])
+        assert ok == (not bad_mask.any())
+        assert each[:5] == [not b for b in bad_mask], (round_i, bad_mask)
+        assert all(each[5:])
+
+
 def test_infinity_signature_rejected():
     sks, pks, tx, ty = world()
     sets = [

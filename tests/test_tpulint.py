@@ -167,6 +167,21 @@ def test_fingerprint_completeness_positive(fixture_findings):
     assert any("pkg.extdep" in m for m in msgs), msgs
 
 
+def test_fingerprint_completeness_multi_entry_point(fixture_findings):
+    """RLC-style sibling entries over one traced module graph: the
+    entry with a PARTIAL source set is reported (for exactly the
+    missing module), and its complete sibling neither masks it nor
+    produces findings of its own."""
+    hits = _by_file(fixture_findings, "entries_bad.py")
+    msgs = [
+        f.message for f in hits if f.rule == "fingerprint-completeness"
+    ]
+    each = [m for m in msgs if "fixture_rlc_each" in m]
+    assert each and all("pkg.extdep" in m for m in each), msgs
+    assert not any("pkg.extmod" in m for m in each), msgs
+    assert not any("fixture_rlc_batch" in m for m in msgs), msgs
+
+
 def test_fingerprint_completeness_negative(fixture_findings):
     # registering the traced modules clears the finding; in-kernels
     # traced functions need no registration

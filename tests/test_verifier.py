@@ -125,6 +125,36 @@ def test_undecodable_signature_still_retries_decodable_sets():
     assert m.invalid_sets.value == 1
 
 
+def test_bisection_isolates_tampered_set_on_device():
+    """A failed RLC batch above the bisection leaf re-verifies through
+    REAL device sub-batches: halves re-dispatch as smaller RLC jobs and
+    the leaf runs per-set verdicts — the tampered set is isolated, the
+    honest ones are credited (host-oracle bisection semantics are
+    covered at scale in test_verifier_rlc.py)."""
+    sks = [GTB.keygen(b"verifier-%d" % i) for i in range(N_KEYS)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=N_KEYS)
+    table.register(pks)
+    # leaf=1 forces genuine sub-batch dispatches even on a 3-set job
+    verifier = TpuBlsVerifier(
+        table, rng=np.random.default_rng(7), bisect_leaf=1
+    )
+    sets = [
+        single_set(sks, 0, b"bis-0"),
+        single_set(sks, 1, b"bis-1"),
+        single_set(sks, 2, b"bis-2", tamper=True),
+    ]
+    assert not verifier.verify_signature_sets(sets, VerifyOptions(batchable=True))
+    m = verifier.metrics
+    assert m.batch_retries.value == 1
+    assert m.rlc_fallback.value == 1
+    assert m.rlc_bisect_depth.count == 1
+    assert m.success_jobs.value == 2
+    assert m.invalid_sets.value == 1
+    # the honest half cleared by its sub-batch counts as batch success
+    assert m.batch_sigs_success.value >= 2
+
+
 def test_verify_on_main_thread_cpu_path():
     """The latency fast path (reference: validation/block.ts:146) verifies
     synchronously on the host CPU ground truth."""

@@ -1,0 +1,227 @@
+"""RLC batch-mode host semantics: bisection fallback, escape hatch,
+fallback accounting, span attributes.
+
+The device kernels are replaced by a host ORACLE here (the bisection
+planner never needs them), so the adversarial cases — one tampered set
+in a 2048-set job, an all-invalid job — run in milliseconds in the
+default tier.  The same bisection driving REAL device sub-batches is
+covered by the slow tier (test_verifier.py), and RLC==per-set verdict
+equivalence on the real kernels by test_kernels_verify.py.
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls import (
+    PubkeyTable,
+    SignatureSet,
+    TpuBlsVerifier,
+    VerifyOptions,
+)
+from lodestar_tpu.bls.verifier import _DeviceJob
+from lodestar_tpu.crypto import bls as GTB
+from lodestar_tpu.crypto.hash_to_curve import hash_to_g2
+
+pytestmark = pytest.mark.smoke
+
+
+class FakeSet:
+    """A stand-in signature set: only truth value + sliceability matter
+    to the bisection planner."""
+
+    __slots__ = ("ok",)
+
+    def __init__(self, ok: bool):
+        self.ok = ok
+
+
+class OracleVerifier(TpuBlsVerifier):
+    """TpuBlsVerifier with the three device seams replaced by a host
+    oracle that reads FakeSet.ok, recording the call pattern."""
+
+    def __init__(self, bisect_leaf):
+        super().__init__(
+            PubkeyTable(capacity=2),
+            rng=np.random.default_rng(0),
+            bisect_leaf=bisect_leaf,
+        )
+        self.batch_calls = []
+        self.leaf_calls = []
+
+    def _dispatch_batch(self, sets, wire):
+        self.batch_calls.append(len(sets))
+        return all(s.ok for s in sets)
+
+    def _batch_verdict(self, handle):
+        return handle
+
+    def _per_set_verdicts(self, sets, wire):
+        self.leaf_calls.append(len(sets))
+        return np.array([s.ok for s in sets])
+
+
+def _job(sets, n_bucket=None):
+    job = _DeviceJob(list(sets), True, True, wire=False)
+    job.batch_ok = False  # the dispatched whole-job batch check failed
+    job.decodable = np.ones(len(sets), bool)
+    job.n_bucket = n_bucket or max(128, len(sets))
+    return job
+
+
+def test_bisection_isolates_single_bad_set_in_2048():
+    v = OracleVerifier(bisect_leaf=16)
+    sets = [FakeSet(True) for _ in range(2048)]
+    sets[1337].ok = False
+    verdicts, depth = v._bisect(sets, False, 1)
+    assert verdicts.shape == (2048,)
+    assert not verdicts[1337] and verdicts.sum() == 2047
+    # one bad set: two sub-batches per level down to the 16-set leaf
+    assert len(v.batch_calls) <= 2 * 7
+    assert depth == 8  # 2048 -> 1024 -> ... -> 16 (leaf)
+    # honest half-batches cleared in bulk, not per set
+    assert v.metrics.batch_sigs_success.value == 2047 - 15
+
+
+def test_bisection_all_invalid_job_terminates_and_rejects_all():
+    v = OracleVerifier(bisect_leaf=16)
+    sets = [FakeSet(False) for _ in range(256)]
+    verdicts, _depth = v._bisect(sets, False, 1)
+    assert not verdicts.any()
+    # degenerates to a full per-set sweep via the leaves (every batch
+    # fails), bounded by the tree's internal nodes
+    assert sum(v.leaf_calls) == 256
+    assert len(v.batch_calls) == 2 + 4 + 8 + 16
+
+
+def test_bisection_randomized_matches_oracle_on_odd_sizes():
+    rng = np.random.default_rng(7)
+    for size, leaf in ((100, 8), (33, 4), (517, 16), (2, 1)):
+        v = OracleVerifier(bisect_leaf=leaf)
+        truth = rng.random(size) > 0.3
+        sets = [FakeSet(bool(t)) for t in truth]
+        verdicts, _ = v._bisect(sets, False, 1)
+        assert (verdicts == truth).all(), (size, leaf)
+
+
+def test_finish_job_bisects_and_accounts():
+    v = OracleVerifier(bisect_leaf=16)
+    sets = [FakeSet(True) for _ in range(512)]
+    sets[3].ok = False
+    job = _job(sets)
+    assert v._finish_job(job) is False
+    assert (~job.verdicts).nonzero()[0].tolist() == [3]
+    assert v.metrics.batch_retries.value == 1
+    assert v.metrics.rlc_fallback.value == 1
+    assert v.metrics.rlc_bisect_depth.count == 1
+    assert v.metrics.success_jobs.value == 511
+    assert v.metrics.invalid_sets.value == 1
+
+
+def test_finish_job_small_batch_skips_bisection():
+    """At or under the one-tile leaf the fallback is the plain per-set
+    retry (bisection cannot shed device work below one lane tile)."""
+    v = OracleVerifier(bisect_leaf=128)
+    sets = [FakeSet(True), FakeSet(False), FakeSet(True)]
+    job = _job(sets)
+    job.args, job.valid = (), np.ones(3, np.int32)  # unused by the oracle
+
+    def fake_device_call(name, fn, args):
+        assert name == "each_decoded"
+        return np.array([s.ok for s in sets] + [True] * 125)
+
+    v._device_call = fake_device_call
+    assert v._finish_job(job) is False
+    assert job.verdicts.tolist() == [True, False, True]
+    assert v.batch_calls == [] and v.leaf_calls == []
+    assert v.metrics.rlc_fallback.value == 1
+    assert v.metrics.rlc_bisect_depth.count == 0  # no bisection ran
+
+
+def test_rlc_batch_span_carries_bucket_and_depth(tracing):
+    v = OracleVerifier(bisect_leaf=16)
+    sets = [FakeSet(True) for _ in range(512)]
+    sets[100].ok = False
+    v._finish_job(_job(sets, n_bucket=512))
+    spans = [
+        s for s in tracing.get_tracer().snapshot() if s.name == "bls.rlc_batch"
+    ]
+    assert len(spans) == 1
+    assert spans[0].attrs["n_bucket"] == 512
+    assert spans[0].attrs["accepted"] is False
+    assert spans[0].attrs["bisect_depth"] == 6  # 512 -> ... -> 16
+
+
+@pytest.fixture()
+def tracing():
+    from lodestar_tpu import observability as OB
+
+    tracer = OB.configure(enabled=True, capacity=OB.get_tracer().capacity)
+    tracer.clear()
+    try:
+        yield OB
+    finally:
+        OB.configure(enabled=False)
+        OB.get_tracer().clear()
+
+
+# -- dispatch-path selection + escape hatch ---------------------------------
+
+
+def _world(n_keys=3):
+    sks = [GTB.keygen(b"rlc-%d" % i) for i in range(n_keys)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=n_keys)
+    assert table.register(pks) == list(range(n_keys))
+    return sks, table
+
+
+class RecordingCall:
+    """Stub _device_call: records entry names, returns all-pass shapes."""
+
+    def __init__(self):
+        self.names = []
+
+    def __call__(self, name, fn, args):
+        self.names.append(name)
+        n = int(np.asarray(args[-1]).shape[0])
+        if name.startswith("batch"):
+            return np.True_, np.ones(n, bool)
+        return np.ones(n, bool)
+
+
+def _sets(sks, n):
+    out = []
+    for i in range(n):
+        msg = b"root-%d" % i
+        out.append(
+            SignatureSet.single(
+                i % len(sks), hash_to_g2(msg), GTB.sign(sks[i % len(sks)], msg)
+            )
+        )
+    return out
+
+
+def test_rlc_default_dispatches_batch_entry():
+    sks, table = _world()
+    v = TpuBlsVerifier(table, rng=np.random.default_rng(1))
+    assert v._use_rlc
+    rec = RecordingCall()
+    v._device_call = rec
+    job = v.begin_job(_sets(sks, 3), batchable=True)
+    assert rec.names == ["batch_decoded"]
+    assert v.finish_job(job) is True
+
+
+def test_rlc_escape_hatch_forces_per_set(monkeypatch):
+    monkeypatch.setenv("LODESTAR_TPU_BLS_RLC", "0")
+    sks, table = _world()
+    v = TpuBlsVerifier(table, rng=np.random.default_rng(1))
+    assert not v._use_rlc
+    rec = RecordingCall()
+    v._device_call = rec
+    job = v.begin_job(_sets(sks, 3), batchable=True)
+    assert rec.names == ["each_decoded"]
+    assert v.finish_job(job) is True
+    # nothing was batched, so nothing counts as a batch retry
+    assert v.metrics.batch_retries.value == 0
+    assert v.metrics.batchable_sigs.value == 3
