@@ -427,10 +427,14 @@ class Project:
 
     @staticmethod
     def _is_jit_expr(node: ast.AST) -> bool:
-        """`jax.jit`, `jit`, or `partial(jax.jit, ...)`."""
-        if isinstance(node, ast.Name) and node.id == "jit":
+        """`jax.jit`, `jit`, `ops_jit` (the instrumented dispatcher in
+        kernels/jit_dispatch.py), `partial(jax.jit, ...)`, or a direct
+        decorator call `ops_jit(name=...)`."""
+        if isinstance(node, ast.Name) and node.id in ("jit", "ops_jit"):
             return True
-        if isinstance(node, ast.Attribute) and node.attr == "jit":
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "jit", "ops_jit",
+        ):
             return True
         if isinstance(node, ast.Call):
             fn = node.func
@@ -439,6 +443,10 @@ class Project:
             ) or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
             if is_partial and node.args:
                 return Project._is_jit_expr(node.args[0])
+            # `@ops_jit(name=..., static_argnums=...)` configures and
+            # returns the jit wrapper directly
+            if Project._is_jit_expr(fn):
+                return True
         return False
 
     def _fn_ref_arg(

@@ -43,9 +43,11 @@ dtype-discipline (warning)
 node-hygiene (warning; bare except is error)
     Bare `except:` swallows KeyboardInterrupt/SystemExit — name the
     exception (the repo idiom is `except Exception:  # noqa: BLE001`
-    with a reason).  Under network/, chain/, sync/: no blocking calls
-    (`time.sleep`, `jax.device_get`, `.block_until_ready()`) inside
-    `async def` bodies — they stall the event loop for every peer.
+    with a reason).  Under network/, chain/, sync/, bls/ (the
+    accumulate-and-flush pipeline's loop lives there): no blocking
+    calls (`time.sleep`, `jax.device_get`, `.block_until_ready()`)
+    inside `async def` bodies — they stall the event loop for every
+    peer.
     The observability BLOCKING SINK APIs (`write_chrome_trace`,
     `dump_chrome_trace`, `trace_summary`) count too: opening
     `trace_span` in async code is fine (cheap, O(1)), but draining or
@@ -508,7 +510,7 @@ class DtypeDisciplineRule(Rule):
 
 # ---------------------------------------------------------------------------
 
-_ASYNC_DIRS = {"network", "chain", "sync"}
+_ASYNC_DIRS = {"network", "chain", "sync", "bls"}
 _BLOCKING_ATTRS = {"block_until_ready"}
 # observability's blocking sink APIs: they walk/serialize the whole
 # trace ring (file IO, O(ring) aggregation) — span BODIES in async code

@@ -8,9 +8,13 @@ batched JAX kernels on a device:
   signature_set  — the ISignatureSet model (single | aggregate)
   pubkey_table   — device-resident validator pubkey table (Index2Pubkey)
   verifier       — TpuBlsVerifier: buckets, batch->retry, backpressure
+  service        — BlsVerifierService: the flat coalescing job queue
+  pipeline       — BlsVerificationPipeline: shape-bucketed accumulate-
+                   and-flush feed with priority lanes (ISSUE 11)
   metrics        — lodestar_bls_thread_pool_* compatible counters
 """
 
 from .signature_set import SignatureSet, SignatureSetType  # noqa: F401
 from .pubkey_table import PubkeyTable  # noqa: F401
 from .verifier import TpuBlsVerifier, VerifyOptions  # noqa: F401
+from .pipeline import BlsVerificationPipeline, create_bls_service  # noqa: F401

@@ -11,6 +11,10 @@ The service reproduces the reference `BlsMultiThreadWorkerPool` contract
   - backpressure: `can_accept_work()` is False once MAX_PENDING_JOBS jobs
     are queued or buffered (index.ts:143-149), the signal the gossip
     NetworkProcessor throttles on (processor/index.ts:357-371),
+  - the buffering POLICY is a seam: `_submit_buffered_locked`,
+    `_poll_buffers_locked`, and `_close_flush_locked` are the three
+    hooks the accumulate-and-flush pipeline (bls/pipeline.py) overrides
+    to replace this flat window with per-shape-bucket accumulators,
   - a failed merged batch re-verifies per job so one bad signature cannot
     poison other jobs' verdicts (worker.ts:74-96),
   - `verify_on_main_thread` bypasses the queue and verifies synchronously
@@ -107,6 +111,10 @@ class BlsVerifierService:
         self._tail_run_len = 0
         self._tail_run_wire: Optional[bool] = None
         self._pending = 0  # queued + buffered + in-flight jobs
+        # queued + buffered + in-flight SETS — the unit the pipeline's
+        # high-water backpressure is measured in (a 1-set gossip job and
+        # a 512-set range-sync job are very different work)
+        self._pending_sets = 0
         self._closed = False
         # dispatcher begins device jobs; resolver syncs them in order.
         # The bounded in-flight queue pipelines dispatch latency.
@@ -160,11 +168,10 @@ class BlsVerifierService:
                 job.future.set_exception(RuntimeError("verifier closed"))
                 return job.future
             self._pending += 1
+            self._pending_sets += len(job.sets)
+            self.metrics.pipeline_pending_sets.set(self._pending_sets)
             if opts.batchable and len(job.sets) < self._max_buffered:
-                self._buffer_append_locked(job)
-                if self._buffer_deadline is None:
-                    self._buffer_deadline = time.perf_counter() + self._buffer_wait
-                self._maybe_flush_buffer_locked()
+                self._submit_buffered_locked(job)
             else:
                 self._queue.append([job])
             self.metrics.queue_length.set(self._pending)
@@ -176,6 +183,18 @@ class BlsVerifierService:
     ) -> bool:
         """Synchronous wrapper (blocks on the service future)."""
         return self.verify_signature_sets_async(sets, opts).result()
+
+    def _submit_buffered_locked(self, job: _Job) -> None:
+        """Buffering-policy hook: route one batchable job into the
+        coalescing buffer.  The flush timer anchors on the OLDEST
+        buffered set's enqueue time (`job.t_submit` is stamped before
+        lock acquisition), so p99 submit->flush latency stays bounded by
+        the window even when lock contention or a busy dispatcher delays
+        the append (ISSUE 11 satellite)."""
+        self._buffer_append_locked(job)
+        if self._buffer_deadline is None:
+            self._buffer_deadline = job.t_submit + self._buffer_wait
+        self._maybe_flush_buffer_locked()
 
     def _buffer_append_locked(self, job: _Job) -> None:
         """Append to the buffer, advancing the trailing-run tracker with
@@ -219,6 +238,19 @@ class BlsVerifierService:
 
     # -- dispatcher -------------------------------------------------------
 
+    def _poll_buffers_locked(self, now: float) -> Optional[float]:
+        """Buffering-policy hook: flush any deadline-due buffers into
+        the dispatch queue; return seconds until the next deadline (the
+        dispatcher's wait timeout), or None when nothing is buffered."""
+        if self._buffer and (
+            self._buffer_deadline is not None
+            and now >= self._buffer_deadline
+        ):
+            self._flush_buffer_locked()
+        if self._buffer_deadline is None:
+            return None
+        return max(self._buffer_deadline - now, 0.0)
+
     def _run(self) -> None:
         """Dispatcher: pull groups, begin device jobs, hand to resolver."""
         while True:
@@ -228,17 +260,10 @@ class BlsVerifierService:
                         self._inflight.put(None)  # wake + stop resolver
                         return
                     now = time.perf_counter()
-                    if self._buffer and (
-                        self._buffer_deadline is not None
-                        and now >= self._buffer_deadline
-                    ):
-                        self._flush_buffer_locked()
+                    timeout = self._poll_buffers_locked(now)
                     if self._queue:
                         group = self._queue.pop(0)
                         break
-                    timeout = None
-                    if self._buffer_deadline is not None:
-                        timeout = max(self._buffer_deadline - now, 0.0)
                     self._lock.wait(timeout=timeout)
                 self.metrics.queue_length.set(self._pending)
             self._dispatch(group)
@@ -291,6 +316,8 @@ class BlsVerifierService:
             self.metrics.error_jobs.inc(len(group))
             with self._lock:
                 self._pending -= len(group)
+                self._pending_sets -= sum(len(j.sets) for j in group)
+                self.metrics.pipeline_pending_sets.set(self._pending_sets)
                 self.metrics.queue_length.set(self._pending)
                 self._lock.notify_all()
             return
@@ -437,20 +464,29 @@ class BlsVerifierService:
                         self.metrics.time_per_sig_set.observe(dt / nsets)
                 with self._lock:
                     self._pending -= len(group)
+                    self._pending_sets -= sum(len(j.sets) for j in group)
+                    self.metrics.pipeline_pending_sets.set(self._pending_sets)
                     self.metrics.queue_length.set(self._pending)
                     self._lock.notify_all()
 
     # -- shutdown (reference: multithread/index.ts:193-214) ---------------
+
+    def _close_flush_locked(self) -> None:
+        """Buffering-policy hook: drain every buffer into the dispatch
+        queue at shutdown (the queued jobs are then rejected)."""
+        self._flush_buffer_locked()
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-            self._flush_buffer_locked()
+            self._close_flush_locked()
             rejected = [j for g in self._queue for j in g]
             self._queue = []
             self._pending -= len(rejected)
+            self._pending_sets -= sum(len(j.sets) for j in rejected)
+            self.metrics.pipeline_pending_sets.set(self._pending_sets)
             self._lock.notify_all()
         for j in rejected:
             j.future.set_exception(RuntimeError("verifier closed"))

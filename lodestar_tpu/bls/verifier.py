@@ -69,9 +69,19 @@ MAX_AGG_INDICES = K_BUCKETS[-1]
 
 
 class VerifyOptions:
-    def __init__(self, batchable: bool = False, verify_on_main_thread: bool = False):
+    def __init__(
+        self,
+        batchable: bool = False,
+        verify_on_main_thread: bool = False,
+        priority: bool = False,
+    ):
         self.batchable = batchable
         self.verify_on_main_thread = verify_on_main_thread
+        # block-critical batchable sets (proposer signatures, aggregate-
+        # and-proof): the accumulate-and-flush pipeline (bls/pipeline.py)
+        # routes these onto its short-deadline lane so they are never
+        # starved behind subnet-attestation bucket fill
+        self.priority = priority
 
 
 class _DeviceJob:

@@ -31,6 +31,7 @@ from . import canonical as CN
 from . import core as C
 from . import curve as CV
 from . import fp2 as F2
+from . import jit_dispatch as JD
 from . import layout as LY
 from . import sqrt as SQ
 from . import tower as TW
@@ -227,7 +228,7 @@ def _k_hash_g2(u00, u01, u10, u11, sgn, ox0, ox1, oy0, oy1, oz0, oz1, ook):
     ook[...] = ok[None, :].astype(jnp.int32)
 
 
-@jax.jit
+@JD.ops_jit
 def hash_to_g2_device(u00, u01, u10, u11, sgn_bits):
     """Batched map_to_curve: u as PLAIN limbs [NL, n], sgn_bits int32
     [2, n] (sgn0(u0), sgn0(u1) from the host's hash_to_field integers).
@@ -314,7 +315,7 @@ def _k_g1_keyvalidate(x0, flags, ox, oy, ook):
     ook[...] = (ok & ~inf)[None, :].astype(jnp.int32)  # infinity never valid
 
 
-@jax.jit
+@JD.ops_jit
 def g1_keyvalidate_device(x0, flag_bits):
     """Batched pubkey decompression + KeyValidate: x as PLAIN limbs,
     flag_bits int32 [2, n] = (sign, is_infinity).  Returns
@@ -330,7 +331,7 @@ def g1_keyvalidate_device(x0, flag_bits):
     return (ox, oy), ook[0] != 0
 
 
-@jax.jit
+@JD.ops_jit
 def g2_decompress_device(x0, x1, flag_bits):
     """Batched G2 decompression: x as PLAIN limbs, flag_bits int32 [2, n]
     = (sign, is_infinity).  Returns ((x, y) mont affine planes, ok[n])."""

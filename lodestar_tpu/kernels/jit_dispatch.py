@@ -1,0 +1,135 @@
+"""Instrumented `jax.jit` — names per-function XLA compile time.
+
+dev/NOTES.md round-7 finding: the fast tier's budget is spent in
+XLA:CPU `jax.jit` compiles of the `ops/`-layer glue, invisible to the
+kernel instrumentation (no pallas build, no export-cache activity).
+`ops_jit` is a drop-in `jax.jit` replacement that notices the FIRST
+dispatch of every abstract input signature — the call that pays
+trace + compile — and names it:
+
+  - a `ops.jit_compile` span (attrs: fn, the signature ordinal), so
+    compile time shows up in `trace_summary()` the way
+    `kernels.export_trace` does for export artifacts,
+  - a `lodestar_tpu_ops_jit_compile_seconds{fn}` histogram in the
+    process-global registry, folded into
+    `observability.kernel_compile_snapshot()` (and therefore into every
+    bench.py "phases" record).
+
+Warm dispatches take one host-side signature probe (tuple build + set
+lookup) — noise next to any device work.  Calls made INSIDE an outer
+trace (tracer arguments) bypass the instrumentation entirely: the inner
+jit inlines there and the timing would misattribute the outer trace.
+
+Lives in kernels/ so the verify pipeline can import it without dragging
+observability/metrics modules into the export-cache fingerprint contract
+(kernels/ is fingerprinted wholesale); `ops/dispatch.py` re-exports it
+as the public ops-boundary API.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                from ..utils.metrics import global_registry
+
+                _METRICS = global_registry().labeled_histogram(
+                    "lodestar_tpu_ops_jit_compile_seconds",
+                    "Wall seconds of the first jit dispatch (trace + XLA "
+                    "compile + run) per instrumented function and input "
+                    "signature",
+                    "fn",
+                    (0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120, 600),
+                )
+    return _METRICS
+
+
+def _is_tracer(x) -> bool:
+    tracer = getattr(jax.core, "Tracer", None)
+    return tracer is not None and isinstance(x, tracer)
+
+
+# past this many distinct signatures the wrapper stops recording new
+# compiles (warm-path behavior) — a shape-polymorphic caller must not
+# grow the seen set without bound
+_MAX_TRACKED_SIGNATURES = 4096
+
+
+def _signature(args, kwargs, value_keyed: bool):
+    """Hashable abstract signature of a call: treedef + per-leaf
+    (shape, dtype).  Returns None when any leaf is a tracer (the call
+    is being inlined into an outer trace — skip instrumentation).
+
+    Non-array leaves (Python scalars) key by TYPE only unless the jit
+    has static args (`value_keyed`): jax.jit traces plain scalars by
+    abstract dtype, so keying their VALUES would count every new value
+    as a bogus 'first dispatch'; with static_argnums/argnames a new
+    value really is a recompile."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        if _is_tracer(leaf):
+            return None
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            if value_keyed:
+                sig.append((type(leaf).__name__, repr(leaf)[:32]))
+            else:
+                sig.append((type(leaf).__name__,))
+        else:
+            sig.append((tuple(shape), str(dtype)))
+    return (treedef, tuple(sig))
+
+
+def ops_jit(fn: Optional[Callable] = None, *, name: Optional[str] = None, **jit_kwargs):
+    """`@ops_jit` / `@ops_jit(name=..., static_argnums=...)` — jax.jit
+    with first-dispatch-per-signature compile accounting."""
+    if fn is None:
+        return lambda f: ops_jit(f, name=name, **jit_kwargs)
+    jitted = jax.jit(fn, **jit_kwargs)
+    label = name or getattr(fn, "__name__", "fn")
+    value_keyed = bool(
+        jit_kwargs.get("static_argnums") or jit_kwargs.get("static_argnames")
+    )
+    seen = set()
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        key = _signature(args, kwargs, value_keyed)
+        if key is not None:
+            with lock:
+                if len(seen) >= _MAX_TRACKED_SIGNATURES:
+                    first = False
+                else:
+                    first = key not in seen
+                if first:
+                    seen.add(key)
+                    ordinal = len(seen)
+            if first:
+                from ..observability import trace_span
+
+                t0 = time.perf_counter()
+                with trace_span("ops.jit_compile", fn=label, signature=ordinal):
+                    out = jitted(*args, **kwargs)
+                _metrics().observe(label, time.perf_counter() - t0)
+                return out
+        return jitted(*args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    wrapper._jitted = jitted  # seam: the raw jax.jit callable
+    return wrapper

@@ -39,6 +39,7 @@ from . import core as C
 from . import curve as CV
 from . import fp2 as F2
 from . import ingest as IG
+from . import jit_dispatch as JD
 from . import launch as LA
 from . import layout as LY
 from . import pairing as KP
@@ -460,7 +461,7 @@ def _k_mont4(a0, a1, a2, a3, *outs):
 # pallas kernel stays its own bounded compile unit) -------------------------
 
 
-@jax.jit
+@JD.ops_jit
 def _j_substitute(live, pk0, pk1, pk2, sx0, sx1, sy0, sy1):
     """Dead lanes -> generator points (keeps every lane on-curve)."""
     n = live.shape[0]
@@ -476,7 +477,7 @@ def _j_substitute(live, pk0, pk1, pk2, sx0, sx1, sy0, sy1):
     return px, py, pz, sx, sy
 
 
-@jax.jit
+@JD.ops_jit
 def _j_sum_lanes(px0, px1, py0, py1, pz0, pz1, pinf):
     (jX, jY, jZ), j_inf = CV.sum_points_lanes(
         CV.FP2_OPS,
@@ -486,7 +487,7 @@ def _j_sum_lanes(px0, px1, py0, py1, pz0, pz1, pinf):
     return (*jX, *jY, *jZ, j_inf[None, :].astype(jnp.int32))
 
 
-@jax.jit
+@JD.ops_jit
 def _j_product12(fpartial, live_mask):
     fprod = jax.tree_util.tree_leaves(
         KP.product12_lanes(_unflatten_f12(fpartial), live_mask)
@@ -494,7 +495,7 @@ def _j_product12(fpartial, live_mask):
     return tuple(fprod)
 
 
-@jax.jit
+@JD.ops_jit
 def _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid):
     sub_ok = (sub[0] != 0) | ~live
     batch_ok = (
@@ -681,7 +682,7 @@ def _prod(fN, live_i, n):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@JD.ops_jit
 def _j_seg_sum_g1(px, py, pz, dead, group):
     """Segmented inclusive jacobian prefix-scan over the lane axis.
 
@@ -710,7 +711,7 @@ def _j_seg_sum_g1(px, py, pz, dead, group):
     return pts, inf
 
 
-@jax.jit
+@JD.ops_jit
 def _j_group_heads(
     pts, seg_inf, msg_x0, msg_x1, msg_y0, msg_y1, head_lanes, glive
 ):

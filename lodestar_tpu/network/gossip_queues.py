@@ -143,10 +143,16 @@ class GossipQueue(Generic[T]):
         opts: GossipQueueOpts,
         topic: Optional[str] = None,
         metrics: Optional[GossipQueueMetrics] = None,
+        on_drop=None,
     ):
         self.opts = opts
         self.topic = topic
         self.metrics = metrics if topic is not None else None
+        # per-ITEM drop observer fn(item) — the backpressure->scoring
+        # coupling charges each shed message's OWN publisher (a LIFO
+        # ratio drop sheds the oldest backlog, which belongs to whoever
+        # flooded it there, not to the peer whose publish overflowed)
+        self.on_drop = on_drop
         self._q: Deque[T] = deque()
         self._t: Deque[float] = deque()  # per-item enqueue perf_counter
         self._drop_ratio = 0.0
@@ -224,6 +230,9 @@ class GossipQueue(Generic[T]):
             return 0
         if count >= len(self._q):
             n = len(self._q)
+            if self.on_drop is not None:
+                for item in self._q:
+                    self._observe_drop(item)
             self._q.clear()
             self._t.clear()
             return n
@@ -231,17 +240,28 @@ class GossipQueue(Generic[T]):
         # the oldest (drop from the right/newest).
         for _ in range(count):
             if self.opts.type is QueueType.LIFO:
-                self._q.popleft()
+                item = self._q.popleft()
                 self._t.popleft()
             else:
-                self._q.pop()
+                item = self._q.pop()
                 self._t.pop()
+            self._observe_drop(item)
         return count
 
+    def _observe_drop(self, item: T) -> None:
+        if self.on_drop is None:
+            return
+        try:
+            self.on_drop(item)
+        except Exception:  # noqa: BLE001 — a scoring fault must never
+            pass  # break the queue discipline
 
-def create_gossip_queues(registry=None) -> Dict[GossipType, GossipQueue]:
+
+def create_gossip_queues(
+    registry=None, on_drop=None
+) -> Dict[GossipType, GossipQueue]:
     metrics = GossipQueueMetrics(registry)
     return {
-        t: GossipQueue(o, topic=t.value, metrics=metrics)
+        t: GossipQueue(o, topic=t.value, metrics=metrics, on_drop=on_drop)
         for t, o in GOSSIP_QUEUE_OPTS.items()
     }

@@ -10,8 +10,15 @@ packages/beacon-node/src/network/processor/index.ts):
     MAX_JOBS_SUBMITTED_PER_TICK jobs per tick (index.ts:61),
   - before every job the processor re-checks downstream backpressure —
     the BLS service's `can_accept_work()` (the reference's
-    blsThreadPoolCanAcceptWork, index.ts:357-371) and an optional regen
-    gate — and stops pulling except for bypass topics (beacon_block),
+    blsThreadPoolCanAcceptWork, index.ts:357-371; the pipeline's
+    high-water mark counts buffered + queued + in-flight SETS) and an
+    optional regen gate — and stops pulling except for bypass topics
+    (beacon_block),
+  - when a stalled processor's queues overflow, the shed messages charge
+    their publisher through the peer scorer's backpressure penalty
+    (scoring.py on_backpressure_drop, gossipsub P7): peers flooding a
+    saturated node pay for it while the drop lands on the per-topic
+    `lodestar_gossip_queue_dropped_total` series (gossip_queues.py),
   - messages whose block root is unknown are parked for reprocessing and
     re-enqueued when the block arrives (capped at 16,384; index.ts:64-67),
     pruned per clock slot,
@@ -50,16 +57,22 @@ EARLIEST_PERMISSABLE_SLOT_DISTANCE = 32  # reference: index.ts:34
 
 class PendingGossipMessage:
     """A received-but-unvalidated gossip message (the reference's
-    PendingGossipsubMessage, processor/types.ts)."""
+    PendingGossipsubMessage, processor/types.ts).  `peer_id` names the
+    propagation source (reference: propagationSource) so overflow drops
+    can be charged to the publisher."""
 
-    __slots__ = ("topic", "data", "slot", "block_root", "seen_at")
+    __slots__ = ("topic", "data", "slot", "block_root", "seen_at", "peer_id")
 
-    def __init__(self, topic, data, slot=None, block_root=None, seen_at=0.0):
+    def __init__(
+        self, topic, data, slot=None, block_root=None, seen_at=0.0,
+        peer_id=None,
+    ):
         self.topic = topic
         self.data = data
         self.slot = slot
         self.block_root = block_root
         self.seen_at = seen_at
+        self.peer_id = peer_id
 
 
 class ProcessorStats:
@@ -93,11 +106,20 @@ class NetworkProcessor:
         has_block_root: Optional[Callable[[str], bool]] = None,
         max_jobs_per_tick: int = MAX_JOBS_SUBMITTED_PER_TICK,
         registry=None,
+        scorer=None,
     ):
+        # backpressure->scoring coupling (ISSUE 11): an object with
+        # `on_backpressure_drop(peer_id, topic)` (GossipPeerScorer);
+        # every SHED message charges its own publisher (a LIFO ratio
+        # drop evicts the oldest backlog — the flooder's — so the peer
+        # whose honest publish happened to overflow is not the one
+        # penalized)
+        self.scorer = scorer
         # registry: where queue latency/depth series land (node passes
         # its own; None = the process-global observability registry)
         self.queues: Dict[GossipType, GossipQueue] = create_gossip_queues(
-            registry
+            registry,
+            on_drop=self._on_queue_drop if scorer is not None else None,
         )
         self.worker = worker
         self.can_accept_work_fns = can_accept_work_fns
@@ -132,6 +154,19 @@ class NetworkProcessor:
                 self.stats.reprocess_parked += 1
                 return
         self._push(message)
+
+    def _on_queue_drop(self, message: PendingGossipMessage) -> None:
+        """Per-item overflow observer (gossip_queues.on_drop): the queue
+        only overflows when downstream (the verification pipeline)
+        cannot keep up — each shed message costs ITS publisher one
+        behaviour-penalty unit (the drop count itself already landed on
+        lodestar_gossip_queue_dropped_total)."""
+        peer = getattr(message, "peer_id", None)
+        if peer is not None:
+            topic = getattr(message, "topic", None)
+            self.scorer.on_backpressure_drop(
+                peer, topic.value if topic is not None else None
+            )
 
     def _push(self, message: PendingGossipMessage) -> None:
         dropped = self.queues[message.topic].add(message)

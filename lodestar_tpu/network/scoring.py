@@ -343,10 +343,16 @@ class GossipPeerScorer:
         self._invalid_counts: Dict[tuple, float] = {}
         # peer -> positive deliveries score component
         self._positive: Dict[str, float] = {}
+        # peer -> behaviour-penalty counter (P7, squared above the
+        # threshold) — fed by the verification pipeline's backpressure
+        # coupling: messages a peer keeps publishing into a saturated
+        # node that the gossip queues then shed (ISSUE 11)
+        self._behaviour_penalties: Dict[str, float] = {}
 
     def gossip_score(self, peer_id: str) -> float:
         """The peer's gossipsub score: capped positive deliveries plus
-        the squared invalid-message penalties."""
+        the squared invalid-message penalties plus the squared
+        above-threshold behaviour penalty (P7)."""
         score = min(
             self._positive.get(peer_id, 0.0), self.params.topic_score_cap
         )
@@ -362,7 +368,63 @@ class GossipPeerScorer:
                 * count
                 * count
             )
+        excess = (
+            self._behaviour_penalties.get(peer_id, 0.0)
+            - self.params.behaviour_penalty_threshold
+        )
+        if excess > 0:
+            score += self.params.behaviour_penalty_weight * excess * excess
         return score
+
+    def behaviour_penalty(self, peer_id: str) -> float:
+        """The raw P7 counter (pre-threshold, pre-square) — test and
+        dashboard introspection."""
+        return self._behaviour_penalties.get(peer_id, 0.0)
+
+    def on_backpressure_drop(
+        self, peer_id: str, topic: Optional[str] = None, count: float = 1.0
+    ) -> float:
+        """Charge a peer whose publishing the overloaded node had to
+        shed (gossip-queue overflow while the verification pipeline's
+        high-water backpressure holds the processor).  Counted on the
+        gossipsub BEHAVIOUR penalty (P7): unlike P4 the shed message was
+        never validated, so it must not count as an invalid delivery —
+        but a peer that keeps flooding a saturated node pays
+        quadratically above the threshold, exactly like other protocol
+        abuse.  Returns the peer's updated gossip score."""
+        self._behaviour_penalties[peer_id] = (
+            self._behaviour_penalties.get(peer_id, 0.0) + count
+        )
+        score = self.gossip_score(peer_id)
+        if self.book is not None:
+            # app-level observer: one unit per shed message (ratio
+            # drops shed several per overflow; the book clamps totals)
+            self.book.add(peer_id, -float(count))
+        return score
+
+    def decay(self) -> None:
+        """One decay interval over the penalty counters (gossipsub spec:
+        counters decay by their per-interval factor and zero out below
+        decay_to_zero) — lets a peer that stopped flooding recover."""
+        d = self.params.behaviour_penalty_decay
+        floor = self.params.decay_to_zero
+        for pid in list(self._behaviour_penalties):
+            v = self._behaviour_penalties[pid] * d
+            if v < floor:
+                del self._behaviour_penalties[pid]
+            else:
+                self._behaviour_penalties[pid] = v
+        for key in list(self._invalid_counts):
+            topic = key[1]
+            tp = self.params.topics.get(topic)
+            decay_factor = (
+                tp.invalid_message_deliveries_decay if tp is not None else d
+            )
+            v = self._invalid_counts[key] * decay_factor
+            if v < floor:
+                del self._invalid_counts[key]
+            else:
+                self._invalid_counts[key] = v
 
     def on_invalid_message(self, peer_id: str, topic: str) -> float:
         key = (peer_id, topic)

@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 
 from . import params
 from .api.server import BeaconApiServer, DefaultHandlers
-from .bls.service import BlsVerifierService
+from .bls.pipeline import create_bls_service
 from .bls.signature_set import WireSignatureSet
 from .bls.verifier import TpuBlsVerifier, VerifyOptions
 from .chain.clock import Clock
@@ -103,7 +103,9 @@ class BeaconNode:
         verifier = opts.verifier or TpuBlsVerifier(
             pubkey_table, metrics=self.metrics
         )
-        self.bls = BlsVerifierService(verifier)
+        # the accumulate-and-flush pipeline by default; the PR 10 flat
+        # buffer under LODESTAR_TPU_BLS_PIPELINE=0 (bls/pipeline.py)
+        self.bls = create_bls_service(verifier)
 
         self.seen_attesters = SeenAttesters()
         self.processor = NetworkProcessor(
@@ -148,8 +150,11 @@ class BeaconNode:
         signing_root: bytes,
         signature: bytes,
         block_root: Optional[str] = None,
+        peer_id: Optional[str] = None,
     ) -> None:
-        """Enqueue one attestation's validation (async verdict)."""
+        """Enqueue one attestation's validation (async verdict).
+        `peer_id` attributes the publish so overflow drops under
+        backpressure charge the flooding peer (processor scorer hook)."""
         self.processor.on_gossip_message(
             PendingGossipMessage(
                 GossipType.beacon_attestation,
@@ -157,6 +162,7 @@ class BeaconNode:
                 slot=slot,
                 block_root=block_root,
                 seen_at=time.time(),
+                peer_id=peer_id,
             )
         )
 
@@ -179,8 +185,15 @@ class BeaconNode:
         # derivation lives with the extractors, and hash-to-curve reuse
         # already happens in the verifier's MessageCache keyed by root.
         ws = WireSignatureSet.single(validator_index, signing_root, signature)
+        # subnet attestations ride the pipeline's standard (long-window)
+        # lane; block-critical topics (aggregate_and_proof, blocks) would
+        # pass priority=True for the short-deadline lane
         fut = self.bls.verify_signature_sets_async(
-            [ws], VerifyOptions(batchable=True)
+            [ws],
+            VerifyOptions(
+                batchable=True,
+                priority=msg.topic is not GossipType.beacon_attestation,
+            ),
         )
         self._pending_attesters.add((epoch, validator_index))
         self._futures.append((validator_index, epoch, fut))
@@ -249,7 +262,7 @@ class FullBeaconNode:
             table = PubkeyTable(capacity=max(anchor_state.num_validators, 1))
             table.register_compressed(list(anchor_state.pubkeys))
             verifier = TpuBlsVerifier(table, metrics=self.metrics)
-        self.bls = BlsVerifierService(verifier)
+        self.bls = create_bls_service(verifier)
 
         # monitor (optional)
         self.monitor = None
@@ -410,6 +423,9 @@ class FullBeaconNode:
             [self.bls.can_accept_work],
             has_block_root=self.fork_choice.has_block,
             registry=self.registry,
+            # overflow drops charge the publisher (gossipsub P7) while
+            # the pipeline's high-water backpressure holds the pull loop
+            scorer=self.scorer,
         )
 
         # sync drivers (sources injected per peer/transport)
@@ -496,6 +512,12 @@ class FullBeaconNode:
 
         # clock wiring: processor ticks, boost lifecycle, cache pruning
         self.clock.on_slot(self.processor.on_clock_slot)
+        if self.scorer is not None:
+            # gossipsub decay interval == one slot (scoring.py
+            # decay_interval_ms): penalty counters must shrink every
+            # tick or a peer caught in one backpressure episode stays
+            # graylisted for the process lifetime
+            self.clock.on_slot(lambda _s: self.scorer.decay())
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
         self.clock.on_slot(self.prepare_scheduler.on_slot)

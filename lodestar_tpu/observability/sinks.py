@@ -142,6 +142,7 @@ def kernel_compile_snapshot() -> dict:
     hits = reg.get("lodestar_tpu_export_cache_hits_total")
     misses = reg.get("lodestar_tpu_export_cache_misses_total")
     trace_s = reg.get("lodestar_tpu_export_trace_seconds")
+    ops_jit_s = reg.get("lodestar_tpu_ops_jit_compile_seconds")
 
     def _label_total(metric) -> float:
         if metric is None:
@@ -155,9 +156,18 @@ def kernel_compile_snapshot() -> dict:
         "export_cache_misses": _label_total(misses),
         "export_trace_seconds": 0.0,
         "export_traces": 0,
+        # ops-boundary jax.jit first-dispatch totals (kernels/
+        # jit_dispatch.py) — the XLA:CPU compile time the round-7 traces
+        # showed eating the tier-1 budget, now a named number
+        "ops_jit_compile_seconds": 0.0,
+        "ops_jit_compiles": 0,
     }
     if trace_s is not None:
         for entry in trace_s.label_values():
             out["export_trace_seconds"] += trace_s.sum(entry)
             out["export_traces"] += trace_s.count(entry)
+    if ops_jit_s is not None:
+        for fn in ops_jit_s.label_values():
+            out["ops_jit_compile_seconds"] += ops_jit_s.sum(fn)
+            out["ops_jit_compiles"] += ops_jit_s.count(fn)
     return out

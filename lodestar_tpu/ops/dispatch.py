@@ -1,0 +1,18 @@
+"""The ops-boundary jit dispatcher — public alias.
+
+`ops_jit` is the drop-in `jax.jit` used at the `ops/`-layer jit roots
+(kernels/verify.py, kernels/ingest.py glue) so per-function XLA:CPU
+compile time is NAMED — an `ops.jit_compile` span in `trace_summary()`
+and a `lodestar_tpu_ops_jit_compile_seconds{fn}` histogram — the way
+`lodestar_tpu_export_trace_seconds{entry}` names export traces
+(dev/NOTES.md round-7 follow-up).
+
+The implementation lives in `kernels/jit_dispatch.py` (kernels/ is
+export-cache-fingerprinted wholesale, so kernel modules can import it
+without widening any entry's `sources=` contract); this module is the
+import point for everything outside kernels/.
+"""
+
+from ..kernels.jit_dispatch import ops_jit  # noqa: F401
+
+__all__ = ["ops_jit"]

@@ -392,6 +392,25 @@ class BlsPoolMetrics:
             "phase",
             _SECONDS,
         )
+        # accumulate-and-flush pipeline observability (ISSUE 11): how
+        # full the shape buckets are when they dispatch, why they
+        # dispatched, and how much work is resident end-to-end
+        self.bucket_fill_ratio = r.histogram(
+            "lodestar_bls_bucket_fill_ratio",
+            "Signature sets per flush over the padded device N-bucket",
+            [0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0],
+        )
+        self.flush_reason = r.labeled_counter(
+            "lodestar_bls_flush_reason_total",
+            "Pipeline bucket flushes by trigger (fill = exact bucket | "
+            "spill = partial, pushed out by an overshooting job | "
+            "deadline | close)",
+            "reason",
+        )
+        self.pipeline_pending_sets = r.gauge(
+            "lodestar_bls_pipeline_pending_sets",
+            "Buffered + queued + in-flight signature sets (high-water unit)",
+        )
 
 
 class BlsSingleThreadMetrics:

@@ -20,13 +20,22 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compilation cache under a REPO-LOCAL dir (ISSUE 11
+# satellite; dev/NOTES.md round-7): the fast tier's budget goes to
+# XLA:CPU `jax.jit` compiles of the ops/-layer glue, and /tmp caches
+# are wiped between driver sessions — a repo-local cache survives, so
+# repeat tier-1 runs start warm.  JAX_COMPILATION_CACHE_DIR overrides
+# (CI can point it at a shared volume).
+_JAX_CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_CACHE_DIR)
+
 import jax  # noqa: E402  (import order is the point here)
 
 if os.environ.get("LODESTAR_TPU_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the pairing kernels are compile-heavy, and
-# the cache makes repeat test runs start in seconds instead of minutes.
-jax.config.update("jax_compilation_cache_dir", "/tmp/lodestar_tpu_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _JAX_CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

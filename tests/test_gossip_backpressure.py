@@ -1,0 +1,201 @@
+"""Gossip-queue drop policies under sustained backpressure (ISSUE 11).
+
+The coupling under test: while the verification pipeline's high-water
+mark holds `can_accept_work()` False, the NetworkProcessor stops
+pulling, the per-topic queues overflow, and on every shed message the
+depth gauge, the dropped counter, AND the peer scorer's backpressure
+penalty fire together — then all three recover once the pipeline drains
+and the processor resumes.
+"""
+
+import pytest
+
+from lodestar_tpu.network.gossip_queues import (
+    DropByCount,
+    DropByRatio,
+    GossipQueue,
+    GossipQueueOpts,
+    GossipType,
+    QueueType,
+)
+from lodestar_tpu.network.processor import NetworkProcessor, PendingGossipMessage
+from lodestar_tpu.network.scoring import (
+    GOSSIP_SCORE_THRESHOLDS,
+    GossipPeerScorer,
+    PeerScoreParams,
+)
+from lodestar_tpu.utils.metrics import Registry
+
+pytestmark = pytest.mark.smoke
+
+
+def make_scorer():
+    return GossipPeerScorer(
+        PeerScoreParams(
+            behaviour_penalty_weight=-100.0,
+            behaviour_penalty_threshold=2.0,
+            behaviour_penalty_decay=0.2,
+            decay_to_zero=0.01,
+        )
+    )
+
+
+def make_processor(topic, opts, accept_flag, registry, scorer):
+    done = []
+    proc = NetworkProcessor(
+        lambda msg: done.append(msg),
+        [lambda: accept_flag["ok"]],
+        registry=registry,
+        scorer=scorer,
+    )
+    # shrink the topic's queue so overflow is reachable in a fast test;
+    # reuse the processor's metrics object (so the gauge/counter series
+    # under test are the production ones) and its per-item drop hook
+    metrics = proc.queues[topic].metrics
+    proc.queues[topic] = GossipQueue(
+        opts,
+        topic=topic.value,
+        metrics=metrics,
+        on_drop=proc._on_queue_drop if scorer is not None else None,
+    )
+    return proc, done
+
+
+def msg(topic, i, peer="flooder"):
+    return PendingGossipMessage(topic, ("payload", i), peer_id=peer)
+
+
+def test_drop_by_count_backpressure_fires_all_three_signals_and_recovers():
+    topic = GossipType.beacon_aggregate_and_proof  # LIFO, DropByCount
+    reg = Registry()
+    scorer = make_scorer()
+    accept = {"ok": False}  # pipeline saturated: processor must not pull
+    proc, done = make_processor(
+        topic,
+        GossipQueueOpts(QueueType.LIFO, 8, DropByCount(1)),
+        accept,
+        reg,
+        scorer,
+    )
+    for i in range(12):
+        proc.on_gossip_message(msg(topic, i))
+    assert done == []  # nothing pulled under backpressure
+    # the three signals fire together:
+    depth = reg.get("lodestar_gossip_queue_length")
+    dropped = reg.get("lodestar_gossip_queue_dropped_total")
+    assert depth.get(topic.value) == 8.0
+    assert dropped.get(topic.value) == 4.0
+    assert proc.stats.dropped == 4
+    assert scorer.behaviour_penalty("flooder") == 4.0
+    # 4 penalties, threshold 2 -> P7 = -100 * (4-2)^2
+    assert scorer.gossip_score("flooder") == pytest.approx(-400.0)
+    assert proc.stats.cannot_accept_ticks > 0
+
+    # drain: the pipeline catches up, the processor resumes pulling
+    accept["ok"] = True
+    proc.execute_work()
+    assert len(done) == 8
+    assert depth.get(topic.value) == 0.0
+    # no new drops or penalties after the drain
+    proc.on_gossip_message(msg(topic, 99))
+    assert dropped.get(topic.value) == 4.0
+    assert scorer.behaviour_penalty("flooder") == 4.0
+    # and the peer's score recovers as the penalty counter decays
+    for _ in range(10):
+        scorer.decay()
+    assert scorer.behaviour_penalty("flooder") == 0.0
+    assert scorer.gossip_score("flooder") == 0.0
+    assert not scorer.is_banned("flooder")
+
+
+def test_drop_by_ratio_escalates_and_charges_per_shed_message():
+    topic = GossipType.beacon_attestation  # LIFO, DropByRatio
+    reg = Registry()
+    scorer = make_scorer()
+    accept = {"ok": False}
+    proc, done = make_processor(
+        topic,
+        GossipQueueOpts(QueueType.LIFO, 10, DropByRatio(0.2, 0.2)),
+        accept,
+        reg,
+        scorer,
+    )
+    q = proc.queues[topic]
+    total_dropped = 0
+    for i in range(40):
+        proc.on_gossip_message(msg(topic, i))
+    dropped = reg.get("lodestar_gossip_queue_dropped_total")
+    depth = reg.get("lodestar_gossip_queue_length")
+    total_dropped = dropped.get(topic.value)
+    assert total_dropped > 0
+    # escalation: the ratio stepped past its start after repeat overflows
+    assert q.drop_ratio > 0.2
+    # every shed message charged the publisher, 1:1
+    assert scorer.behaviour_penalty("flooder") == total_dropped
+    assert depth.get(topic.value) == float(len(q))
+    assert scorer.gossip_score("flooder") < 0
+
+    # sustained flooding puts the peer past the graylist threshold
+    for i in range(300):
+        proc.on_gossip_message(msg(topic, 1000 + i))
+    assert scorer.is_banned("flooder")
+    assert (
+        scorer.gossip_score("flooder")
+        <= GOSSIP_SCORE_THRESHOLDS.graylist_threshold
+    )
+
+    # drain and recover
+    accept["ok"] = True
+    while proc.execute_work():
+        pass
+    assert depth.get(topic.value) == 0.0
+    for _ in range(60):
+        scorer.decay()
+    assert not scorer.is_banned("flooder")
+
+
+def test_drops_without_peer_attribution_do_not_charge():
+    topic = GossipType.beacon_aggregate_and_proof
+    reg = Registry()
+    scorer = make_scorer()
+    proc, _ = make_processor(
+        topic,
+        GossipQueueOpts(QueueType.LIFO, 4, DropByCount(1)),
+        {"ok": False},
+        reg,
+        scorer,
+    )
+    for i in range(8):
+        proc.on_gossip_message(msg(topic, i, peer=None))
+    assert reg.get("lodestar_gossip_queue_dropped_total").get(topic.value) == 4.0
+    assert scorer.behaviour_penalty("flooder") == 0.0
+    assert scorer._behaviour_penalties == {}
+
+
+def test_drops_charge_the_shed_messages_publisher_not_the_trigger():
+    """Review fix: a LIFO ratio-drop sheds the OLDEST backlog — the
+    flooder's — so an honest peer whose single publish overflows the
+    queue must not be the one charged."""
+    topic = GossipType.beacon_attestation
+    reg = Registry()
+    scorer = make_scorer()
+    proc, _ = make_processor(
+        topic,
+        GossipQueueOpts(QueueType.LIFO, 10, DropByRatio(0.2, 0.2)),
+        {"ok": False},
+        reg,
+        scorer,
+    )
+    for i in range(10):  # the flooder fills the queue exactly
+        proc.on_gossip_message(msg(topic, i, peer="flooder"))
+    assert scorer.behaviour_penalty("flooder") == 0.0  # no overflow yet
+    # one honest publish overflows: the shed messages are the flooder's
+    proc.on_gossip_message(msg(topic, 99, peer="honest"))
+    dropped = reg.get("lodestar_gossip_queue_dropped_total").get(topic.value)
+    assert dropped > 0
+    assert scorer.behaviour_penalty("honest") == 0.0
+    assert scorer.behaviour_penalty("flooder") == dropped
+    # the honest peer's message survived (LIFO keeps the newest)
+    assert any(
+        m.peer_id == "honest" for m in proc.queues[topic].get_all()
+    )

@@ -229,3 +229,53 @@ def test_bus_graylists_invalid_spammer_end_to_end(score_params):
     ok = bus.publish("honest", topic, encode_message(b"\xfe" * 40))
     assert ok == 1
     assert handlers.results["beacon_block"]["reject"] == before["reject"] + 1
+
+
+def test_backpressure_drop_charges_behaviour_penalty(score_params):
+    """ISSUE 11: shed messages under backpressure count on the gossipsub
+    BEHAVIOUR penalty (P7) — free below the threshold, quadratic above
+    it, decaying back to zero once the peer stops flooding."""
+    from lodestar_tpu.network.peers import PeerScoreBook
+
+    book = PeerScoreBook()
+    scorer = GossipPeerScorer(score_params, book)
+    t = score_params.behaviour_penalty_threshold
+    w = score_params.behaviour_penalty_weight
+    assert w < 0  # derived weight must punish
+    for _ in range(int(t)):
+        scorer.on_backpressure_drop("flooder", "some/topic")
+    # at the threshold the P7 term is still zero
+    assert scorer.gossip_score("flooder") == 0.0
+    assert scorer.behaviour_penalty("flooder") == t
+    scorer.on_backpressure_drop("flooder")
+    assert scorer.gossip_score("flooder") == pytest.approx(w * 1.0)
+    scorer.on_backpressure_drop("flooder")
+    assert scorer.gossip_score("flooder") == pytest.approx(w * 4.0)
+    # the app-level book observed one clamped unit per shed message
+    assert book.score("flooder") == pytest.approx(-(t + 2))
+    # an innocent peer is untouched
+    assert scorer.gossip_score("bystander") == 0.0
+    # decay: the counter shrinks by its per-interval factor and the
+    # peer recovers once it stops flooding
+    before = scorer.behaviour_penalty("flooder")
+    scorer.decay()
+    after = scorer.behaviour_penalty("flooder")
+    assert after == pytest.approx(
+        before * score_params.behaviour_penalty_decay
+    )
+    for _ in range(500):
+        scorer.decay()
+    assert scorer.behaviour_penalty("flooder") == 0.0
+    assert scorer.gossip_score("flooder") == 0.0
+
+
+def test_decay_shrinks_invalid_message_counters(score_params):
+    scorer = GossipPeerScorer(score_params)
+    topic = topic_string(DIGEST, GossipTopicName.beacon_block)
+    scorer.on_invalid_message("spammer", topic)
+    scorer.on_invalid_message("spammer", topic)
+    s0 = scorer.gossip_score("spammer")
+    assert s0 < 0
+    scorer.decay()
+    s1 = scorer.gossip_score("spammer")
+    assert s0 < s1 < 0  # penalty decayed toward zero, not past it

@@ -174,6 +174,31 @@ def test_partial_bucket_still_waits_for_deadline():
     assert sum(c[0] for c in stub.calls) == 20
 
 
+def test_flush_deadline_anchors_on_oldest_set():
+    """Regression (ISSUE 11 satellite): the flush timer anchors on the
+    OLDEST buffered set's enqueue time (`_Job.t_submit`, stamped before
+    lock acquisition) — staggered submits must flush one window after
+    the FIRST submit, so p99 submit->flush is actually bounded by
+    MAX_BUFFER_WAIT_MS."""
+    stub = HandleStub()
+    svc = BlsVerifierService(stub, buffer_wait_ms=400)
+    t0 = time.perf_counter()
+    fa = svc.verify_signature_sets_async(
+        [fake_set(0)], VerifyOptions(batchable=True)
+    )
+    time.sleep(0.35)  # inside the window
+    fb = svc.verify_signature_sets_async(
+        [fake_set(1)], VerifyOptions(batchable=True)
+    )
+    assert fa.result(timeout=5) and fb.result(timeout=5)
+    elapsed = time.perf_counter() - t0
+    svc.close()
+    # correct anchor: ~0.40s after the first submit; a timer re-anchored
+    # at the second submit would stretch to ~0.75s
+    assert elapsed < 0.62, f"flush took {elapsed:.3f}s — deadline re-anchored?"
+    assert sum(c[0] for c in stub.calls) == 2
+
+
 def test_non_batchable_jobs_bypass_buffer():
     stub = StubVerifier()
     svc = BlsVerifierService(stub, buffer_wait_ms=10_000)
