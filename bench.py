@@ -107,6 +107,59 @@ def _phase_snapshot() -> dict:
     return snap
 
 
+def _slo_snapshot() -> dict:
+    """The lodestar_slo_* breach counters from the process-global
+    registry (ISSUE 12) — zeros unless an SLO engine ran in-process,
+    but the shape is uniform so BENCH trend consumers can diff it.
+    Import stays lazy and failure-proof: the snapshot must attach even
+    on pre-jax probe failures."""
+    try:
+        from lodestar_tpu.observability.slo import breach_snapshot
+
+        return breach_snapshot()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not fail a run
+        return {"error": str(e)[:200]}
+
+
+# Flight recording on bench failure (ISSUE 12): a dead probe leaves a
+# loadable bundle (span ring, phase timings, SLO counters) instead of a
+# bare null.  On by default only under `python bench.py` (the __main__
+# blocks flip _FLIGHTREC_ON) or when BENCH_FLIGHTREC_DIR names a
+# directory — in-process stub tests stay side-effect-free.
+_FLIGHTREC_ON = False
+_FLIGHT_RECORDER = None
+
+
+def _bench_flight_record(stage: str, detail: str):
+    """Capture one failure bundle; returns its path or None (recorder
+    disabled, rate-limited, or itself broken)."""
+    global _FLIGHT_RECORDER
+    directory = os.environ.get("BENCH_FLIGHTREC_DIR")
+    if directory is None and not _FLIGHTREC_ON:
+        return None
+    try:
+        if _FLIGHT_RECORDER is None:
+            from lodestar_tpu.observability.flight_recorder import (
+                FlightRecorder,
+            )
+
+            _FLIGHT_RECORDER = FlightRecorder(
+                directory or "flightrec_bench",
+                # every distinct failure stage in one run matters; the
+                # caps still bound a crash loop re-running bench
+                min_interval_s=0.0,
+                max_bundles=8,
+            )
+            _FLIGHT_RECORDER.add_provider("phases", _phase_snapshot)
+            _FLIGHT_RECORDER.add_provider("slo", _slo_snapshot)
+        return _FLIGHT_RECORDER.record(
+            f"bench.{stage}", {"detail": detail[-2000:]}
+        )
+    except Exception as e:  # noqa: BLE001 — the recorder must never
+        print(f"# flight record failed: {e}", file=sys.stderr)
+        return None
+
+
 def _emit_failure(
     stage: str, detail: str, metric: str = None, unit: str = "sets/s"
 ) -> None:
@@ -119,7 +172,9 @@ def _emit_failure(
     BENCH_*.json consumers never average a failure into a trend.
     `metric`/`unit` default to the headline BLS metric; secondary probes
     (state_roots_per_s) pass their own so every skip record shares ONE
-    schema."""
+    schema.  Every skip also carries the SLO snapshot and — when the
+    recorder is on — the path of a flight-record bundle, so a dead
+    round is diagnosable from its artifacts alone (r03–r05 were not)."""
     print(
         json.dumps(
             {
@@ -130,6 +185,8 @@ def _emit_failure(
                 "skipped": True,
                 "error": f"{stage}: {detail}"[-2000:],
                 "phases": _phase_snapshot(),
+                "slo": _slo_snapshot(),
+                "flight_record": _bench_flight_record(stage, detail),
             }
         ),
         flush=True,
@@ -370,6 +427,7 @@ def _probe_state_roots() -> None:
         # alongside)
         record.setdefault("vs_baseline", None)
         record["phases"] = _phase_snapshot()
+        record["slo"] = _slo_snapshot()
         print(json.dumps(record), flush=True)
     except ValueError:
         _emit_failure(
@@ -377,6 +435,14 @@ def _probe_state_roots() -> None:
             metric="state_roots_per_s", unit="roots/s",
         )
 
+
+if __name__ == "__main__":
+    # the driver invocation records failure bundles by default
+    # (./flightrec_bench or BENCH_FLIGHTREC_DIR); in-process stub
+    # tests only record when they set the env var.  Flipped BEFORE the
+    # first possible _emit_failure (the config check below) so even a
+    # config failure leaves a bundle.
+    _FLIGHTREC_ON = True
 
 _BENCH_PLATFORM = os.environ.get("BENCH_PLATFORM", "tpu")
 if _BENCH_PLATFORM not in ("tpu", "cpu"):
@@ -513,6 +579,7 @@ def main_wire():
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                 "phases": _phase_snapshot(),
+                "slo": _slo_snapshot(),
             }
         )
     )
@@ -567,6 +634,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "unit": "sets/s",
                     "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                     "phases": _phase_snapshot(),
+                    "slo": _slo_snapshot(),
                 }
             ),
             flush=True,
@@ -636,6 +704,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "unit": "s",
                     "vs_baseline": None,
                     "phases": _phase_snapshot(),
+                    "slo": _slo_snapshot(),
                 }
             ),
             flush=True,
@@ -806,6 +875,7 @@ def _probe_pipeline(verifier) -> None:
                     ),
                     "flush_reasons": reasons,
                     "phases": _phase_snapshot(),
+                    "slo": _slo_snapshot(),
                 }
             ),
             flush=True,
@@ -874,6 +944,7 @@ def main_decoded():
                 "unit": "sets/s",
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                 "phases": _phase_snapshot(),
+                "slo": _slo_snapshot(),
             }
         )
     )

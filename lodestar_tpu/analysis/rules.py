@@ -40,6 +40,20 @@ dtype-discipline (warning)
     weak type changes with jax config) and must not embed int literals
     >= 2**31 (they overflow the int32 world the kernels run in).
 
+metric-hygiene (error; prefix is warning)
+    Every registered metric name must carry the ``lodestar_`` prefix
+    (reference-parity families — ``beacon_``, ``validator_monitor_``,
+    ``libp2p_`` — are allowlisted because the shipped Grafana
+    dashboards expect the upstream names verbatim).  One name must not
+    be registered twice with different metric types or label
+    dimensions: utils/metrics.Registry dedupes by name FIRST-WINS, so
+    the second registration silently reads/writes the wrong
+    instrument.  Label dimensions must be bounded: a per-peer /
+    per-slot / per-span-id label value grows the exposition without
+    limit and melts Prometheus — keys like peer_id, slot, span_id,
+    block_root are rejected both as declared label names and as
+    observed label values.
+
 node-hygiene (warning; bare except is error)
     Bare `except:` swallows KeyboardInterrupt/SystemExit — name the
     exception (the repo idiom is `except Exception:  # noqa: BLE001`
@@ -582,11 +596,236 @@ class NodeHygieneRule(Rule):
 
 # ---------------------------------------------------------------------------
 
+# utils/metrics.Registry registration methods (name is the first arg)
+_REG_METHODS = {
+    "counter",
+    "gauge",
+    "histogram",
+    "labeled_gauge",
+    "labeled_counter",
+    "labeled_histogram",
+}
+# metric families allowed WITHOUT the lodestar_ prefix: they mirror the
+# reference client's exposition verbatim so the shipped Grafana
+# dashboards keep working (utils/beacon_metrics.py, validator_monitor)
+_ALLOWED_PREFIXES = ("lodestar_", "beacon_", "validator_monitor_", "libp2p_")
+# label names/values whose cardinality is unbounded in a live node
+_UNBOUNDED_LABELS = {
+    "peer",
+    "peer_id",
+    "slot",
+    "span_id",
+    "parent_id",
+    "root",
+    "block_root",
+    "validator_index",
+    "epoch",
+}
+# labeled-metric write methods whose FIRST argument is a label value
+_LABEL_WRITE_METHODS = {"observe", "inc", "set"}
+
+
+class MetricHygieneRule(Rule):
+    name = "metric-hygiene"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # fully-resolved name -> [(signature, mod, node)] for the
+        # cross-module duplicate check; signature = (method, label)
+        registrations: dict = {}
+        for mod in project.modules.values():
+            # test modules register throwaway metrics around assertions
+            # (the fixture package carries the rule's own goldens)
+            if mod.modname.split(".")[-1].startswith("test_"):
+                continue
+            consts = self._str_assignments(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute
+                ):
+                    continue
+                attr = node.func.attr
+                if attr in _REG_METHODS and len(node.args) >= 2:
+                    self._check_registration(
+                        project, mod, node, attr, consts, registrations, out
+                    )
+                elif (
+                    attr in _LABEL_WRITE_METHODS and len(node.args) >= 2
+                ):
+                    self._check_label_value(mod, node, out)
+        for name, sites in registrations.items():
+            sigs = {sig for sig, _mod, _node in sites}
+            if len(sigs) <= 1:
+                continue
+            for sig, mod, node in sites[1:]:
+                if sig == sites[0][0]:
+                    continue
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"metric {name!r} re-registered as "
+                        f"{self._sig_str(sig)} after being registered "
+                        f"as {self._sig_str(sites[0][0])} "
+                        f"({sites[0][1].display_path}) — the Registry "
+                        f"dedupes by name first-wins, so this site "
+                        f"silently gets the other instrument",
+                    )
+                )
+        return out
+
+    def _check_registration(
+        self, project, mod, node, method, consts, registrations, out
+    ) -> None:
+        full, resolved = self._resolve_str(node.args[0], consts)
+        if resolved is None:
+            return  # dynamically built name: nothing to reason about
+        if resolved and not any(
+            resolved.startswith(p) or p.startswith(resolved)
+            for p in _ALLOWED_PREFIXES
+        ):
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"metric name {resolved + ('' if full else '...')!r} "
+                    f"lacks the lodestar_ prefix (allowed families: "
+                    f"{', '.join(_ALLOWED_PREFIXES)}) — unprefixed "
+                    f"names collide with other exporters on shared "
+                    f"Prometheus",
+                    severity="warning",
+                )
+            )
+        label = None
+        if method.startswith("labeled_"):
+            label_node = (
+                node.args[2]
+                if len(node.args) > 2
+                else next(
+                    (kw.value for kw in node.keywords if kw.arg == "label"),
+                    None,
+                )
+            )
+            if isinstance(label_node, ast.Constant) and isinstance(
+                label_node.value, str
+            ):
+                label = label_node.value
+                if label.lower() in _UNBOUNDED_LABELS:
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"label {label!r} on metric "
+                            f"{resolved!r} is unbounded-cardinality "
+                            f"(one series per {label}) — aggregate "
+                            f"before labelling or drop the dimension",
+                        )
+                    )
+        if full:
+            registrations.setdefault(resolved, []).append(
+                ((method, label), mod, node)
+            )
+
+    def _check_label_value(self, mod, node, out) -> None:
+        """First argument of `.observe/inc/set(label_value, x)` built
+        from an unbounded identifier (a bare `peer_id`, or an f-string
+        interpolating one) creates one series per value."""
+        arg = node.args[0]
+        bad = None
+        if isinstance(arg, ast.Name) and arg.id.lower() in _UNBOUNDED_LABELS:
+            bad = arg.id
+        elif isinstance(arg, ast.JoinedStr):
+            for part in arg.values:
+                if not isinstance(part, ast.FormattedValue):
+                    continue
+                for n in ast.walk(part.value):
+                    ident = (
+                        n.id
+                        if isinstance(n, ast.Name)
+                        else n.attr
+                        if isinstance(n, ast.Attribute)
+                        else None
+                    )
+                    if ident and ident.lower() in _UNBOUNDED_LABELS:
+                        bad = ident
+                        break
+        if bad is not None:
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"label value built from `{bad}` in "
+                    f"`.{node.func.attr}(...)` is unbounded-cardinality "
+                    f"(one series per {bad}) — bucket or aggregate the "
+                    f"dimension instead",
+                )
+            )
+
+    @staticmethod
+    def _sig_str(sig) -> str:
+        method, label = sig
+        return f"{method}(label={label!r})" if label else method
+
+    @staticmethod
+    def _str_assignments(tree: ast.AST) -> dict:
+        """name -> str for every simple `NAME = "literal"` assignment
+        anywhere in the module (prefix variables like
+        `p = "lodestar_bls_thread_pool_"`); last one wins."""
+        out: dict = {}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                out[node.targets[0].id] = node.value.value
+        return out
+
+    @classmethod
+    def _resolve_str(cls, node: ast.AST, consts: dict):
+        """(fully_resolved, text) — text is the statically-known
+        LEADING part of the name ('' when nothing is known, None when
+        the expression is not string-shaped)."""
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, str):
+                return True, node.value
+            return False, None
+        if isinstance(node, ast.Name):
+            if node.id in consts:
+                return True, consts[node.id]
+            return False, ""  # a string var we cannot see: no prefix info
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            lf, lt = cls._resolve_str(node.left, consts)
+            rf, rt = cls._resolve_str(node.right, consts)
+            if lt is None or rt is None:
+                return False, None
+            if lf and rf:
+                return True, lt + rt
+            return False, lt  # left's leading part is all we know
+        if isinstance(node, ast.JoinedStr):
+            text = ""
+            for part in node.values:
+                if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str
+                ):
+                    text += part.value
+                else:
+                    return False, text
+            return True, text
+        return False, None
+
+
+# ---------------------------------------------------------------------------
+
 ALL_RULES = [
     KernelPurityRule(),
     GatherHazardRule(),
     FingerprintCompletenessRule(),
     DtypeDisciplineRule(),
+    MetricHygieneRule(),
     NodeHygieneRule(),
 ]
 

@@ -246,6 +246,7 @@ ROUTES: Tuple[Route, ...] = (
     # events namespace (reference: routes/events.ts — SSE stream)
     Route("GET", "/eth/v1/events", "get_events"),
     # lodestar namespace (reference: api/impl/lodestar/index.ts)
+    Route("GET", "/eth/v1/lodestar/health", "get_lodestar_health"),
     Route("GET", "/eth/v1/lodestar/slasher", "get_slasher_status"),
     Route("GET", "/eth/v1/lodestar/gossip-queue-items/{gossip_type}", "dump_gossip_queue"),
     Route("GET", "/eth/v1/lodestar/bls-metrics", "get_bls_metrics"),

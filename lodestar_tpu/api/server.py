@@ -38,6 +38,8 @@ class DefaultHandlers:
         proposer_cache=None,
         kzg_setup=None,
         slasher=None,
+        slo=None,
+        flight_recorder=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -59,9 +61,23 @@ class DefaultHandlers:
         self.proposer_cache = proposer_cache  # prepare_beacon_proposer
         self.kzg_setup = kzg_setup  # deneb blob verification / publishing
         self.slasher = slasher  # SlasherService for the status route
+        self.slo = slo  # SloEngine for the lodestar health route
+        self.flight_recorder = flight_recorder  # bundle inventory
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
+
+    def get_lodestar_health(self, params, body):
+        """GET /eth/v1/lodestar/health — slot-anchored SLO status:
+        per-objective evaluation/breach counters and budgets, recent
+        breach details, anomaly events, and the flight recorder's
+        bundle inventory (observability/slo.py status shape)."""
+        if self.slo is None:
+            return 501, {"message": "slo engine not enabled"}
+        data = self.slo.status()
+        if self.flight_recorder is not None:
+            data["flight_recorder"] = self.flight_recorder.status()
+        return 200, {"data": data}
 
     def get_version(self, params, body):
         return 200, {"data": {"version": self.version}}

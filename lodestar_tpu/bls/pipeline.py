@@ -22,7 +22,13 @@ so the pipeline accumulates:
     deadline lane so they are never starved behind subnet-attestation
     fill; plain subnet attestations ride a longer window to maximize
     bucket occupancy.  Non-batchable jobs (block import) bypass
-    buffering entirely, exactly as in the base service.
+    buffering entirely, exactly as in the base service.  A critical
+    job submitted into an otherwise-IDLE pipeline (no queued groups,
+    no in-flight device work, no other accumulating bucket) flushes
+    immediately (`reason=idle`): the window only buys occupancy when
+    something could coalesce with it, and synchronous submitters —
+    the full-node gossip loop verifying aggregates one at a time —
+    must not serialize a pure lane-window wait per message.
   - **Deadlines anchor on the oldest set.**  Each accumulator's flush
     timer is `oldest_job.t_submit + lane_wait` (stamped before lock
     acquisition), so p99 submit->flush latency is bounded by the lane
@@ -48,6 +54,7 @@ return the PR 10 flat-buffer `BlsVerifierService` instead.
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -128,6 +135,10 @@ class BlsVerificationPipeline(BlsVerifierService):
         }
         self._high_water_sets = high_water_sets
         self._flush_records: deque = deque(maxlen=512)
+        # monotone per-flush sequence: incremental consumers (the SLO
+        # engine's per-slot critical-lane p99) remember the last seq
+        # they saw instead of re-counting the ring
+        self._flush_seq = 0
         kwargs.setdefault("max_buffered_sigs", N_BUCKETS[-1])
         kwargs.setdefault("buffer_wait_ms", standard_wait_ms)
         # backpressure is counted in SETS here: the inherited job cap
@@ -207,10 +218,32 @@ class BlsVerificationPipeline(BlsVerifierService):
             # after a spill): same padding-free dispatch, no deadline
             self._flush_bucket_locked(key, "fill")
             return
+        if key[2] == LANE_CRITICAL and self._pipeline_idle_locked(key):
+            # adaptive batching (ISSUE 12 review fix): waiting out the
+            # critical window only buys occupancy when OTHER work could
+            # join or the device is busy anyway.  A lone critical job
+            # submitted into an otherwise-idle pipeline — the full-node
+            # gossip loop verifying aggregates SYNCHRONOUSLY, one at a
+            # time — would serialize a pure 25 ms idle wait per
+            # message; flush it now instead.  Under load (queued
+            # groups, in-flight device work, or other accumulating
+            # buckets) criticals still coalesce toward the deadline.
+            self._flush_bucket_locked(key, "idle")
+            return
         if acc.deadline is None:
             # anchor on the oldest buffered set's enqueue time (stamped
             # in _Job.__init__, before lock acquisition)
             acc.deadline = job.t_submit + self._lane_wait[key[2]]
+
+    def _pipeline_idle_locked(self, key: Tuple[bool, int, str]) -> bool:
+        """Nothing for a critical job to overlap with: no dispatch-
+        queued groups, no in-flight device work, and no OTHER
+        accumulator holding sets that will flush soon."""
+        if self._queue or self._inflight_groups:
+            return False
+        return not any(
+            acc.sets for k, acc in self._buckets.items() if k != key
+        )
 
     # -- the flush side ---------------------------------------------------
 
@@ -222,8 +255,14 @@ class BlsVerificationPipeline(BlsVerifierService):
         pad = _padded_lanes(acc.sets, self._max_fill)
         ratio = min(acc.sets / pad, 1.0)
         wire, k_bucket, lane = key
+        # submit->flush wait of the OLDEST buffered job — the quantity
+        # the lane deadline bounds, and the series the SLO engine's
+        # pipeline_critical_p99 objective evaluates per slot (jobs
+        # append in arrival order, so jobs[0] is the anchor)
+        oldest_wait = time.perf_counter() - acc.jobs[0].t_submit
         self.metrics.bucket_fill_ratio.observe(ratio)
         self.metrics.flush_reason.inc(reason, 1.0)
+        self._flush_seq += 1
         with _trace_span(
             "bls.pipeline.flush",
             reason=reason,
@@ -232,9 +271,11 @@ class BlsVerificationPipeline(BlsVerifierService):
             k_bucket=k_bucket,
             sets=acc.sets,
             n_bucket=pad,
+            oldest_wait_s=oldest_wait,
         ):
             self._flush_records.append(
                 {
+                    "seq": self._flush_seq,
                     "reason": reason,
                     "lane": lane,
                     "wire": wire,
@@ -242,6 +283,7 @@ class BlsVerificationPipeline(BlsVerifierService):
                     "sets": acc.sets,
                     "n_bucket": pad,
                     "fill_ratio": ratio,
+                    "oldest_wait_s": oldest_wait,
                 }
             )
 

@@ -120,6 +120,10 @@ class BlsVerifierService:
         # The bounded in-flight queue pipelines dispatch latency.
         self._inflight: "SimpleQueue" = SimpleQueue()
         self._inflight_slots = threading.Semaphore(max_inflight_jobs)
+        # groups begun but not yet resolved — the pipeline's critical-
+        # lane idle test reads this (under the lock): batching is only
+        # worth waiting for while the device has work to overlap with
+        self._inflight_groups = 0
         # BlsWorkResult-parity records of recent device jobs (reference:
         # multithread/types.ts:26-38 — workerId, batchRetries,
         # batchSigsSuccess, workerStartNs, workerEndNs)
@@ -322,6 +326,8 @@ class BlsVerifierService:
                 self._lock.notify_all()
             return
         self._inflight_slots.acquire()  # backpressure: bounded in-flight
+        with self._lock:
+            self._inflight_groups += 1
         self._inflight.put((group, handles, t0, dispatch_start_ns))
 
     def _resolve_loop(self) -> None:
@@ -465,6 +471,7 @@ class BlsVerifierService:
                 with self._lock:
                     self._pending -= len(group)
                     self._pending_sets -= sum(len(j.sets) for j in group)
+                    self._inflight_groups -= 1
                     self.metrics.pipeline_pending_sets.set(self._pending_sets)
                     self.metrics.queue_length.set(self._pending)
                     self._lock.notify_all()

@@ -107,6 +107,12 @@ class BeaconChain:
         # optional SlasherService (slasher/service.py): fed every
         # imported block header; pruned on finalization below
         self.slasher = None
+        # optional import-completion observer `fn(slot)` (ISSUE 12):
+        # the SLO engine timestamps completed imports against the slot
+        # deadlines here.  Distinct from ChainEvent.block because it
+        # must fire exception-isolated and AFTER the head update — the
+        # moment the imported block is actually usable downstream.
+        self.on_import_complete = None
         # beacon root -> execution block hash (payload-carrying blocks)
         self._execution_block_hash: Dict[str, bytes] = {}
         # roots imported optimistically (EL said SYNCING/ACCEPTED)
@@ -488,6 +494,12 @@ class BeaconChain:
         self._notify_forkchoice()
         if self.monitor is not None and self.monitor.tracked_indices:
             self._monitor_imported_block(view, post, signed_block)
+        if self.on_import_complete is not None:
+            try:
+                self.on_import_complete(int(block["slot"]))
+            except Exception as e:  # noqa: BLE001 — SLO bookkeeping
+                # must never fail an already-landed import
+                self.log.warn("import-complete observer failed", error=str(e))
         return root
 
     def _monitor_imported_block(self, view, post, signed_block) -> None:

@@ -87,9 +87,21 @@ class GossipValidators:
     pool insertion + fork-choice updates + seen-cache marking.
     """
 
-    def __init__(self, chain, verifier, current_slot_fn=None):
+    def __init__(self, chain, verifier, current_slot_fn=None, bls_service=None):
         self.chain = chain
         self.verifier = verifier
+        # optional BlsVerifierService/BlsVerificationPipeline: block-
+        # critical verifications (aggregate-and-proof's three-set job,
+        # duplicate-proposer signatures) ride its 25 ms critical lane
+        # (`VerifyOptions(priority=True)`) instead of a synchronous
+        # raw-verifier call — they coalesce with other critical sets
+        # and can never be starved behind subnet-attestation bucket
+        # fill (ISSUE 12 satellite, the PR 11 ROADMAP leftover).
+        # Subnet attestations stay on the raw verifier: their verdict
+        # gates the synchronous gossip forward decision, and the
+        # standard lane's 250 ms window is not a price this call site
+        # can pay per message.
+        self.service = bls_service
         # wall-clock slot source (the node's Clock).  Without one the
         # head slot is the fallback — degraded when the head lags (fresh
         # messages beyond head+1 are ignored), so live compositions
@@ -165,11 +177,31 @@ class GossipValidators:
         if not self.chain.fork_choice.has_block(bytes(root).hex()):
             _ignore(f"unknown block root {bytes(root).hex()[:16]}")
 
-    def _verify(self, sets: Sequence[WireSignatureSet]) -> None:
-        ok = self.verifier.verify_signature_sets(
-            list(sets), VerifyOptions(batchable=True)
+    def _verify_ok(
+        self, sets: Sequence[WireSignatureSet], priority: bool = False
+    ) -> bool:
+        """ONE home for the lane-routing policy: priority verifications
+        ride the service's critical lane when a service is wired,
+        everything else (and service-less compositions) verifies
+        synchronously on the raw verifier.  Callers that score rather
+        than reject (the duplicate-proposer slasher path) read the bool;
+        gossip validators raise through `_verify`."""
+        if priority and self.service is not None:
+            return bool(
+                self.service.verify_signature_sets(
+                    list(sets), VerifyOptions(batchable=True, priority=True)
+                )
+            )
+        return bool(
+            self.verifier.verify_signature_sets(
+                list(sets), VerifyOptions(batchable=True)
+            )
         )
-        if not ok:
+
+    def _verify(
+        self, sets: Sequence[WireSignatureSet], priority: bool = False
+    ) -> None:
+        if not self._verify_ok(sets, priority=priority):
             _reject("signature verification failed")
 
     # -- beacon_attestation_{subnet} (reference: validation/attestation.ts)
@@ -268,6 +300,7 @@ class GossipValidators:
         ):
             _reject("selection proof does not select aggregator")
         # THREE statements, ONE verifier job (aggregateAndProof.ts:166-172)
+        # — block-critical, so it rides the service's 25 ms lane
         sets = [
             get_selection_proof_signature_set(
                 view, slot, aggregator, msg["selection_proof"]
@@ -275,7 +308,7 @@ class GossipValidators:
             get_aggregate_and_proof_signature_set(view, signed_agg),
             get_indexed_attestation_signature_set(view, indexed),
         ]
-        self._verify(sets)
+        self._verify(sets, priority=True)
         if self.seen_aggregators.is_known(epoch, aggregator):
             _ignore("aggregator seen while verifying")
         self.seen_aggregators.add(epoch, aggregator)

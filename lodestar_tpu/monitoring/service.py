@@ -29,6 +29,7 @@ class MonitoringService:
         bls_metrics=None,
         beacon_metrics=None,
         validator_monitor=None,
+        slo=None,
         interval_s: float = 60.0,
         collect_system: bool = True,
         timeout_s: float = 10.0,
@@ -40,6 +41,8 @@ class MonitoringService:
         self.beacon_metrics = beacon_metrics
         # utils/validator_monitor.ValidatorMonitor: duty performance
         self.validator_monitor = validator_monitor
+        # observability/slo.SloEngine: per-objective breach counters
+        self.slo = slo
         self.interval_s = interval_s
         self.collect_system = collect_system
         self.timeout_s = timeout_s
@@ -87,6 +90,20 @@ class MonitoringService:
                 phase: float(m.verify_seconds.sum(phase))
                 for phase in m.verify_seconds.label_values()
             }
+        if self.slo is not None:
+            # slot-anchored SLO health (ISSUE 12): remote collectors
+            # see the same breach counters /eth/v1/lodestar/health
+            # serves, reduced to per-objective totals
+            try:
+                status = self.slo.status()
+                beacon["slo_status"] = status["status"]
+                beacon["slo_breaches"] = {
+                    obj: entry["breaches"]
+                    for obj, entry in status["objectives"].items()
+                }
+                beacon["slo_last_breach_slot"] = status["last_breach_slot"]
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                pass
         if self.beacon_metrics is not None:
             bm = self.beacon_metrics
             beacon["block_import_seconds_total"] = float(

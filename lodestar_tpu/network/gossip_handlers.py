@@ -38,10 +38,24 @@ class GossipHandlers:
     reference's message deserialization errors.
     """
 
-    def __init__(self, chain, verifier, current_slot_fn=None, kzg_setup=None):
+    def __init__(
+        self,
+        chain,
+        verifier,
+        current_slot_fn=None,
+        kzg_setup=None,
+        bls_service=None,
+    ):
         self.chain = chain
+        # `bls_service` (the node's BlsVerifierService/pipeline) routes
+        # block-critical verifications onto the 25 ms critical lane
+        # (validation.py `_verify(priority=True)`); without one, every
+        # verification stays on the raw verifier exactly as before
         self.validators = GossipValidators(
-            chain, verifier, current_slot_fn=current_slot_fn
+            chain,
+            verifier,
+            current_slot_fn=current_slot_fn,
+            bls_service=bls_service,
         )
         self.log = get_logger("network/gossip_handlers")
         self.seen_block_proposers = SeenBlockProposers()
@@ -157,7 +171,6 @@ class GossipHandlers:
         the slashing dry-run re-checks anyway)."""
         from .. import params as _p
         from ..bls.signature_set import WireSignatureSet
-        from ..bls.verifier import VerifyOptions
 
         block = signed["message"]
         slot = int(block["slot"])
@@ -167,9 +180,11 @@ class GossipHandlers:
             cfg.get_fork_types(slot)[0].hash_tree_root(block),
             cfg.get_domain(slot, _p.DOMAIN_BEACON_PROPOSER, slot),
         )
-        ok = self.validators.verifier.verify_signature_sets(
+        # a proposer signature is a critical-lane verification whenever
+        # the service is wired (same lane-routing seam as aggregates)
+        ok = self.validators._verify_ok(
             [WireSignatureSet.single(proposer, root, bytes(signed["signature"]))],
-            VerifyOptions(batchable=True),
+            priority=True,
         )
         if ok:
             self.slasher.ingest_block(signed, trusted=True)

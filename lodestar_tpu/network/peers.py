@@ -109,6 +109,13 @@ class PeerScoreBook:
             return ScoreState.disconnected
         return ScoreState.healthy
 
+    def snapshot(self) -> dict:
+        """peer_id -> decayed score, over a COPY of the book — the
+        flight recorder's provider reads this while network callbacks
+        insert peers, so it must neither iterate the live dict nor
+        hand out pre-decay scores."""
+        return {pid: self.score(pid) for pid in list(self._peers)}
+
     # -- status handshake (peerManager.ts assertPeerRelevance) -------------
 
     def on_status(self, peer_id: str, status: PeerStatus) -> None:

@@ -127,6 +127,14 @@ class NetworkProcessor:
         self.max_jobs_per_tick = max_jobs_per_tick
         self.stats = ProcessorStats()
         self.current_slot = 0
+        # anomaly hook (ISSUE 12): called ONCE per slot, the first time
+        # a tick stalls on downstream backpressure — the flight
+        # recorder's "backpressure trip" trigger.  Edge-triggered (the
+        # per-tick stall count lives in stats.cannot_accept_ticks) and
+        # re-armed by the slot clock, so a saturated slot costs one
+        # callback, not one per stalled pull.
+        self.on_backpressure_trip: Optional[Callable[[int], None]] = None
+        self._backpressure_reported = False
         # slot -> root -> [messages awaiting that block]
         self._awaiting: Dict[int, Dict[str, List[PendingGossipMessage]]] = {}
         self._awaiting_count = 0
@@ -188,6 +196,7 @@ class NetworkProcessor:
 
     def on_clock_slot(self, slot: int) -> None:
         self.current_slot = slot
+        self._backpressure_reported = False  # re-arm the trip hook
         # awaiting messages are pruned every slot (reference: index.ts:281-299)
         for s in list(self._awaiting):
             if s < slot:
@@ -211,6 +220,7 @@ class NetworkProcessor:
                 if not accept and not bypass:
                     self.stats.cannot_accept_ticks += 1
                     self.stats.submitted += submitted
+                    self._notify_backpressure_trip()
                     return submitted
                 item = self.queues[topic].next()
                 if item is not None:
@@ -222,6 +232,15 @@ class NetworkProcessor:
                 break
         self.stats.submitted += submitted
         return submitted
+
+    def _notify_backpressure_trip(self) -> None:
+        if self._backpressure_reported or self.on_backpressure_trip is None:
+            return
+        self._backpressure_reported = True
+        try:
+            self.on_backpressure_trip(self.current_slot)
+        except Exception:  # noqa: BLE001 — an observer fault must not
+            pass  # break the scheduling loop
 
     # -- introspection (reference: dumpGossipQueue) ------------------------
 

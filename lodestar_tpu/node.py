@@ -77,6 +77,13 @@ class NodeOptions:
     run_slasher: bool = True
     # slasher surround-history window in epochs (Lighthouse default)
     slasher_history_length: int = 4096
+    # slot-anchored SLO engine (observability/slo.py): per-slot
+    # deadline evaluation + time-series sampling; on by default (the
+    # tick costs < 1 ms) — flip off for minimal compositions
+    run_slo: bool = True
+    # flight-recorder output directory (observability/flight_recorder):
+    # None = breaches only count, nothing is captured to disk
+    flightrec_dir: Optional[str] = None
 
 
 class BeaconNode:
@@ -378,6 +385,9 @@ class FullBeaconNode:
             verifier,
             current_slot_fn=lambda: self.clock.current_slot,
             kzg_setup=opts.kzg_setup,
+            # aggregate/proposer verifications ride the service's 25 ms
+            # critical lane (ISSUE 12 satellite; PR 11 ROADMAP leftover)
+            bls_service=self.bls,
         )
         # verified gossip attestations/aggregates + duplicate-proposer
         # blocks feed the slasher (imported blocks arrive via the chain)
@@ -427,6 +437,157 @@ class FullBeaconNode:
             # the pipeline's high-water backpressure holds the pull loop
             scorer=self.scorer,
         )
+
+        # slot-anchored SLO engine + flight recorder (ISSUE 12): the
+        # engine evaluates the protocol's per-slot deadlines from the
+        # instrumentation the subsystems above already emit; the
+        # recorder captures a bounded forensic bundle on breach/anomaly
+        self.slo = None
+        self.flight_recorder = None
+        if opts.run_slo:
+            from .observability.slo import (
+                QUEUE_DROP_BURST_THRESHOLD,
+                SloEngine,
+            )
+            from .observability.timeseries import (
+                MetricsSampler,
+                TimeSeriesRing,
+                histogram_totals,
+                labeled_total,
+            )
+
+            ring = TimeSeriesRing()
+            if opts.flightrec_dir:
+                from .observability.flight_recorder import FlightRecorder
+
+                self.flight_recorder = FlightRecorder(
+                    opts.flightrec_dir,
+                    registry=self.registry,
+                    timeseries=ring,
+                )
+            sampler = MetricsSampler(ring)
+            reg = self.registry
+            m = self.metrics
+            # levels: where the pipeline and the gossip queues ARE
+            sampler.add_gauge(
+                "pipeline_pending_sets",
+                lambda: m.pipeline_pending_sets.value,
+            )
+            sampler.add_gauge(
+                "gossip_queue_depth",
+                lambda: sum(len(q) for q in self.processor.queues.values()),
+            )
+            # per-slot rates: what the interval COST (histogram deltas)
+            sampler.add_delta(
+                "bucket_fill_ratio_sum", lambda: m.bucket_fill_ratio.sum
+            )
+            sampler.add_delta(
+                "bucket_fill_ratio_count", lambda: m.bucket_fill_ratio.count
+            )
+            sampler.add_delta(
+                "gossip_queue_latency_seconds",
+                lambda: histogram_totals(
+                    reg.get("lodestar_gossip_queue_latency_seconds")
+                )[1],
+            )
+            sampler.add_delta(
+                "gossip_queue_dropped",
+                lambda: labeled_total(
+                    reg.get("lodestar_gossip_queue_dropped_total")
+                ),
+            )
+            sampler.add_delta(
+                "import_phase_seconds",
+                lambda: histogram_totals(
+                    reg.get("lodestar_block_import_phase_seconds")
+                )[1],
+            )
+            from .observability import kernel_compile_snapshot
+
+            def _compile_seconds_total() -> float:
+                snap = kernel_compile_snapshot()  # ONE read per sample
+                return (
+                    snap["ops_jit_compile_seconds"]
+                    + snap["export_trace_seconds"]
+                )
+
+            sampler.add_delta("compile_seconds", _compile_seconds_total)
+            self.slo = SloEngine(
+                self.clock,
+                registry=self.registry,
+                recorder=self.flight_recorder,
+                sampler=sampler,
+                pipeline=(
+                    self.bls if hasattr(self.bls, "flush_stats") else None
+                ),
+            )
+            # anomaly watchers: cumulative counters, per-slot deltas
+            self.slo.add_watcher(
+                "queue_drop_burst",
+                lambda: labeled_total(
+                    reg.get("lodestar_gossip_queue_dropped_total")
+                ),
+                threshold=QUEUE_DROP_BURST_THRESHOLD,
+            )
+            self.slo.add_watcher(
+                "rlc_bisection", lambda: m.rlc_fallback.value, threshold=1.0
+            )
+            # event triggers: edge-triggered backpressure trip from the
+            # processor, import completion from the chain, first
+            # verified attestation per slot from the pool feed
+            self.processor.on_backpressure_trip = (
+                lambda slot: self.slo.anomaly(
+                    "backpressure_trip", {"slot": slot}
+                )
+            )
+            self.chain.on_import_complete = self.slo.on_block_imported
+            self.chain.emitter.on(
+                ChainEvent.attestation,
+                lambda att: self.slo.on_attestation(
+                    int(att["data"]["slot"])
+                ),
+            )
+            if self.flight_recorder is not None:
+                fr = self.flight_recorder
+                fr.add_provider(
+                    "metrics",
+                    lambda: self.registry.expose(),
+                )
+                fr.add_provider(
+                    "flush_stats",
+                    lambda: (
+                        self.bls.flush_stats()
+                        if hasattr(self.bls, "flush_stats")
+                        else []
+                    ),
+                )
+                fr.add_provider("scoring", self.score_book.snapshot)
+                fr.add_provider(
+                    "head",
+                    lambda: {
+                        "head_root": self.chain.head_root_hex,
+                        "head_slot": int(self.chain.head_state.slot),
+                        "finalized_epoch": int(
+                            self.chain.head_state.finalized_checkpoint[
+                                "epoch"
+                            ]
+                        ),
+                        "imported_blocks": int(self.chain.imported_blocks),
+                        "clock_slot": self.clock.current_slot,
+                    },
+                )
+                fr.add_provider(
+                    "queues",
+                    lambda: {
+                        "lengths": self.processor.queue_lengths(),
+                        "submitted": self.processor.stats.submitted,
+                        "dropped": self.processor.stats.dropped,
+                        "cannot_accept_ticks": (
+                            self.processor.stats.cannot_accept_ticks
+                        ),
+                    },
+                )
+                fr.add_provider("slo", lambda: self.slo.status())
 
         # sync drivers (sources injected per peer/transport)
         self.range_sync = RangeSync(self.chain, kzg_setup=opts.kzg_setup)
@@ -512,6 +673,9 @@ class FullBeaconNode:
 
         # clock wiring: processor ticks, boost lifecycle, cache pruning
         self.clock.on_slot(self.processor.on_clock_slot)
+        if self.slo is not None:
+            # SLO evaluation + time-series sample once per slot tick
+            self.clock.on_slot(self.slo.on_slot)
         if self.scorer is not None:
             # gossipsub decay interval == one slot (scoring.py
             # decay_interval_ms): penalty counters must shrink every
@@ -589,6 +753,8 @@ class FullBeaconNode:
                     validator_store=opts.validator_store,
                     kzg_setup=opts.kzg_setup,
                     slasher=self.slasher,
+                    slo=self.slo,
+                    flight_recorder=self.flight_recorder,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)

@@ -30,6 +30,13 @@ from .sinks import (  # noqa: F401
     trace_summary,
     write_chrome_trace,
 )
+from .timeseries import MetricsSampler, TimeSeriesRing  # noqa: F401
+from .slo import SloEngine, breach_snapshot  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    list_bundles,
+    load_bundle,
+)
 
 # every process with tracing gets the /metrics derivation for free
 install_registry_sink()
@@ -47,4 +54,11 @@ __all__ = [
     "kernel_compile_snapshot",
     "trace_summary",
     "write_chrome_trace",
+    "MetricsSampler",
+    "TimeSeriesRing",
+    "SloEngine",
+    "breach_snapshot",
+    "FlightRecorder",
+    "list_bundles",
+    "load_bundle",
 ]

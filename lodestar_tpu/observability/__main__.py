@@ -1,14 +1,23 @@
-"""CLI: inspect traces — `python -m lodestar_tpu.observability`.
+"""CLI: inspect traces, SLO health, flight records.
 
     python -m lodestar_tpu.observability summary trace.json
     python -m lodestar_tpu.observability summary --url http://127.0.0.1:9100
     python -m lodestar_tpu.observability dump --url http://127.0.0.1:9100 --out trace.json
+    python -m lodestar_tpu.observability health --url http://127.0.0.1:9596
+    python -m lodestar_tpu.observability flightrec ./flightrec
+    python -m lodestar_tpu.observability flightrec ./flightrec/fr-000001-slo.import_before_boundary
 
 `summary` prints top spans by SELF time plus kernel compile totals;
 `dump` writes a loadable Chrome trace JSON.  Sources, in precedence
 order: an explicit file, `--url` (a metrics server's GET /trace), or
 this process's own ring (empty unless something traced in-process).
-Exit 0 on success, 2 on usage/load errors.
+`health` queries a live node's `GET /eth/v1/lodestar/health` (the
+beacon API base goes in --url) and exits 1 when the SLO engine reports
+degraded.  `flightrec` lists the bundles under a recorder directory,
+or — pointed at one bundle — prints its manifest and validates the
+captured trace/time-series load.
+Exit 0 on success (healthy), 1 on degraded health, 2 on usage/load
+errors.
 """
 
 from __future__ import annotations
@@ -63,15 +72,122 @@ def _load(path: Optional[str], url: Optional[str]) -> List[SpanRecord]:
     return get_tracer().snapshot()
 
 
+def _cmd_health(args) -> int:
+    if not args.url:
+        print("error: health needs --url <beacon api base>", file=sys.stderr)
+        return 2
+    import urllib.request
+
+    endpoint = args.url.rstrip("/") + "/eth/v1/lodestar/health"
+    try:
+        with urllib.request.urlopen(endpoint, timeout=30) as resp:
+            data = json.loads(resp.read())["data"]
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: could not load health: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(data, sys.stdout, indent=2)
+        print()
+    else:
+        print(
+            f"status: {data['status']} | slot {data['current_slot']} | "
+            f"last breach slot {data['last_breach_slot']}"
+        )
+        print(f"{'objective':<34} {'evals':>8} {'breaches':>9} {'budget s':>9}")
+        for obj, row in data.get("objectives", {}).items():
+            print(
+                f"{obj:<34} {row['evaluations']:>8.0f} "
+                f"{row['breaches']:>9.0f} {row['budget_s']:>9.3f}"
+            )
+        for name, count in data.get("anomaly_events", {}).items():
+            print(f"anomaly {name}: {count:.0f}")
+        fr = data.get("flight_recorder")
+        if fr:
+            print(
+                f"flight recorder: {fr['bundles']} bundles, "
+                f"{fr['total_bytes']} bytes in {fr['directory']} "
+                f"({fr['suppressed']:.0f} suppressed)"
+            )
+        for b in data.get("recent_breaches", [])[-5:]:
+            print(f"breach {b}")
+    return 1 if data.get("status") == "degraded" else 0
+
+
+def _cmd_flightrec(args) -> int:
+    import os
+
+    from .flight_recorder import MANIFEST, list_bundles, load_bundle
+
+    target = args.file or "flightrec"
+    if os.path.isfile(os.path.join(target, MANIFEST)):
+        # one bundle: show the manifest + validate the capture loads
+        try:
+            bundle = load_bundle(target)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"error: could not load bundle: {e}", file=sys.stderr)
+            return 2
+        trace = bundle["files"].get("trace.json") or {}
+        ts = bundle["files"].get("timeseries.json") or []
+        summary = {
+            "manifest": bundle["manifest"],
+            "trace_events": len(trace.get("traceEvents", ())),
+            "timeseries_rows": len(ts),
+        }
+        if args.json:
+            json.dump(summary, sys.stdout, indent=2, default=str)
+            print()
+        else:
+            m = bundle["manifest"]
+            print(f"reason: {m['reason']}  created: {m['created_unix']}")
+            print(f"context: {m.get('context')}")
+            print(
+                f"files: {', '.join(m.get('files', []))} | "
+                f"{summary['trace_events']} trace events, "
+                f"{summary['timeseries_rows']} time-series rows"
+            )
+        return 0
+    bundles = list_bundles(target)
+    if args.json:
+        json.dump(bundles, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    if not bundles:
+        print(f"no bundles under {target}")
+        return 0
+    print(f"{'bundle':<56} {'bytes':>9} reason")
+    for b in bundles:
+        print(
+            f"{os.path.basename(b['path']):<56} {b['bytes']:>9} "
+            f"{b.get('reason', b.get('error', '?'))}"
+        )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m lodestar_tpu.observability")
-    ap.add_argument("command", choices=("summary", "dump"))
-    ap.add_argument("file", nargs="?", help="Chrome trace JSON to read")
-    ap.add_argument("--url", help="live node metrics server (GET /trace)")
+    ap.add_argument(
+        "command", choices=("summary", "dump", "health", "flightrec")
+    )
+    ap.add_argument(
+        "file",
+        nargs="?",
+        help="Chrome trace JSON to read, or (flightrec) a recorder "
+        "directory / single bundle",
+    )
+    ap.add_argument(
+        "--url",
+        help="live node: metrics server (GET /trace) for summary/dump, "
+        "beacon API base for health",
+    )
     ap.add_argument("--out", help="dump: write here instead of stdout")
     ap.add_argument("--top", type=int, default=20, help="summary rows")
     ap.add_argument("--json", action="store_true", help="machine output")
     args = ap.parse_args(argv)
+
+    if args.command == "health":
+        return _cmd_health(args)
+    if args.command == "flightrec":
+        return _cmd_flightrec(args)
 
     try:
         records = _load(args.file, args.url)

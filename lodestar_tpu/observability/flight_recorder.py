@@ -1,0 +1,302 @@
+"""Flight recorder — capture state WHEN it matters, bounded ALWAYS.
+
+Bench rounds r03–r05 died as bare nulls: by the time anyone looked, the
+span ring, the metrics, and the pipeline's flush history were gone with
+the process.  The recorder turns anomalies (SLO breaches, backpressure
+trips, RLC bisections, queue-drop bursts, bench probe failures) into
+on-disk bundles captured AT the anomaly, while three hard bounds keep a
+breach storm from becoming its own outage:
+
+  - **rate limit** — at most one bundle per ``min_interval_s`` (further
+    triggers count on ``lodestar_flight_recorder_suppressed_total`` and
+    are dropped; the FIRST bundle of a storm is the useful one),
+  - **bundle count** — at most ``max_bundles`` on disk, oldest pruned,
+  - **byte cap** — total recorder directory size <= ``max_total_bytes``,
+    oldest pruned first (the newest bundle always survives).
+
+One bundle is a directory::
+
+    fr-000042-slo.import_before_boundary/
+        manifest.json     # schema, reason, context, created, file list
+        trace.json        # Chrome trace of the span ring (PR 8 sinks)
+        timeseries.json   # the MetricsSampler window (timeseries.py)
+        <provider>.json   # each registered provider's payload
+        <provider>.txt    # ... or text, when the provider returns str
+
+Providers are late-bound callables (metrics exposition, pipeline
+``flush_stats()``, peer-scoring state, head summary) registered by the
+node composition (node.py) — a provider that raises contributes an
+``{"error": ...}`` stub instead of killing the capture.  ``record()``
+is safe from any thread and never raises.
+
+CLI: ``python -m lodestar_tpu.observability flightrec <dir>`` lists and
+inspects bundles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.metrics import Registry, global_registry
+
+MANIFEST = "manifest.json"
+SCHEMA = 1
+
+DEFAULT_MIN_INTERVAL_S = 30.0
+DEFAULT_MAX_BUNDLES = 16
+DEFAULT_MAX_TOTAL_BYTES = 64 * 1024 * 1024
+
+_REASON_SAFE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _sanitize(reason: str) -> str:
+    return _REASON_SAFE.sub("_", reason)[:64] or "anomaly"
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for base, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(base, f))
+            except OSError:
+                pass
+    return total
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        directory: str,
+        min_interval_s: float = DEFAULT_MIN_INTERVAL_S,
+        max_bundles: int = DEFAULT_MAX_BUNDLES,
+        max_total_bytes: int = DEFAULT_MAX_TOTAL_BYTES,
+        registry: Optional[Registry] = None,
+        timeseries=None,
+    ):
+        self.directory = directory
+        self.min_interval_s = min_interval_s
+        self.max_bundles = max_bundles
+        self.max_total_bytes = max_total_bytes
+        self.timeseries = timeseries  # TimeSeriesRing (window() source)
+        self._providers: Dict[str, Callable[[], object]] = {}
+        self._lock = threading.Lock()
+        self._last_record_t: Optional[float] = None
+        r = registry or global_registry()
+        self.m_bundles = r.labeled_counter(
+            "lodestar_flight_recorder_bundles_total",
+            "Flight-record bundles written, by trigger reason",
+            "reason",
+        )
+        self.m_suppressed = r.counter(
+            "lodestar_flight_recorder_suppressed_total",
+            "Triggers dropped by the recorder rate limit",
+        )
+        os.makedirs(directory, exist_ok=True)
+        self._seq = self._scan_max_seq() + 1
+        # per-bundle byte sizes, maintained on write/prune so status()
+        # (polled per health request) never re-walks the directory
+        self._sizes: Dict[str, int] = {
+            b: _dir_bytes(b) for b in self._bundles_on_disk()
+        }
+
+    def _scan_max_seq(self) -> int:
+        best = 0
+        try:
+            for name in os.listdir(self.directory):
+                m = re.match(r"fr-(\d+)-", name)
+                if m:
+                    best = max(best, int(m.group(1)))
+        except OSError:
+            pass
+        return best
+
+    def add_provider(self, name: str, fn: Callable[[], object]) -> None:
+        """`fn()` -> JSON-serializable payload (or str for a text
+        file), captured into `<name>.json`/`<name>.txt` per bundle."""
+        self._providers[name] = fn
+
+    # -- capture ------------------------------------------------------------
+
+    def record(self, reason: str, context: Optional[dict] = None) -> Optional[str]:
+        """Write one bundle; returns its path, or None when the rate
+        limit suppressed the capture or the write failed.  Never
+        raises: the recorder is called from clock ticks and failure
+        paths that must survive it."""
+        try:
+            return self._record(reason, context)
+        except Exception:  # noqa: BLE001 — a broken recorder must not
+            # cascade into the path that triggered it.  The rate-limit
+            # window was claimed before the failed write: release it so
+            # the NEXT trigger retries instead of a storm's entire
+            # first window passing with nothing on disk.
+            with self._lock:
+                self._last_record_t = None
+            return None
+
+    def _record(self, reason: str, context: Optional[dict]) -> Optional[str]:
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._last_record_t is not None
+                and now - self._last_record_t < self.min_interval_s
+            ):
+                self.m_suppressed.inc()
+                return None
+            self._last_record_t = now
+            seq = self._seq
+            self._seq += 1
+        name = f"fr-{seq:06d}-{_sanitize(reason)}"
+        path = os.path.join(self.directory, name)
+        os.makedirs(path, exist_ok=True)
+        files: List[str] = []
+
+        def _write_json(fname: str, payload) -> None:
+            with open(os.path.join(path, fname), "w") as f:
+                json.dump(payload, f, default=str)
+            files.append(fname)
+
+        # the span ring as a loadable Chrome trace (empty when tracing
+        # is off — the manifest records which)
+        from .sinks import dump_chrome_trace
+        from .tracer import enabled as _tracing_enabled
+
+        _write_json("trace.json", dump_chrome_trace())
+        if self.timeseries is not None:
+            _write_json("timeseries.json", self.timeseries.window())
+        for pname, fn in self._providers.items():
+            try:
+                payload = fn()
+            except Exception as e:  # noqa: BLE001 — capture the fault,
+                payload = {"error": f"{type(e).__name__}: {e}"}  # not die of it
+            if isinstance(payload, str):
+                fname = f"{pname}.txt"
+                with open(os.path.join(path, fname), "w") as f:
+                    f.write(payload)
+                files.append(fname)
+            else:
+                _write_json(f"{pname}.json", payload)
+        manifest = {
+            "schema": SCHEMA,
+            "reason": reason,
+            "context": context or {},
+            "created_unix": time.time(),
+            "tracing_enabled": _tracing_enabled(),
+            "files": sorted(files),
+        }
+        with open(os.path.join(path, MANIFEST), "w") as f:
+            json.dump(manifest, f, default=str)
+        self.m_bundles.inc(reason, 1.0)
+        # the size ledger is read by status() from the API thread:
+        # mutate it (and prune against it) only under the lock
+        with self._lock:
+            self._sizes[path] = _dir_bytes(path)
+            self._prune_locked()
+        return path
+
+    # -- bounds -------------------------------------------------------------
+
+    def _bundles_on_disk(self) -> List[str]:
+        try:
+            names = sorted(
+                n
+                for n in os.listdir(self.directory)
+                if n.startswith("fr-")
+                and os.path.isdir(os.path.join(self.directory, n))
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _prune_locked(self) -> None:
+        """Delete oldest bundles until both the count and byte caps
+        hold; the newest bundle is never deleted.  Caller holds
+        self._lock (the ledger doubles as status()'s data)."""
+        bundles = self._bundles_on_disk()
+        for b in bundles:  # externally-placed bundles get sized once
+            if b not in self._sizes:
+                self._sizes[b] = _dir_bytes(b)
+        total = sum(self._sizes.get(b, 0) for b in bundles)
+        while bundles[:-1] and (
+            len(bundles) > self.max_bundles or total > self.max_total_bytes
+        ):
+            victim = bundles.pop(0)
+            total -= self._sizes.pop(victim, 0)
+            shutil.rmtree(victim, ignore_errors=True)
+
+    def status(self) -> dict:
+        """O(1) inventory from the maintained size ledger (the health
+        endpoint polls this; it must not re-walk the directory).  The
+        lock guards against a concurrent capture mutating the ledger
+        mid-sum."""
+        with self._lock:
+            bundles = len(self._sizes)
+            total_bytes = sum(self._sizes.values())
+        return {
+            "directory": self.directory,
+            "bundles": bundles,
+            "total_bytes": total_bytes,
+            "suppressed": self.m_suppressed.value,
+            "min_interval_s": self.min_interval_s,
+            "max_bundles": self.max_bundles,
+            "max_total_bytes": self.max_total_bytes,
+        }
+
+
+# -- offline inspection (CLI + tests) ---------------------------------------
+
+
+def list_bundles(directory: str) -> List[dict]:
+    """[{path, reason, created_unix, bytes, files}] oldest first; a
+    bundle whose manifest is unreadable reports its error in-line."""
+    out: List[dict] = []
+    try:
+        names = sorted(
+            n
+            for n in os.listdir(directory)
+            if n.startswith("fr-")
+            and os.path.isdir(os.path.join(directory, n))
+        )
+    except OSError:
+        return out
+    for n in names:
+        path = os.path.join(directory, n)
+        entry = {"path": path, "bytes": _dir_bytes(path)}
+        try:
+            with open(os.path.join(path, MANIFEST)) as f:
+                m = json.load(f)
+            entry.update(
+                reason=m.get("reason"),
+                created_unix=m.get("created_unix"),
+                files=m.get("files", []),
+            )
+        except Exception as e:  # noqa: BLE001 — half-written bundle
+            entry["error"] = f"{type(e).__name__}: {e}"
+        out.append(entry)
+    return out
+
+
+def load_bundle(path: str) -> dict:
+    """Manifest + parsed JSON payloads of one bundle (text files are
+    returned verbatim) — the programmatic loader tests assert with."""
+    with open(os.path.join(path, MANIFEST)) as f:
+        manifest = json.load(f)
+    payloads: Dict[str, object] = {}
+    for fname in manifest.get("files", []):
+        fpath = os.path.join(path, fname)
+        try:
+            if fname.endswith(".json"):
+                with open(fpath) as f:
+                    payloads[fname] = json.load(f)
+            else:
+                with open(fpath) as f:
+                    payloads[fname] = f.read()
+        except Exception as e:  # noqa: BLE001
+            payloads[fname] = {"error": f"{type(e).__name__}: {e}"}
+    return {"manifest": manifest, "files": payloads}

@@ -1,0 +1,476 @@
+"""Slot-anchored SLO engine — evaluate the signals against the protocol.
+
+The consensus client's correctness is temporal: attesters vote on the
+new head at 1/3 slot, aggregators broadcast at 2/3, and a block that
+imports after the slot boundary is invisible to the next proposer's
+fork choice (the EdDSA/BLS committee study, arXiv:2302.00418, puts
+signature-verification latency directly on this path; sub-second-
+finality designs, arXiv:2603.10242, only tighten the budgets).  PR 8
+made the node's hot paths EMIT spans and histograms and PR 11 gave the
+verification pipeline lane deadlines — but nothing in the tree
+*evaluated* those signals against the protocol's deadlines.  This
+engine does, per slot, from the node clock (chain/clock.py ``on_slot``):
+
+  objectives (breach counters on ``lodestar_slo_breaches_total``):
+
+  - ``attestation_head_by_third`` — slot S's block finished importing
+    by ``slot_start(S) + 1/3 slot``: later, and this node's attesters
+    (and everyone it forwards to) vote on the PARENT head.  Evaluated
+    the moment the import completes (chain/chain.py hook), so a block
+    that limps in two slots late still books its breach.
+  - ``import_before_boundary`` — the same import completed before
+    ``slot_start(S+1)``: the hard deadline for the next proposer to
+    build on it.
+  - ``aggregate_inputs_by_two_thirds`` — the FIRST verified attestation
+    for slot S landed by ``slot_start(S) + 2/3 slot``: aggregators
+    broadcast at 2/3 and can only pack what the pipeline has verified.
+    Evaluated at the S+1 boundary; attestation-less slots are skipped,
+    not breached (an empty subnet is not a latency fault) — but a
+    first attestation arriving AFTER the boundary is judged the moment
+    it lands, so the worst starvation cannot hide behind the skip.
+  - ``pipeline_critical_p99`` — p99 of the critical lane's oldest-set
+    wait at flush (bls/pipeline.py flush records) stayed inside the
+    lane window + dispatch headroom.  This is the series the ROADMAP's
+    "tune the lane windows against real dispatch latency" item needed.
+  - ``compile_stall`` — jit/export compile seconds spent inside one
+    slot stayed under a threshold: a mid-epoch recompile eats exactly
+    the budget the other objectives measure.
+
+  anomaly watchers (``lodestar_slo_anomaly_events_total``): cumulative
+  counters polled once per slot — backpressure trips, queue-drop
+  bursts, RLC bisections — whose per-slot delta crossing a threshold
+  triggers the flight recorder without being a timeline objective.
+
+Every breach and watcher event requests a (rate-limited) flight-record
+capture — written at the NEXT clock tick, never inline on the
+import/gossip path that detected it — and every tick drives one
+MetricsSampler sample so the recorder's bundle carries the minutes of
+history leading up to the anomaly.  The whole per-slot evaluation is dict lookups plus a bounded
+scan of recent flush records: < 1 ms per slot, asserted in
+tests/test_slo.py.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .. import params
+from ..utils.metrics import Registry, global_registry
+
+# objective names (the `objective` label values)
+OBJ_ATTESTATION_HEAD = "attestation_head_by_third"
+OBJ_AGGREGATE_INPUTS = "aggregate_inputs_by_two_thirds"
+OBJ_IMPORT_BOUNDARY = "import_before_boundary"
+OBJ_CRITICAL_P99 = "pipeline_critical_p99"
+OBJ_COMPILE_STALL = "compile_stall"
+
+ALL_OBJECTIVES = (
+    OBJ_ATTESTATION_HEAD,
+    OBJ_AGGREGATE_INPUTS,
+    OBJ_IMPORT_BOUNDARY,
+    OBJ_CRITICAL_P99,
+    OBJ_COMPILE_STALL,
+)
+
+# Deadline constants (dev/NOTES.md round 10 records the reasoning):
+# the protocol fixes 1/3 and 2/3; the critical-lane budget is the 25 ms
+# lane window plus dispatch/device headroom sized from the ISSUE 11
+# stub oracle (measured critical p99 30 ms at window 25 ms) — 40 ms
+# separates "lane working" from "lane starved" without flapping on
+# scheduler jitter.  One second of compile inside a 12 s slot is the
+# smallest stall that visibly eats a deadline budget.
+ATTESTATION_DEADLINE_FRACTION = 1.0 / 3.0
+AGGREGATE_DEADLINE_FRACTION = 2.0 / 3.0
+CRITICAL_P99_BUDGET_S = 0.040
+COMPILE_STALL_THRESHOLD_S = 1.0
+# queue-drop watcher: fewer shed messages per slot than this is normal
+# overflow-policy churn under load; a burst past it means the
+# backpressure coupling is shedding faster than peers are being charged
+QUEUE_DROP_BURST_THRESHOLD = 64.0
+
+# slots of per-slot event state kept before pruning (2 mainnet epochs)
+_STATE_HORIZON_SLOTS = 64
+# a breach within this many slots of "now" reports status=degraded
+DEGRADED_WINDOW_SLOTS = params.SLOTS_PER_EPOCH
+
+
+def _p99(xs: List[float]) -> Optional[float]:
+    """Nearest-rank p99 (rounds UP): for small n this selects the
+    MAXIMUM — a floor()-style index would exclude the worst sample for
+    every n <= 100, which is exactly the sample a latency objective
+    exists to catch."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, math.ceil(0.99 * len(s)) - 1))]
+
+
+class _Watcher:
+    __slots__ = ("name", "fn", "threshold", "last")
+
+    def __init__(self, name, fn, threshold):
+        self.name = name
+        self.fn = fn
+        self.threshold = threshold
+        self.last: Optional[float] = None
+
+
+class SloEngine:
+    """Per-slot timeline objectives over the existing instrumentation.
+
+    `clock` is the node Clock (chain/clock.py) — ALL deadlines are
+    measured in ITS time, so simulated/replayed slots evaluate exactly
+    like wall-clock ones.  `recorder` (observability/flight_recorder.py)
+    is optional; without one, breaches only count.
+    """
+
+    def __init__(
+        self,
+        clock,
+        registry: Optional[Registry] = None,
+        recorder=None,
+        sampler=None,
+        pipeline=None,
+        attestation_deadline_fraction: float = ATTESTATION_DEADLINE_FRACTION,
+        aggregate_deadline_fraction: float = AGGREGATE_DEADLINE_FRACTION,
+        critical_p99_budget_s: float = CRITICAL_P99_BUDGET_S,
+        compile_stall_threshold_s: float = COMPILE_STALL_THRESHOLD_S,
+    ):
+        self.clock = clock
+        self.recorder = recorder
+        self.sampler = sampler  # MetricsSampler; one sample per slot
+        self.pipeline = pipeline  # BlsVerificationPipeline (flush_stats)
+        self.att_fraction = attestation_deadline_fraction
+        self.agg_fraction = aggregate_deadline_fraction
+        self.critical_budget = critical_p99_budget_s
+        self.compile_threshold = compile_stall_threshold_s
+
+        r = registry or global_registry()
+        self.registry = r
+        self.m_breaches = r.labeled_counter(
+            "lodestar_slo_breaches_total",
+            "Slot-anchored SLO objective breaches",
+            "objective",
+        )
+        self.m_evaluations = r.labeled_counter(
+            "lodestar_slo_evaluations_total",
+            "Slot-anchored SLO objective evaluations (skipped slots "
+            "do not count)",
+            "objective",
+        )
+        self.m_anomalies = r.labeled_counter(
+            "lodestar_slo_anomaly_events_total",
+            "Watcher-detected anomaly events (backpressure trips, "
+            "queue-drop bursts, RLC bisections)",
+            "event",
+        )
+        self.m_last_breach_slot = r.gauge(
+            "lodestar_slo_last_breach_slot",
+            "Slot of the most recent SLO breach (-1 = never)",
+        )
+        self.m_last_breach_slot.set(-1.0)
+
+        self._lock = threading.Lock()
+        # slot -> clock time of the FIRST completed import / verified
+        # attestation for that slot (bounded; pruned per tick)
+        self._import_t: Dict[int, float] = {}
+        self._first_att_t: Dict[int, float] = {}
+        self._recent_breaches: deque = deque(maxlen=64)
+        self._watchers: List[_Watcher] = []
+        self._last_flush_seq = -1
+        self._last_compile_s: Optional[float] = None
+        self._evaluated_slot = -1
+        # capture requests parked for the next clock tick: breaches
+        # detected ON the import/gossip paths must not pay the
+        # recorder's file IO inline (the write would add latency to
+        # exactly the path the objective is measuring).  Bounded: under
+        # a storm the rate limit would drop the excess anyway.
+        self._pending_captures: deque = deque(maxlen=8)
+
+    # -- event ingest (cheap; called from import/gossip paths) -------------
+
+    def on_block_imported(self, slot: int, t: Optional[float] = None) -> None:
+        """First completed import for `slot` books the two import-side
+        objectives immediately (late blocks must not dodge evaluation
+        by arriving after their boundary tick).
+
+        Imports more than one slot behind the clock are SKIPPED, not
+        breached: range-sync/backfill replay thousands of historical
+        blocks through the same chain.process_block path, and judging
+        them against deadlines that expired hours ago would flood the
+        counters (and the recorder) with breaches that say nothing
+        about this node's live pipeline."""
+        slot = int(slot)
+        if self.clock.current_slot > slot + 1:
+            return  # historical import (sync/backfill), not a live slot
+        with self._lock:
+            if slot in self._import_t:
+                return  # side-fork re-import; the first one was judged
+            t = self.clock.now if t is None else t
+            self._import_t[slot] = t
+        start = self.clock.slot_start(slot)
+        sps = params.SECONDS_PER_SLOT
+        att_deadline = start + self.att_fraction * sps
+        boundary = start + sps
+        self._evaluate(
+            OBJ_ATTESTATION_HEAD,
+            slot,
+            breached=t > att_deadline,
+            detail={"import_at_s": t - start, "deadline_s": att_deadline - start},
+        )
+        self._evaluate(
+            OBJ_IMPORT_BOUNDARY,
+            slot,
+            breached=t >= boundary,
+            detail={"import_at_s": t - start, "deadline_s": sps},
+        )
+
+    def on_attestation(self, slot: int, t: Optional[float] = None) -> None:
+        """A verified attestation FOR `slot` (gossip accept); only the
+        first per slot is kept.  If slot's boundary tick has ALREADY
+        passed (it was skipped for lack of data), a late first
+        attestation is judged immediately — arriving after the boundary
+        is the worst possible breach of the 2/3 objective, and must not
+        masquerade as an empty subnet."""
+        slot = int(slot)
+        with self._lock:
+            if slot in self._first_att_t:
+                return
+            self._first_att_t[slot] = self.clock.now if t is None else t
+        if self._evaluated_slot > slot:
+            self._evaluate_aggregate_inputs(slot)
+
+    def add_watcher(
+        self, name: str, fn: Callable[[], float], threshold: float = 1.0
+    ) -> None:
+        """Poll cumulative `fn()` each slot; a per-slot delta >=
+        `threshold` is an anomaly event (counted + recorded)."""
+        self._watchers.append(_Watcher(name, fn, threshold))
+
+    # -- the per-slot tick (clock.on_slot) ---------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        slot = int(slot)
+        if slot <= self._evaluated_slot:
+            return
+        self._evaluated_slot = slot
+        prev = slot - 1
+        if prev >= 0:
+            self._evaluate_aggregate_inputs(prev)
+            self._evaluate_critical_lane(prev)
+            self._evaluate_compile_stall(prev)
+        self._poll_watchers(prev)
+        if self.sampler is not None:
+            try:
+                # slot-ALIGNED timestamp, not clock.now: a multi-slot
+                # set_time catch-up emits every intermediate tick with
+                # the clock already at the final time, which would give
+                # different slots' rows one shared timestamp and
+                # misattribute the per-slot deltas
+                self.sampler.sample(self.clock.slot_start(slot))
+            except Exception:  # noqa: BLE001 — sampling must never
+                pass  # abort the slot tick
+        with self._lock:
+            floor = slot - _STATE_HORIZON_SLOTS
+            for d in (self._import_t, self._first_att_t):
+                for s in [k for k in d if k < floor]:
+                    del d[s]
+        # capture AFTER the sample, so the bundle's time-series window
+        # includes this tick's row; breaches found during THIS tick
+        # flush here too (the tick is off the import/gossip hot paths)
+        self._drain_captures()
+
+    def _evaluate_aggregate_inputs(self, slot: int) -> None:
+        with self._lock:
+            t = self._first_att_t.get(slot)
+        if t is None:
+            return  # no attestations for the slot: skip, not breach
+        start = self.clock.slot_start(slot)
+        deadline = start + self.agg_fraction * params.SECONDS_PER_SLOT
+        self._evaluate(
+            OBJ_AGGREGATE_INPUTS,
+            slot,
+            breached=t > deadline,
+            detail={
+                "first_attestation_at_s": t - start,
+                "deadline_s": deadline - start,
+            },
+        )
+
+    def _evaluate_critical_lane(self, slot: int) -> None:
+        if self.pipeline is None:
+            return
+        try:
+            records = self.pipeline.flush_stats()
+        except Exception:  # noqa: BLE001 — a closing pipeline mid-tick
+            return
+        waits = []
+        max_seq = self._last_flush_seq
+        for rec in records:
+            seq = rec.get("seq", -1)
+            if seq <= self._last_flush_seq:
+                continue
+            max_seq = max(max_seq, seq)
+            if rec.get("lane") == "critical":
+                w = rec.get("oldest_wait_s")
+                if w is not None:
+                    waits.append(float(w))
+        self._last_flush_seq = max_seq
+        p99 = _p99(waits)
+        if p99 is None:
+            return  # no critical flushes this slot: skip
+        self._evaluate(
+            OBJ_CRITICAL_P99,
+            slot,
+            breached=p99 > self.critical_budget,
+            detail={
+                "p99_s": p99,
+                "budget_s": self.critical_budget,
+                "flushes": len(waits),
+            },
+        )
+
+    def _evaluate_compile_stall(self, slot: int) -> None:
+        from .sinks import kernel_compile_snapshot
+
+        try:
+            snap = kernel_compile_snapshot()
+            total = float(
+                snap["ops_jit_compile_seconds"] + snap["export_trace_seconds"]
+            )
+        except Exception:  # noqa: BLE001 — diagnostics must not breach
+            return
+        prev = self._last_compile_s
+        self._last_compile_s = total
+        if prev is None:
+            return  # baseline read
+        delta = total - prev
+        self._evaluate(
+            OBJ_COMPILE_STALL,
+            slot,
+            breached=delta >= self.compile_threshold,
+            detail={"compile_s": delta, "threshold_s": self.compile_threshold},
+        )
+
+    def anomaly(self, name: str, context: Optional[dict] = None) -> None:
+        """Count + flight-record one externally observed anomaly event
+        (the processor's backpressure-trip hook calls this directly;
+        watchers funnel through it on their per-slot delta)."""
+        self.m_anomalies.inc(name, 1.0)
+        self._record(f"event.{name}", context or {})
+
+    def _poll_watchers(self, slot: int) -> None:
+        for w in self._watchers:
+            try:
+                cur = float(w.fn())
+            except Exception:  # noqa: BLE001 — a dead source is not an
+                continue  # anomaly in itself
+            prev, w.last = w.last, cur
+            if prev is None:
+                continue
+            delta = cur - prev
+            if delta >= w.threshold:
+                self.anomaly(
+                    w.name,
+                    {"slot": slot, "delta": delta, "threshold": w.threshold},
+                )
+
+    # -- breach bookkeeping -------------------------------------------------
+
+    def _evaluate(
+        self, objective: str, slot: int, breached: bool, detail: dict
+    ) -> None:
+        self.m_evaluations.inc(objective, 1.0)
+        if not breached:
+            return
+        self.m_breaches.inc(objective, 1.0)
+        self.m_last_breach_slot.set(float(slot))
+        entry = {"objective": objective, "slot": slot}
+        entry.update(detail)
+        with self._lock:
+            self._recent_breaches.append(entry)
+        self._record(f"slo.{objective}", entry)
+
+    def _record(self, reason: str, context: dict) -> None:
+        """Park a capture request for the next clock tick (breaches are
+        detected on the import/gossip paths; the bundle's file IO must
+        not run there)."""
+        if self.recorder is None:
+            return
+        with self._lock:
+            self._pending_captures.append((reason, context))
+
+    def _drain_captures(self) -> None:
+        with self._lock:
+            pending = list(self._pending_captures)
+            self._pending_captures.clear()
+        for reason, context in pending:
+            try:
+                self.recorder.record(reason, context)
+            except Exception:  # noqa: BLE001 — the recorder must never
+                pass  # take down the clock tick
+
+    # -- introspection (health endpoint / monitoring push) ------------------
+
+    def breach_count(self, objective: str) -> float:
+        return self.m_breaches.get(objective)
+
+    def status(self) -> dict:
+        """The health-endpoint body: per-objective counters + budgets,
+        recent breach details, ok/degraded verdict."""
+        cur = self.clock.current_slot
+        last_breach = int(self.m_last_breach_slot.value)
+        degraded = (
+            last_breach >= 0 and cur - last_breach <= DEGRADED_WINDOW_SLOTS
+        )
+        budgets = {
+            OBJ_ATTESTATION_HEAD: self.att_fraction * params.SECONDS_PER_SLOT,
+            OBJ_AGGREGATE_INPUTS: self.agg_fraction * params.SECONDS_PER_SLOT,
+            OBJ_IMPORT_BOUNDARY: float(params.SECONDS_PER_SLOT),
+            OBJ_CRITICAL_P99: self.critical_budget,
+            OBJ_COMPILE_STALL: self.compile_threshold,
+        }
+        with self._lock:
+            recent = list(self._recent_breaches)
+        return {
+            "status": "degraded" if degraded else "ok",
+            "current_slot": cur,
+            "last_breach_slot": last_breach,
+            "objectives": {
+                obj: {
+                    "evaluations": self.m_evaluations.get(obj),
+                    "breaches": self.m_breaches.get(obj),
+                    "budget_s": budgets[obj],
+                }
+                for obj in ALL_OBJECTIVES
+            },
+            "anomaly_events": {
+                name: self.m_anomalies.get(name)
+                for name in self.m_anomalies.label_values()
+            },
+            "recent_breaches": recent,
+        }
+
+
+def breach_snapshot(registry: Optional[Registry] = None) -> dict:
+    """Plain-dict read of the lodestar_slo_* counters from a registry
+    (zeros when no engine ever ran there) — what bench.py attaches to
+    every probe record and the monitoring service pushes."""
+    r = registry or global_registry()
+    out = {"breaches": {}, "evaluations": {}, "anomaly_events": {}}
+    breaches = r.get("lodestar_slo_breaches_total")
+    evals = r.get("lodestar_slo_evaluations_total")
+    anomalies = r.get("lodestar_slo_anomaly_events_total")
+    for key, metric in (
+        ("breaches", breaches),
+        ("evaluations", evals),
+        ("anomaly_events", anomalies),
+    ):
+        if metric is not None:
+            out[key] = {lv: metric.get(lv) for lv in metric.label_values()}
+    last = r.get("lodestar_slo_last_breach_slot")
+    out["last_breach_slot"] = int(last.value) if last is not None else -1
+    return out

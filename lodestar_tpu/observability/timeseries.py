@@ -1,0 +1,128 @@
+"""In-process time series — recent HISTORY for gauges and counters.
+
+PR 8 gave the node point-in-time snapshots (/metrics, trace_summary)
+and PR 11 gave it lane deadlines, but when a deadline is blown the
+question is always "what was the pipeline doing for the last minute?" —
+and a scrape-based Prometheus may be absent (bench subprocesses, sims,
+the driver host) or too coarse to answer it.  This module keeps a small
+fixed-capacity ring of fixed-interval samples IN PROCESS:
+
+  - ``TimeSeriesRing`` — bounded deque of ``(t, {series: value})``
+    rows; O(1) append under a lock, snapshot/window reads for the
+    flight recorder and the health endpoint.
+  - ``MetricsSampler`` — named sources over the ring.  Two source
+    kinds: ``add_gauge(name, fn)`` records ``fn()`` as-is (pending
+    sets, queue depth); ``add_delta(name, fn)`` records the CHANGE of a
+    cumulative reading since the previous sample (histogram sums/
+    counts, drop counters) so each row holds per-interval rates, not
+    lifetime totals.
+
+The SLO engine (observability/slo.py) drives ``sample()`` once per slot
+from the node clock; a full sample is a handful of attribute reads and
+one dict append, so the per-slot cost stays well inside the < 1 ms
+budget asserted in tests/test_slo.py.  A broken source records ``None``
+for its series and never aborts the sample — history must survive the
+very faults it exists to explain.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+DEFAULT_CAPACITY = 1024  # ~3.4 hours of mainnet slots
+
+
+class TimeSeriesRing:
+    """Bounded, thread-safe ring of ``{"t": ..., series...}`` rows."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def append(self, t: float, values: Dict[str, Optional[float]]) -> None:
+        row = {"t": t}
+        row.update(values)
+        with self._lock:
+            self._ring.append(row)
+
+    def window(self, since: Optional[float] = None) -> List[dict]:
+        """Rows with ``t >= since`` (everything when ``since`` is None),
+        oldest first — the flight-record bundle's time-series file."""
+        with self._lock:
+            rows = list(self._ring)
+        if since is None:
+            return rows
+        return [r for r in rows if r["t"] >= since]
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+class MetricsSampler:
+    """Named sources -> one ring row per ``sample()`` call."""
+
+    def __init__(self, ring: Optional[TimeSeriesRing] = None):
+        # explicit None test: an EMPTY ring is falsy (it has __len__),
+        # and `ring or ...` would silently sample into a fresh one
+        self.ring = ring if ring is not None else TimeSeriesRing()
+        # (name, fn, is_delta); deltas carry their previous reading
+        self._sources: List[Tuple[str, Callable[[], float], bool]] = []
+        self._last: Dict[str, float] = {}
+
+    def add_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """Record ``fn()`` verbatim each sample (point-in-time level)."""
+        self._sources.append((name, fn, False))
+
+    def add_delta(self, name: str, fn: Callable[[], float]) -> None:
+        """Record the increase of cumulative ``fn()`` since the last
+        sample (first sample records 0 — the baseline read)."""
+        self._sources.append((name, fn, True))
+
+    def sample(self, t: float) -> dict:
+        values: Dict[str, Optional[float]] = {}
+        for name, fn, is_delta in self._sources:
+            try:
+                raw = float(fn())
+            except Exception:  # noqa: BLE001 — a dead source must not
+                values[name] = None  # kill the whole sample
+                continue
+            if is_delta:
+                prev = self._last.get(name)
+                self._last[name] = raw
+                values[name] = raw - prev if prev is not None else 0.0
+            else:
+                values[name] = raw
+        self.ring.append(t, values)
+        return values
+
+
+def histogram_totals(metric) -> Tuple[float, float]:
+    """(count, sum) across every label of a utils/metrics histogram —
+    plain or labeled, None-safe — the cumulative reading ``add_delta``
+    sources feed from."""
+    if metric is None:
+        return 0.0, 0.0
+    if hasattr(metric, "label_values"):
+        count = sum(metric.count(lv) for lv in metric.label_values())
+        total = sum(metric.sum(lv) for lv in metric.label_values())
+        return float(count), float(total)
+    return float(metric.count), float(metric.sum)
+
+
+def labeled_total(metric) -> float:
+    """Sum of a LabeledCounter/LabeledGauge across its labels (0.0 when
+    the metric has not been registered yet)."""
+    if metric is None:
+        return 0.0
+    return float(sum(metric.get(lv) for lv in metric.label_values()))

@@ -404,7 +404,8 @@ class BlsPoolMetrics:
             "lodestar_bls_flush_reason_total",
             "Pipeline bucket flushes by trigger (fill = exact bucket | "
             "spill = partial, pushed out by an overshooting job | "
-            "deadline | close)",
+            "deadline | idle = lone critical job with nothing to "
+            "coalesce against | close)",
             "reason",
         )
         self.pipeline_pending_sets = r.gauge(

@@ -1,0 +1,41 @@
+"""metric-hygiene positives: unprefixed name, conflicting duplicate
+registration, unbounded label name, unbounded label value."""
+
+
+class _FakeRegistry:
+    """Shaped like utils/metrics.Registry; the rule keys on the method
+    names, not the type."""
+
+    def counter(self, name, help_):
+        return self
+
+    def gauge(self, name, help_):
+        return self
+
+    def labeled_gauge(self, name, help_, label):
+        return self
+
+    def labeled_histogram(self, name, help_, label, buckets):
+        return self
+
+    def observe(self, label_value, v):
+        pass
+
+
+def register(r: _FakeRegistry, peer_id: str):
+    # unprefixed name: collides with other exporters
+    bad_prefix = r.counter("verified_messages_total", "no family prefix")
+    # ONE name, two different instrument types: the Registry dedupes by
+    # name first-wins, so the gauge site silently gets the counter
+    r.counter("lodestar_dup_series_total", "first registration")
+    r.gauge("lodestar_dup_series_total", "conflicting re-registration")
+    # a per-peer label dimension grows the exposition without bound
+    per_peer = r.labeled_gauge(
+        "lodestar_peer_lag_seconds", "per-peer lag", "peer_id"
+    )
+    # an unbounded label VALUE on an otherwise fine dimension
+    hist = r.labeled_histogram(
+        "lodestar_fixture_seconds", "timings", "stage", [0.1, 1.0]
+    )
+    hist.observe(f"stage-{peer_id}", 0.5)
+    return bad_prefix, per_peer

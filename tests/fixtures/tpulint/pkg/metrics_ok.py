@@ -1,0 +1,42 @@
+"""metric-hygiene negatives: prefixed names, parity families, bounded
+labels, prefix-variable concatenation, benign plain-gauge sets."""
+
+
+class _FakeRegistry:
+    def counter(self, name, help_):
+        return self
+
+    def gauge(self, name, help_):
+        return self
+
+    def labeled_counter(self, name, help_, label):
+        return self
+
+    def labeled_histogram(self, name, help_, label, buckets):
+        return self
+
+    def observe(self, label_value, v):
+        pass
+
+    def set(self, v):
+        pass
+
+
+def register(r: _FakeRegistry, phase: str):
+    p = "lodestar_fixture_"
+    ok = r.counter(p + "events_total", "prefix via variable concat")
+    # reference-parity families are allowlisted (dashboards expect them)
+    r.gauge("beacon_head_slot_fixture", "parity family")
+    r.gauge("validator_monitor_fixture_total", "parity family")
+    # a bounded label dimension, observed with a bounded value
+    hist = r.labeled_histogram(
+        "lodestar_fixture_phase_seconds", "timings", "phase", [0.1, 1.0]
+    )
+    hist.observe(phase, 0.5)
+    # the SAME name re-registered with the SAME signature is idempotent
+    r.labeled_counter("lodestar_fixture_verdicts_total", "verdicts", "kind")
+    r.labeled_counter("lodestar_fixture_verdicts_total", "verdicts", "kind")
+    # a plain gauge set(value) is not a label write
+    gauge = r.gauge("lodestar_fixture_depth", "queue depth")
+    gauge.set(3.0)
+    return ok

@@ -490,3 +490,191 @@ def test_job_cap_does_not_bind_before_set_high_water():
     del futs2
     assert svc.can_accept_work()  # < 1000 sets: still accepting
     svc.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: gossip handlers route block-critical verification through the
+# service's critical lane (PR 11 ROADMAP leftover) + flush-record telemetry
+# ---------------------------------------------------------------------------
+
+
+class RawSpy:
+    """Raw-verifier stand-in that counts synchronous calls."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def verify_signature_sets(self, sets, opts=None):
+        self.calls += 1
+        return True
+
+
+def test_gossip_validators_priority_rides_critical_lane_under_flood():
+    """Regression (ISSUE 12 satellite): a flood of subnet attestations
+    filling the standard lane cannot starve an aggregate verification
+    past the critical window.  GossipValidators with a wired service
+    routes `priority=True` verifications through the pipeline's 25 ms
+    lane; the raw verifier is NOT called for them."""
+    from lodestar_tpu.chain.validation import GossipValidators
+
+    stub = HandleStub()
+    pipe = BlsVerificationPipeline(
+        stub, critical_wait_ms=30, standard_wait_ms=10_000
+    )
+    raw = RawSpy()
+    v = GossipValidators(chain=None, verifier=raw, bls_service=pipe)
+    # the flood: 100 subnet attestations parked on the standard lane
+    # (far from the 128 fill, 10 s window — they are going nowhere)
+    std = [submit(pipe, single(i)) for i in range(100)]
+    t0 = time.perf_counter()
+    v._verify([agg(0, k=3)], priority=True)  # no exception = verified
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"critical verification took {dt:.3f}s — starved?"
+    assert raw.calls == 0  # routed through the service, not raw
+    assert all(not f.done() for f in std)  # flood still parked
+    # the flush that carried it rode the critical lane within window
+    lanes = [r for r in pipe.flush_stats() if r["lane"] == "critical"]
+    assert lanes and lanes[0]["oldest_wait_s"] < 1.0
+    # non-priority verification still uses the raw verifier (subnet
+    # attestations must not pay the standard lane's window here)
+    v._verify([single(999)], priority=False)
+    assert raw.calls == 1
+    pipe.close()
+
+
+def test_gossip_validators_without_service_keep_raw_path():
+    from lodestar_tpu.chain.validation import GossipValidators
+
+    raw = RawSpy()
+    v = GossipValidators(chain=None, verifier=raw)
+    v._verify([single(0)], priority=True)  # no service: raw fallback
+    assert raw.calls == 1
+
+
+def test_flush_records_carry_seq_and_oldest_wait():
+    """The SLO engine consumes flush records incrementally by `seq` and
+    judges the critical lane by `oldest_wait_s` (ISSUE 12)."""
+    stub = HandleStub()
+    svc = BlsVerificationPipeline(stub, standard_wait_ms=40)
+    futs = [submit(svc, single(i)) for i in range(128)]  # exact fill
+    assert all(f.result(timeout=5) for f in futs)
+    fut = submit(svc, single(999))  # deadline flush
+    assert fut.result(timeout=5)
+    svc.close()
+    stats = svc.flush_stats()
+    assert [r["seq"] for r in stats] == sorted(
+        r["seq"] for r in stats
+    ) and len({r["seq"] for r in stats}) == len(stats)
+    fill = next(r for r in stats if r["reason"] == "fill")
+    assert 0.0 <= fill["oldest_wait_s"] < 5.0
+    deadline_rec = next(r for r in stats if r["reason"] == "deadline")
+    # the deadline flush waited out (about) the 40 ms window
+    assert deadline_rec["oldest_wait_s"] >= 0.035
+
+
+def test_bench_failure_records_carry_slo_snapshot_and_flight_record(
+    capsys, monkeypatch, tmp_path
+):
+    """ISSUE 12 acceptance: a bench skip/failure record carries the SLO
+    snapshot and a flight-record path, so a future r06 backend-init
+    failure leaves a forensic artifact instead of a bare null."""
+    import json
+    import os
+
+    import bench
+
+    monkeypatch.setenv("BENCH_FLIGHTREC_DIR", str(tmp_path / "fr"))
+    monkeypatch.setattr(bench, "_FLIGHT_RECORDER", None)
+    bench._emit_failure("backend-init-probe", "stub tunnel death")
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["skipped"] is True and rec["value"] is None
+    assert "slo" in rec and "breaches" in rec["slo"]
+    assert rec["flight_record"] is not None
+    assert os.path.isdir(rec["flight_record"])
+    from lodestar_tpu.observability.flight_recorder import load_bundle
+
+    bundle = load_bundle(rec["flight_record"])
+    assert bundle["manifest"]["reason"] == "bench.backend-init-probe"
+    assert "stub tunnel death" in bundle["manifest"]["context"]["detail"]
+    # the bundle carries the phase timings + SLO counters
+    assert "phases.json" in bundle["files"]
+    assert "slo.json" in bundle["files"]
+    # traces parse even with tracing off (empty event list)
+    assert isinstance(
+        bundle["files"]["trace.json"]["traceEvents"], list
+    )
+
+
+def test_bench_failure_without_recorder_env_writes_nothing(
+    capsys, monkeypatch, tmp_path
+):
+    import json
+
+    import bench
+
+    monkeypatch.delenv("BENCH_FLIGHTREC_DIR", raising=False)
+    monkeypatch.setattr(bench, "_FLIGHT_RECORDER", None)
+    monkeypatch.setattr(bench, "_FLIGHTREC_ON", False)
+    monkeypatch.chdir(tmp_path)
+    bench._emit_failure("run", "in-process stub failure")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["flight_record"] is None
+    assert "slo" in rec  # the snapshot still attaches
+    assert not (tmp_path / "flightrec_bench").exists()
+
+
+def test_bench_measured_records_carry_slo_snapshot(capsys, monkeypatch):
+    import json
+
+    import bench
+
+    class FakeMessages:
+        def get_many(self, roots):
+            return [None] * len(roots)
+
+    class FakeVerifier(HandleStub):
+        _use_rlc = True
+        table = list(range(512))
+        messages = FakeMessages()
+
+    monkeypatch.setattr(bench, "BENCH_PIPELINE_ATTS", 16)
+    monkeypatch.setattr(bench, "BENCH_PIPELINE_SUBNETS", 4)
+    monkeypatch.setattr(bench, "BENCH_PIPELINE_WAVES", 1)
+    bench._probe_pipeline(FakeVerifier())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 1 and recs[0].get("skipped") is None
+    assert "slo" in recs[0] and "breaches" in recs[0]["slo"]
+
+
+def test_lone_critical_job_flushes_immediately_when_idle():
+    """Review fix: a critical job submitted into an otherwise-idle
+    pipeline must NOT serialize the full lane window — the synchronous
+    gossip loop verifies aggregates one at a time, and a pure 25 ms
+    wait per message would add >1 s/slot of idle to the scheduler."""
+    stub = HandleStub()
+    svc = BlsVerificationPipeline(
+        stub, critical_wait_ms=5_000, standard_wait_ms=10_000
+    )
+    t0 = time.perf_counter()
+    fut = submit(svc, agg(0, k=3), priority=True)
+    assert fut.result(timeout=5)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"idle critical job waited {dt:.3f}s (lane window?)"
+    stats = svc.flush_stats()
+    assert stats and stats[0]["reason"] == "idle"
+    assert stats[0]["lane"] == "critical"
+    # with standard work ACCUMULATING, criticals coalesce toward the
+    # deadline as before (the idle fast path must not fire under load)
+    futs = [submit(svc, single(i)) for i in range(8)]
+    crit = submit(svc, agg(1, k=3), priority=True)
+    time.sleep(0.05)
+    assert not crit.done()  # parked on the (long) critical deadline
+    svc.close()
+    del futs
+    lanes = [r for r in svc.flush_stats() if r["lane"] == "critical"]
+    assert [r["reason"] for r in lanes] == ["idle", "close"]

@@ -465,6 +465,97 @@ def test_observability_cli_summary_and_dump(tracing, tmp_path):
     assert json.loads(dumped.read_text())["traceEvents"]
 
 
+def test_observability_cli_url_source(tracing):
+    """ISSUE 12 satellite: the CLI's --url leg (summary AND dump
+    against a live metrics server's GET /trace) was untested."""
+    import json
+
+    from lodestar_tpu.observability.__main__ import main as obs_main
+    from lodestar_tpu.utils.metrics_server import HttpMetricsServer
+
+    OB = tracing
+    with OB.trace_span("url.span"):
+        pass
+    srv = HttpMetricsServer(Registry(), port=0)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            # both the bare base URL and an explicit /trace resolve
+            assert obs_main(["summary", "--url", url, "--json"]) == 0
+        summary = json.loads(buf.getvalue())
+        assert any(r["name"] == "url.span" for r in summary["spans"])
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert obs_main(["dump", "--url", url + "/trace"]) == 0
+        doc = json.loads(buf.getvalue())
+        assert any(
+            e["name"] == "url.span" for e in doc["traceEvents"]
+        )
+    finally:
+        srv.close()
+
+
+def test_observability_cli_load_error_exit_code(tmp_path):
+    from lodestar_tpu.observability.__main__ import main as obs_main
+
+    assert obs_main(["summary", str(tmp_path / "missing.json")]) == 2
+    bad = tmp_path / "not_json.json"
+    bad.write_text("this is not a trace")
+    assert obs_main(["dump", str(bad)]) == 2
+
+
+def test_tracer_snapshot_under_concurrent_writers(tracing):
+    """ISSUE 12 satellite: snapshot() while writer threads append must
+    return a consistent list (bounded, fully-formed records) and never
+    raise — the flight recorder drains the ring mid-anomaly, exactly
+    when the hot paths are busiest."""
+    import threading
+
+    OB = tracing
+    OB.configure(capacity=512)
+    try:
+        stop = threading.Event()
+        errors = []
+
+        def writer(tid):
+            try:
+                i = 0
+                while not stop.is_set():
+                    with OB.trace_span(f"w{tid}", i=i):
+                        pass
+                    i += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(300):
+                snap = OB.get_tracer().snapshot()
+                assert len(snap) <= 512
+                for rec in snap:
+                    # every record is FINISHED: full field set, sane tid
+                    assert rec.span_id > 0 and rec.dur_us >= 0
+                    assert rec.name.startswith("w")
+                # the sinks built on snapshot() hold up too
+                OB.dump_chrome_trace(snap)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors
+    finally:
+        OB.configure(capacity=65536)
+
+
 def test_metrics_server_trace_endpoint_and_global_merge(tracing, tmp_path):
     """Acceptance slice: /metrics exposes the compile/cache and
     gossip-queue series (process-global registry merged into the node

@@ -1,0 +1,628 @@
+"""Slot-anchored SLO engine + flight recorder (ISSUE 12 acceptance).
+
+Fast stub tests of the tentpole contract:
+
+  - a replayed HEALTHY slot sequence produces zero breaches (no false
+    positives) while every objective still evaluates,
+  - an induced late-import + backpressure-trip scenario produces the
+    correct breach counters AND a loadable flight-record bundle
+    (Chrome-trace JSON parses, time-series window non-empty),
+  - per-slot SLO evaluation + time-series sampling stay under 1 ms,
+  - the recorder honors its rate limit and on-disk caps under a
+    breach storm,
+  - the health surface: SloEngine.status(), breach_snapshot(), the
+    GET /eth/v1/lodestar/health handler, and the CLI subcommands.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.clock import Clock
+from lodestar_tpu.observability import flight_recorder as FR
+from lodestar_tpu.observability.slo import (
+    ALL_OBJECTIVES,
+    OBJ_AGGREGATE_INPUTS,
+    OBJ_ATTESTATION_HEAD,
+    OBJ_COMPILE_STALL,
+    OBJ_CRITICAL_P99,
+    OBJ_IMPORT_BOUNDARY,
+    SloEngine,
+    breach_snapshot,
+)
+from lodestar_tpu.observability.timeseries import (
+    MetricsSampler,
+    TimeSeriesRing,
+    histogram_totals,
+)
+from lodestar_tpu.utils.metrics import Registry
+
+pytestmark = pytest.mark.smoke
+
+SPS = params.SECONDS_PER_SLOT
+
+
+class PipelineStub:
+    """flush_stats()-shaped record feed (bls/pipeline.py)."""
+
+    def __init__(self):
+        self.records = []
+        self._seq = 0
+
+    def add(self, lane, oldest_wait_s):
+        self._seq += 1
+        self.records.append(
+            {
+                "seq": self._seq,
+                "lane": lane,
+                "reason": "deadline",
+                "sets": 1,
+                "n_bucket": 128,
+                "fill_ratio": 1 / 128,
+                "oldest_wait_s": oldest_wait_s,
+            }
+        )
+
+    def flush_stats(self):
+        return list(self.records)
+
+
+def make_engine(tmp_path=None, pipeline=None, recorder_kwargs=None, **kw):
+    clock = Clock(genesis_time=0.0)
+    registry = Registry()
+    recorder = None
+    if tmp_path is not None:
+        recorder = FR.FlightRecorder(
+            str(tmp_path / "flightrec"),
+            registry=registry,
+            **(recorder_kwargs or {"min_interval_s": 0.0}),
+        )
+    ring = TimeSeriesRing()
+    if recorder is not None:
+        recorder.timeseries = ring
+    sampler = MetricsSampler(ring)
+    state = {"gauge": 0.0}
+    sampler.add_gauge("pending_sets", lambda: state["gauge"])
+    sampler.add_delta("drops", lambda: state.get("drops", 0.0))
+    engine = SloEngine(
+        clock,
+        registry=registry,
+        recorder=recorder,
+        sampler=sampler,
+        pipeline=pipeline,
+        **kw,
+    )
+    clock.on_slot(engine.on_slot)
+    return clock, engine, recorder, ring, state
+
+
+def drive_healthy_slot(clock, engine, slot, pipeline=None):
+    """Advance into `slot`, then replay its events at healthy phase
+    offsets (import at 0.2 slot, first attestation at 0.45 slot)."""
+    start = clock.slot_start(slot)
+    clock.set_time(start)
+    engine.on_attestation(slot, t=start + 0.45 * SPS)
+    engine.on_block_imported(slot, t=start + 0.2 * SPS)
+    if pipeline is not None:
+        pipeline.add("critical", 0.010)  # inside the 40 ms budget
+
+
+# ---------------------------------------------------------------------------
+# acceptance: healthy sequence -> zero breaches; induced anomaly -> breaches
+# ---------------------------------------------------------------------------
+
+
+def test_healthy_slot_sequence_produces_zero_breaches(tmp_path):
+    pipeline = PipelineStub()
+    clock, engine, recorder, ring, _ = make_engine(tmp_path, pipeline)
+    n_slots = 8
+    for slot in range(1, n_slots + 1):
+        drive_healthy_slot(clock, engine, slot, pipeline)
+    clock.set_time(clock.slot_start(n_slots + 1))  # close the last slot
+    for obj in ALL_OBJECTIVES:
+        assert engine.breach_count(obj) == 0, engine.status()
+    st = engine.status()
+    assert st["status"] == "ok"
+    assert st["last_breach_slot"] == -1
+    # every objective actually evaluated (no vacuous pass)
+    assert st["objectives"][OBJ_ATTESTATION_HEAD]["evaluations"] == n_slots
+    assert st["objectives"][OBJ_IMPORT_BOUNDARY]["evaluations"] == n_slots
+    assert st["objectives"][OBJ_AGGREGATE_INPUTS]["evaluations"] == n_slots
+    assert st["objectives"][OBJ_CRITICAL_P99]["evaluations"] == n_slots
+    # compile-stall needs one baseline read before it can evaluate
+    assert st["objectives"][OBJ_COMPILE_STALL]["evaluations"] >= n_slots - 1
+    # no anomaly: nothing was captured
+    assert FR.list_bundles(recorder.directory) == []
+    # the per-slot sampler filled the ring (one row per tick; the
+    # first set_time also emits slot 0)
+    assert len(ring) == n_slots + 2
+
+
+def test_late_import_and_backpressure_trip_breach_and_bundle(tmp_path):
+    from lodestar_tpu import observability as OB
+    from lodestar_tpu.network.processor import (
+        NetworkProcessor,
+        PendingGossipMessage,
+    )
+    from lodestar_tpu.network.gossip_queues import GossipType
+
+    OB.configure(enabled=True)
+    OB.get_tracer().clear()
+    try:
+        pipeline = PipelineStub()
+        clock, engine, recorder, ring, _ = make_engine(tmp_path, pipeline)
+        with OB.trace_span("test.import", slot=2):
+            pass  # something in the ring for the bundle's trace.json
+        drive_healthy_slot(clock, engine, 1, pipeline)
+        # slot 2's block limps in 1.2 slots late: both import-side
+        # objectives breach the moment the import completes
+        start2 = clock.slot_start(2)
+        clock.set_time(start2)
+        engine.on_attestation(2, t=start2 + 0.4 * SPS)
+        clock.set_time(clock.slot_start(3) + 0.2 * SPS)
+        engine.on_block_imported(2)  # t = clock.now, past the boundary
+        assert engine.breach_count(OBJ_ATTESTATION_HEAD) == 1
+        assert engine.breach_count(OBJ_IMPORT_BOUNDARY) == 1
+        assert engine.breach_count(OBJ_AGGREGATE_INPUTS) == 0
+
+        # backpressure trip: the processor's edge-triggered hook fires
+        # ONCE per slot while downstream reports saturation
+        proc = NetworkProcessor(
+            lambda msg: None, [lambda: False], registry=Registry()
+        )
+        proc.on_backpressure_trip = lambda slot: engine.anomaly(
+            "backpressure_trip", {"slot": slot}
+        )
+        for _ in range(3):
+            proc.on_gossip_message(
+                PendingGossipMessage(GossipType.beacon_attestation, b"x")
+            )
+        assert engine.m_anomalies.get("backpressure_trip") == 1.0
+        proc.on_clock_slot(5)  # re-arms the edge trigger
+        proc.on_gossip_message(
+            PendingGossipMessage(GossipType.beacon_attestation, b"x")
+        )
+        assert engine.m_anomalies.get("backpressure_trip") == 2.0
+
+        # captures are DEFERRED off the import/gossip paths: nothing on
+        # disk until the next clock tick drains the queue
+        assert FR.list_bundles(recorder.directory) == []
+        clock.set_time(clock.slot_start(4))
+
+        # the bundles: breaches + anomalies each captured one
+        bundles = FR.list_bundles(recorder.directory)
+        reasons = [b["reason"] for b in bundles]
+        assert f"slo.{OBJ_ATTESTATION_HEAD}" in reasons
+        assert f"slo.{OBJ_IMPORT_BOUNDARY}" in reasons
+        assert "event.backpressure_trip" in reasons
+        # loadable: the Chrome trace parses, the time-series window is
+        # non-empty, the manifest names every file
+        loaded = FR.load_bundle(bundles[-1]["path"])
+        trace = loaded["files"]["trace.json"]
+        assert isinstance(trace["traceEvents"], list)
+        assert any(
+            e["name"] == "test.import" for e in trace["traceEvents"]
+        )
+        ts = loaded["files"]["timeseries.json"]
+        assert len(ts) >= 1 and "t" in ts[0] and "pending_sets" in ts[0]
+        assert set(loaded["manifest"]["files"]) == set(loaded["files"])
+        assert loaded["manifest"]["schema"] == FR.SCHEMA
+    finally:
+        OB.configure(enabled=False)
+        OB.get_tracer().clear()
+
+
+def test_status_degrades_then_recovers(tmp_path):
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(2) + 1.5 * SPS)  # slots 0..3 tick
+    engine.on_block_imported(2)  # late -> breach
+    assert engine.status()["status"] == "degraded"
+    # one epoch of clean slots later the verdict recovers
+    clock.set_time(clock.slot_start(2 + params.SLOTS_PER_EPOCH + 2))
+    assert engine.status()["status"] == "ok"
+    # the counters, unlike the verdict, never forget
+    assert engine.breach_count(OBJ_IMPORT_BOUNDARY) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-objective units
+# ---------------------------------------------------------------------------
+
+
+def test_critical_lane_p99_objective_is_seq_incremental():
+    pipeline = PipelineStub()
+    clock, engine, _rec, _ring, _ = make_engine(pipeline=pipeline)
+    pipeline.add("critical", 0.200)  # way past the 40 ms budget
+    pipeline.add("standard", 5.000)  # standard lane is NOT judged
+    clock.set_time(clock.slot_start(1))
+    clock.set_time(clock.slot_start(2))
+    assert engine.breach_count(OBJ_CRITICAL_P99) == 1
+    evals = engine.m_evaluations.get(OBJ_CRITICAL_P99)
+    # no NEW flush records -> no new evaluation (seq cursor moved on)
+    clock.set_time(clock.slot_start(3))
+    assert engine.m_evaluations.get(OBJ_CRITICAL_P99) == evals
+    pipeline.add("critical", 0.005)
+    clock.set_time(clock.slot_start(4))
+    assert engine.m_evaluations.get(OBJ_CRITICAL_P99) == evals + 1
+    assert engine.breach_count(OBJ_CRITICAL_P99) == 1  # healthy flush
+
+
+def test_compile_stall_objective(monkeypatch):
+    from lodestar_tpu.observability import sinks
+
+    compile_s = {"v": 0.0}
+    monkeypatch.setattr(
+        sinks,
+        "kernel_compile_snapshot",
+        lambda: {
+            "ops_jit_compile_seconds": compile_s["v"],
+            "export_trace_seconds": 0.0,
+        },
+    )
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(1))  # baseline read
+    compile_s["v"] = 0.2  # under the 1 s threshold
+    clock.set_time(clock.slot_start(2))
+    assert engine.breach_count(OBJ_COMPILE_STALL) == 0
+    compile_s["v"] = 2.5  # +2.3 s inside one slot: a stall
+    clock.set_time(clock.slot_start(3))
+    assert engine.breach_count(OBJ_COMPILE_STALL) == 1
+
+
+def test_anomaly_watcher_fires_on_delta(tmp_path):
+    clock, engine, recorder, _ring, _ = make_engine(tmp_path)
+    dropped = {"v": 0.0}
+    engine.add_watcher("queue_drop_burst", lambda: dropped["v"], threshold=64)
+    clock.set_time(clock.slot_start(1))  # baseline
+    dropped["v"] = 10.0  # small churn: no event
+    clock.set_time(clock.slot_start(2))
+    assert engine.m_anomalies.get("queue_drop_burst") == 0.0
+    dropped["v"] = 200.0  # +190 in one slot: burst
+    clock.set_time(clock.slot_start(3))
+    assert engine.m_anomalies.get("queue_drop_burst") == 1.0
+    reasons = [b["reason"] for b in FR.list_bundles(recorder.directory)]
+    assert "event.queue_drop_burst" in reasons
+
+
+def test_historical_sync_imports_are_skipped_not_breached():
+    """Review fix: range-sync/backfill replay thousands of old blocks
+    through the same import path; judging them against deadlines that
+    expired hours ago would flood the counters with breaches that say
+    nothing about the live pipeline."""
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(100))
+    for slot in range(10, 60):  # a range-sync batch, all far behind
+        engine.on_block_imported(slot)
+    assert engine.m_evaluations.get(OBJ_IMPORT_BOUNDARY) == 0
+    assert engine.breach_count(OBJ_IMPORT_BOUNDARY) == 0
+    # the live edge still evaluates: head-1 and head are judged
+    engine.on_block_imported(99, t=clock.slot_start(99) + 0.1 * SPS)
+    engine.on_block_imported(100, t=clock.slot_start(100) + 0.1 * SPS)
+    assert engine.m_evaluations.get(OBJ_IMPORT_BOUNDARY) == 2
+
+
+def test_side_fork_reimport_is_judged_once():
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(1))
+    engine.on_block_imported(1, t=clock.slot_start(1) + 0.1 * SPS)
+    engine.on_block_imported(1, t=clock.slot_start(1) + 5.0 * SPS)  # late dup
+    assert engine.m_evaluations.get(OBJ_IMPORT_BOUNDARY) == 1
+    assert engine.breach_count(OBJ_IMPORT_BOUNDARY) == 0
+
+
+def test_attestation_less_slot_is_skipped_not_breached():
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(1))
+    clock.set_time(clock.slot_start(2))  # slot 1 had no attestations
+    assert engine.m_evaluations.get(OBJ_AGGREGATE_INPUTS) == 0
+    assert engine.breach_count(OBJ_AGGREGATE_INPUTS) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bounded cost
+# ---------------------------------------------------------------------------
+
+
+def test_per_slot_evaluation_and_sampling_under_1ms():
+    pipeline = PipelineStub()
+    clock, engine, _rec, _ring, _ = make_engine(pipeline=pipeline)
+    n = 500
+    # warm the code paths once before timing
+    drive_healthy_slot(clock, engine, 1, pipeline)
+    t0 = time.perf_counter()
+    for slot in range(2, n + 2):
+        start = clock.slot_start(slot)
+        clock.set_time(start)
+        engine.on_attestation(slot, t=start + 0.4 * SPS)
+        engine.on_block_imported(slot, t=start + 0.2 * SPS)
+        if slot % 8 == 0:
+            pipeline.add("critical", 0.01)
+    per_slot = (time.perf_counter() - t0) / n
+    assert per_slot < 1e-3, f"SLO tick cost {per_slot * 1e3:.3f} ms/slot"
+    for obj in ALL_OBJECTIVES:
+        assert engine.breach_count(obj) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: recorder bounds under a breach storm
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_rate_limit_suppresses_storm(tmp_path):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=3600.0, registry=Registry()
+    )
+    first = rec.record("slo.import_before_boundary", {"slot": 1})
+    assert first is not None
+    for i in range(20):
+        assert rec.record("slo.import_before_boundary", {"slot": i}) is None
+    assert len(FR.list_bundles(rec.directory)) == 1
+    assert rec.m_suppressed.value == 20
+    assert rec.status()["suppressed"] == 20
+
+
+def test_recorder_bundle_count_cap(tmp_path):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"),
+        min_interval_s=0.0,
+        max_bundles=3,
+        registry=Registry(),
+    )
+    for i in range(9):
+        assert rec.record(f"reason-{i}") is not None
+    bundles = FR.list_bundles(rec.directory)
+    assert len(bundles) == 3
+    # oldest pruned, newest kept
+    assert bundles[-1]["reason"] == "reason-8"
+    assert bundles[0]["reason"] == "reason-6"
+
+
+def test_recorder_byte_cap_keeps_newest(tmp_path):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"),
+        min_interval_s=0.0,
+        max_bundles=1000,
+        max_total_bytes=20_000,
+        registry=Registry(),
+    )
+    rec.add_provider("blob", lambda: {"pad": "x" * 8_000})
+    for i in range(10):
+        assert rec.record(f"big-{i}") is not None
+    bundles = FR.list_bundles(rec.directory)
+    total = sum(b["bytes"] for b in bundles)
+    assert total <= 20_000 + 10_000  # cap + at most one newest bundle over
+    assert len(bundles) < 10
+    assert bundles[-1]["reason"] == "big-9"
+
+
+def test_recorder_failed_write_releases_rate_limit_window(tmp_path, monkeypatch):
+    """Review fix: a failed bundle write must not burn the whole
+    rate-limit window — the next trigger retries, so a storm's first
+    DIAGNOSTIC bundle is not lost to a transient disk error."""
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=3600.0, registry=Registry()
+    )
+    real_makedirs = FR.os.makedirs
+    boom = {"on": True}
+
+    def flaky_makedirs(path, *a, **kw):
+        if boom["on"] and "fr-" in str(path):
+            raise OSError("disk full")
+        return real_makedirs(path, *a, **kw)
+
+    monkeypatch.setattr(FR.os, "makedirs", flaky_makedirs)
+    assert rec.record("slo.breach") is None  # write failed
+    boom["on"] = False
+    assert rec.record("slo.breach") is not None  # window released: retry lands
+    assert len(FR.list_bundles(rec.directory)) == 1
+    # and the window is CLAIMED again after the success
+    assert rec.record("slo.breach") is None
+    assert rec.m_suppressed.value == 1
+
+
+def test_recorder_status_is_ledger_backed(tmp_path):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=0.0, registry=Registry()
+    )
+    rec.record("a")
+    rec.record("b")
+    st = rec.status()
+    assert st["bundles"] == 2
+    assert st["total_bytes"] == sum(
+        b["bytes"] for b in FR.list_bundles(rec.directory)
+    )
+    # a fresh recorder over the same directory rebuilds the ledger
+    rec2 = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=0.0, registry=Registry()
+    )
+    assert rec2.status()["bundles"] == 2
+
+
+def test_recorder_provider_fault_is_captured_not_fatal(tmp_path):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=0.0, registry=Registry()
+    )
+
+    def broken():
+        raise RuntimeError("provider died")
+
+    rec.add_provider("broken", broken)
+    rec.add_provider("text", lambda: "plain exposition\n")
+    path = rec.record("anomaly")
+    assert path is not None
+    loaded = FR.load_bundle(path)
+    assert "provider died" in loaded["files"]["broken.json"]["error"]
+    assert loaded["files"]["text.txt"] == "plain exposition\n"
+
+
+# ---------------------------------------------------------------------------
+# surfaces: snapshot, REST handler, CLI
+# ---------------------------------------------------------------------------
+
+
+def test_breach_snapshot_reads_registry():
+    clock, engine, _rec, _ring, _ = make_engine()
+    assert breach_snapshot(engine.registry)["breaches"] == {}
+    clock.set_time(clock.slot_start(3) + 1.5 * SPS)
+    engine.on_block_imported(3)  # late
+    snap = breach_snapshot(engine.registry)
+    assert snap["breaches"][OBJ_IMPORT_BOUNDARY] == 1.0
+    assert snap["breaches"][OBJ_ATTESTATION_HEAD] == 1.0
+    assert snap["last_breach_slot"] == 3
+    # a registry with no engine reads as zeros, same shape
+    empty = breach_snapshot(Registry())
+    assert empty == {
+        "breaches": {},
+        "evaluations": {},
+        "anomaly_events": {},
+        "last_breach_slot": -1,
+    }
+
+
+def test_health_handler_and_cli(tmp_path):
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.observability.__main__ import main as obs_main
+
+    clock, engine, recorder, _ring, _ = make_engine(tmp_path)
+    handlers = DefaultHandlers(slo=engine, flight_recorder=recorder)
+    code, body = handlers.get_lodestar_health({}, None)
+    assert code == 200
+    assert body["data"]["status"] == "ok"
+    assert set(body["data"]["objectives"]) == set(ALL_OBJECTIVES)
+    assert body["data"]["flight_recorder"]["bundles"] == 0
+    # without an engine the route answers 501 like other absent parts
+    assert DefaultHandlers().get_lodestar_health({}, None)[0] == 501
+
+    api = BeaconApiServer(handlers, port=0)
+    api.listen()
+    try:
+        url = f"http://127.0.0.1:{api.port}"
+        assert obs_main(["health", "--url", url]) == 0
+        assert obs_main(["health", "--url", url, "--json"]) == 0
+        # a breach inside the degraded window flips the exit code
+        clock.set_time(clock.slot_start(2) + 1.5 * SPS)
+        engine.on_block_imported(2)
+        assert obs_main(["health", "--url", url]) == 1
+    finally:
+        api.close()
+    assert obs_main(["health"]) == 2  # --url is required
+
+
+def test_flightrec_cli_lists_and_inspects(tmp_path, capsys):
+    rec = FR.FlightRecorder(
+        str(tmp_path / "fr"), min_interval_s=0.0, registry=Registry()
+    )
+    rec.timeseries = TimeSeriesRing()
+    rec.timeseries.append(1.0, {"pending_sets": 3.0})
+    path = rec.record("slo.import_before_boundary", {"slot": 7})
+    from lodestar_tpu.observability.__main__ import main as obs_main
+
+    assert obs_main(["flightrec", rec.directory]) == 0
+    out = capsys.readouterr().out
+    assert "slo.import_before_boundary" in out
+    assert obs_main(["flightrec", path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["manifest"]["context"] == {"slot": 7}
+    assert summary["timeseries_rows"] == 1
+    assert obs_main(["flightrec", str(tmp_path / "empty")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# time-series ring mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_gauge_and_delta_sources():
+    ring = TimeSeriesRing(capacity=4)
+    sampler = MetricsSampler(ring)
+    state = {"level": 5.0, "total": 100.0}
+    sampler.add_gauge("level", lambda: state["level"])
+    sampler.add_delta("total", lambda: state["total"])
+
+    def broken():
+        raise RuntimeError("source died")
+
+    sampler.add_gauge("broken", broken)
+    sampler.sample(1.0)
+    state.update(level=7.0, total=130.0)
+    sampler.sample(2.0)
+    rows = ring.window()
+    assert rows[0] == {"t": 1.0, "level": 5.0, "total": 0.0, "broken": None}
+    assert rows[1] == {"t": 2.0, "level": 7.0, "total": 30.0, "broken": None}
+    # capacity bound: the ring keeps the newest rows only
+    for t in range(3, 9):
+        sampler.sample(float(t))
+    assert len(ring) == 4
+    assert ring.window(since=7.0)[0]["t"] == 7.0
+    assert ring.latest()["t"] == 8.0
+
+
+def test_histogram_totals_helper():
+    from lodestar_tpu.utils.metrics import Histogram, LabeledHistogram
+
+    h = Histogram("lodestar_x_seconds", "x", [0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    assert histogram_totals(h) == (2.0, 0.55)
+    lh = LabeledHistogram("lodestar_y_seconds", "y", "phase", [0.1])
+    lh.observe("a", 0.2)
+    lh.observe("b", 0.3)
+    count, total = histogram_totals(lh)
+    assert count == 2.0 and total == pytest.approx(0.5)
+    assert histogram_totals(None) == (0.0, 0.0)
+
+
+def test_timeseries_ring_concurrent_appends():
+    ring = TimeSeriesRing(capacity=256)
+    stop = threading.Event()
+
+    def writer(k):
+        i = 0
+        while not stop.is_set():
+            ring.append(float(i), {"w": float(k)})
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(200):
+            rows = ring.window()
+            assert len(rows) <= 256
+            assert all("t" in r for r in rows)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=5)
+
+
+def test_late_first_attestation_is_breached_not_skipped():
+    """Review fix: a first attestation arriving AFTER the slot
+    boundary is the worst 2/3-objective breach — it must be judged on
+    arrival, not recorded as an empty-subnet skip."""
+    clock, engine, _rec, _ring, _ = make_engine()
+    clock.set_time(clock.slot_start(1))
+    clock.set_time(clock.slot_start(2))  # slot 1's boundary: no data yet
+    assert engine.m_evaluations.get(OBJ_AGGREGATE_INPUTS) == 0
+    engine.on_attestation(1)  # lands mid-slot-2, way past 2/3 of slot 1
+    assert engine.m_evaluations.get(OBJ_AGGREGATE_INPUTS) == 1
+    assert engine.breach_count(OBJ_AGGREGATE_INPUTS) == 1
+    # a SECOND late attestation for the same slot does not re-judge
+    engine.on_attestation(1)
+    assert engine.m_evaluations.get(OBJ_AGGREGATE_INPUTS) == 1
+
+
+def test_p99_selects_worst_sample_for_small_n():
+    """Review fix: nearest-rank p99 must include the maximum for small
+    sample counts — one pathological flush per slot must trip the
+    critical-lane objective."""
+    pipeline = PipelineStub()
+    clock, engine, _rec, _ring, _ = make_engine(pipeline=pipeline)
+    pipeline.add("critical", 0.001)
+    pipeline.add("critical", 0.500)  # 12x over budget — the worst one
+    clock.set_time(clock.slot_start(1))
+    clock.set_time(clock.slot_start(2))
+    assert engine.breach_count(OBJ_CRITICAL_P99) == 1

@@ -156,6 +156,23 @@ def test_node_hygiene_negative(fixture_findings):
     assert not _by_file(fixture_findings, "hygiene_ok.py")
 
 
+def test_metric_hygiene_positive(fixture_findings):
+    hits = _by_file(fixture_findings, "metrics_bad.py")
+    msgs = [f.message for f in hits if f.rule == "metric-hygiene"]
+    assert any("lacks the lodestar_ prefix" in m for m in msgs), msgs
+    assert any("re-registered as gauge" in m for m in msgs), msgs
+    assert any(
+        "label 'peer_id'" in m and "unbounded-cardinality" in m
+        for m in msgs
+    ), msgs
+    assert any("label value built from `peer_id`" in m for m in msgs), msgs
+    assert len(msgs) == 4, msgs
+
+
+def test_metric_hygiene_negative(fixture_findings):
+    assert not _by_file(fixture_findings, "metrics_ok.py")
+
+
 def test_fingerprint_completeness_positive(fixture_findings):
     hits = _by_file(fixture_findings, "entries_bad.py")
     msgs = [
