@@ -213,6 +213,15 @@ def _emit_pipeline_skip(stage: str, detail: str) -> None:
     )
 
 
+def _emit_effective_skip(stage: str, detail: str) -> None:
+    _emit_failure(
+        stage,
+        detail,
+        metric="bls_pipeline_effective_atts_per_s",
+        unit="atts/s",
+    )
+
+
 def _probe_backend() -> None:
     """Initialize the TPU backend in THROWAWAY subprocesses with hard
     timeouts, so an unresponsive axon tunnel is diagnosed instead of
@@ -274,6 +283,10 @@ def _probe_backend() -> None:
         and os.environ.get("BENCH_MODE", "wire") != "decoded"
     ):
         _emit_pipeline_skip("backend-init-probe", last or "probe failed")
+        if os.environ.get("BENCH_PREAGG", "1") != "0":
+            _emit_effective_skip(
+                "backend-init-probe", last or "probe failed"
+            )
     sys.exit(1)
 
 
@@ -587,6 +600,8 @@ def main_wire():
         _probe_rlc(verifier, jobs)
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         _probe_pipeline(verifier)
+        if os.environ.get("BENCH_PREAGG", "1") != "0":
+            _probe_effective_atts(verifier)
 
 
 # -- RLC amortization + adversarial-floor probes (ISSUE 10) -----------------
@@ -722,78 +737,135 @@ def _probe_rlc(verifier, jobs) -> None:
 # per-slot attestation-data cadence) trickle through the NetworkProcessor
 # into the shape-bucketed accumulate-and-flush pipeline, with a few
 # block-critical aggregate submissions riding the short-deadline lane.
-# Reports verified-atts/s plus the two numbers the tentpole is judged
-# on: set-weighted mean bucket occupancy and p99 submit->verdict latency
-# for the critical lane.
+# Reports verified-atts/s plus the two numbers the ISSUE 11 tentpole is
+# judged on: set-weighted mean bucket occupancy and p99 submit->verdict
+# latency for the critical lane.  The ISSUE 13 probe below reuses the
+# same flood harness with a DUPLICATE-heavy shape.
 BENCH_PIPELINE_ATTS = int(os.environ.get("BENCH_PIPELINE_ATTS", "2048"))
 BENCH_PIPELINE_SUBNETS = int(os.environ.get("BENCH_PIPELINE_SUBNETS", "64"))
 BENCH_PIPELINE_WAVES = int(os.environ.get("BENCH_PIPELINE_WAVES", "8"))
 
 
+def _att_factory(verifier, sks, roots):
+    """j -> the j-th distinct WireSignatureSet over `roots`, signed with
+    the deterministic bench keys the verifier's table was built from
+    (index j -> pks[j % DISTINCT], tiled); signatures memoized so
+    repeated j yields byte-identical messages."""
+    capacity = len(verifier.table)
+    sig_cache = {}
+
+    def att(j):
+        vi = j % capacity
+        root = roots[j % len(roots)]
+        key = vi % DISTINCT
+        if (key, root) not in sig_cache:
+            sig_cache[(key, root)] = GCC.g2_compress(GTB.sign(sks[key], root))
+        return WireSignatureSet.single(vi, root, sig_cache[(key, root)])
+
+    return att
+
+
+def _drive_flood(pipeline, att, distinct, waves, dup):
+    """The shared flood harness (both pipeline probes): `distinct`
+    standard attestations in `waves` waves, each published `dup` times
+    (relay fan-in), plus two block-critical submissions per wave on the
+    short-deadline lane, all through a NetworkProcessor honoring the
+    pipeline's backpressure.  Returns (verdicts, dt_s, sorted crit
+    submit->verdict latencies)."""
+    import threading as _threading
+
+    from lodestar_tpu.bls.verifier import VerifyOptions
+    from lodestar_tpu.network.gossip_queues import GossipType
+    from lodestar_tpu.network.processor import (
+        NetworkProcessor,
+        PendingGossipMessage,
+    )
+    from lodestar_tpu.utils.metrics import Registry
+
+    lat_lock = _threading.Lock()
+    crit_lat, futs = [], []
+
+    def submit(ws, critical, peer):
+        t0 = time.perf_counter()
+        fut = pipeline.verify_signature_sets_async(
+            [ws],
+            VerifyOptions(batchable=True, priority=critical, peer_id=peer),
+        )
+        if critical:
+            def _done(_f, t0=t0):
+                with lat_lock:
+                    crit_lat.append(time.perf_counter() - t0)
+            fut.add_done_callback(_done)
+        futs.append(fut)
+
+    def worker(msg):
+        ws, critical = msg.data
+        submit(ws, critical, msg.peer_id)
+
+    # private registry: the probe's queue series must not leak into
+    # the process-global exposition (tests call this in-process)
+    proc = NetworkProcessor(
+        worker, [pipeline.can_accept_work], registry=Registry()
+    )
+    per_wave = max(1, distinct // waves)
+    t1 = time.perf_counter()
+    j = 0
+    for _wave in range(waves):
+        for _ in range(per_wave):
+            ws = att(j)
+            for d in range(dup):
+                proc.on_gossip_message(
+                    PendingGossipMessage(
+                        GossipType.beacon_attestation,
+                        (ws, False),
+                        peer_id="bench-peer-%d" % d,
+                    )
+                )
+            j += 1
+        # block-critical submissions ride the aggregate topic + the
+        # pipeline's short-deadline lane (the p99 the records report)
+        for _ in range(2):
+            proc.on_gossip_message(
+                PendingGossipMessage(
+                    GossipType.beacon_aggregate_and_proof,
+                    (att(j), True),
+                    peer_id="bench-peer",
+                )
+            )
+            j += 1
+        # drain anything backpressure parked, then next wave
+        while any(len(q) for q in proc.queues.values()):
+            proc.execute_work()
+            time.sleep(0.001)
+    verdicts = [f.result(timeout=600) for f in futs]
+    dt = time.perf_counter() - t1
+    return verdicts, dt, sorted(crit_lat)
+
+
+def _flood_p99(sorted_lat):
+    if not sorted_lat:
+        return None
+    return sorted_lat[min(len(sorted_lat) - 1, int(0.99 * (len(sorted_lat) - 1)))]
+
+
 def _probe_pipeline(verifier) -> None:
     t_stage0 = time.monotonic()
     try:
-        import threading as _threading
-
         from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
         from lodestar_tpu.bls.verifier import VerifyOptions
-        from lodestar_tpu.network.gossip_queues import GossipType
-        from lodestar_tpu.network.processor import (
-            NetworkProcessor,
-            PendingGossipMessage,
-        )
-        from lodestar_tpu.utils.metrics import Registry
 
         if not getattr(verifier, "_use_rlc", True):
             _emit_pipeline_skip(
                 "pipeline-probe", "LODESTAR_TPU_BLS_RLC=0: RLC disabled"
             )
             return
-        # the same deterministic keys build_wire_world registered in the
-        # verifier's table (index j -> pks[j % DISTINCT], tiled)
         sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
-        capacity = len(verifier.table)
         roots = [
             b"pipeline subnet root %d" % s
             for s in range(BENCH_PIPELINE_SUBNETS)
         ]
-        sig_cache = {}
-
-        def att(j):
-            vi = j % capacity
-            root = roots[j % BENCH_PIPELINE_SUBNETS]
-            key = vi % DISTINCT
-            if (key, root) not in sig_cache:
-                sig_cache[(key, root)] = GCC.g2_compress(
-                    GTB.sign(sks[key], root)
-                )
-            return WireSignatureSet.single(vi, root, sig_cache[(key, root)])
-
+        att = _att_factory(verifier, sks, roots)
         pipeline = BlsVerificationPipeline(verifier)
-        lat_lock = _threading.Lock()
-        crit_lat, futs = [], []
-
-        def submit(ws, critical):
-            t0 = time.perf_counter()
-            fut = pipeline.verify_signature_sets_async(
-                [ws], VerifyOptions(batchable=True, priority=critical)
-            )
-            if critical:
-                def _done(_f, t0=t0):
-                    with lat_lock:
-                        crit_lat.append(time.perf_counter() - t0)
-                fut.add_done_callback(_done)
-            futs.append(fut)
-
-        def worker(msg):
-            ws, critical = msg.data
-            submit(ws, critical)
-
-        # private registry: the probe's queue series must not leak into
-        # the process-global exposition (tests call this in-process)
-        proc = NetworkProcessor(
-            worker, [pipeline.can_accept_work], registry=Registry()
-        )
 
         # hash all subnet roots in one device batch + warm the critical
         # lane's bucket before the timed region (compile/trace is the
@@ -805,36 +877,9 @@ def _probe_pipeline(verifier) -> None:
         ), "pipeline warmup failed verification"
         pipeline.reset_flush_stats()
 
-        per_wave = max(1, BENCH_PIPELINE_ATTS // BENCH_PIPELINE_WAVES)
-        t1 = time.perf_counter()
-        j = 0
-        for wave in range(BENCH_PIPELINE_WAVES):
-            for _ in range(per_wave):
-                proc.on_gossip_message(
-                    PendingGossipMessage(
-                        GossipType.beacon_attestation,
-                        (att(j), False),
-                        peer_id="bench-peer",
-                    )
-                )
-                j += 1
-            # two block-critical submissions per wave ride the
-            # aggregate topic + the pipeline's short-deadline lane
-            for _ in range(2):
-                proc.on_gossip_message(
-                    PendingGossipMessage(
-                        GossipType.beacon_aggregate_and_proof,
-                        (att(j), True),
-                        peer_id="bench-peer",
-                    )
-                )
-                j += 1
-            # drain anything backpressure parked, then next wave
-            while any(len(q) for q in proc.queues.values()):
-                proc.execute_work()
-                time.sleep(0.001)
-        verdicts = [f.result(timeout=600) for f in futs]
-        dt = time.perf_counter() - t1
+        verdicts, dt, crit_lat = _drive_flood(
+            pipeline, att, BENCH_PIPELINE_ATTS, BENCH_PIPELINE_WAVES, dup=1
+        )
         occupancy = pipeline.mean_fill_ratio()
         reasons = {}
         for rec in pipeline.flush_stats():
@@ -853,12 +898,7 @@ def _probe_pipeline(verifier) -> None:
                 f"{len(verdicts) - n_ok} valid atts failed verification",
             )
             return
-        crit_lat.sort()
-        p99 = (
-            crit_lat[min(len(crit_lat) - 1, int(0.99 * (len(crit_lat) - 1)))]
-            if crit_lat
-            else None
-        )
+        p99 = _flood_p99(crit_lat)
         atts_per_s = len(verdicts) / dt
         print(
             json.dumps(
@@ -882,6 +922,126 @@ def _probe_pipeline(verifier) -> None:
         )
     except Exception as e:  # noqa: BLE001 — probe failures emit a skip record
         _emit_pipeline_skip("pipeline-probe", f"{type(e).__name__}: {e}")
+
+
+# -- pre-verify aggregation probe (ISSUE 13) --------------------------------
+# The same flood harness, DUPLICATE-heavy: every distinct (validator,
+# root) message is published BENCH_PREAGG_DUP times (gossip relay
+# fan-in) and each subnet root is attested by a committee's worth of
+# validators, so the aggregation stage has both dedupe and same-root
+# point-adds to exploit.  Reports the tentpole's three numbers:
+# effective atts/s (every verdict delivered), verified sets/s (what
+# actually reached the pairing), and their ratio — the mean aggregation
+# factor the acceptance criteria bound at >= 3.
+BENCH_PREAGG_ATTS = int(os.environ.get("BENCH_PREAGG_ATTS", "2048"))
+BENCH_PREAGG_SUBNETS = int(os.environ.get("BENCH_PREAGG_SUBNETS", "64"))
+BENCH_PREAGG_DUP = int(os.environ.get("BENCH_PREAGG_DUP", "2"))
+BENCH_PREAGG_WAVES = int(os.environ.get("BENCH_PREAGG_WAVES", "8"))
+
+
+def _probe_effective_atts(verifier) -> None:
+    t_stage0 = time.monotonic()
+    try:
+        from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
+        from lodestar_tpu.bls.verifier import VerifyOptions
+
+        if not getattr(verifier, "_use_rlc", True):
+            _emit_effective_skip(
+                "preagg-probe", "LODESTAR_TPU_BLS_RLC=0: RLC disabled"
+            )
+            return
+        if os.environ.get(
+            "LODESTAR_TPU_BLS_PREAGG", "1"
+        ).strip().lower() in ("0", "false", "no", "off"):
+            _emit_effective_skip(
+                "preagg-probe", "LODESTAR_TPU_BLS_PREAGG=0: stage disabled"
+            )
+            return
+        sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
+        roots = [
+            b"preagg subnet root %d" % s for s in range(BENCH_PREAGG_SUBNETS)
+        ]
+        att = _att_factory(verifier, sks, roots)
+        pipeline = BlsVerificationPipeline(verifier)
+        if pipeline._agg is None:
+            _emit_effective_skip(
+                "preagg-probe", "verifier cannot aggregate (no stage)"
+            )
+            pipeline.close()
+            return
+
+        # warm on a DISJOINT root namespace: warmup messages must never
+        # seed the seen-map/buckets the measured flood then hits, or
+        # the dedupe would flatter the timed region
+        warm_roots = [
+            b"preagg warm root %d" % s for s in range(BENCH_PREAGG_SUBNETS)
+        ]
+        verifier.messages.get_many(roots + warm_roots)
+        warm_att = _att_factory(verifier, sks, warm_roots)
+        warm = [warm_att(j) for j in range(128)]
+        assert pipeline.verify_signature_sets(
+            warm, VerifyOptions(batchable=True)
+        ), "preagg warmup failed verification"
+        base_stats = pipeline.agg_stats()
+
+        distinct = max(1, BENCH_PREAGG_ATTS // BENCH_PREAGG_DUP)
+        verdicts, dt, crit_lat = _drive_flood(
+            pipeline, att, distinct, BENCH_PREAGG_WAVES, dup=BENCH_PREAGG_DUP
+        )
+        stats = pipeline.agg_stats()
+        pipeline.close()
+        n_ok = sum(1 for v in verdicts if v)
+        _phase_mark(
+            "preagg_probe",
+            time.monotonic() - t_stage0,
+            ok=n_ok == len(verdicts),
+            atts=len(verdicts),
+        )
+        if n_ok != len(verdicts):
+            _emit_effective_skip(
+                "preagg-probe",
+                f"{len(verdicts) - n_ok} valid atts failed verification",
+            )
+            return
+        contributions = stats["contributions"] - base_stats["contributions"]
+        sets_out = stats["sets"] - base_stats["sets"]
+        if sets_out <= 0:
+            _emit_effective_skip(
+                "preagg-probe", "aggregation stage produced no sets"
+            )
+            return
+        factor = contributions / sets_out
+        p99 = _flood_p99(crit_lat)
+        atts_per_s = len(verdicts) / dt
+        print(
+            json.dumps(
+                {
+                    "metric": "bls_pipeline_effective_atts_per_s",
+                    "value": round(atts_per_s, 2),
+                    "unit": "atts/s",
+                    "vs_baseline": round(atts_per_s / BASELINE_SETS_PER_S, 4),
+                    "verified_sets_per_s": round(sets_out / dt, 2),
+                    "aggregation_factor_mean": round(factor, 4),
+                    "dedup": stats["dedup"] - base_stats["dedup"],
+                    "seen_served": (
+                        stats["seen_served"] - base_stats["seen_served"]
+                    ),
+                    "bisections": (
+                        stats["bisections"] - base_stats["bisections"]
+                    ),
+                    "critical_p99_submit_to_verdict_s": (
+                        round(p99, 4) if p99 is not None else None
+                    ),
+                    "phases": _phase_snapshot(),
+                    "slo": _slo_snapshot(),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 — probe failures emit a skip record
+        _emit_effective_skip("preagg-probe", f"{type(e).__name__}: {e}")
+
+
 
 
 def build_decoded_inputs():

@@ -151,21 +151,43 @@ def build_table(paths: List[str]) -> dict:
 # bls_rlc_bisect_seconds) — the regression gate inverts for these
 _LOWER_IS_BETTER_UNITS = {"s", "seconds", "ms", "us"}
 
+# authoritative unit registry for metrics whose archived records might
+# predate (or drop) the "unit" field — keeps the regression gate
+# direction-aware even for unit-less cells.  New probes register here.
+_METRIC_UNITS = {
+    "bls_signature_sets_verified_per_s": "sets/s",
+    "bls_rlc_signature_sets_verified_per_s": "sets/s",
+    "bls_rlc_bisect_seconds": "s",
+    "bls_pipeline_verified_atts_per_s": "atts/s",
+    # ISSUE 13: effective throughput AFTER pre-verify aggregation —
+    # atts/s, higher is better; a drop beyond threshold exits 1
+    "bls_pipeline_effective_atts_per_s": "atts/s",
+    "state_roots_per_s": "roots/s",
+}
 
-def _lower_is_better(row: List[dict]) -> bool:
+
+def _lower_is_better(row: List[dict], metric: Optional[str] = None) -> bool:
     unit = next(
         (c.get("unit") for c in reversed(row) if c.get("unit")), None
     )
+    if unit is None and metric is not None:
+        unit = _METRIC_UNITS.get(metric)
     return unit in _LOWER_IS_BETTER_UNITS
 
 
-def is_regression(metric_row: List[dict], delta: Optional[dict], threshold: float) -> bool:
-    """Direction-aware: throughput (sets/s, roots/s, ...) regresses when
-    it DROPS beyond the threshold; time metrics (unit 's') regress when
-    they GROW beyond it."""
+def is_regression(
+    metric_row: List[dict],
+    delta: Optional[dict],
+    threshold: float,
+    metric: Optional[str] = None,
+) -> bool:
+    """Direction-aware: throughput (sets/s, atts/s, roots/s, ...)
+    regresses when it DROPS beyond the threshold; time metrics (unit
+    's') regress when they GROW beyond it.  `metric` resolves the
+    direction through _METRIC_UNITS when the cells carry no unit."""
     if delta is None or delta["ratio"] is None:
         return False
-    if _lower_is_better(metric_row):
+    if _lower_is_better(metric_row, metric):
         return delta["ratio"] > 1.0 + threshold
     return delta["ratio"] < 1.0 - threshold
 
@@ -219,7 +241,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     regressions = {
         m: d
         for m, d in dts.items()
-        if is_regression(table["metrics"][m], d, args.threshold)
+        if is_regression(table["metrics"][m], d, args.threshold, metric=m)
     }
 
     if args.json:
@@ -269,7 +291,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         for m in sorted(regressions):
             d = regressions[m]
             direction = (
-                "time grew" if _lower_is_better(table["metrics"][m])
+                "time grew" if _lower_is_better(table["metrics"][m], m)
                 else "throughput dropped"
             )
             print(

@@ -11,10 +11,13 @@ batched JAX kernels on a device:
   service        — BlsVerifierService: the flat coalescing job queue
   pipeline       — BlsVerificationPipeline: shape-bucketed accumulate-
                    and-flush feed with priority lanes (ISSUE 11)
+  aggregator     — PreVerifyAggregator: same-root bucketing + dedupe +
+                   G2 point-add ahead of the verify queue (ISSUE 13)
   metrics        — lodestar_bls_thread_pool_* compatible counters
 """
 
 from .signature_set import SignatureSet, SignatureSetType  # noqa: F401
-from .pubkey_table import PubkeyTable  # noqa: F401
+from .pubkey_table import PubkeyTable, plan_disjoint_gathers  # noqa: F401
 from .verifier import TpuBlsVerifier, VerifyOptions  # noqa: F401
 from .pipeline import BlsVerificationPipeline, create_bls_service  # noqa: F401
+from .aggregator import PreVerifyAggregator  # noqa: F401

@@ -47,6 +47,17 @@ Observability: every flush emits a `bls.pipeline.flush` span
 (utils/metrics.py); `flush_stats()` exposes the same records to tests
 and the `bench.py bls_pipeline_verified_atts_per_s` probe.
 
+Ahead of the accumulators sits the PRE-VERIFY AGGREGATION stage
+(ISSUE 13, bls/aggregator.py): batchable standard-lane wire sets are
+bucketed by signing root, exact duplicates deduped (in-flight followers
++ a resolved-verdict seen-map), and each bucket's disjoint-index layers
+point-add their signatures in G2 to verify as ONE set through the same
+RLC batch path — per-message verdicts fan back out, and a failed layer
+bisects contributor-wise.  The stage engages only when the verifier can
+aggregate (`aggregate_wire_signatures`) and `LODESTAR_TPU_BLS_PREAGG`
+is not 0; off, every message verifies as its own set exactly as in
+PR 11.
+
 Escape hatch: `LODESTAR_TPU_BLS_PIPELINE=0` makes `create_bls_service`
 return the PR 10 flat-buffer `BlsVerifierService` instead.
 """
@@ -124,6 +135,8 @@ class BlsVerificationPipeline(BlsVerifierService):
         critical_wait_ms: float = CRITICAL_WAIT_MS,
         standard_wait_ms: float = STANDARD_WAIT_MS,
         high_water_sets: int = HIGH_WATER_SETS,
+        preagg: Optional[bool] = None,
+        scorer=None,
         **kwargs,
     ):
         # attrs the dispatcher thread reads must exist before
@@ -139,6 +152,14 @@ class BlsVerificationPipeline(BlsVerifierService):
         # engine's per-slot critical-lane p99) remember the last seq
         # they saw instead of re-counting the ring
         self._flush_seq = 0
+        # pre-verify aggregation stage (ISSUE 13): requires a verifier
+        # that can point-add wire signatures; LODESTAR_TPU_BLS_PREAGG=0
+        # restores per-message verification
+        self._agg = None
+        sum_fn = getattr(verifier, "aggregate_wire_signatures", None)
+        if preagg is None:
+            env = os.environ.get("LODESTAR_TPU_BLS_PREAGG", "1")
+            preagg = env.strip().lower() not in ("0", "false", "no", "off")
         kwargs.setdefault("max_buffered_sigs", N_BUCKETS[-1])
         kwargs.setdefault("buffer_wait_ms", standard_wait_ms)
         # backpressure is counted in SETS here: the inherited job cap
@@ -148,6 +169,15 @@ class BlsVerificationPipeline(BlsVerifierService):
         # binding constraint while still bounding bookkeeping
         kwargs.setdefault("max_pending_jobs", high_water_sets)
         super().__init__(verifier, **kwargs)
+        if preagg and sum_fn is not None:
+            from .aggregator import PreVerifyAggregator
+
+            self._agg = PreVerifyAggregator(
+                self,
+                self._lane_wait[LANE_STANDARD],
+                sum_fn,
+                scorer=scorer,
+            )
         # full-window cap per bucket: the largest exact fill the device
         # accepts — past it the flush can only split into capped runs
         self._max_fill = (
@@ -170,6 +200,58 @@ class BlsVerificationPipeline(BlsVerifierService):
         with self._lock:
             return self._pending_sets
 
+    # -- pre-verify aggregation seams (ISSUE 13) ---------------------------
+
+    def set_scorer(self, scorer) -> None:
+        """Late-bind the gossip peer scorer (the node builds it after
+        the service): isolated invalid contributors then charge their
+        publisher (bls/aggregator.py attribution)."""
+        if self._agg is not None:
+            self._agg.scorer = scorer
+
+    def verify_signature_sets_async(self, sets, opts=None):
+        fut = super().verify_signature_sets_async(sets, opts)
+        if self._agg is not None and self._agg._deferred:
+            # deliver verdicts the aggregation stage settled under the
+            # submission lock (seen-map serves) outside it.  The
+            # lock-free emptiness read keeps the common no-settlement
+            # submit at one lock acquisition; a racy stale read is
+            # harmless — every settling path drains its own deferrals
+            # (_on_layer_done) or is followed by a drain (close)
+            self._agg.drain()
+        return fut
+
+    def preagg_verdict(self, wire_set) -> Optional[bool]:
+        """Resolved verdict for an exact (root, indices, signature)
+        match in the aggregation stage's seen-map, else None (the
+        gossip handlers' suppressed-duplicate fast path)."""
+        if self._agg is None:
+            return None
+        return self._agg.seen_verdict(wire_set)
+
+    def agg_stats(self) -> Optional[dict]:
+        if self._agg is None:
+            return None
+        return self._agg.stats_snapshot()
+
+    def mean_aggregation_factor(self) -> Optional[float]:
+        """Contributions per verified set through the aggregation stage
+        (None when the stage is off or idle) — the ISSUE 13 acceptance
+        number."""
+        if self._agg is None:
+            return None
+        return self._agg.mean_aggregation_factor()
+
+    def _dispatch(self, group) -> None:
+        if self._agg is not None:
+            for job in group:
+                # collapse pending layers into their aggregated set
+                # OUTSIDE the lock, in the dispatcher thread (the G2
+                # point-add is host/device work no submitter should
+                # serialize behind)
+                self._agg.materialize_job(job)
+        super()._dispatch(group)
+
     # -- the accumulate side ----------------------------------------------
 
     @staticmethod
@@ -190,6 +272,12 @@ class BlsVerificationPipeline(BlsVerifierService):
         return (wire, self._k_bucket(job), lane)
 
     def _submit_buffered_locked(self, job: _Job) -> None:
+        if self._agg is not None and self._agg.eligible(job):
+            # standard-lane wire sets route through the aggregation
+            # stage: bucketed by signing root, deduped, layered, and
+            # verified as aggregated sets (bls/aggregator.py)
+            self._agg.add_locked(job)
+            return
         key = self._bucket_key(job)
         acc = self._buckets.get(key)
         if acc is None:
@@ -241,6 +329,8 @@ class BlsVerificationPipeline(BlsVerifierService):
         accumulator holding sets that will flush soon."""
         if self._queue or self._inflight_groups:
             return False
+        if self._agg is not None and self._agg.pending_contributions():
+            return False  # buffered aggregation work will flush soon
         return not any(
             acc.sets for k, acc in self._buckets.items() if k != key
         )
@@ -301,13 +391,29 @@ class BlsVerificationPipeline(BlsVerifierService):
                 next_deadline is None or acc.deadline < next_deadline
             ):
                 next_deadline = acc.deadline
+        if self._agg is not None:
+            agg_wait = self._agg.poll_locked(now)
+            if agg_wait is not None and (
+                next_deadline is None or now + agg_wait < next_deadline
+            ):
+                next_deadline = now + agg_wait
         if next_deadline is None:
             return None
         return max(next_deadline - now, 0.0)
 
     def _close_flush_locked(self) -> None:
+        if self._agg is not None:
+            # buffered contributions reject like queued jobs; layer
+            # jobs already queued/in-flight credit their members
+            # through the standard rejection/resolution callbacks
+            self._agg.close_locked()
         for key in list(self._buckets):
             self._flush_bucket_locked(key, "close")
+
+    def close(self) -> None:
+        super().close()
+        if self._agg is not None:
+            self._agg.drain()
 
     # -- introspection ----------------------------------------------------
 

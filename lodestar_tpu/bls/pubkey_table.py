@@ -29,6 +29,51 @@ from ..crypto import curves as C
 from ..kernels import layout as LY
 
 
+def plan_disjoint_gathers(
+    index_tuples: Sequence[Sequence[int]], max_indices: int
+) -> List[List[int]]:
+    """Pack contributor index tuples into layers with UNIQUE indices.
+
+    The pre-verify aggregation stage (bls/aggregator.py) merges wire
+    attestations sharing one signing root into one signature set whose
+    pubkey side is a device gather over the combined validator indices.
+    Naively concatenating the tuples fetches the same pubkey row once
+    per message when aggregation bits overlap — and, worse, repeated
+    rows change the aggregate sum (c*pk per c-fold index), which is why
+    the eth2 spec refuses to merge overlapping aggregates at all.  This
+    planner keeps both properties: contributors are packed greedily
+    (first fit, submission order) into layers whose index sets are
+    pairwise DISJOINT and whose combined size stays <= `max_indices`,
+    so within a layer every pubkey row is gathered exactly once and the
+    plain G1 tree-add is the exact aggregate pubkey.  Overlapping
+    contributors land in separate layers (one extra verified set per
+    overlap depth — rare outside adversarial floods, since the seen
+    caches already dedupe per-validator gossip).
+
+    Returns layers as lists of POSITIONS into `index_tuples`.  A
+    contributor whose own tuple repeats an index or exceeds
+    `max_indices` gets a singleton layer (verified as submitted, never
+    merged).
+    """
+    layers: List[List[int]] = []
+    layer_sets: List[set] = []
+    for pos, idxs in enumerate(index_tuples):
+        own = set(idxs)
+        if len(own) != len(idxs) or len(idxs) > max_indices:
+            layers.append([pos])
+            layer_sets.append(set())  # poisoned: nothing else joins
+            continue
+        for li, seen in enumerate(layer_sets):
+            if seen and not (seen & own) and len(seen) + len(own) <= max_indices:
+                layers[li].append(pos)
+                seen |= own
+                break
+        else:
+            layers.append([pos])
+            layer_sets.append(set(own))
+    return layers
+
+
 class PubkeyTable:
     """Append-only affine G1 table with device mirror."""
 

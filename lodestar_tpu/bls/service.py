@@ -60,8 +60,11 @@ MAX_INFLIGHT_JOBS = 4
 
 
 class _Job:
+    # `agg_members` is set only on the pre-verify aggregation stage's
+    # internal layer jobs (bls/aggregator.py): the contributions whose
+    # verdicts the job's future fans out to
     __slots__ = ("sets", "opts", "future", "t_submit", "t_submit_ns",
-                 "trace_parent")
+                 "trace_parent", "agg_members")
 
     def __init__(self, sets, opts):
         self.sets = sets

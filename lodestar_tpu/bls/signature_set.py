@@ -99,6 +99,15 @@ class WireSignatureSet:
             bytes(signature),
         )
 
+    def dedupe_key(self) -> Tuple[bytes, Tuple[int, ...], bytes]:
+        """The exact-identity key of this statement: (signing root,
+        indices, signature bytes).  Two wire sets with equal keys are
+        the SAME message (BLS signing is deterministic), so one verdict
+        serves both — the pre-verify aggregation stage's dedupe index
+        and seen-map key on this (bls/aggregator.py); anything looser
+        would let a forged duplicate ride an honest verdict."""
+        return (self.signing_root, self.indices, self.signature)
+
     @staticmethod
     def external(pubkeys: Sequence[bytes], signing_root: bytes, signature: bytes):
         """A set whose keys are not validator-registry members."""

@@ -74,6 +74,8 @@ class VerifyOptions:
         batchable: bool = False,
         verify_on_main_thread: bool = False,
         priority: bool = False,
+        peer_id: Optional[str] = None,
+        topic: Optional[str] = None,
     ):
         self.batchable = batchable
         self.verify_on_main_thread = verify_on_main_thread
@@ -82,6 +84,12 @@ class VerifyOptions:
         # routes these onto its short-deadline lane so they are never
         # starved behind subnet-attestation bucket fill
         self.priority = priority
+        # publish attribution (ISSUE 13): when the pre-verify
+        # aggregation stage isolates THIS submission's signature as the
+        # invalid one in a failed aggregate, the named peer is charged
+        # through the gossip scorer (bls/aggregator.py)
+        self.peer_id = peer_id
+        self.topic = topic
 
 
 class _DeviceJob:
@@ -333,6 +341,144 @@ class TpuBlsVerifier:
         return CP.multi_pairing_is_one(
             [(agg, s.message), (CB.NEG_G1_GEN, s.signature)]
         )
+
+    # -- pre-verify signature aggregation (ISSUE 13) ----------------------
+
+    def aggregate_wire_signatures(
+        self, groups: Sequence[Sequence[bytes]]
+    ) -> List[Optional[bytes]]:
+        """Point-add each group's compressed G2 signatures -> one
+        compressed aggregate per group (None when a member is
+        undecodable — the caller then dispatches the members
+        unaggregated).  This is the aggregation stage's sum seam
+        (bls/aggregator.py): on the TPU backend the adds run in one
+        batched device dispatch (kernels/verify.aggregate_g2_sum_device
+        via the `agg_g2_sum` export-cache entry); elsewhere — and as
+        the fault fallback — the host ground-truth path decompresses
+        and jacobian-adds per group."""
+        groups = [list(g) for g in groups]
+        if not groups:
+            return []
+        if self._use_agg_device():
+            try:
+                return self._aggregate_wire_device(groups)
+            except Exception as e:  # noqa: BLE001 — aggregation must
+                # never take down verification; host fallback
+                import logging
+
+                logging.getLogger("lodestar_tpu").warning(
+                    "device signature aggregation failed (%s); host path", e
+                )
+        return [self._aggregate_wire_host(g) for g in groups]
+
+    def _use_agg_device(self) -> bool:
+        env = os.environ.get("LODESTAR_TPU_AGG_DEVICE")
+        if env is not None:
+            return env.strip().lower() not in ("0", "false", "no", "off", "")
+        return jax.default_backend() == "tpu"
+
+    @staticmethod
+    def _aggregate_wire_host(sigs: List[bytes]) -> Optional[bytes]:
+        from ..crypto.curves import g2_compress, g2_decompress
+
+        pts = []
+        for s in sigs:
+            try:
+                pts.append(g2_decompress(s))
+            except ValueError:
+                return None
+        return g2_compress(C.multi_add(C.FP2_OPS, pts))
+
+    def _aggregate_wire_device(
+        self, groups: List[List[bytes]]
+    ) -> List[Optional[bytes]]:
+        """One `agg_g2_sum` dispatch per <= BT groups: segmented G2 sum
+        of the decompressed signatures, group heads converted to affine
+        on device, compressed back on the host (no sqrt — y is known)."""
+        out: List[Optional[bytes]] = []
+        start = 0
+        while start < len(groups):
+            chunk: List[List[bytes]] = []
+            total = 0
+            while (
+                start + len(chunk) < len(groups)
+                and len(chunk) < KV.BT
+                and (
+                    not chunk
+                    or total + len(groups[start + len(chunk)])
+                    <= N_BUCKETS[-1]
+                )
+            ):
+                total += len(groups[start + len(chunk)])
+                chunk.append(groups[start + len(chunk)])
+            out.extend(self._aggregate_chunk_device(chunk, total))
+            start += len(chunk)
+        return out
+
+    def _aggregate_chunk_device(
+        self, chunk: List[List[bytes]], total: int
+    ) -> List[Optional[bytes]]:
+        from ..crypto.curves import g2_compress
+        from .ingest import encode_wire_planes
+
+        n = _bucket(total, N_BUCKETS)
+        flat = [s for g in chunk for s in g]
+        sig_x0, sig_x1, flags, host_bad = encode_wire_planes(flat, n)
+        group = np.zeros(n, np.int32)
+        head_lanes = np.zeros(KV.BT, np.int32)
+        glive = np.zeros(KV.BT, np.int32)
+        pos = 0
+        for gi, g in enumerate(chunk):
+            group[pos : pos + len(g)] = gi
+            pos += len(g)
+            head_lanes[gi] = pos - 1
+            glive[gi] = 1
+        # padding lanes: fresh ids so they can never merge into the
+        # last real group (they are dead either way)
+        if n > total:
+            group[total:] = np.arange(
+                len(chunk), len(chunk) + n - total, dtype=np.int32
+            )
+        ax0, ax1, ay0, ay1, g_inf, ok_row = self._device_call(
+            "agg_g2_sum",
+            KV.aggregate_g2_sum_device,
+            (
+                jnp.asarray(sig_x0), jnp.asarray(sig_x1), jnp.asarray(flags),
+                jnp.asarray(group), jnp.asarray(head_lanes),
+                jnp.asarray(glive),
+            ),
+        )
+        ok = np.asarray(ok_row)[0, :total] != 0
+        ok &= ~host_bad[:total]
+        g_inf = np.asarray(g_inf)[0] != 0
+        ax0, ax1, ay0, ay1 = (
+            np.asarray(a) for a in (ax0, ax1, ay0, ay1)
+        )
+        out: List[Optional[bytes]] = []
+        pos = 0
+        rinv, p = LY.R_INV, LY.P
+        for gi, g in enumerate(chunk):
+            members_ok = bool(ok[pos : pos + len(g)].all())
+            pos += len(g)
+            if not members_ok:
+                # an off-curve/undecodable member: the device excluded
+                # it from the sum, so the total is NOT the aggregate —
+                # the caller falls back to unaggregated dispatch
+                out.append(None)
+                continue
+            if g_inf[gi]:
+                out.append(g2_compress(None))
+                continue
+            x = (
+                int(LY.from_limbs(ax0[:, gi])) * rinv % p,
+                int(LY.from_limbs(ax1[:, gi])) * rinv % p,
+            )
+            y = (
+                int(LY.from_limbs(ay0[:, gi])) * rinv % p,
+                int(LY.from_limbs(ay1[:, gi])) * rinv % p,
+            )
+            out.append(g2_compress((x, y)))
+        return out
 
     def begin_job(self, sets: List[SignatureSet], batchable: bool) -> "_DeviceJob":
         """Dispatch one job (<= max_job_sets sets) WITHOUT blocking.

@@ -401,5 +401,19 @@ def _register_builtin_entries() -> None:
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
 
+    # the pre-verify aggregation stage's batched G2-sum (ISSUE 13):
+    # same crypto-constant fingerprint scope as the verify entries (the
+    # decompression + group-law kernels bake the same curve constants)
+    def _agg_g2_sum():
+        from .rlc_entries import export_specs_agg_g2_sum
+
+        return export_specs_agg_g2_sum()
+
+    register_entry(
+        "agg_g2_sum",
+        _agg_g2_sum,
+        sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
+    )
+
 
 _register_builtin_entries()

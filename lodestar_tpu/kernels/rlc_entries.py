@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from . import verify as KV
 from .verify import (
+    aggregate_g2_sum_device,
     verify_batch_device,
     verify_batch_device_wire,
     verify_batch_device_wire_grouped,
@@ -130,4 +131,21 @@ def export_specs_each_decoded(
     return (
         verify_each_device,
         _decoded_common(n, k, table) + [_sds((n,))],
+    )
+
+
+def export_specs_agg_g2_sum(n: int = DEF_N) -> Tuple:
+    """The pre-verify aggregation stage's batched G2-sum dispatch
+    (ISSUE 13): compressed signature planes + flag bits, segment ids,
+    group head lanes + liveness (bls/verifier._aggregate_chunk_device
+    builds exactly these)."""
+    nl = KV.NL
+    return (
+        aggregate_g2_sum_device,
+        [
+            _sds((nl, n)), _sds((nl, n)),       # sig_x0, sig_x1
+            _sds((2, n)),                       # sig (sign, inf) flags
+            _sds((n,)),                         # group ids
+            _sds((KV.BT,)), _sds((KV.BT,)),     # head_lanes, glive
+        ],
     )

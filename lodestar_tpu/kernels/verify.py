@@ -828,6 +828,97 @@ def verify_batch_device_wire_grouped(
     return _j_batch_verdict(ok2, sub, live, pk_inf, sig_bad, valid)
 
 
+# ---------------------------------------------------------------------------
+# Pre-verify signature aggregation (ISSUE 13: bls/aggregator.py)
+#
+# k wire signatures sharing one signing root point-add into ONE G2
+# point before verification: the pairing check then costs one set
+# instead of k.  The sum is a segmented jacobian prefix-scan over the
+# lane axis (the FP2 twin of _j_seg_sum_g1), fed by the SAME device
+# decompression kernel the wire verify path uses; group totals gather
+# onto one BT-lane tile and convert to affine (Montgomery form — the
+# host converts limbs to ground-truth ints and re-compresses).
+# ---------------------------------------------------------------------------
+
+
+@JD.ops_jit
+def _j_seg_sum_g2(x0, x1, y0, y1, dead, group):
+    """Segmented inclusive jacobian prefix-scan over the lane axis in
+    G2 (see _j_seg_sum_g1 for the roll-based scheme).  `dead` lanes
+    count as infinity; the LAST lane of each segment holds its total."""
+    n = group.shape[0]
+    one2 = CV._one_plane_like(CV.FP2_OPS, (x0, x1))
+    pts = ((x0, x1), (y0, y1), one2)
+    inf = dead
+    lane = jnp.arange(n, dtype=jnp.int32)
+    s = 1
+    while s < n:
+        prev = jax.tree_util.tree_map(
+            lambda a: jnp.roll(a, s, axis=-1), pts
+        )
+        prev_inf = jnp.roll(inf, s)
+        prev_group = jnp.roll(group, s)
+        ok = (lane >= s) & (prev_group == group)
+        pts, inf = CV.jac_add_full(
+            CV.FP2_OPS, pts, inf, prev, jnp.where(ok, prev_inf, True)
+        )
+        s *= 2
+    return (*pts[0], *pts[1], *pts[2], inf)
+
+
+@JD.ops_jit
+def _j_agg_heads(px0, px1, py0, py1, pz0, pz1, seg_inf, head_lanes, glive):
+    """Gather each group's jacobian total (its last lane) onto one
+    BT-lane tile; dead group lanes are flagged via the inf row (cheap
+    gather/select glue — the affine conversion reuses the tiled
+    _k_affine_g2 kernel the batch tail already compiles)."""
+    heads = [
+        jnp.take(a, head_lanes, axis=-1)
+        for a in (px0, px1, py0, py1, pz0, pz1)
+    ]
+    g_inf = jnp.take(seg_inf, head_lanes) | (glive == 0)
+    return (*heads, g_inf[None, :].astype(jnp.int32))
+
+
+def aggregate_g2_sum_device(sig_x0, sig_x1, sig_flags, group, head_lanes, glive):
+    """Batched G2 point-add of compressed wire signatures, grouped.
+
+    sig planes/flags: the encode_wire_planes layout for n signatures (n
+    a multiple of BT); group: int32[n] nondecreasing lane-contiguous
+    group ids; head_lanes: int32[BT] lane of each group's LAST member;
+    glive: int32[BT] 1 for real groups (<= BT groups per dispatch).
+
+    Returns (ax0, ax1, ay0, ay1, g_inf_row, ok_row):
+      - affine G2 planes [NL, BT] in MONTGOMERY form, one aggregate per
+        group head lane (generator-substituted where g_inf — the same
+        dead-lane convention as _k_affine_g2 in the batch tail),
+      - g_inf_row int32[1, BT]: the group total is the point at
+        infinity (compresses to the infinity encoding),
+      - ok_row int32[1, n]: per-input decompression success — a False
+        lane means the caller must drop to the host path for that
+        group (the flagged signature is off-curve/undecodable and the
+        device sum excluded it).
+    """
+    n = sig_flags.shape[1]
+    (sx0, sx1, sy0, sy1), dec_ok = _decompress_sig(sig_x0, sig_x1, sig_flags, n)
+    bad = (sig_flags[1] != 0) | ~dec_ok
+    px0, px1, py0, py1, pz0, pz1, seg_inf = _j_seg_sum_g2(
+        sx0, sx1, sy0, sy1, bad, group
+    )
+    hx0, hx1, hy0, hy1, hz0, hz1, hinf = _j_agg_heads(
+        px0, px1, py0, py1, pz0, pz1, seg_inf, head_lanes, glive
+    )
+    ax0, ax1, ay0, ay1, g_inf = _tiled(
+        _k_affine_g2,
+        (hx0, hx1, hy0, hy1, hz0, hz1, hinf),
+        [NL] * 6 + [1],
+        [NL] * 4 + [1],
+        BT,
+    )
+    ok_row = (~bad)[None, :].astype(jnp.int32)
+    return ax0, ax1, ay0, ay1, g_inf, ok_row
+
+
 def verify_each_device(
     table_x, table_y, idx, kmask,
     msg_x0, msg_x1, msg_y0, msg_y1,

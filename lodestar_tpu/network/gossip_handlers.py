@@ -213,10 +213,22 @@ class GossipHandlers:
             for i in indexed["attesting_indices"]
         ):
             return
-        ok = self.validators.verifier.verify_signature_sets(
-            [get_indexed_attestation_signature_set(view, indexed)],
-            VerifyOptions(batchable=True),
-        )
+        sset = get_indexed_attestation_signature_set(view, indexed)
+        # a suppressed duplicate usually IS a message the pre-verify
+        # aggregation stage already judged (same data root => same
+        # bucket): serve the verdict from its seen-map — exact
+        # (root, indices, signature) match only, so a forged duplicate
+        # can never ride an honest verdict — and pay the standalone
+        # verification only on a miss (ISSUE 13 satellite)
+        ok = None
+        service = getattr(self.validators, "service", None)
+        lookup = getattr(service, "preagg_verdict", None)
+        if lookup is not None:
+            ok = lookup(sset)
+        if ok is None:
+            ok = self.validators.verifier.verify_signature_sets(
+                [sset], VerifyOptions(batchable=True)
+            )
         self.slasher.record_equivocation_probe(
             indexed["attesting_indices"], target, root, bool(ok)
         )

@@ -193,13 +193,19 @@ class BeaconNode:
         # already happens in the verifier's MessageCache keyed by root.
         ws = WireSignatureSet.single(validator_index, signing_root, signature)
         # subnet attestations ride the pipeline's standard (long-window)
-        # lane; block-critical topics (aggregate_and_proof, blocks) would
-        # pass priority=True for the short-deadline lane
+        # lane — where the pre-verify aggregation stage buckets them by
+        # signing root (ISSUE 13); block-critical topics
+        # (aggregate_and_proof, blocks) would pass priority=True for the
+        # short-deadline lane.  peer_id/topic attribute the publish so a
+        # contributor isolated as invalid by aggregate bisection charges
+        # its publisher through the gossip scorer.
         fut = self.bls.verify_signature_sets_async(
             [ws],
             VerifyOptions(
                 batchable=True,
                 priority=msg.topic is not GossipType.beacon_attestation,
+                peer_id=msg.peer_id,
+                topic=msg.topic.value if msg.topic is not None else None,
             ),
         )
         self._pending_attesters.add((epoch, validator_index))
@@ -425,6 +431,11 @@ class FullBeaconNode:
                     ),
                     scorer=self.scorer,
                 )
+        if self.scorer is not None and hasattr(self.bls, "set_scorer"):
+            # pre-verify aggregation attribution (ISSUE 13): a
+            # contributor isolated as invalid by contributor-wise
+            # bisection charges its publisher (bls/aggregator.py)
+            self.bls.set_scorer(self.scorer)
 
         # network processor over the validators' backpressure (queue
         # latency/depth series land in this node's registry)
@@ -483,6 +494,17 @@ class FullBeaconNode:
             )
             sampler.add_delta(
                 "bucket_fill_ratio_count", lambda: m.bucket_fill_ratio.count
+            )
+            # pre-verify aggregation (ISSUE 13): per-slot sum/count of
+            # the lodestar_bls_aggregation_factor histogram — the slot's
+            # mean messages-per-verified-set is sum/count
+            sampler.add_delta(
+                "bls_aggregation_factor_sum",
+                lambda: m.aggregation_factor.sum,
+            )
+            sampler.add_delta(
+                "bls_aggregation_factor_count",
+                lambda: m.aggregation_factor.count,
             )
             sampler.add_delta(
                 "gossip_queue_latency_seconds",

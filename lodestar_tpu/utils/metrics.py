@@ -412,6 +412,38 @@ class BlsPoolMetrics:
             "lodestar_bls_pipeline_pending_sets",
             "Buffered + queued + in-flight signature sets (high-water unit)",
         )
+        # pre-verify aggregation stage (ISSUE 13, bls/aggregator.py):
+        # how many gossip messages each verified set carries
+        self.aggregation_factor = r.histogram(
+            "lodestar_bls_aggregation_factor",
+            "Contributions per verified signature set at each "
+            "aggregation-stage flush (dedupe + same-root point-adds)",
+            [1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0],
+        )
+        self.preagg_contributions = r.counter(
+            "lodestar_bls_preagg_contributions_total",
+            "Signature-set submissions routed through the pre-verify "
+            "aggregation stage",
+        )
+        self.preagg_sets = r.counter(
+            "lodestar_bls_preagg_sets_total",
+            "Aggregated/leaf signature sets the stage handed to the "
+            "verify path",
+        )
+        self.preagg_dedup = r.counter(
+            "lodestar_bls_preagg_dedup_total",
+            "Exact-duplicate contributions sharing an in-flight twin's "
+            "verdict",
+        )
+        self.preagg_seen_served = r.counter(
+            "lodestar_bls_preagg_seen_served_total",
+            "Contributions served from the resolved-verdict seen-map "
+            "with zero device work",
+        )
+        self.preagg_bisections = r.counter(
+            "lodestar_bls_preagg_bisections_total",
+            "Failed aggregates split contributor-wise for attribution",
+        )
 
 
 class BlsSingleThreadMetrics:

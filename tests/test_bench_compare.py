@@ -191,3 +191,52 @@ def test_real_repo_rounds_parse_clean():
     d = bench_compare.deltas(table)["bls_signature_sets_verified_per_s"]
     assert d["prev_round"] == "r01" and d["last_round"] == "r02"
     assert bench_compare.main(paths) == 0
+
+
+def test_effective_atts_metric_direction_registered(tmp_path, capsys):
+    """ISSUE 13 satellite: `bls_pipeline_effective_atts_per_s` is a
+    throughput metric — a drop beyond threshold exits 1, a rise exits 0,
+    and the direction holds even when archived cells lost their unit
+    (the _METRIC_UNITS registry pins it)."""
+    m = "bls_pipeline_effective_atts_per_s"
+    assert bench_compare._METRIC_UNITS[m] == "atts/s"
+    drop = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 9000.0,
+                              "unit": "atts/s"}]),
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 4000.0,
+                              "unit": "atts/s"}]),
+    ]
+    assert bench_compare.main(drop + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+    rise = [
+        _round(tmp_path / "BENCH_r03.json",
+               tail_records=[{"metric": m, "value": 4000.0}]),  # no unit
+        _round(tmp_path / "BENCH_r04.json",
+               tail_records=[{"metric": m, "value": 9000.0}]),
+    ]
+    assert bench_compare.main(rise + ["--threshold", "0.05"]) == 0
+    capsys.readouterr()
+    unitless_drop = [
+        _round(tmp_path / "BENCH_r05.json",
+               tail_records=[{"metric": m, "value": 9000.0}]),
+        _round(tmp_path / "BENCH_r06.json",
+               tail_records=[{"metric": m, "value": 4000.0}]),
+    ]
+    assert bench_compare.main(unitless_drop + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+
+
+def test_unitless_time_metric_direction_resolved_by_registry(tmp_path, capsys):
+    """A unit-less bls_rlc_bisect_seconds GROWTH still gates (the
+    registry knows it is lower-is-better)."""
+    m = "bls_rlc_bisect_seconds"
+    grow = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 1.0}]),
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 3.0}]),
+    ]
+    assert bench_compare.main(grow + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()

@@ -1,0 +1,892 @@
+"""Pre-verify attestation aggregation (ISSUE 13, bls/aggregator.py).
+
+Stub-verifier (host-only) tests of the tentpole contract: signing-root
+bucketing, exact-duplicate dedupe + the resolved-verdict seen-map,
+disjoint-layer packing (unique gather indices), contributor-wise
+bisection with publisher attribution, the escape hatch, the randomized
+verdict-equivalence property (aggregated-then-bisected == per-message),
+and the acceptance oracle: mean aggregation factor >= 3 under a
+duplicate-heavy flood at an unchanged critical-lane p99.  The slow tier
+(test_kernels_verify-style real crypto) exercises the device G2-sum.
+"""
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
+from lodestar_tpu.bls.pubkey_table import plan_disjoint_gathers
+from lodestar_tpu.bls.signature_set import WireSignatureSet
+from lodestar_tpu.bls.verifier import VerifyOptions
+from lodestar_tpu.utils.metrics import BlsPoolMetrics
+
+pytestmark = pytest.mark.smoke
+
+
+def _multiset(xs):
+    return tuple(sorted(xs))
+
+
+class StubAggVerifier:
+    """IBlsVerifier stub that models BLS aggregation semantics without
+    curve math: signatures are opaque 96-byte tokens bound to a
+    (root, index-multiset, valid) oracle entry; aggregating tokens
+    produces a token whose validity is the AND of its members (the
+    almost-sure behaviour of real point addition for honestly-formed
+    invalid signatures).  begin/finish expose per-set verdicts so the
+    service's positional slicing works exactly as with the device."""
+
+    max_job_sets = 512
+
+    class _Handle:
+        def __init__(self, sets, verdicts):
+            self.sets = sets
+            self.ok_big = True
+            self.batch_retries = 0
+            self.batch_sigs_success = sum(verdicts)
+            self.verdicts = verdicts
+
+    def __init__(self):
+        self.metrics = BlsPoolMetrics()
+        self.oracle = {}
+        self.begun = []
+        self.sum_calls = 0
+        self._lock = threading.Lock()
+
+    def sig(self, root, indices, ok=True):
+        payload = repr((root, _multiset(indices), ok)).encode()
+        b = bytearray(96)
+        b[0] = 0x80  # compression bit; x coords stay < p
+        b[1:33] = hashlib.sha256(payload).digest()
+        s = bytes(b)
+        self.oracle[s] = (root, _multiset(indices), ok)
+        return s
+
+    def aggregate_wire_signatures(self, groups):
+        out = []
+        with self._lock:
+            self.sum_calls += 1
+        for g in groups:
+            infos = [self.oracle.get(s) for s in g]
+            if any(i is None for i in infos):
+                out.append(None)
+                continue
+            root = infos[0][0]
+            idx = tuple(i for info in infos for i in info[1])
+            ok = all(info[2] for info in infos) and all(
+                info[0] == root for info in infos
+            )
+            out.append(self.sig(root, idx, ok))
+        return out
+
+    def _verdict(self, s):
+        o = self.oracle.get(s.signature)
+        return bool(
+            o is not None
+            and o[0] == s.signing_root
+            and o[1] == _multiset(s.indices)
+            and o[2]
+        )
+
+    def verify_signature_sets(self, sets, opts=None):
+        return all(self._verdict(s) for s in sets)
+
+    def begin_job(self, sets, batchable):
+        v = [self._verdict(s) for s in sets]
+        with self._lock:
+            self.begun.append(list(sets))
+        return self._Handle(list(sets), v)
+
+    def finish_job(self, handle):
+        return all(handle.verdicts)
+
+    def close(self):
+        pass
+
+
+def wire(v, root, indices, ok=True, sig=None):
+    indices = tuple(indices)
+    s = sig if sig is not None else v.sig(root, indices, ok)
+    if len(indices) == 1:
+        return WireSignatureSet.single(indices[0], root, s)
+    return WireSignatureSet.aggregate(indices, root, s)
+
+
+def submit(pipe, ws, priority=False, peer_id=None):
+    return pipe.verify_signature_sets_async(
+        [ws],
+        VerifyOptions(
+            batchable=True,
+            priority=priority,
+            peer_id=peer_id,
+            topic="beacon_attestation",
+        ),
+    )
+
+
+ROOT = b"r" * 32
+ROOT2 = b"q" * 32
+
+
+def make_pipe(v=None, wait_ms=60, **kw):
+    v = v or StubAggVerifier()
+    pipe = BlsVerificationPipeline(v, standard_wait_ms=wait_ms, **kw)
+    return v, pipe
+
+
+# -- bucketing + layering ----------------------------------------------------
+
+
+def test_same_root_messages_verify_as_one_aggregated_set():
+    v, pipe = make_pipe()
+    assert pipe._agg is not None
+    futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(6)]
+    assert all(f.result(timeout=10) for f in futs)
+    pipe.close()
+    # ONE begun device job carrying ONE 6-index aggregate set
+    agg_sets = [s for g in v.begun for s in g]
+    assert len(agg_sets) == 1
+    assert _multiset(agg_sets[0].indices) == (0, 1, 2, 3, 4, 5)
+    assert agg_sets[0].signing_root == ROOT
+    assert pipe.mean_aggregation_factor() == pytest.approx(6.0)
+    assert v.metrics.aggregation_factor.count == 1
+
+
+def test_distinct_roots_bucket_separately_but_share_one_device_job():
+    v, pipe = make_pipe()
+    futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(3)]
+    futs += [submit(pipe, wire(v, ROOT2, (10 + i,))) for i in range(3)]
+    assert all(f.result(timeout=10) for f in futs)
+    pipe.close()
+    assert len(v.begun) == 1  # one flush group -> one merged device job
+    roots = {s.signing_root for s in v.begun[0]}
+    assert roots == {ROOT, ROOT2}
+    assert len(v.begun[0]) == 2  # one aggregate per bucket
+
+
+def test_overlapping_bits_split_into_disjoint_layers_with_unique_indices():
+    """ISSUE 13 satellite regression (heavy-overlap bits): every
+    aggregated set's gather indices are UNIQUE — overlapping
+    contributors go to separate layers instead of fetching (and
+    point-adding) the same pubkey row with the wrong multiplicity."""
+    v, pipe = make_pipe()
+    # five 3-bit aggregates, all containing validator 7
+    futs = [
+        submit(pipe, wire(v, ROOT, (7, 100 + 2 * i, 101 + 2 * i)))
+        for i in range(5)
+    ]
+    assert all(f.result(timeout=10) for f in futs)
+    pipe.close()
+    sets = [s for g in v.begun for s in g]
+    for s in sets:
+        assert len(set(s.indices)) == len(s.indices), s.indices
+    # validator 7 appears once per layer, never twice in one set
+    assert sum(s.indices.count(7) for s in sets) == 5
+    assert len(sets) == 5  # pairwise overlap => one layer each
+
+
+def test_plan_disjoint_gathers_unit():
+    # disjoint contributors pack into one layer
+    assert plan_disjoint_gathers([(1, 2), (3, 4), (5,)], 64) == [[0, 1, 2]]
+    # overlap forces a second layer
+    assert plan_disjoint_gathers([(1, 2), (2, 3)], 64) == [[0], [1]]
+    # the second layer still packs disjoint latecomers
+    assert plan_disjoint_gathers([(1,), (1, 2), (3,)], 64) == [[0, 2], [1]]
+    # max_indices bounds a layer
+    assert plan_disjoint_gathers([(1, 2), (3, 4)], 3) == [[0], [1]]
+    # a contributor with self-repeated indices is isolated (poisoned
+    # layer: nothing may join it)
+    plan = plan_disjoint_gathers([(1, 1), (2,), (3,)], 64)
+    assert [0] in plan and any(set(l) == {1, 2} for l in plan)
+
+
+# -- dedupe + seen-map -------------------------------------------------------
+
+
+def test_exact_duplicates_share_one_contribution():
+    v, pipe = make_pipe()
+    s0 = v.sig(ROOT, (0,))
+    futs = [submit(pipe, wire(v, ROOT, (0,), sig=s0)) for _ in range(5)]
+    futs.append(submit(pipe, wire(v, ROOT, (1,))))
+    assert all(f.result(timeout=10) for f in futs)
+    pipe.close()
+    stats = pipe.agg_stats()
+    assert stats["dedup"] == 4  # four followers of the first copy
+    assert stats["contributions"] == 6
+    # the device saw ONE 2-index aggregate, not 6 sets
+    sets = [s for g in v.begun for s in g]
+    assert len(sets) == 1 and _multiset(sets[0].indices) == (0, 1)
+    assert pipe.mean_aggregation_factor() == pytest.approx(6.0)
+
+
+def test_seen_map_serves_resolved_duplicates_with_zero_work():
+    v, pipe = make_pipe()
+    s0 = v.sig(ROOT, (0,))
+    ws = wire(v, ROOT, (0,), sig=s0)
+    assert submit(pipe, ws).result(timeout=10) is True
+    begun_before = len(v.begun)
+    # an identical replay resolves instantly from the seen-map
+    fut = submit(pipe, wire(v, ROOT, (0,), sig=s0))
+    assert fut.result(timeout=1) is True
+    assert len(v.begun) == begun_before  # no new device work
+    assert pipe.agg_stats()["seen_served"] == 1
+    # the public lookup the gossip handlers use — exact match only
+    assert pipe.preagg_verdict(ws) is True
+    forged = wire(v, ROOT, (0,), ok=False)  # same (root, index), new sig
+    assert pipe.preagg_verdict(forged) is None
+    pipe.close()
+
+
+def test_negative_verdicts_are_remembered_too():
+    v, pipe = make_pipe()
+    bad = v.sig(ROOT, (3,), ok=False)
+    ws = wire(v, ROOT, (3,), sig=bad)
+    assert submit(pipe, ws).result(timeout=10) is False
+    fut = submit(pipe, wire(v, ROOT, (3,), sig=bad))
+    assert fut.result(timeout=1) is False
+    assert pipe.preagg_verdict(ws) is False
+    pipe.close()
+
+
+# -- bisection + attribution -------------------------------------------------
+
+
+def test_failed_aggregate_bisects_to_the_single_bad_contributor():
+    v, pipe = make_pipe()
+    futs = [
+        submit(pipe, wire(v, ROOT, (i,), ok=(i != 5))) for i in range(8)
+    ]
+    res = [f.result(timeout=10) for f in futs]
+    pipe.close()
+    assert res == [True] * 5 + [False] + [True] * 2
+    stats = pipe.agg_stats()
+    assert stats["bisections"] >= 1
+    assert v.metrics.preagg_bisections.value >= 1
+    # O(log k): the bad contributor was isolated in ~2*log2(8) extra
+    # sets, not a full per-message sweep
+    assert stats["sets"] <= 1 + 2 * 3
+
+
+def test_bisection_attributes_invalid_contributor_to_its_publisher():
+    class ScorerSpy:
+        def __init__(self):
+            self.charged = []
+
+        def on_invalid_message(self, peer, topic):
+            self.charged.append((peer, topic))
+
+    scorer = ScorerSpy()
+    v, pipe = make_pipe(scorer=scorer)
+    futs = [
+        submit(pipe, wire(v, ROOT, (i,), ok=(i != 2)), peer_id=f"peer-{i}")
+        for i in range(4)
+    ]
+    res = [f.result(timeout=10) for f in futs]
+    pipe.close()
+    assert res == [True, True, False, True]
+    assert scorer.charged == [("peer-2", "beacon_attestation")]
+
+
+def test_set_scorer_late_binds():
+    class ScorerSpy:
+        def __init__(self):
+            self.charged = []
+
+        def on_invalid_message(self, peer, topic):
+            self.charged.append(peer)
+
+    v, pipe = make_pipe()
+    scorer = ScorerSpy()
+    pipe.set_scorer(scorer)
+    fut = submit(pipe, wire(v, ROOT, (0,), ok=False), peer_id="px")
+    assert fut.result(timeout=10) is False
+    pipe.close()
+    assert scorer.charged == ["px"]
+
+
+def test_unparsable_and_infinity_signatures_fail_without_poisoning():
+    v, pipe = make_pipe()
+    good = submit(pipe, wire(v, ROOT, (0,)))
+    garbage = WireSignatureSet.single(1, ROOT, b"\x00" * 96)  # no C bit
+    inf = WireSignatureSet.single(2, ROOT, bytes([0xC0]) + b"\x00" * 95)
+    f_garbage = submit(pipe, garbage)
+    f_inf = submit(pipe, inf)
+    assert f_garbage.result(timeout=10) is False
+    assert f_inf.result(timeout=10) is False
+    assert good.result(timeout=10) is True
+    pipe.close()
+    # neither reached the aggregate (verdicts were immediate)
+    sets = [s for g in v.begun for s in g]
+    assert all(len(s.indices) == 1 and s.indices[0] == 0 for s in sets)
+
+
+# -- escape hatch + eligibility ----------------------------------------------
+
+
+def test_escape_hatch_disables_the_stage(monkeypatch):
+    monkeypatch.setenv("LODESTAR_TPU_BLS_PREAGG", "0")
+    v = StubAggVerifier()
+    pipe = BlsVerificationPipeline(v, standard_wait_ms=40)
+    assert pipe._agg is None
+    futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(4)]
+    assert all(f.result(timeout=10) for f in futs)
+    pipe.close()
+    # every message verified as its own set (PR 11 behaviour)
+    assert sorted(len(g) for g in v.begun) and sum(
+        len(g) for g in v.begun
+    ) == 4
+    assert pipe.mean_aggregation_factor() is None
+    assert pipe.preagg_verdict(wire(v, ROOT, (0,))) is None
+
+
+def test_verifier_without_sum_seam_disables_the_stage():
+    from tests.test_bls_pipeline import HandleStub
+
+    pipe = BlsVerificationPipeline(HandleStub(), standard_wait_ms=40)
+    assert pipe._agg is None
+    pipe.close()
+
+
+def test_priority_and_nonwire_jobs_bypass_the_stage():
+    from lodestar_tpu.bls.signature_set import SignatureSet
+
+    v, pipe = make_pipe(wait_ms=10_000, critical_wait_ms=30)
+    crit = submit(pipe, wire(v, ROOT, (0,)), priority=True)
+    assert crit.result(timeout=10) is True  # critical lane, no 10s wait
+    decoded = pipe.verify_signature_sets_async(
+        [SignatureSet.single(0, ("m", 0), ("s", 0))],
+        VerifyOptions(batchable=True),
+    )
+    time.sleep(0.05)
+    assert pipe.agg_stats()["contributions"] == 0
+    pipe.close()
+    del decoded
+
+
+# -- the property test (ISSUE 13 satellite) ----------------------------------
+
+
+@pytest.mark.parametrize("preagg", [True, False])
+def test_verdict_equivalence_randomized(preagg, monkeypatch):
+    """Aggregated-then-bisected verdicts == per-message individual
+    verification across valid/invalid mixes, overlapping aggregation
+    bits, duplicates, and odd bucket sizes — with the stage on AND off
+    (the acceptance criterion's both-ways run)."""
+    import random
+
+    monkeypatch.setenv("LODESTAR_TPU_BLS_PREAGG", "1" if preagg else "0")
+    rng = random.Random(1337)
+    v = StubAggVerifier()
+    pipe = BlsVerificationPipeline(v, standard_wait_ms=30)
+    assert (pipe._agg is not None) == preagg
+    roots = [bytes([r]) * 32 for r in range(5)]
+    messages = []
+    for _ in range(90):
+        root = rng.choice(roots)
+        k = rng.choice([1, 1, 1, 2, 3])
+        indices = tuple(rng.sample(range(12), k))
+        ok = rng.random() > 0.25
+        ws = wire(v, root, indices, ok=ok)
+        for _dup in range(rng.choice([1, 1, 2])):
+            messages.append((ws, ok))
+    futs = [(submit(pipe, ws), ok) for ws, ok in messages]
+    got = [f.result(timeout=30) for f, _ok in futs]
+    want = [ok for _f, ok in futs]
+    pipe.close()
+    assert got == want
+
+
+# -- acceptance oracle -------------------------------------------------------
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(0.99 * (len(xs) - 1)))] if xs else None
+
+
+def test_duplicate_flood_meets_aggregation_factor_acceptance():
+    """ISSUE 13 acceptance (fast stub): a duplicate-heavy 8-wave flood —
+    each distinct message published twice, 8 attesters per root — must
+    deliver effective atts >= 3x verified sets (mean aggregation factor
+    >= 3) while block-critical sets keep the PR 11 critical-lane p99
+    (30 ms window + scheduler slack)."""
+    v, pipe = make_pipe(wait_ms=120, critical_wait_ms=30)
+    crit_lat, futs = [], []
+    lock = threading.Lock()
+
+    def track_crit(ws):
+        t0 = time.perf_counter()
+        f = submit(pipe, ws, priority=True)
+        f.add_done_callback(
+            lambda _f, t0=t0: crit_lat.append(time.perf_counter() - t0)
+        )
+        futs.append(f)
+
+    roots = [bytes([r]) * 32 for r in range(8)]
+    j = 0
+    for wave in range(8):
+        for r, root in enumerate(roots):
+            for a in range(8):  # 8 attesters per root per wave
+                ws = wire(v, root, (wave * 64 + r * 8 + a,))
+                for _dup in range(2):  # duplicate-heavy: every message x2
+                    futs.append(submit(pipe, ws))
+                j += 2
+        track_crit(wire(v, bytes([100 + wave]) * 32, (999,)))
+        time.sleep(0.02)
+    assert all(f.result(timeout=30) for f in futs)
+    factor = pipe.mean_aggregation_factor()
+    stats = pipe.agg_stats()
+    pipe.close()
+    assert factor is not None and factor >= 3.0, (factor, stats)
+    assert stats["dedup"] + stats["seen_served"] >= j // 4
+    p99 = _p99(crit_lat)
+    assert p99 is not None and p99 <= 0.03 + 0.20, p99
+    del lock
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_preagg_flush_emits_span_and_factor_histogram():
+    from lodestar_tpu import observability as OB
+
+    OB.configure(enabled=True)
+    OB.get_tracer().clear()
+    try:
+        v, pipe = make_pipe()
+        futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(4)]
+        assert all(f.result(timeout=10) for f in futs)
+        pipe.close()
+        spans = [
+            r
+            for r in OB.get_tracer().snapshot()
+            if r.name == "bls.preagg.flush"
+        ]
+        assert spans, "no bls.preagg.flush span recorded"
+        attrs = spans[0].attrs
+        assert attrs["buckets"] == 1 and attrs["contributions"] == 4
+        assert attrs["sets"] == 1 and attrs["factor"] == pytest.approx(4.0)
+        assert attrs["reason"] == "deadline"
+        assert 0.0 <= attrs["oldest_wait_s"] < 5.0
+        assert v.metrics.aggregation_factor.count == 1
+        assert v.metrics.aggregation_factor.sum == pytest.approx(4.0)
+        assert v.metrics.preagg_contributions.value == 4
+        assert v.metrics.preagg_sets.value == 1
+    finally:
+        OB.configure(enabled=False)
+        OB.get_tracer().clear()
+
+
+def test_close_rejects_buffered_contributions():
+    v, pipe = make_pipe(wait_ms=60_000)
+    fut = submit(pipe, wire(v, ROOT, (0,)))
+    pipe.close()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=5)
+    assert pipe.pending_sets() == 0
+
+
+def test_pending_sets_counts_buffered_contributions():
+    v, pipe = make_pipe(wait_ms=60_000, high_water_sets=8)
+    futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(10)]
+    assert pipe.pending_sets() == 10
+    assert not pipe.can_accept_work()  # backpressure sees the stage
+    pipe.close()
+    del futs
+
+
+# -- suppressed-double-vote fast path (ISSUE 13 satellite) -------------------
+
+
+def _recovery_world(monkeypatch, pipe, v, ws):
+    """A GossipHandlers wired to stubs, with the signature-set builder
+    pinned to `ws` (the wire set whose verdict may sit in the
+    aggregation seen-map)."""
+    from lodestar_tpu.network.gossip_handlers import GossipHandlers
+    from lodestar_tpu.state_transition import signature_sets as SS
+
+    class RawSpy:
+        def __init__(self):
+            self.calls = 0
+
+        def verify_signature_sets(self, sets, opts=None):
+            self.calls += 1
+            return True
+
+    class SlasherStub:
+        def __init__(self):
+            self.probes = []
+            self.ingested = []
+
+        def should_check_equivocation(self, i, target, root):
+            return True
+
+        def record_equivocation_probe(self, idxs, target, root, ok):
+            self.probes.append((tuple(int(i) for i in idxs), bool(ok)))
+
+        def ingest_attestation(self, indexed):
+            self.ingested.append(indexed)
+
+    class ViewStub:
+        @staticmethod
+        def get_indexed_attestation(att):
+            return {
+                "attesting_indices": list(ws.indices),
+                "data": att["data"],
+                "signature": ws.signature,
+            }
+
+    raw = RawSpy()
+    handlers = GossipHandlers(chain=None, verifier=raw, bls_service=pipe)
+    handlers.slasher = SlasherStub()
+    monkeypatch.setattr(handlers.validators, "_view", lambda: ViewStub())
+    monkeypatch.setattr(
+        SS, "get_indexed_attestation_signature_set", lambda view, idx: ws
+    )
+    attestation = {
+        "data": {
+            "slot": 8,
+            "index": 0,
+            "beacon_block_root": b"\x00" * 32,
+            "source": {"epoch": 0, "root": b"\x00" * 32},
+            "target": {"epoch": 1, "root": b"\x11" * 32},
+        }
+    }
+    return handlers, raw, attestation
+
+
+def test_suppressed_double_vote_served_from_aggregation_seen_map(monkeypatch):
+    v, pipe = make_pipe()
+    ws = wire(v, ROOT, (7,))
+    assert submit(pipe, ws).result(timeout=10) is True  # seeds the seen-map
+    handlers, raw, att = _recovery_world(monkeypatch, pipe, v, ws)
+    handlers._recover_suppressed_double_vote(att)
+    assert raw.calls == 0  # verdict served, no standalone verification
+    assert handlers.slasher.probes == [((7,), True)]
+    assert len(handlers.slasher.ingested) == 1
+    pipe.close()
+
+
+def test_suppressed_double_vote_falls_back_on_seen_map_miss(monkeypatch):
+    v, pipe = make_pipe()
+    ws = wire(v, ROOT, (7,))  # never submitted -> not in the seen-map
+    handlers, raw, att = _recovery_world(monkeypatch, pipe, v, ws)
+    handlers._recover_suppressed_double_vote(att)
+    assert raw.calls == 1  # standalone verification paid as before
+    assert handlers.slasher.probes == [((7,), True)]
+    pipe.close()
+
+
+# -- bench probe (CI satellite) ----------------------------------------------
+
+
+def test_bench_effective_probe_skip_semantics(capsys):
+    import json
+
+    import bench
+
+    class Broken:
+        _use_rlc = True
+        table = []
+
+    bench._probe_effective_atts(Broken())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["metric"] == "bls_pipeline_effective_atts_per_s"
+    assert rec["value"] is None and rec["skipped"] is True
+    assert rec["unit"] == "atts/s"
+    assert "preagg-probe" in rec["error"]
+
+
+def test_bench_effective_probe_respects_escape_hatches(capsys, monkeypatch):
+    import json
+
+    import bench
+
+    class RlcOff:
+        _use_rlc = False
+
+    bench._probe_effective_atts(RlcOff())
+    monkeypatch.setenv("LODESTAR_TPU_BLS_PREAGG", "0")
+
+    class PreaggOff:
+        _use_rlc = True
+
+    bench._probe_effective_atts(PreaggOff())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 2 and all(r["skipped"] for r in recs)
+    assert "RLC disabled" in recs[0]["error"]
+    assert "stage disabled" in recs[1]["error"]
+
+
+def test_bench_effective_probe_happy_path_emits_record(capsys, monkeypatch):
+    """The probe's duplicate-heavy gossip->processor->pipeline loop
+    end-to-end with the stub verifier: one measured record carrying
+    effective atts/s, verified sets/s, and a mean aggregation factor
+    meeting the >= 3 acceptance bound."""
+    import json
+
+    import bench
+
+    stub = StubAggVerifier()
+
+    # root-keyed stub tokens replace real signing: verdicts/sums ignore
+    # indices (the probe's flood is all-valid)
+    def _verdict(s):
+        o = stub.oracle.get(s.signature)
+        return bool(o is not None and o[0] == s.signing_root and o[2])
+
+    stub._verdict = _verdict
+
+    def agg(groups):
+        out = []
+        for g in groups:
+            infos = [stub.oracle.get(s) for s in g]
+            if any(i is None for i in infos):
+                out.append(None)
+                continue
+            out.append(stub.sig(infos[0][0], (), all(i[2] for i in infos)))
+        return out
+
+    class FakeMessages:
+        def get_many(self, roots):
+            return [None] * len(roots)
+
+    class FakeVerifier:
+        _use_rlc = True
+        table = list(range(512))
+        messages = FakeMessages()
+        metrics = stub.metrics
+        max_job_sets = 512
+        aggregate_wire_signatures = staticmethod(agg)
+        verify_signature_sets = stub.verify_signature_sets
+        begin_job = stub.begin_job
+        finish_job = stub.finish_job
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(bench, "BENCH_PREAGG_ATTS", 64)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_SUBNETS", 4)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_DUP", 2)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_WAVES", 2)
+    monkeypatch.setattr(bench.GTB, "keygen", lambda seed: seed)
+    monkeypatch.setattr(bench.GTB, "sign", lambda sk, root: (sk, root))
+    monkeypatch.setattr(
+        bench.GCC, "g2_compress", lambda pt: stub.sig(pt[1], (), True)
+    )
+
+    bench._probe_effective_atts(FakeVerifier())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 1, recs
+    rec = recs[0]
+    assert rec["metric"] == "bls_pipeline_effective_atts_per_s"
+    assert rec.get("skipped") is None and rec["value"] > 0
+    assert rec["unit"] == "atts/s"
+    assert rec["aggregation_factor_mean"] >= 3.0
+    assert rec["verified_sets_per_s"] > 0
+    assert rec["value"] >= 3 * rec["verified_sets_per_s"] * 0.99
+    assert "slo" in rec
+
+
+# -- slow tier: real crypto + real kernels -----------------------------------
+
+
+def _real_world(n_keys=4):
+    import numpy as np
+
+    from lodestar_tpu.bls import PubkeyTable, TpuBlsVerifier
+    from lodestar_tpu.crypto import bls as GTB
+
+    sks = [GTB.keygen(b"preagg-%d" % i) for i in range(n_keys)]
+    pks = [GTB.sk_to_pk(sk) for sk in sks]
+    table = PubkeyTable(capacity=n_keys)
+    table.register(pks)
+    return sks, TpuBlsVerifier(table, rng=np.random.default_rng(3))
+
+
+@pytest.mark.slow
+def test_device_g2_sum_matches_host_ground_truth():
+    """kernels/verify.aggregate_g2_sum_device == the host decompress+
+    jacobian-add oracle, including multi-group dispatch, duplicate
+    members, and the undecodable-member None contract."""
+    from lodestar_tpu.crypto import bls as GTB
+    from lodestar_tpu.crypto import curves as GCC
+
+    sks, v = _real_world(4)
+    root = b"m" * 32
+    sigs = [GCC.g2_compress(GTB.sign(sk, root)) for sk in sks]
+    groups = [sigs[:3], sigs[3:4], [sigs[0], sigs[0]]]
+    host = [v._aggregate_wire_host(g) for g in groups]
+    dev = v._aggregate_wire_device(groups)
+    assert host == dev
+    ref = GCC.multi_add(
+        GCC.FP2_OPS, [GCC.g2_decompress(s) for s in groups[0]]
+    )
+    assert GCC.g2_decompress(host[0]) == ref
+    # an undecodable member voids the whole group (the caller then
+    # dispatches unaggregated)
+    bad = bytes([0x80]) + b"\xff" * 95
+    assert v._aggregate_wire_device([[sigs[0], bad]]) == [None]
+
+
+@pytest.mark.slow
+def test_preagg_real_crypto_verdicts_match_individual():
+    """End-to-end on the real verifier (host G2 sums on the CPU
+    backend, real RLC verification kernels): aggregated-then-bisected
+    verdicts equal per-message individual verification for a bucket
+    mixing valid signatures, a tampered one, and a duplicate."""
+    from lodestar_tpu.crypto import bls as GTB
+    from lodestar_tpu.crypto import curves as GCC
+
+    sks, v = _real_world(4)
+    root = b"real preagg root".ljust(32, b"\x00")
+    sigs = [GCC.g2_compress(GTB.sign(sk, root)) for sk in sks]
+    tampered = bytearray(sigs[2])
+    tampered[-1] ^= 0x01  # still decodable with overwhelming probability
+    messages = [
+        WireSignatureSet.single(0, root, sigs[0]),
+        WireSignatureSet.single(1, root, sigs[1]),
+        WireSignatureSet.single(2, root, bytes(tampered)),
+        WireSignatureSet.single(3, root, sigs[3]),
+        WireSignatureSet.single(0, root, sigs[0]),  # exact duplicate
+    ]
+    expected = v.verify_signature_sets_individually(list(messages))
+    pipe = BlsVerificationPipeline(v, standard_wait_ms=60)
+    assert pipe._agg is not None
+    futs = [submit(pipe, ws) for ws in messages]
+    got = [f.result(timeout=1200) for f in futs]
+    pipe.close()
+    assert got == expected
+    assert got == [True, True, False, True, True]
+    assert pipe.agg_stats()["dedup"] == 1
+
+
+def test_aggregate_chunk_device_wrapper_round_trips(monkeypatch):
+    """The verifier's `agg_g2_sum` host wrapper (fast, stubbed device):
+    group/padding layout handed to the dispatch, Montgomery->int->
+    compress conversion of the head planes, infinity groups, and the
+    None contract for groups with an undecodable member."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from lodestar_tpu.bls.pubkey_table import PubkeyTable
+    from lodestar_tpu.bls.verifier import TpuBlsVerifier
+    from lodestar_tpu.crypto import bls as GTB
+    from lodestar_tpu.crypto import curves as GCC
+    from lodestar_tpu.kernels import layout as LY
+    from lodestar_tpu.kernels import verify as KV
+
+    sks = [GTB.keygen(b"wrap-%d" % i) for i in range(3)]
+    root = b"w" * 32
+    pts = [GTB.sign(sk, root) for sk in sks]
+    sigs = [GCC.g2_compress(p) for p in pts]
+    neg = GCC.g2_compress((pts[0][0], GCC.F.fp2_neg(pts[0][1])))
+    groups = [sigs[:2], [sigs[2]], [sigs[0], neg]]  # last sums to O
+
+    v = TpuBlsVerifier(PubkeyTable(capacity=1), rng=np.random.default_rng(0))
+    seen = {}
+
+    def fake_device_call(name, fn, args):
+        assert name == "agg_g2_sum"
+        sig_x0, sig_x1, flags, group, head_lanes, glive = (
+            np.asarray(a) for a in args
+        )
+        n = flags.shape[1]
+        seen["layout"] = (group.copy(), head_lanes.copy(), glive.copy(), n)
+        # padding lanes carry fresh group ids and the inert flag
+        total = sum(len(g) for g in groups)
+        assert n % 128 == 0 and (flags[1, total:] == 1).all()
+        assert len(np.unique(group)) == len(groups) + (n - total)
+        # host-computed expected sums, emitted in the device layout
+        # (Montgomery planes, generator-substituted infinity lanes)
+        ax = np.zeros((KV.NL, KV.BT), np.int32)
+        ax1 = np.zeros((KV.NL, KV.BT), np.int32)
+        ay = np.zeros((KV.NL, KV.BT), np.int32)
+        ay1 = np.zeros((KV.NL, KV.BT), np.int32)
+        g_inf = np.zeros((1, KV.BT), np.int32)
+        g_inf[0, :] = 1
+        for gi, g in enumerate(groups):
+            agg = GCC.multi_add(GCC.FP2_OPS, [GCC.g2_decompress(s) for s in g])
+            if agg is None:
+                g_inf[0, gi] = 1
+                continue
+            g_inf[0, gi] = 0
+            ax[:, gi] = LY.to_limbs(agg[0][0] * LY.R_MOD_P % LY.P)
+            ax1[:, gi] = LY.to_limbs(agg[0][1] * LY.R_MOD_P % LY.P)
+            ay[:, gi] = LY.to_limbs(agg[1][0] * LY.R_MOD_P % LY.P)
+            ay1[:, gi] = LY.to_limbs(agg[1][1] * LY.R_MOD_P % LY.P)
+        ok = np.zeros((1, n), np.int32)
+        ok[0, :total] = 1
+        return tuple(
+            jnp.asarray(a) for a in (ax, ax1, ay, ay1, g_inf, ok)
+        )
+
+    monkeypatch.setattr(v, "_device_call", fake_device_call)
+    out = v._aggregate_wire_device([list(g) for g in groups])
+    assert out == [v._aggregate_wire_host(g) for g in groups]
+    assert out[1] == sigs[2]  # singleton group round-trips exactly
+    assert out[2] == GCC.g2_compress(None)  # cancelling pair -> infinity
+    # an undecodable member -> that group degrades to None (host path
+    # refuses too), others unaffected
+    bad = bytes([0x80]) + b"\xff" * 95
+
+    def fake_bad_call(name, fn, args):
+        res = list(fake_device_call(name, fn, args))
+        ok = np.asarray(res[5]).copy()
+        ok[0, 2] = 0  # the bad member's lane
+        res[5] = jnp.asarray(ok)
+        return tuple(res)
+
+    monkeypatch.setattr(v, "_device_call", fake_bad_call)
+    out = v._aggregate_wire_device([sigs[:2], [sigs[2], bad]])
+    assert out[0] is not None and out[1] is None
+
+
+def test_pending_sets_never_double_counts_through_flush(monkeypatch):
+    """Review fix: when the stage flushes, the contributor-side set
+    units HAND OFF to the layer jobs' own accounting — a blocked
+    dispatcher must never show submissions counted twice (before the
+    fix, 6 in-flight submissions read 7+, tripping backpressure at
+    ~half the configured high-water mark)."""
+    gate = threading.Event()
+    v = StubAggVerifier()
+    orig_begin = v.begin_job
+
+    def slow_begin(sets, batchable):
+        gate.wait(5)  # hold the device leg so layer jobs stay in flight
+        return orig_begin(sets, batchable)
+
+    v.begin_job = slow_begin
+    pipe = BlsVerificationPipeline(v, standard_wait_ms=30)
+    futs = [submit(pipe, wire(v, ROOT, (i,))) for i in range(3)]
+    futs += [submit(pipe, wire(v, ROOT2, (10 + i,))) for i in range(3)]
+    peak = 0
+    t0 = time.time()
+    while time.time() - t0 < 0.3:
+        peak = max(peak, pipe.pending_sets())
+        time.sleep(0.005)
+    gate.set()
+    assert all(f.result(timeout=10) for f in futs)
+    deadline = time.time() + 5
+    while pipe.pending_sets() != 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert pipe.pending_sets() == 0
+    pipe.close()
+    assert peak <= 6, f"pending_sets peaked at {peak} for 6 submissions"

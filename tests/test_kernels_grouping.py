@@ -78,6 +78,74 @@ def test_segmented_g1_sum_matches_oracle():
     assert all(np.asarray(inf2))
 
 
+def _jac_decode_g2(planes):
+    """[NL, B] Montgomery jacobian FP2 planes -> affine oracle points."""
+    x0 = LY.decode_batch(np.asarray(planes[0]))
+    x1 = LY.decode_batch(np.asarray(planes[1]))
+    y0 = LY.decode_batch(np.asarray(planes[2]))
+    y1 = LY.decode_batch(np.asarray(planes[3]))
+    z0 = LY.decode_batch(np.asarray(planes[4]))
+    z1 = LY.decode_batch(np.asarray(planes[5]))
+    out = []
+    for i in range(len(x0)):
+        z = (z0[i], z1[i])
+        if z == (0, 0):
+            out.append(None)
+            continue
+        zi = GF.fp2_inv(z)
+        zi2 = GF.fp2_sqr(zi)
+        out.append(
+            (
+                GF.fp2_mul((x0[i], x1[i]), zi2),
+                GF.fp2_mul((y0[i], y1[i]), GF.fp2_mul(zi2, zi)),
+            )
+        )
+    return out
+
+
+@pytest.mark.slow
+def test_segmented_g2_sum_matches_oracle():
+    """The pre-verify aggregation stage's G2 scan (ISSUE 13,
+    KV._j_seg_sum_g2): segment totals at head lanes == the host
+    jacobian-add oracle, dead lanes excluded, all-dead segments at
+    infinity — the FP2 twin of the G1 test above, at tiny width.
+
+    Slow tier: the FP2 jac_add_full rounds trace ~160 s of XLA graph
+    on the 1-core host EVERY run (tracing is uncacheable — dev/NOTES
+    round 4), which the tier-1 budget cannot absorb; the algorithm is
+    the G1 twin's (fast tier above), only the field ops differ."""
+    n = 8
+    ks = [3, 5, 7, 11, 13, 17, 19, 23]
+    pts = [GC.scalar_mul(GC.FP2_OPS, GC.G2_GEN, k) for k in ks]
+    group = np.asarray([0, 0, 0, 1, 1, 2, 3, 3], np.int32)
+    dead = np.zeros(n, bool)
+    dead[4] = True  # excluded from group 1's sum
+    px0 = jnp.asarray(LY.encode_batch([p[0][0] for p in pts]))
+    px1 = jnp.asarray(LY.encode_batch([p[0][1] for p in pts]))
+    py0 = jnp.asarray(LY.encode_batch([p[1][0] for p in pts]))
+    py1 = jnp.asarray(LY.encode_batch([p[1][1] for p in pts]))
+    out = KV._j_seg_sum_g2(
+        px0, px1, py0, py1, jnp.asarray(dead), jnp.asarray(group)
+    )
+    decoded = _jac_decode_g2(out[:6])
+    inf = list(np.asarray(out[6]))
+    expected = {
+        2: [0, 1, 2],        # group 0
+        4: [3],              # group 1 (lane 4 dead)
+        5: [5],              # group 2
+        7: [6, 7],           # group 3
+    }
+    for head, members in expected.items():
+        want = GC.multi_add(GC.FP2_OPS, [pts[i] for i in members])
+        assert not inf[head]
+        assert decoded[head] == want, f"head lane {head}"
+    dead2 = np.ones(n, bool)
+    out2 = KV._j_seg_sum_g2(
+        px0, px1, py0, py1, jnp.asarray(dead2), jnp.asarray(group)
+    )
+    assert all(np.asarray(out2[6]))
+
+
 # -- full grouped pipeline (interpret mode, one lane tile) ------------------
 
 pytestmark_slow = pytest.mark.slow
