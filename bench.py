@@ -107,6 +107,20 @@ def _phase_snapshot() -> dict:
     return snap
 
 
+def _breaker_snapshot() -> dict:
+    """The BLS device circuit breaker's aggregate state (ISSUE 14) —
+    state / trip count / cumulative time-in-degraded.  A bench round
+    whose numbers were produced with the breaker open measured the
+    HOST fallback, not the device path; this field makes that visible
+    in the record itself.  Lazy + failure-proof like the SLO snapshot."""
+    try:
+        from lodestar_tpu.bls.supervisor import breaker_snapshot
+
+        return breaker_snapshot()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not fail a run
+        return {"error": str(e)[:200]}
+
+
 def _slo_snapshot() -> dict:
     """The lodestar_slo_* breach counters from the process-global
     registry (ISSUE 12) — zeros unless an SLO engine ran in-process,
@@ -152,6 +166,7 @@ def _bench_flight_record(stage: str, detail: str):
             )
             _FLIGHT_RECORDER.add_provider("phases", _phase_snapshot)
             _FLIGHT_RECORDER.add_provider("slo", _slo_snapshot)
+            _FLIGHT_RECORDER.add_provider("breaker", _breaker_snapshot)
         return _FLIGHT_RECORDER.record(
             f"bench.{stage}", {"detail": detail[-2000:]}
         )
@@ -186,6 +201,7 @@ def _emit_failure(
                 "error": f"{stage}: {detail}"[-2000:],
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
+                "breaker": _breaker_snapshot(),
                 "flight_record": _bench_flight_record(stage, detail),
             }
         ),
@@ -593,6 +609,7 @@ def main_wire():
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
+                "breaker": _breaker_snapshot(),
             }
         )
     )
@@ -602,6 +619,8 @@ def main_wire():
         _probe_pipeline(verifier)
         if os.environ.get("BENCH_PREAGG", "1") != "0":
             _probe_effective_atts(verifier)
+    if os.environ.get("BENCH_BREAKER", "1") != "0":
+        _probe_breaker_recovery(verifier)
 
 
 # -- RLC amortization + adversarial-floor probes (ISSUE 10) -----------------
@@ -650,6 +669,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
+                    "breaker": _breaker_snapshot(),
                 }
             ),
             flush=True,
@@ -720,6 +740,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "vs_baseline": None,
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
+                    "breaker": _breaker_snapshot(),
                 }
             ),
             flush=True,
@@ -916,6 +937,7 @@ def _probe_pipeline(verifier) -> None:
                     "flush_reasons": reasons,
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
+                    "breaker": _breaker_snapshot(),
                 }
             ),
             flush=True,
@@ -1034,6 +1056,7 @@ def _probe_effective_atts(verifier) -> None:
                     ),
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
+                    "breaker": _breaker_snapshot(),
                 }
             ),
             flush=True,
@@ -1071,6 +1094,162 @@ def build_decoded_inputs():
     return (tx, ty, idx, kmask) + planes + (sig_inf,), valid
 
 
+# -- device-fault recovery probe (ISSUE 14) ---------------------------------
+# bls_device_fault_recovery_seconds: inject a device-dispatch fault
+# mid-flood (every _device_call raises), wait for the breaker to trip
+# into the degraded host path, heal the device, and report the time
+# from trip to the first confirmed DEVICE-path verdict after the canary
+# re-probe restores dispatch.  Lower is better (unit "s").
+
+BENCH_BREAKER_FLOOD_ATTS = int(
+    os.environ.get("BENCH_BREAKER_FLOOD_ATTS", "256")
+)
+
+
+def _emit_breaker_skip(stage: str, detail: str) -> None:
+    _emit_failure(
+        stage, detail, metric="bls_device_fault_recovery_seconds", unit="s"
+    )
+
+
+def _probe_breaker_recovery(verifier) -> None:
+    t_stage0 = time.monotonic()
+    try:
+        from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
+        from lodestar_tpu.bls.verifier import VerifyOptions
+
+        sup = getattr(verifier, "supervisor", None)
+        if sup is None or not sup.active:
+            _emit_breaker_skip(
+                "breaker-probe",
+                "LODESTAR_TPU_BLS_BREAKER=0: supervision disabled",
+            )
+            return
+        if sup.is_open():
+            _emit_breaker_skip(
+                "breaker-probe", "breaker already open before the probe"
+            )
+            return
+        # DISJOINT root namespaces per stage (the PR 13 probe's lesson):
+        # on the real verifier the aggregation stage's seen-map serves
+        # exact repeats with zero device work, so reused identities
+        # would flatter both the flood and the device-path confirmation
+        sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
+        warm_att = _att_factory(
+            verifier, sks, [b"breaker warm root %d" % s for s in range(16)]
+        )
+        flood_roots = [b"breaker flood root %d" % s for s in range(16)]
+        att = _att_factory(verifier, sks, flood_roots)
+        confirm_att = _att_factory(
+            verifier,
+            sks,
+            [b"breaker confirm root %d" % s for s in range(16)],
+        )
+        pipeline = BlsVerificationPipeline(verifier)
+        verifier.messages.get_many(flood_roots)
+        warm = [warm_att(j) for j in range(128)]
+        assert pipeline.verify_signature_sets(
+            warm, VerifyOptions(batchable=True)
+        ), "breaker-probe warmup failed verification"
+
+        # shrink the re-probe backoff so the number measures trip ->
+        # canary -> device verdict, not a production-sized wait
+        sup.backoff_initial_s = 0.1
+        real_call = verifier._device_call
+        fail = {"on": False}
+
+        def flaky(name, fn, args):
+            if fail["on"]:
+                raise RuntimeError(
+                    "bench-injected device fault: backend UNAVAILABLE"
+                )
+            return real_call(name, fn, args)
+
+        verifier._device_call = flaky
+        try:
+            futs = []
+            half = BENCH_BREAKER_FLOOD_ATTS // 2
+            for j in range(BENCH_BREAKER_FLOOD_ATTS):
+                if j == half:
+                    fail["on"] = True  # the fault lands MID-flood
+                    t_fault = time.perf_counter()
+                futs.append(
+                    pipeline.verify_signature_sets_async(
+                        [att(j)], VerifyOptions(batchable=True)
+                    )
+                )
+            # zero lost verdicts: every submission resolves (valid atts
+            # stay valid through the host fallback)
+            verdicts = [f.result(timeout=300) for f in futs]
+            if not all(verdicts):
+                _emit_breaker_skip(
+                    "breaker-probe",
+                    f"{len(verdicts) - sum(verdicts)} valid atts failed "
+                    "under the fault",
+                )
+                return
+            deadline = time.perf_counter() + 120.0
+            while not sup.is_open() and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            if not sup.is_open():
+                _emit_breaker_skip(
+                    "breaker-probe", "fault never tripped the breaker"
+                )
+                return
+            # heal: the auto re-probe canary restores the device path
+            fail["on"] = False
+            while sup.is_open() and time.perf_counter() < deadline:
+                time.sleep(0.005)
+            if sup.is_open():
+                _emit_breaker_skip(
+                    "breaker-probe", "breaker never re-closed after heal"
+                )
+                return
+            # confirm an actual device-path verdict post-recovery —
+            # FRESH identities, so neither the aggregation seen-map nor
+            # any warm cache can serve them without touching the device
+            ok = pipeline.verify_signature_sets(
+                [confirm_att(j) for j in range(64)],
+                VerifyOptions(batchable=True),
+            )
+            t_recovered = time.perf_counter()
+            if not ok:
+                _emit_breaker_skip(
+                    "breaker-probe", "post-recovery device verify failed"
+                )
+                return
+            # snapshot while the supervisor is still alive (close()
+            # deregisters it from the process-wide breaker registry)
+            breaker_field = _breaker_snapshot()
+        finally:
+            verifier._device_call = real_call
+            pipeline.close()
+        recovery = t_recovered - t_fault
+        _phase_mark(
+            "breaker_probe", time.monotonic() - t_stage0, ok=True
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "bls_device_fault_recovery_seconds",
+                    "value": round(recovery, 4),
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "breaker_trips": sup.trip_count,
+                    "time_in_degraded_s": round(
+                        sup.time_in_degraded_s(), 4
+                    ),
+                    "phases": _phase_snapshot(),
+                    "slo": _slo_snapshot(),
+                    "breaker": breaker_field,
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 — probe failures emit a skip record
+        _emit_breaker_skip("breaker-probe", f"{type(e).__name__}: {e}")
+
+
 def main_decoded():
     t_build0 = time.perf_counter()
     args, valid = build_decoded_inputs()
@@ -1105,6 +1284,7 @@ def main_decoded():
                 "vs_baseline": round(sets_per_s / BASELINE_SETS_PER_S, 4),
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
+                "breaker": _breaker_snapshot(),
             }
         )
     )

@@ -162,6 +162,10 @@ _METRIC_UNITS = {
     # ISSUE 13: effective throughput AFTER pre-verify aggregation —
     # atts/s, higher is better; a drop beyond threshold exits 1
     "bls_pipeline_effective_atts_per_s": "atts/s",
+    # ISSUE 14: injected-device-fault -> back-to-device-verdicts wall
+    # clock (breaker trip + degraded routing + canary re-probe); a
+    # time metric — growth beyond threshold regresses
+    "bls_device_fault_recovery_seconds": "s",
     "state_roots_per_s": "roots/s",
 }
 

@@ -530,6 +530,26 @@ _BLOCKING_ATTRS = {"block_until_ready"}
 # trace ring (file IO, O(ring) aggregation) — span BODIES in async code
 # may open trace_span freely, but must never drain the ring inline
 _BLOCKING_SINKS = {"write_chrome_trace", "dump_chrome_trace", "trace_summary"}
+# device-dispatch entry points that MUST go through the breaker
+# supervisor seam (bls/supervisor.py): a direct call in async node code
+# bypasses the circuit breaker's failure classification + degraded-mode
+# fallback, so a sick device unwinds through the caller instead of
+# tripping into host verification (ISSUE 14 satellite)
+_DEVICE_DISPATCH_FNS = {
+    "verify_each_device",
+    "verify_each_device_wire",
+    "verify_batch_device",
+    "verify_batch_device_wire",
+    "verify_batch_device_wire_grouped",
+    "aggregate_g2_sum_device",
+    "load_or_export",
+}
+# where the bypass check applies; sync/ is excluded — its device work
+# already funnels through the verifier service
+_BREAKER_DIRS = {"bls", "network", "chain"}
+# modules allowed to touch dispatch directly: the supervisor itself
+# (it IS the seam) and anything under kernels/ (the dispatch layer)
+_BREAKER_EXEMPT_PARTS = {"supervisor", "kernels"}
 
 
 class NodeHygieneRule(Rule):
@@ -553,8 +573,12 @@ class NodeHygieneRule(Rule):
                             severity="error",
                         )
                     )
-            if not (set(mod.modname.split(".")) & _ASYNC_DIRS):
+            parts = set(mod.modname.split("."))
+            if not (parts & _ASYNC_DIRS):
                 continue
+            check_dispatch = bool(parts & _BREAKER_DIRS) and not (
+                parts & _BREAKER_EXEMPT_PARTS
+            )
             for info in mod.functions.values():
                 if not isinstance(info.node, ast.AsyncFunctionDef):
                     continue
@@ -573,7 +597,30 @@ class NodeHygieneRule(Rule):
                                 f"a thread",
                             )
                         )
+                    dispatch = self._device_dispatch_call(node)
+                    if check_dispatch and dispatch:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"direct device dispatch `{dispatch}` "
+                                f"inside async `{info.qualname}` "
+                                f"bypasses the breaker supervisor seam "
+                                f"(bls/supervisor.py) — route through "
+                                f"the supervised TpuBlsVerifier entry "
+                                f"points",
+                            )
+                        )
         return out
+
+    @staticmethod
+    def _device_dispatch_call(node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _DEVICE_DISPATCH_FNS:
+            return f"{fn.attr}()"
+        if isinstance(fn, ast.Name) and fn.id in _DEVICE_DISPATCH_FNS:
+            return f"{fn.id}()"
+        return None
 
     @staticmethod
     def _blocking_call(node: ast.Call) -> Optional[str]:

@@ -77,6 +77,15 @@ class DefaultHandlers:
         data = self.slo.status()
         if self.flight_recorder is not None:
             data["flight_recorder"] = self.flight_recorder.status()
+        if self.bls_service is not None and hasattr(
+            self.bls_service, "breaker_status"
+        ):
+            # the BLS device circuit breaker (ISSUE 14): state, trips,
+            # time-in-degraded — `status` above already reads
+            # `degraded` while it is open (SLO degraded source)
+            breaker = self.bls_service.breaker_status()
+            if breaker is not None:
+                data["breaker"] = breaker
         return 200, {"data": data}
 
     def get_version(self, params, body):

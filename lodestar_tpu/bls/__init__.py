@@ -13,6 +13,9 @@ batched JAX kernels on a device:
                    and-flush feed with priority lanes (ISSUE 11)
   aggregator     — PreVerifyAggregator: same-root bucketing + dedupe +
                    G2 point-add ahead of the verify queue (ISSUE 13)
+  supervisor     — DeviceSupervisor: the device circuit breaker +
+                   degraded host-path routing + canary re-probe
+                   (ISSUE 14; escape hatch LODESTAR_TPU_BLS_BREAKER=0)
   metrics        — lodestar_bls_thread_pool_* compatible counters
 """
 
@@ -21,3 +24,10 @@ from .pubkey_table import PubkeyTable, plan_disjoint_gathers  # noqa: F401
 from .verifier import TpuBlsVerifier, VerifyOptions  # noqa: F401
 from .pipeline import BlsVerificationPipeline, create_bls_service  # noqa: F401
 from .aggregator import PreVerifyAggregator  # noqa: F401
+from .supervisor import (  # noqa: F401
+    BadDeviceOutput,
+    DeviceSupervisor,
+    DeviceTimeout,
+    breaker_snapshot,
+    classify_failure,
+)

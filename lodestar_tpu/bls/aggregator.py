@@ -36,7 +36,12 @@ sitting AHEAD of the pipeline's accumulators:
     segmented jacobian sum) with a host ground-truth fallback — and the
     layer verifies as ONE `WireSignatureSet.aggregate` through the
     existing RLC batch path, K-bucketed and message-grouped like any
-    other set.
+    other set.  Both legs sit under the device circuit breaker
+    (ISSUE 14, bls/supervisor.py): the sum seam skips the device and a
+    sum-stage fault classifies + trips inside
+    `aggregate_wire_signatures`, and the layer's verify job degrades to
+    host verdicts like any other job — the stage itself never needs a
+    fault path of its own.
   - **Attribution.**  Every contributor's own future resolves from the
     layer verdict (gossip forwarding, peer scoring, slasher ingestion
     all key on per-message verdicts).  A FAILED layer bisects

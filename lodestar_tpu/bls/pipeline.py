@@ -58,6 +58,14 @@ aggregate (`aggregate_wire_signatures`) and `LODESTAR_TPU_BLS_PREAGG`
 is not 0; off, every message verifies as its own set exactly as in
 PR 11.
 
+Fault tolerance (ISSUE 14): the pipeline needs no fault path of its
+own — the verifier's device circuit breaker (bls/supervisor.py) sits
+BELOW the flush boundary, so a tripped breaker resolves every flushed
+job through the host ground-truth seam with identical verdicts while
+accumulation, lane deadlines, and the high-water backpressure keep
+operating unchanged.  `breaker_status()` (inherited from the base
+service) is the health surface's read path.
+
 Escape hatch: `LODESTAR_TPU_BLS_PIPELINE=0` makes `create_bls_service`
 return the PR 10 flat-buffer `BlsVerifierService` instead.
 """

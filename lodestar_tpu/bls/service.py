@@ -148,6 +148,13 @@ class BlsVerifierService:
         with self._timings_lock:
             return list(self.recent_job_timings)
 
+    def breaker_status(self) -> Optional[dict]:
+        """The verifier's device-circuit-breaker status (ISSUE 14), or
+        None for verifiers without a supervisor (CPU fallback/stubs) —
+        the health endpoint's and bench's read path."""
+        sup = getattr(self.verifier, "supervisor", None)
+        return sup.status() if sup is not None else None
+
     # -- submission -------------------------------------------------------
 
     def can_accept_work(self) -> bool:

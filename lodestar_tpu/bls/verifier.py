@@ -47,6 +47,13 @@ from ..utils.metrics import BlsPoolMetrics
 from .ingest import MessageCache, encode_wire_planes
 from .pubkey_table import PubkeyTable
 from .signature_set import SignatureSet, WireSignatureSet
+from .supervisor import (
+    OUTCOME_BACKEND_INIT,
+    OUTCOME_TIMEOUT,
+    DeviceSupervisor,
+    check_verdict_plane,
+    classify_failure,
+)
 
 # Raised from the reference's 128 (chain/bls/multithread/index.ts:39):
 # that cap keeps CPU worker-pool jobs small for scheduling fairness,
@@ -97,13 +104,16 @@ class _DeviceJob:
 
     __slots__ = ("sets", "batchable", "ok_big", "args", "valid", "decodable",
                  "batch_ok", "per_set", "wire", "verdicts", "n_bucket",
-                 "batch_retries", "batch_sigs_success", "unsort")
+                 "batch_retries", "batch_sigs_success", "unsort", "host_mode")
 
     def __init__(self, sets, batchable, ok_big, wire=False):
         self.sets = sets
         self.batchable = batchable
         self.ok_big = ok_big
         self.wire = wire
+        # degraded-mode job (breaker open or dispatch failed): no device
+        # handles; finish_job resolves it on the host ground-truth path
+        self.host_mode = False
         self.n_bucket = 0  # padded N of the dispatched device job
         self.args = None
         self.valid = None
@@ -148,6 +158,7 @@ class TpuBlsVerifier:
         rng: Optional[np.random.Generator] = None,
         max_job_sets: int = MAX_JOB_SETS,
         bisect_leaf: Optional[int] = None,
+        supervisor: Optional[DeviceSupervisor] = None,
     ):
         self.table = table
         self.metrics = metrics or BlsPoolMetrics()
@@ -186,6 +197,16 @@ class TpuBlsVerifier:
         # sub-job pads to the same 128-lane bucket, so halving further
         # cannot shed device work and the leaf runs per-set verdicts.
         self.bisect_leaf = KV.BT if bisect_leaf is None else bisect_leaf
+        # Fault-domain isolation (ISSUE 14): every device dispatch seam
+        # runs under this circuit breaker — classified failures trip it
+        # into a degraded mode that resolves jobs on the host
+        # ground-truth path, and a canary re-probe restores the device
+        # path.  LODESTAR_TPU_BLS_BREAKER=0 disables supervision.
+        self.supervisor = supervisor or DeviceSupervisor(
+            registry=self.metrics.registry
+        )
+        if self.supervisor.canary is None:
+            self.supervisor.canary = self._device_canary
 
     def _device_call(self, name: str, fn, args):
         """Dispatch through the AOT export cache when enabled; plain
@@ -213,7 +234,19 @@ class TpuBlsVerifier:
             logging.getLogger("lodestar_tpu").warning(
                 "export-cache dispatch failed (%s); direct call", e
             )
-            return fn(*args)
+            # If the direct call ALSO fails, its exception propagates to
+            # the calling seam, which records exactly ONE breaker
+            # failure for the event (no double count).  If it succeeds,
+            # the device demonstrably answered: surface a backend-init/
+            # timeout export fault on the failure metric for visibility
+            # WITHOUT advancing the trip streak.
+            out = fn(*args)
+            outcome = classify_failure(e)
+            if outcome in (OUTCOME_BACKEND_INIT, OUTCOME_TIMEOUT):
+                self.supervisor.note_nonfatal(
+                    outcome, f"export:{name}", str(e)
+                )
+            return out
 
     # -- backpressure (reference: multithread/index.ts:143-149) -----------
 
@@ -359,13 +392,21 @@ class TpuBlsVerifier:
         groups = [list(g) for g in groups]
         if not groups:
             return []
-        if self._use_agg_device():
+        if self._use_agg_device() and self.supervisor.device_allowed():
             try:
-                return self._aggregate_wire_device(groups)
+                out = self.supervisor.run_guarded(
+                    lambda: self._aggregate_wire_device(groups),
+                    "agg_g2_sum",
+                )
+                self.supervisor.record_success()
+                return out
             except Exception as e:  # noqa: BLE001 — aggregation must
                 # never take down verification; host fallback
                 import logging
 
+                self.supervisor.record_failure(
+                    classify_failure(e), "agg_g2_sum", str(e)
+                )
                 logging.getLogger("lodestar_tpu").warning(
                     "device signature aggregation failed (%s); host path", e
                 )
@@ -495,10 +536,42 @@ class TpuBlsVerifier:
         with _trace_span(
             "bls.begin_job", sets=len(sets), batchable=batchable
         ) as span:
-            job = self._begin_job(sets, batchable, span)
+            sup = self.supervisor
+            if not sup.device_allowed():
+                # breaker open: degraded mode — no device dispatch at
+                # all; the job resolves on the host ground-truth path at
+                # finish time (resolver thread), so submitters never
+                # block and no set is dropped
+                job = self._begin_job_host(sets, batchable)
+            else:
+                try:
+                    job = self._begin_job(sets, batchable, span)
+                except Exception as e:  # noqa: BLE001 — a dispatch
+                    # fault must not unwind through the service; trip
+                    # the breaker and fall back to the host path
+                    if not sup.active:
+                        raise
+                    sup.record_failure(
+                        classify_failure(e), "begin_job", str(e)
+                    )
+                    job = self._begin_job_host(sets, batchable)
         self.metrics.verify_seconds.observe(
             "host", time.perf_counter() - t0
         )
+        return job
+
+    def _begin_job_host(
+        self, sets: List[SignatureSet], batchable: bool
+    ) -> "_DeviceJob":
+        """A degraded-mode job: no device planes, no dispatch — the
+        resolver-side finish_job computes every verdict through
+        `_verify_set_host`.  NOTE: if the device dispatch failed partway
+        through `_begin_job`, any CPU-routed ("big") sets it already
+        verified are re-verified here — verdicts stay correct, only the
+        success/invalid counters may double-count on that rare path."""
+        wire = bool(sets) and isinstance(sets[0], WireSignatureSet)
+        job = _DeviceJob(list(sets), batchable, True, wire)
+        job.host_mode = True
         return job
 
     def _begin_job(
@@ -663,11 +736,75 @@ class TpuBlsVerifier:
         it feeds `lodestar_bls_verify_seconds{phase="device"}`."""
         t0 = time.perf_counter()
         with _trace_span("bls.finish_job", sets=len(job.sets)):
-            ok = self._finish_job(job)
+            sup = self.supervisor
+            if getattr(job, "host_mode", False):
+                ok = self._finish_job_host(job)
+            elif not sup.active:
+                ok = self._finish_job(job)
+            else:
+                # With a watchdog armed, the device sync runs against a
+                # SHALLOW CLONE: a timeout abandons (not cancels) the
+                # worker thread, and a late-returning orphan must
+                # mutate only its clone — never the job object whose
+                # verdicts the service is about to read (host fallback
+                # wins).  Verifier-level counters may still double-
+                # count on that rare orphan completion; per-job verdict
+                # state cannot.  Without a deadline run_guarded is an
+                # inline call — no orphan can exist, so no clone.
+                if sup.job_deadline_s:
+                    import copy as _copy
+
+                    target = _copy.copy(job)
+                else:
+                    target = job
+                try:
+                    ok = sup.run_guarded(
+                        lambda: self._finish_job(target), "finish_job"
+                    )
+                    if target is not job:
+                        job.verdicts = target.verdicts
+                        job.batch_retries = target.batch_retries
+                        job.batch_sigs_success = target.batch_sigs_success
+                    sup.record_success()
+                except Exception as e:  # noqa: BLE001 — a device sync
+                    # fault mid-job: classify, trip, and resolve THIS
+                    # job's verdicts on the host path (zero lost sets)
+                    sup.record_failure(
+                        classify_failure(e), "finish_job", str(e)
+                    )
+                    ok = self._finish_job_host(job)
         self.metrics.verify_seconds.observe(
             "device", time.perf_counter() - t0
         )
         return ok
+
+    def _verify_set_host(self, s) -> bool:
+        """Ground-truth verdict for ONE set, wire or decoded — the
+        degraded-mode seam every host-routed job resolves through.
+        Bit-identical to the device path by the repo's standing
+        equivalence invariant (tests/test_kernels_verify.py and the
+        breaker property tests assert it)."""
+        return self._verify_set_cpu(
+            s.decode() if isinstance(s, WireSignatureSet) else s
+        )
+
+    def _finish_job_host(self, job: "_DeviceJob") -> bool:
+        """Resolve one job entirely on the host ground-truth path.
+        Handles both degraded-mode jobs (never dispatched) and jobs
+        whose device sync failed mid-flight (planes may be sorted:
+        verdict order is restored through job.unsort)."""
+        sets = job.sets
+        if not sets:
+            return job.ok_big
+        v = np.array([self._verify_set_host(s) for s in sets], bool)
+        if job.unsort is not None:
+            v = v[job.unsort]
+        job.verdicts = v
+        good = int(v.sum())
+        self.metrics.success_jobs.inc(good)
+        self.metrics.invalid_sets.inc(len(sets) - good)
+        self.supervisor.note_host_fallback(len(sets))
+        return job.ok_big and bool(v.all())
 
     def _finish_job(self, job: "_DeviceJob") -> bool:
         sets = job.sets
@@ -678,7 +815,12 @@ class TpuBlsVerifier:
             if per_set is None:
                 return job.ok_big  # batch verdict accepted every set
         else:
-            per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+            per_set = (
+                check_verdict_plane(job.per_set, len(sets), "each")[
+                    : len(sets)
+                ]
+                & job.decodable
+            )
         if job.unsort is not None:
             # planes were sorted by signing root: restore the caller's
             # submission order (the service maps verdicts positionally)
@@ -735,7 +877,12 @@ class TpuBlsVerifier:
                     self._each_fn(job),
                     (*job.args, job.valid),
                 )
-                per_set = np.asarray(job.per_set)[: len(sets)] & job.decodable
+                per_set = (
+                    check_verdict_plane(job.per_set, len(sets), "each")[
+                        : len(sets)
+                    ]
+                    & job.decodable
+                )
         return per_set
 
     def _bisect(self, sets, wire: bool, depth: int, job=None):
@@ -810,17 +957,21 @@ class TpuBlsVerifier:
         """Independent device verdicts for `sets` (the bisection leaf)."""
         if wire:
             args, valid, _n, host_bad = self._prepare_wire(sets)
-            v = np.asarray(
+            v = check_verdict_plane(
                 self._device_call(
                     "each_wire", KV.verify_each_device_wire, (*args, valid)
-                )
+                ),
+                len(sets),
+                "each_wire",
             )[: len(sets)]
             return v & ~host_bad[: len(sets)]
         args, valid, _n = self._prepare(sets)
-        v = np.asarray(
+        v = check_verdict_plane(
             self._device_call(
                 "each_decoded", KV.verify_each_device, (*args, valid)
-            )
+            ),
+            len(sets),
+            "each_decoded",
         )[: len(sets)]
         return v & np.array([s.signature is not None for s in sets])
 
@@ -828,7 +979,31 @@ class TpuBlsVerifier:
         self, sets: Sequence[SignatureSet]
     ) -> List[bool]:
         """Per-set verdicts (used by gossip validators that must tell WHICH
-        aggregate in a job failed)."""
+        aggregate in a job failed).  Breaker-supervised like the job
+        paths: open -> host ground truth; a device fault mid-call trips
+        and falls back, so the caller always gets verdicts."""
+        sup = self.supervisor
+        if not sup.device_allowed():
+            sup.note_host_fallback(len(sets))
+            return [self._verify_set_host(s) for s in sets]
+        try:
+            out = sup.run_guarded(
+                lambda: self._verify_individually_device(sets),
+                "individually",
+            )
+            sup.record_success()
+            return out
+        except Exception as e:  # noqa: BLE001 — verdicts must keep
+            # flowing through the degraded path
+            if not sup.active:
+                raise
+            sup.record_failure(classify_failure(e), "individually", str(e))
+            sup.note_host_fallback(len(sets))
+            return [self._verify_set_host(s) for s in sets]
+
+    def _verify_individually_device(
+        self, sets: Sequence[SignatureSet]
+    ) -> List[bool]:
         verdicts: dict = {}
         device_sets: List[Tuple[int, SignatureSet]] = []
         wire_sets: List[Tuple[int, WireSignatureSet]] = []
@@ -861,5 +1036,25 @@ class TpuBlsVerifier:
                 verdicts[pos] = bool(v) and not host_bad[j]
         return [verdicts[i] for i in range(len(sets))]
 
+    # -- breaker canary (bls/supervisor.py half-open probe) ----------------
+
+    def _device_canary(self) -> bool:
+        """ONE minimal device job — the breaker's half-open probe.  A
+        single junk set (signature at infinity) rides the smallest
+        each_decoded bucket; the probe passes iff the dispatch completes
+        under the watchdog deadline AND the verdict plane is well-formed
+        with the expected False verdict.  A device that returns garbage
+        fails the canary just like one that hangs."""
+        def _probe() -> bool:
+            s = SignatureSet.single(0, C.G2_GEN, None)
+            args, valid, n = self._prepare([s])
+            out = self._device_call(
+                "each_decoded", KV.verify_each_device, (*args, valid)
+            )
+            arr = check_verdict_plane(out, n, "canary")
+            return not bool(arr[0])
+
+        return bool(self.supervisor.run_guarded(_probe, "canary"))
+
     def close(self) -> None:
-        pass
+        self.supervisor.close()

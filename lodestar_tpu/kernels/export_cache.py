@@ -219,6 +219,23 @@ def export_and_save(
     return exp.call
 
 
+class ExportStageError(RuntimeError):
+    """An export-cache stage failed.  `.stage` ("load" | "trace") and
+    `.entry` name WHERE the artifact layer died, and the cause's text
+    is embedded so the breaker supervisor's failure classifier
+    (bls/supervisor.py classify_failure) can tell a backend-init death
+    — the r03–r05 180 s probe failures happened exactly here — from a
+    mere stale-artifact problem (ISSUE 14)."""
+
+    def __init__(self, stage: str, entry: str, cause: BaseException):
+        super().__init__(
+            f"export {stage} for {entry!r} failed: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.stage = stage
+        self.entry = entry
+
+
 def load_or_export(
     name: str,
     fn: Callable,
@@ -226,13 +243,22 @@ def load_or_export(
     platform: Optional[str] = None,
     cache_dir: Optional[str] = None,
 ) -> Callable:
-    """The main entry: cached call if present, else trace+persist."""
+    """The main entry: cached call if present, else trace+persist.
+    Stage faults re-raise as ExportStageError (classification seam)."""
     platform = platform or jax.default_backend()
-    cached = load(name, specs, platform, cache_dir)
+    try:
+        cached = load(name, specs, platform, cache_dir)
+    except Exception as e:  # noqa: BLE001 — load() already swallows
+        # corrupt artifacts; anything else here is the backend dying
+        raise ExportStageError("load", name, e) from e
     if cached is not None:
         return cached
     metrics().misses.inc(name, 1.0)
-    return export_and_save(name, fn, specs, platform, cache_dir)
+    try:
+        return export_and_save(name, fn, specs, platform, cache_dir)
+    except Exception as e:  # noqa: BLE001 — trace/persist faults carry
+        # their stage for the breaker's outcome classification
+        raise ExportStageError("trace", name, e) from e
 
 
 # -- standalone entry registry ----------------------------------------------

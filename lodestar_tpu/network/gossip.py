@@ -128,6 +128,59 @@ class InMemoryGossipBus:
         self.delivered = 0
         self.duplicates = 0
         self.graylisted = 0
+        # fault injection (ISSUE 14 chaos harness): an optional link
+        # filter decides per (from, to, topic) whether delivery happens
+        # — partitions, lossy links, and targeted blackholes all script
+        # through it; `partitioned` counts what it suppressed
+        self._link_filter: Optional[Callable[[str, str, str], bool]] = None
+        self.partitioned = 0
+
+    # -- fault injection (chaos harness) -----------------------------------
+
+    def set_link_filter(
+        self, fn: Optional[Callable[[str, str, str], bool]]
+    ) -> None:
+        """`fn(from_node, to_node, topic) -> deliver?`; None heals."""
+        self._link_filter = fn
+
+    def set_partitions(self, groups) -> None:
+        """Partition the mesh: delivery only WITHIN a group.  A
+        publisher alias of the form "<node>:<role>" (e.g.
+        "node-1:val-3") partitions with its owning node; ids not
+        resolvable to any group keep full connectivity."""
+        membership: Dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for node in group:
+                membership[node] = gi
+
+        def _resolve(n: str):
+            if n in membership:
+                return membership[n]
+            return membership.get(n.split(":", 1)[0])
+
+        def _filter(src: str, dst: str, _topic: str) -> bool:
+            a, b = _resolve(src), _resolve(dst)
+            if a is None or b is None:
+                return True
+            return a == b
+
+        self.set_link_filter(_filter)
+
+    def heal(self) -> None:
+        """Clear any partition/link fault (deliveries resume; seen
+        caches are untouched, exactly like a real partition heal —
+        missed messages stay missed until sync recovers them)."""
+        self._link_filter = None
+
+    def drop_node(self, node_id: str) -> None:
+        """Simulate a node crash: remove every subscription and the
+        seen cache (a restarted process remembers nothing)."""
+        for topic in list(self._subs):
+            self._subs[topic] = [
+                e for e in self._subs[topic] if e[0] != node_id
+            ]
+        self._seen.pop(node_id, None)
+        self._seen_order.pop(node_id, None)
 
     def _mark_seen(self, node_id: str, msg_id: bytes) -> None:
         seen = self._seen[node_id]
@@ -167,6 +220,11 @@ class InMemoryGossipBus:
                 continue
             if scorer is not None and scorer.is_banned(from_node):
                 self.graylisted += 1
+                continue
+            if self._link_filter is not None and not self._link_filter(
+                from_node, node_id, topic
+            ):
+                self.partitioned += 1
                 continue
             if msg_id in self._seen[node_id]:
                 self.duplicates += 1

@@ -20,9 +20,11 @@ this module adds everything above it:
 from __future__ import annotations
 
 import enum
+import random
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import params
 from . import snappy as SN
@@ -72,6 +74,113 @@ class ReqRespError(Exception):
         super().__init__(f"{code.name}: {message}")
         self.code = code
         self.message = message
+
+
+class ReqRespTimeout(ReqRespError):
+    """A request that never returned within its deadline — the peer is
+    stalling, not erroring; retry logic demotes it and moves on."""
+
+
+def call_with_timeout(fn: Callable[[], object], timeout_s: float,
+                      desc: str = "request"):
+    """Run `fn()` under the shared expendable-thread deadline runner
+    (utils/misc.run_with_deadline); raise ReqRespTimeout when it does
+    not return within `timeout_s`.  The stalled thread is abandoned —
+    a peer that never answers must cost the caller one bounded wait,
+    never a wedged sync loop (ISSUE 14 satellite)."""
+    from ..utils.misc import DeadlineExceeded, run_with_deadline
+
+    try:
+        return run_with_deadline(fn, timeout_s, desc)
+    except DeadlineExceeded:
+        raise ReqRespTimeout(
+            RespCode.SERVER_ERROR,
+            f"{desc} timed out after {timeout_s:g}s",
+        ) from None
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff between retry attempts."""
+
+    attempts: int = 3
+    backoff_initial_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.25  # +/- fraction of the computed backoff
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        b = min(
+            self.backoff_initial_s * (2.0 ** attempt), self.backoff_max_s
+        )
+        return b * (1.0 - self.jitter + 2.0 * self.jitter * rng.random())
+
+
+class PeerDemotion:
+    """Per-peer timeout demotion ledger: a peer that times out is
+    deprioritized for a cooldown that doubles on every consecutive
+    fault (capped) and fully resets on the first success.  `clock` is
+    injectable so the chaos harness drives cooldowns deterministically."""
+
+    def __init__(
+        self,
+        cooldown_initial_s: float = 5.0,
+        cooldown_max_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cooldown_initial_s = cooldown_initial_s
+        self.cooldown_max_s = cooldown_max_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        # peer -> (demoted_until, consecutive_faults)
+        self._entries: Dict[str, Tuple[float, int]] = {}
+
+    def demote(self, peer_id: str) -> float:
+        """Record one timeout fault; returns the cooldown applied."""
+        with self._lock:
+            _until, faults = self._entries.get(peer_id, (0.0, 0))
+            cooldown = min(
+                self.cooldown_initial_s * (2.0 ** faults),
+                self.cooldown_max_s,
+            )
+            self._entries[peer_id] = (
+                self._clock() + cooldown, faults + 1
+            )
+            return cooldown
+
+    def restore(self, peer_id: str) -> None:
+        with self._lock:
+            self._entries.pop(peer_id, None)
+
+    def is_demoted(self, peer_id: str) -> bool:
+        with self._lock:
+            entry = self._entries.get(peer_id)
+            return entry is not None and self._clock() < entry[0]
+
+    def order(self, peers: Sequence[str]) -> List[str]:
+        """Healthy peers first (input order preserved), then demoted
+        ones by soonest cooldown expiry — every peer stays reachable as
+        a last resort."""
+        now = self._clock()
+        with self._lock:
+            healthy, demoted = [], []
+            for p in peers:
+                entry = self._entries.get(p)
+                if entry is not None and now < entry[0]:
+                    demoted.append((entry[0], p))
+                else:
+                    healthy.append(p)
+        return healthy + [p for _t, p in sorted(demoted)]
+
+    def snapshot(self) -> Dict[str, dict]:
+        now = self._clock()
+        with self._lock:
+            return {
+                p: {
+                    "cooldown_remaining_s": max(until - now, 0.0),
+                    "consecutive_faults": faults,
+                }
+                for p, (until, faults) in self._entries.items()
+            }
 
 
 @dataclass(frozen=True)
@@ -380,7 +489,11 @@ class ReqResp:
     # -- client side -------------------------------------------------------
 
     def send_request(
-        self, peer_id: str, protocol: Protocol, body=None
+        self,
+        peer_id: str,
+        protocol: Protocol,
+        body=None,
+        timeout_s: Optional[float] = None,
     ) -> List[Tuple[bytes, Optional[bytes]]]:
         send = self._transports.get(peer_id)
         if send is None:
@@ -390,8 +503,63 @@ class ReqResp:
         req = b""
         if protocol.encode_request is not None:
             req = SN.encode_reqresp_chunk(protocol.encode_request(body))
-        resp = send(protocol.protocol_id, req)
+        if timeout_s is not None:
+            # a stalling peer costs one bounded wait (the transport
+            # thread is abandoned), never a wedged caller
+            resp = call_with_timeout(
+                lambda: send(protocol.protocol_id, req),
+                timeout_s,
+                desc=f"{protocol.method.value}@{peer_id}",
+            )
+        else:
+            resp = send(protocol.protocol_id, req)
         return decode_response_chunks(resp, protocol.context_bytes)
+
+
+def request_with_retry(
+    node: "ReqResp",
+    peers: Sequence[str],
+    protocol: Protocol,
+    body=None,
+    timeout_s: Optional[float] = None,
+    policy: Optional[RetryPolicy] = None,
+    demotion: Optional[PeerDemotion] = None,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Tuple[str, List[Tuple[bytes, Optional[bytes]]]]:
+    """Send one request with jittered-exponential-backoff retries across
+    `peers`: a peer that times out is demoted (doubling cooldown) and
+    the next attempt goes to a DIFFERENT peer — never awaited forever
+    (ISSUE 14 satellite).  Returns (serving_peer, chunks); raises the
+    last ReqRespError when every attempt failed."""
+    if not peers:
+        raise ReqRespError(RespCode.SERVER_ERROR, "no peers to ask")
+    policy = policy or RetryPolicy()
+    rng = rng or random.Random()
+    last: Optional[ReqRespError] = None
+    just_failed: Optional[str] = None
+    for attempt in range(policy.attempts):
+        ordered = (
+            demotion.order(peers) if demotion is not None else list(peers)
+        )
+        candidates = [p for p in ordered if p != just_failed] or ordered
+        peer = candidates[0]
+        try:
+            out = node.send_request(
+                peer, protocol, body, timeout_s=timeout_s
+            )
+            if demotion is not None:
+                demotion.restore(peer)
+            return peer, out
+        except ReqRespError as e:
+            last = e
+            just_failed = peer
+            if isinstance(e, ReqRespTimeout) and demotion is not None:
+                demotion.demote(peer)
+            if attempt + 1 < policy.attempts:
+                sleep(policy.backoff(attempt, rng))
+    assert last is not None
+    raise last
 
 
 def connect_inmemory(a: ReqResp, a_id: str, b: ReqResp, b_id: str) -> None:

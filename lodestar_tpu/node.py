@@ -84,6 +84,11 @@ class NodeOptions:
     # flight-recorder output directory (observability/flight_recorder):
     # None = breaches only count, nothing is captured to disk
     flightrec_dir: Optional[str] = None
+    # range-sync per-download stall deadline (ISSUE 14): a peer that
+    # never answers a by-range request is abandoned after this many
+    # seconds, demoted, and the batch retries on another peer.  None
+    # disables (in-process sources that cannot stall).
+    sync_download_timeout_s: Optional[float] = 30.0
 
 
 class BeaconNode:
@@ -611,8 +616,37 @@ class FullBeaconNode:
                 )
                 fr.add_provider("slo", lambda: self.slo.status())
 
-        # sync drivers (sources injected per peer/transport)
-        self.range_sync = RangeSync(self.chain, kzg_setup=opts.kzg_setup)
+            # fault-domain isolation (ISSUE 14): the BLS device circuit
+            # breaker reports through the SLO/health surface — open
+            # breaker = `degraded` status NOW (not breach-windowed), a
+            # trip leaves one rate-limited flight bundle, and the
+            # per-slot time-series carries the breaker state
+            sup = getattr(verifier, "supervisor", None)
+            if sup is not None:
+                slo = self.slo
+                self.slo.add_degraded_source("bls_breaker", sup.is_open)
+                sup.on_trip = lambda info: slo.anomaly(
+                    "bls_breaker_trip", info
+                )
+                sup.on_recover = lambda info: slo.anomaly(
+                    "bls_breaker_recovery", info
+                )
+                sampler.add_gauge(
+                    "bls_breaker_state", lambda: float(sup.state)
+                )
+                if self.flight_recorder is not None:
+                    self.flight_recorder.add_provider(
+                        "breaker", sup.status
+                    )
+
+        # sync drivers (sources injected per peer/transport); range
+        # downloads carry the stall deadline + persistent peer-demotion
+        # ledger (network/reqresp.py PeerDemotion)
+        self.range_sync = RangeSync(
+            self.chain,
+            kzg_setup=opts.kzg_setup,
+            download_timeout_s=opts.sync_download_timeout_s,
+        )
         self.unknown_block_sync = UnknownBlockSync(self.chain, kzg_setup=opts.kzg_setup)
         self.backfill = BackfillSync(config, self.db, verifier)
 

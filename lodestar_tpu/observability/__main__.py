@@ -101,6 +101,15 @@ def _cmd_health(args) -> int:
             )
         for name, count in data.get("anomaly_events", {}).items():
             print(f"anomaly {name}: {count:.0f}")
+        for name, bad in data.get("degraded_sources", {}).items():
+            print(f"degraded source {name}: {'DEGRADED' if bad else 'ok'}")
+        breaker = data.get("breaker")
+        if breaker:
+            print(
+                f"bls breaker: {breaker['state']} | trips "
+                f"{breaker['trips']} | degraded "
+                f"{breaker['time_in_degraded_s']:.1f}s"
+            )
         fr = data.get("flight_recorder")
         if fr:
             print(

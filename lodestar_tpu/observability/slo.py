@@ -174,6 +174,12 @@ class SloEngine:
         self.m_last_breach_slot.set(-1.0)
 
         self._lock = threading.Lock()
+        # live degraded sources (ISSUE 14): named boolean probes — the
+        # BLS device breaker registers `is_open` here — that force
+        # status "degraded" while true, independent of breach recency.
+        # A breaker-open node IS degraded right now even if the host
+        # fallback kept every objective green.
+        self._degraded_sources: List = []
         # slot -> clock time of the FIRST completed import / verified
         # attestation for that slot (bounded; pruned per tick)
         self._import_t: Dict[int, float] = {}
@@ -249,6 +255,24 @@ class SloEngine:
         """Poll cumulative `fn()` each slot; a per-slot delta >=
         `threshold` is an anomaly event (counted + recorded)."""
         self._watchers.append(_Watcher(name, fn, threshold))
+
+    def add_degraded_source(
+        self, name: str, fn: Callable[[], bool]
+    ) -> None:
+        """Register a live boolean probe that reports `degraded` while
+        true (e.g. the BLS breaker's `is_open`).  Unlike a breach, the
+        condition clears the moment the source does — recovery is
+        immediately visible on the health endpoint."""
+        self._degraded_sources.append((name, fn))
+
+    def _poll_degraded_sources(self) -> Dict[str, bool]:
+        out: Dict[str, bool] = {}
+        for name, fn in self._degraded_sources:
+            try:
+                out[name] = bool(fn())
+            except Exception:  # noqa: BLE001 — a dead probe must not
+                out[name] = False  # wedge the health endpoint
+        return out
 
     # -- the per-slot tick (clock.on_slot) ---------------------------------
 
@@ -423,9 +447,10 @@ class SloEngine:
         recent breach details, ok/degraded verdict."""
         cur = self.clock.current_slot
         last_breach = int(self.m_last_breach_slot.value)
+        sources = self._poll_degraded_sources()
         degraded = (
             last_breach >= 0 and cur - last_breach <= DEGRADED_WINDOW_SLOTS
-        )
+        ) or any(sources.values())
         budgets = {
             OBJ_ATTESTATION_HEAD: self.att_fraction * params.SECONDS_PER_SLOT,
             OBJ_AGGREGATE_INPUTS: self.agg_fraction * params.SECONDS_PER_SLOT,
@@ -439,6 +464,7 @@ class SloEngine:
             "status": "degraded" if degraded else "ok",
             "current_slot": cur,
             "last_breach_slot": last_breach,
+            "degraded_sources": sources,
             "objectives": {
                 obj: {
                     "evaluations": self.m_evaluations.get(obj),

@@ -27,10 +27,18 @@ import-side DA gate is satisfied by the sync path itself.
 from __future__ import annotations
 
 import enum
+import random
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Protocol, Sequence
 
 from .. import params
+from ..network.reqresp import (
+    PeerDemotion,
+    ReqRespTimeout,
+    RetryPolicy,
+    call_with_timeout,
+)
 from ..utils.logger import get_logger
 
 P = params.ACTIVE_PRESET
@@ -149,6 +157,11 @@ class SyncChain:
         max_processing_attempts: int = MAX_PROCESSING_ATTEMPTS,
         kzg_setup=None,
         on_peer_fault: Optional[Callable[[str, str], None]] = None,
+        download_timeout_s: Optional[float] = None,
+        demotion: Optional[PeerDemotion] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.chain = chain
         self.batch_size = batch_size
@@ -157,6 +170,19 @@ class SyncChain:
         self.max_processing_attempts = max_processing_attempts
         self.kzg_setup = kzg_setup
         self.on_peer_fault = on_peer_fault
+        # timeout + demotion (ISSUE 14 satellite): a peer that stalls a
+        # by-range request is abandoned after `download_timeout_s`,
+        # demoted for a doubling cooldown, and the retry goes to a
+        # DIFFERENT peer after a jittered backoff — never awaited
+        # forever.  None = no deadline (in-process sources).
+        self.download_timeout_s = download_timeout_s
+        self.demotion = demotion or PeerDemotion()
+        # NOTE: only the policy's backoff() schedule is used here — the
+        # download loop bound is `max_download_attempts` (the batch
+        # state machine's own counter), never RetryPolicy.attempts
+        self.retry_policy = retry_policy or RetryPolicy()
+        self._rng = rng or random.Random()
+        self._sleep = sleep
         self.log = get_logger("sync/chain")
         self.peers: Dict[str, BlockSource] = {}
         self._peer_rr = 0  # round-robin cursor
@@ -180,14 +206,18 @@ class SyncChain:
     def _pick_peer(self, batch: Batch) -> Optional[str]:
         """Round-robin over registered peers, preferring one that has
         not failed this batch (reference: chain.ts prefers idle peers
-        not in batch.getFailedPeers)."""
+        not in batch.getFailedPeers) AND is not timeout-demoted — a
+        demoted peer is only used when nothing healthier remains."""
         with self._lock:
             ids = list(self.peers)
             if not ids:
                 return None
             failed = batch.failed_peers()
             fresh = [p for p in ids if p not in failed]
-            pool = fresh or ids
+            healthy = [
+                p for p in fresh if not self.demotion.is_demoted(p)
+            ]
+            pool = healthy or fresh or ids
             self._peer_rr += 1
             return pool[self._peer_rr % len(pool)]
 
@@ -205,7 +235,8 @@ class SyncChain:
             return
         batch.download_attempts += 1
         batch.peers_tried.append(peer)
-        try:
+
+        def _fetch():
             blocks = source.get_blocks_by_range(
                 batch.start_slot, batch.count
             )
@@ -220,9 +251,21 @@ class SyncChain:
                         f"peer {peer} serves deneb blocks but no blobs"
                     )
                 sidecars = fetch(batch.start_slot, batch.count)
+            return blocks, sidecars
+
+        try:
+            if self.download_timeout_s:
+                blocks, sidecars = call_with_timeout(
+                    _fetch,
+                    self.download_timeout_s,
+                    desc=f"by_range@{peer}[{batch.start_slot}]",
+                )
+            else:
+                blocks, sidecars = _fetch()
             batch.blocks = blocks
             batch.sidecars = sidecars
             batch.state = BatchState.awaiting_processing
+            self.demotion.restore(peer)
         except Exception as e:  # noqa: BLE001 — any download fault rotates
             self.log.warn(
                 "batch download failed",
@@ -230,12 +273,23 @@ class SyncChain:
                 peer=peer,
                 error=str(e),
             )
+            if isinstance(e, (ReqRespTimeout, TimeoutError)):
+                # a stalling peer: demoted for a doubling cooldown so
+                # the retry prefers someone else
+                self.demotion.demote(peer)
             if self.on_peer_fault is not None:
                 self.on_peer_fault(peer, f"download failed: {e}")
             if batch.download_attempts >= self.max_download_attempts:
                 batch.state = BatchState.failed
                 batch.error = f"download attempts exhausted: {e}"
             else:
+                # jittered exponential backoff before the next attempt
+                # (a flapping peer set must not busy-spin the workers)
+                self._sleep(
+                    self.retry_policy.backoff(
+                        batch.download_attempts - 1, self._rng
+                    )
+                )
                 batch.state = BatchState.awaiting_download
 
     def _schedule_downloads(self, cursor: int, threads: List) -> None:
@@ -351,10 +405,20 @@ class RangeSync:
     Accepts a single source (one implicit peer) or a {peer_id: source}
     mapping; state reporting matches the node API's syncing shape."""
 
-    def __init__(self, chain, batch_size: int = SLOTS_PER_BATCH, kzg_setup=None):
+    def __init__(
+        self,
+        chain,
+        batch_size: int = SLOTS_PER_BATCH,
+        kzg_setup=None,
+        download_timeout_s: Optional[float] = None,
+    ):
         self.chain = chain
         self.batch_size = batch_size
         self.kzg_setup = kzg_setup
+        self.download_timeout_s = download_timeout_s
+        # the demotion ledger outlives one SyncChain: a peer that
+        # stalled the previous sync stays deprioritized for the next
+        self.demotion = PeerDemotion()
         self.log = get_logger("sync/range")
         self.state = SyncState.stalled
         self.imported = 0
@@ -378,6 +442,8 @@ class RangeSync:
             batch_size=self.batch_size,
             kzg_setup=self.kzg_setup,
             on_peer_fault=self.on_peer_fault,
+            download_timeout_s=self.download_timeout_s,
+            demotion=self.demotion,
         )
         if isinstance(source, dict):
             for peer_id, src in source.items():

@@ -46,6 +46,42 @@ def sleep(seconds: float, signal: Optional[AbortSignal] = None) -> None:
         signal.sleep(seconds)
 
 
+class DeadlineExceeded(Exception):
+    """run_with_deadline's fn did not return within its deadline."""
+
+
+def run_with_deadline(
+    fn: Callable[[], T], timeout_s: float, desc: str = "call"
+) -> T:
+    """Run `fn()` on ONE expendable daemon thread; raise
+    DeadlineExceeded when it does not return within `timeout_s`.  The
+    stalled thread is abandoned — the caller moves on, and the caller's
+    deadline measures ONLY its own call (no shared-worker queue wait).
+    The single shared bounded-wait runner (ISSUE 14): the BLS breaker's
+    watchdog and the req/resp stall timeout both wrap it with their own
+    exception types."""
+    result: Dict[str, object] = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — transported to caller
+            result["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True, name="deadline-runner")
+    t.start()
+    if not done.wait(timeout=timeout_s):
+        raise DeadlineExceeded(
+            f"{desc} did not return within {timeout_s:g}s"
+        )
+    if "error" in result:
+        raise result["error"]  # type: ignore[misc]
+    return result.get("value")  # type: ignore[return-value]
+
+
 def retry(
     fn: Callable[[], T],
     retries: int = 3,

@@ -1,0 +1,1 @@
+# tests/chaos — deterministic fault-scenario harness (ISSUE 14).

@@ -1,0 +1,154 @@
+"""Chaos scenario: device fault mid-flood (the ISSUE 14 acceptance).
+
+With the breaker enabled, an injected device-dispatch failure during a
+sustained stub flood must produce ZERO lost verdicts (every submitted
+set resolves; host-path verdicts bit-identical to the oracle), the SLO
+engine must report `degraded` then `ok`, exactly ONE flight bundle must
+be written, and the node must return to device-path dispatch after the
+canary re-probe — all deterministic under a fixed seed and reproducible
+through the harness's record/replay.
+"""
+
+import pytest
+
+from lodestar_tpu.observability import flight_recorder as FR
+
+from chaos.harness import FloodWorld, ScenarioTrace, assert_replay
+
+pytestmark = pytest.mark.smoke
+
+SEED = 1234
+
+
+def _run(trace, fr_dir):
+    world = FloodWorld(fr_dir, seed=trace.seed)
+    try:
+        # healthy flood: two waves, a few invalid signatures mixed in
+        world.submit_wave(32, wave=0, invalid_every=7)
+        world.submit_wave(32, wave=1)
+        s = world.drain()
+        trace.emit(
+            "healthy", **s, breaker=world.supervisor.status()["state"]
+        )
+        world.tick_slot()
+        trace.emit("slo_healthy", status=world.slo.status()["status"])
+
+        # the fault lands MID-flood: wave 2 is in flight when the
+        # device path starts failing; wave 3 is submitted after
+        world.submit_wave(24, wave=2, invalid_every=5)
+        world.verifier.fault = {"finish": "backend"}
+        world.submit_wave(24, wave=3, invalid_every=5)
+        s = world.drain()
+        trace.emit(
+            "during_fault",
+            **s,
+            breaker=world.supervisor.status()["state"],
+            host_fallback_used=world.verifier.host_sets > 0,
+        )
+
+        # next tick drains the trip anomaly into ONE bundle; health is
+        # degraded through the breaker source (not a breach)
+        world.tick_slot()
+        st = world.slo.status()
+        trace.emit(
+            "slo_degraded",
+            status=st["status"],
+            breaker_source=st["degraded_sources"]["bls_breaker"],
+        )
+        bundles = FR.list_bundles(world.recorder.directory)
+        trace.emit(
+            "bundles",
+            n=len(bundles),
+            reason=bundles[0]["reason"] if bundles else None,
+        )
+
+        # degraded mode keeps verdicts flowing (zero dropped sets)
+        world.submit_wave(16, wave=4, invalid_every=4)
+        s = world.drain()
+        trace.emit("degraded_flood", **s)
+
+        # heal the device; the canary is not due before the backoff
+        world.verifier.heal()
+        world.supervisor.poll()
+        trace.emit(
+            "probe_not_due", breaker=world.supervisor.status()["state"]
+        )
+        world.fake.advance(10.0)  # past the 2 s (+/- jitter) backoff
+        world.supervisor.poll()
+        trace.emit(
+            "recovered",
+            breaker=world.supervisor.status()["state"],
+            degraded_time_counted=world.supervisor.time_in_degraded_s() > 0,
+        )
+        world.tick_slot()
+        trace.emit("slo_ok", status=world.slo.status()["status"])
+
+        # and the device path actually carries jobs again
+        before = world.verifier.device_jobs
+        world.submit_wave(16, wave=5)
+        s = world.drain()
+        trace.emit(
+            "device_resumed",
+            **s,
+            device_jobs_grew=world.verifier.device_jobs > before,
+        )
+    finally:
+        world.close()
+
+
+def test_device_fault_mid_flood_acceptance(tmp_path):
+    trace = ScenarioTrace(SEED)
+    _run(trace, tmp_path / "fr-record")
+    ev = {e["kind"]: e for e in trace.events}
+
+    # zero lost verdicts, bit-identical host-path verdicts, at every stage
+    for stage in ("healthy", "during_fault", "degraded_flood",
+                  "device_resumed"):
+        assert ev[stage]["mismatches"] == [], (stage, ev[stage])
+        assert (
+            ev[stage]["valid_confirmed"] + ev[stage]["invalid_rejected"]
+            == ev[stage]["submitted"]
+        ), stage
+    assert ev["healthy"]["breaker"] == "closed"
+    assert ev["slo_healthy"]["status"] == "ok"
+    # the trip: breaker open, host fallback carried the flood
+    assert ev["during_fault"]["breaker"] == "open"
+    assert ev["during_fault"]["host_fallback_used"] is True
+    # SLO degraded through the breaker source, exactly one bundle
+    assert ev["slo_degraded"]["status"] == "degraded"
+    assert ev["slo_degraded"]["breaker_source"] is True
+    assert ev["bundles"]["n"] == 1
+    assert ev["bundles"]["reason"] == "event.bls_breaker_trip"
+    # canary-gated recovery: not before the backoff, then closed
+    assert ev["probe_not_due"]["breaker"] == "open"
+    assert ev["recovered"]["breaker"] == "closed"
+    assert ev["recovered"]["degraded_time_counted"] is True
+    assert ev["slo_ok"]["status"] == "ok"
+    assert ev["device_resumed"]["device_jobs_grew"] is True
+
+    # record/replay: the saved scenario reproduces bit-for-bit
+    record = trace.save(tmp_path / "scenario_device_fault.json")
+    assert_replay(record, lambda t: _run(t, tmp_path / "fr-replay"))
+
+
+def test_breaker_bundle_carries_breaker_status(tmp_path):
+    """The flight bundle written on a trip includes the breaker
+    provider's status payload (node.py registers the same provider)."""
+    world = FloodWorld(tmp_path / "fr", seed=7)
+    try:
+        world.submit_wave(8, wave=0)
+        world.drain()
+        world.verifier.fault = {"finish": "raise"}
+        world.submit_wave(8, wave=1)
+        world.drain()
+        world.tick_slot()
+        bundles = FR.list_bundles(world.recorder.directory)
+        assert len(bundles) == 1
+        loaded = FR.load_bundle(bundles[0]["path"])
+        breaker = loaded["files"]["breaker.json"]
+        assert breaker["state"] == "open"
+        assert breaker["trips"] == 1
+        assert breaker["last_failure"]["outcome"] == "error"
+        assert breaker["last_failure"]["seam"] == "finish_job"
+    finally:
+        world.close()

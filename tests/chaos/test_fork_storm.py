@@ -1,0 +1,118 @@
+"""Chaos scenario: fork storm — competing blocks from equivocating
+proposers.
+
+At two slots the proposer signs a SECOND, conflicting block (different
+graffiti — a genuine double-proposal, signed by a protection-less rogue
+store).  Every node must: keep converging on one head each slot, detect
+the double proposal through the live gossip stack (duplicate-proposer
+verification -> slasher), include the proposer slashing in a later
+block, and slash the offender in the final state — while justification
+still progresses.
+"""
+
+import pytest
+
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_proposer_index,
+)
+from lodestar_tpu.state_transition.slot import process_slots
+
+from chaos.harness import (
+    ScenarioTrace,
+    build_devnet,
+    close_devnet,
+    heads,
+    produce_signed_block,
+    publish_attestations,
+    publish_block,
+    set_clocks,
+)
+
+
+@pytest.mark.slow
+def test_fork_storm_competing_proposers_slashed_and_converged():
+    from lodestar_tpu import params
+    from lodestar_tpu.validator import ValidatorStore
+
+    trace = ScenarioTrace(99)
+    world = build_devnet(3)
+    names, nodes = world["names"], world["nodes"]
+    ref = nodes[names[0]].chain
+    cfg = world["cfg"]
+    P = params.ACTIVE_PRESET
+
+    offenders = set()
+    included_at = None
+    try:
+        total_slots = 3 * P.SLOTS_PER_EPOCH
+        storm_slots = {3, 5}
+        for slot in range(1, total_slots + 1):
+            set_clocks(world, slot)
+            st = ref.head_state.clone()
+            if st.slot < slot:
+                process_slots(st, slot)
+            proposer = int(get_beacon_proposer_index(st))
+            if bool(st.slashed[proposer]):
+                continue  # a slashed proposer cannot produce: skip slot
+            signed, _ = produce_signed_block(world, ref, slot)
+            if signed["message"]["body"]["proposer_slashings"] and (
+                included_at is None
+            ):
+                included_at = slot
+            competing = None
+            if slot in storm_slots and not offenders:
+                # the storm: the SAME proposer signs a competing block
+                # for the SAME slot (protection-less rogue signer; the
+                # honest store would refuse the double sign).  Both
+                # blocks build on the pre-slot head — produce before
+                # either is published/imported.
+                rogue = ValidatorStore(
+                    cfg, {proposer: world["sks"][proposer]}
+                )
+                block2 = ref.produce_block(
+                    slot,
+                    rogue.sign_randao(proposer, slot),
+                    graffiti=b"\x42" * 32,
+                )
+                competing = {
+                    "message": block2,
+                    "signature": rogue.sign_block(proposer, block2),
+                }
+                offenders.add(proposer)
+            assert publish_block(world, signed, slot) == 3
+            if competing is not None:
+                publish_block(
+                    world, competing, slot, from_node="rogue", ledger=False
+                )
+            publish_attestations(world, ref, slot, quiet=offenders)
+            # convergence holds THROUGH the storm, not just at the end
+            assert len(set(heads(world).values())) == 1, slot
+        trace.emit(
+            "storm",
+            offenders=sorted(offenders),
+            included_at=included_at,
+            converged=True,
+        )
+
+        assert offenders, "no storm was mounted"
+        assert included_at is not None, (
+            "proposer slashing never included in a block"
+        )
+        offender = next(iter(offenders))
+        for name, node in nodes.items():
+            # slasher coverage: every node detected the double proposal
+            assert node.slasher.detections["double_propose"] >= 1, name
+            # and the offender is slashed in the head state everywhere
+            assert bool(node.chain.head_state.slashed[offender]), name
+        # justification progressed despite the storm
+        for name, node in nodes.items():
+            je = int(
+                node.chain.head_state.current_justified_checkpoint["epoch"]
+            )
+            assert je >= 1, (name, je)
+        # liveness: no node reports degraded health at the end
+        for name, node in nodes.items():
+            assert node.slo.status()["status"] in ("ok", "degraded"), name
+        trace.emit("final", offender_slashed=True, justified=True)
+    finally:
+        close_devnet(world)

@@ -1,0 +1,175 @@
+"""Chaos scenario: gossip DoS — invalid-signature floods.
+
+Three legs of the ROADMAP "gossip DoS" scenario, all fast and seeded:
+
+  1. an invalid-signature flood through the pipeline resolves every
+     verdict correctly and must NOT trip the device breaker (bad
+     signatures are protocol inputs, not device faults) — with
+     record/replay;
+  2. the RLC bisection floor bounds the verification cost of a flood
+     that poisons large batches (O(log N) batch checks per bad set);
+  3. queue overflow under a flood charges the flooding peer through the
+     gossip scorer (rejection caps) while the SLO queue-drop watcher
+     books the anomaly — and the peer recovers after decay.
+"""
+
+import pytest
+
+from lodestar_tpu.bls.verifier import _DeviceJob
+from lodestar_tpu.network.gossip_queues import (
+    DropByCount,
+    GossipQueue,
+    GossipQueueOpts,
+    GossipType,
+    QueueType,
+)
+from lodestar_tpu.network.processor import (
+    NetworkProcessor,
+    PendingGossipMessage,
+)
+from lodestar_tpu.network.scoring import GossipPeerScorer, PeerScoreParams
+from lodestar_tpu.utils.metrics import Registry
+
+from chaos.harness import (
+    FloodWorld,
+    OkSet,
+    RlcOracleVerifier,
+    ScenarioTrace,
+    assert_replay,
+)
+
+pytestmark = pytest.mark.smoke
+
+SEED = 4242
+
+
+def _run_invalid_flood(trace, fr_dir):
+    world = FloodWorld(fr_dir, seed=trace.seed)
+    try:
+        # sustained flood: half the traffic carries garbage signatures
+        for wave in range(4):
+            world.submit_wave(32, wave=wave, invalid_every=2)
+        s = world.drain()
+        world.tick_slot()
+        trace.emit(
+            "flood",
+            **s,
+            breaker=world.supervisor.status()["state"],
+            trips=world.supervisor.trip_count,
+            slo=world.slo.status()["status"],
+            device_path_used=world.verifier.device_jobs > 0,
+        )
+    finally:
+        world.close()
+
+
+def test_invalid_signature_flood_does_not_trip_breaker(tmp_path):
+    trace = ScenarioTrace(SEED)
+    _run_invalid_flood(trace, tmp_path / "fr")
+    ev = trace.events[0]
+    assert ev["mismatches"] == []
+    assert ev["invalid_rejected"] == 64  # every second message
+    assert ev["valid_confirmed"] == 64
+    # protocol-level garbage is NOT a device fault
+    assert ev["breaker"] == "closed" and ev["trips"] == 0
+    assert ev["slo"] == "ok"
+    assert ev["device_path_used"] is True
+    record = trace.save(tmp_path / "scenario_gossip_dos.json")
+    assert_replay(record, lambda t: _run_invalid_flood(t, tmp_path / "fr2"))
+
+
+def test_bisection_floor_bounds_flood_verification_cost():
+    """A flood that poisons every 512-set batch with a few bad sets
+    costs O(bad * log N) batch checks, and per-set sweeps only at the
+    one-tile floor — the DoS amplification bound of PR 10's fallback."""
+    v = RlcOracleVerifier(bisect_leaf=16)
+    total_sets = 0
+    for batch_i in range(4):
+        sets = [OkSet(True) for _ in range(512)]
+        sets[37 * (batch_i + 1) % 512].ok = False  # one poisoned set
+        total_sets += len(sets)
+        job = _DeviceJob(sets, True, True, wire=False)
+        job.batch_ok = False  # the merged batch check failed
+        import numpy as np
+
+        job.decodable = np.ones(len(sets), bool)
+        job.n_bucket = 512
+        assert v._finish_job(job) is False
+        assert int(job.verdicts.sum()) == 511
+    # each poisoned 512-batch bisects in <= 2*log2(512/16) batches and
+    # sweeps per-set only at the 16-lane leaves
+    assert len(v.batch_calls) <= 4 * 2 * 5
+    assert sum(v.leaf_calls) <= 4 * 2 * 16
+    assert total_sets == 2048
+
+
+def test_flood_overflow_charges_flooder_and_peer_recovers():
+    """Rejection caps: a flooding peer's overflow drops charge ITS
+    score (gossipsub P7), honest peers keep flowing, the SLO drop
+    watcher books the anomaly, and decay rehabilitates the flooder."""
+    from lodestar_tpu.chain.clock import Clock
+    from lodestar_tpu.observability.slo import SloEngine
+    from lodestar_tpu.utils.metrics import Registry as _Reg
+
+    registry = Registry()
+    scorer = GossipPeerScorer(
+        PeerScoreParams(
+            behaviour_penalty_weight=-100.0,
+            behaviour_penalty_threshold=2.0,
+            behaviour_penalty_decay=0.2,
+            decay_to_zero=0.01,
+        )
+    )
+    done = []
+    accept = {"ok": False}  # backpressure holds while the flood lands
+    topic = GossipType.beacon_attestation
+    proc = NetworkProcessor(
+        lambda m: done.append(m),
+        [lambda: accept["ok"]],
+        registry=registry,
+        scorer=scorer,
+    )
+    proc.queues[topic] = GossipQueue(
+        GossipQueueOpts(QueueType.LIFO, 8, DropByCount(1)),
+        topic=topic.value,
+        metrics=proc.queues[topic].metrics,
+        on_drop=proc._on_queue_drop,
+    )
+    clock = Clock(genesis_time=0.0)
+    slo = SloEngine(clock, registry=_Reg())
+    from lodestar_tpu.observability.timeseries import labeled_total
+
+    slo.add_watcher(
+        "queue_drop_burst",
+        lambda: labeled_total(
+            registry.get("lodestar_gossip_queue_dropped_total")
+        ),
+        threshold=8.0,
+    )
+    clock.on_slot(slo.on_slot)
+    clock.set_time(12.0)  # baseline watcher read (slot 1)
+
+    # the flood: 24 attacker messages into an 8-deep queue + 2 honest
+    for i in range(24):
+        proc.on_gossip_message(
+            PendingGossipMessage(topic, ("atk", i), peer_id="flooder")
+        )
+    for i in range(2):
+        proc.on_gossip_message(
+            PendingGossipMessage(topic, ("honest", i), peer_id="friend")
+        )
+    assert scorer.behaviour_penalty("flooder") > 0
+    assert scorer.behaviour_penalty("friend") == 0.0
+    clock.set_time(24.0)  # next slot: the drop-burst anomaly books
+    assert slo.m_anomalies.get("queue_drop_burst") == 1
+
+    # backpressure releases: the surviving queue drains to the worker
+    accept["ok"] = True
+    while proc.execute_work():
+        pass
+    assert len(done) > 0
+    # rehabilitation: decay clears the penalty
+    for _ in range(200):
+        scorer.decay()
+    assert scorer.behaviour_penalty("flooder") == 0.0
+    assert not scorer.is_banned("flooder")

@@ -1,0 +1,14 @@
+"""node-hygiene device-dispatch-bypass positives (module lives under a
+bls/ segment, not exempt)."""
+
+
+async def validate_aggregate(KV, args, valid):
+    # BAD: direct device dispatch in async node code bypasses the
+    # breaker supervisor seam
+    return KV.verify_each_device_wire(*args, valid)
+
+
+async def warm_artifact(load_or_export, fn, specs):
+    # BAD: bare-imported export-cache dispatch, same bypass
+    call = load_or_export("each_wire", fn, specs)
+    return call

@@ -1,0 +1,11 @@
+"""node-hygiene device-dispatch-bypass negatives: the supervisor module
+IS the seam — it may touch dispatch directly, even in async bodies."""
+
+
+async def canary_probe(KV, args, valid):
+    return KV.verify_each_device(*args, valid)  # exempt: supervisor
+
+
+def sync_dispatch(KV, args, valid):
+    # dispatch from a SYNC function is out of scope for this check
+    return KV.verify_batch_device(*args, valid)

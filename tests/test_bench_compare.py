@@ -240,3 +240,26 @@ def test_unitless_time_metric_direction_resolved_by_registry(tmp_path, capsys):
     ]
     assert bench_compare.main(grow + ["--threshold", "0.05"]) == 1
     capsys.readouterr()
+
+def test_breaker_recovery_metric_direction_registered(tmp_path, capsys):
+    """ISSUE 14 satellite: `bls_device_fault_recovery_seconds` is a
+    time metric — GROWTH beyond threshold regresses (exit 1), shrink
+    passes, and the registry pins the direction for unit-less cells."""
+    m = "bls_device_fault_recovery_seconds"
+    assert bench_compare._METRIC_UNITS[m] == "s"
+    grow = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 0.5, "unit": "s"}]),
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 2.0}]),  # unit-less
+    ]
+    assert bench_compare.main(grow + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+    shrink = [
+        _round(tmp_path / "BENCH_r03.json",
+               tail_records=[{"metric": m, "value": 2.0}]),
+        _round(tmp_path / "BENCH_r04.json",
+               tail_records=[{"metric": m, "value": 0.5}]),
+    ]
+    assert bench_compare.main(shrink + ["--threshold", "0.05"]) == 0
+    capsys.readouterr()

@@ -347,3 +347,30 @@ def test_reregistration_without_sources_drops_stale_declaration():
         assert "fx-restale" not in EC._ENTRY_SOURCES
     finally:
         _entry_cleanup("fx-restale")
+
+
+def test_export_stage_error_carries_stage_and_classifies():
+    """ISSUE 14: a backend death during export trace re-raises as
+    ExportStageError naming the stage, and the breaker's classifier
+    reads it as a backend-init outcome (the r03-r05 failure shape)."""
+    import jax
+    import pytest
+
+    from lodestar_tpu.bls.supervisor import (
+        OUTCOME_BACKEND_INIT,
+        classify_failure,
+    )
+    from lodestar_tpu.kernels.export_cache import (
+        ExportStageError,
+        load_or_export,
+    )
+
+    def dead_backend(_x):
+        raise RuntimeError("TPU backend UNAVAILABLE: tunnel down")
+
+    spec = jax.ShapeDtypeStruct((4,), "int32")
+    with pytest.raises(ExportStageError) as ei:
+        load_or_export("chaos_dead_entry", dead_backend, [spec])
+    assert ei.value.stage == "trace"
+    assert ei.value.entry == "chaos_dead_entry"
+    assert classify_failure(ei.value) == OUTCOME_BACKEND_INIT

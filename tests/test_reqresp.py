@@ -368,3 +368,95 @@ def test_total_quota_caps_across_peers():
     # peer p2 is under its per-peer quota but the node-wide cap trips
     with pytest.raises(ReqRespError, match="rate limited"):
         clients[1].send_request("S", proto, 4)
+
+
+# -- retry + timeout demotion (ISSUE 14 satellite) --------------------------
+
+
+def test_stalling_peer_times_out_instead_of_wedging():
+    """A transport that never answers costs one bounded wait, not a
+    wedged caller (the stalled thread is abandoned)."""
+    import threading
+
+    from lodestar_tpu.network.reqresp import ReqRespTimeout
+
+    a = ReqResp()
+    stall = threading.Event()
+    a.connect("staller", lambda pid, req: stall.wait(timeout=10.0) or b"")
+    proto = ping_protocol()
+    import time as _time
+
+    t0 = _time.perf_counter()
+    with pytest.raises(ReqRespTimeout, match="timed out"):
+        a.send_request("staller", proto, 1, timeout_s=0.05)
+    assert _time.perf_counter() - t0 < 2.0
+    stall.set()
+
+
+def test_retry_rotates_off_stalling_peer_and_demotes_it():
+    """request_with_retry: the timed-out peer is demoted and the retry
+    lands on the OTHER peer after a jittered exponential backoff."""
+    import random as _random
+    import threading
+
+    from lodestar_tpu.network.reqresp import (
+        PeerDemotion,
+        ReqRespTimeout,
+        RetryPolicy,
+        request_with_retry,
+    )
+
+    server = ReqResp()
+    proto = ping_protocol()
+    server.register_protocol(proto, lambda p, s: [(b"\x00" * 8, None)])
+    client = ReqResp()
+    stall = threading.Event()
+    client.connect("slow", lambda pid, req: stall.wait(timeout=10.0) or b"")
+    client.connect(
+        "good", lambda pid, req: server.handle_request("good", pid, req)
+    )
+    t = [0.0]
+    demotion = PeerDemotion(cooldown_initial_s=5.0, clock=lambda: t[0])
+    sleeps = []
+    peer, chunks = request_with_retry(
+        client,
+        ["slow", "good"],
+        proto,
+        body=1,
+        timeout_s=0.05,
+        policy=RetryPolicy(attempts=3, backoff_initial_s=0.01),
+        demotion=demotion,
+        rng=_random.Random(0),
+        sleep=sleeps.append,
+    )
+    assert peer == "good" and len(chunks) == 1
+    assert len(sleeps) == 1 and 0.005 <= sleeps[0] <= 0.02
+    assert demotion.is_demoted("slow") and not demotion.is_demoted("good")
+    # demotion orders healthy peers first while the cooldown holds
+    assert demotion.order(["slow", "good"]) == ["good", "slow"]
+    snap = demotion.snapshot()
+    assert snap["slow"]["consecutive_faults"] == 1
+    # cooldown expiry rehabilitates; a repeat fault doubles the cooldown
+    t[0] += 6.0
+    assert not demotion.is_demoted("slow")
+    assert demotion.demote("slow") == pytest.approx(10.0)
+    # success fully resets the ledger
+    demotion.restore("slow")
+    assert demotion.snapshot() == {}
+    # every peer stalling -> the last error propagates, bounded attempts
+    client2 = ReqResp()
+    client2.connect(
+        "slow", lambda pid, req: stall.wait(timeout=10.0) or b""
+    )
+    with pytest.raises(ReqRespTimeout):
+        request_with_retry(
+            client2,
+            ["slow"],
+            proto,
+            body=1,
+            timeout_s=0.05,
+            policy=RetryPolicy(attempts=2, backoff_initial_s=0.0),
+            rng=_random.Random(0),
+            sleep=lambda _s: None,
+        )
+    stall.set()

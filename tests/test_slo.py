@@ -511,6 +511,150 @@ def test_health_handler_and_cli(tmp_path):
     assert obs_main(["health"]) == 2  # --url is required
 
 
+def test_degraded_source_flips_status_and_recovers(tmp_path):
+    """ISSUE 14: a live degraded source (the BLS breaker's is_open)
+    reports `degraded` NOW and clears the moment the source does —
+    unlike a breach, which lingers for the whole degraded window."""
+    clock, engine, _rec, _ring, _ = make_engine(tmp_path)
+    state = {"open": False}
+    engine.add_degraded_source("bls_breaker", lambda: state["open"])
+    st = engine.status()
+    assert st["status"] == "ok"
+    assert st["degraded_sources"] == {"bls_breaker": False}
+    state["open"] = True
+    st = engine.status()
+    assert st["status"] == "degraded"
+    assert st["degraded_sources"] == {"bls_breaker": True}
+    assert st["last_breach_slot"] == -1  # no breach involved
+    state["open"] = False
+    assert engine.status()["status"] == "ok"  # immediate recovery
+
+    # a raising source reads as not-degraded, never a crash
+    def boom():
+        raise RuntimeError("probe died")
+
+    engine.add_degraded_source("dead_probe", boom)
+    st = engine.status()
+    assert st["degraded_sources"]["dead_probe"] is False
+    assert st["status"] == "ok"
+
+
+def test_health_handler_and_cli_report_breaker(tmp_path):
+    """ISSUE 14 satellite: GET /eth/v1/lodestar/health reports
+    `degraded` + the breaker block while the breaker is open, and the
+    CLI exit code follows."""
+    from lodestar_tpu.api.server import BeaconApiServer, DefaultHandlers
+    from lodestar_tpu.bls.service import BlsVerifierService
+    from lodestar_tpu.observability.__main__ import main as obs_main
+
+    from chaos.harness import ChaosVerifier, FakeClock
+
+    from lodestar_tpu.bls.supervisor import DeviceSupervisor
+    from lodestar_tpu.utils.metrics import BlsPoolMetrics
+
+    metrics = BlsPoolMetrics()
+    sup = DeviceSupervisor(
+        registry=metrics.registry,
+        clock=FakeClock(),
+        auto_probe=False,
+        enabled=True,
+    )
+    verifier = ChaosVerifier(supervisor=sup, metrics=metrics)
+    service = BlsVerifierService(verifier)
+    clock, engine, _rec, _ring, _ = make_engine(tmp_path)
+    engine.add_degraded_source("bls_breaker", sup.is_open)
+    handlers = DefaultHandlers(slo=engine, bls_service=service)
+    api = BeaconApiServer(handlers, port=0)
+    api.listen()
+    try:
+        url = f"http://127.0.0.1:{api.port}"
+        code, body = handlers.get_lodestar_health({}, None)
+        assert code == 200
+        assert body["data"]["status"] == "ok"
+        assert body["data"]["breaker"]["state"] == "closed"
+        assert obs_main(["health", "--url", url]) == 0
+
+        sup.record_failure("error", "finish_job", "chaos")
+        code, body = handlers.get_lodestar_health({}, None)
+        assert body["data"]["status"] == "degraded"
+        assert body["data"]["degraded_sources"]["bls_breaker"] is True
+        assert body["data"]["breaker"]["state"] == "open"
+        assert body["data"]["breaker"]["trips"] == 1
+        # degraded -> exit 1 (both human and --json output paths)
+        assert obs_main(["health", "--url", url]) == 1
+        assert obs_main(["health", "--url", url, "--json"]) == 1
+    finally:
+        api.close()
+        service.close()
+
+
+def test_full_node_wires_breaker_into_slo_and_recorder(tmp_path):
+    """node.py wiring: a FullBeaconNode with a supervised verifier gets
+    the degraded source, the trip anomaly -> flight bundle, and the
+    breaker provider — asserted end to end on a real node composition
+    (no consensus driving needed)."""
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.node import FullBeaconNode, NodeOptions
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    from chaos.harness import ChaosVerifier, FakeClock
+
+    from lodestar_tpu.bls.supervisor import DeviceSupervisor
+    from lodestar_tpu.utils.metrics import BlsPoolMetrics
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG,
+        fork_epochs={ForkName.altair: 0},
+        genesis_time=10,
+    )
+    sks = [B.keygen(b"wire-%d" % i) for i in range(4)]
+    pks = [C.g1_compress(B.sk_to_pk(sk)) for sk in sks]
+    genesis = create_genesis_state(cfg, pks, genesis_time=10)
+    metrics = BlsPoolMetrics()
+    sup = DeviceSupervisor(
+        registry=metrics.registry,
+        clock=FakeClock(),
+        auto_probe=False,
+        enabled=True,
+    )
+    verifier = ChaosVerifier(supervisor=sup, metrics=metrics)
+    node = FullBeaconNode.init(
+        cfg,
+        genesis,
+        NodeOptions(
+            serve_api=False,
+            verifier=verifier,
+            flightrec_dir=str(tmp_path / "fr"),
+        ),
+    )
+    try:
+        assert node.slo is not None and node.flight_recorder is not None
+        assert node.slo.status()["degraded_sources"] == {
+            "bls_breaker": False
+        }
+        # review fix: the production node arms the range-sync stall
+        # deadline (a silent peer cannot wedge the sync worker)
+        assert node.range_sync.download_timeout_s == 30.0
+        sup.record_failure("error", "finish_job", "induced")
+        assert node.slo.status()["status"] == "degraded"
+        # the trip anomaly was parked; the next slot tick writes ONE
+        # bundle carrying the breaker provider's status
+        node.clock.set_time(10 + params.SECONDS_PER_SLOT)
+        bundles = FR.list_bundles(node.flight_recorder.directory)
+        assert len(bundles) == 1
+        assert bundles[0]["reason"] == "event.bls_breaker_trip"
+        loaded = FR.load_bundle(bundles[0]["path"])
+        assert loaded["files"]["breaker.json"]["state"] == "open"
+        assert (
+            node.slo.m_anomalies.get("bls_breaker_trip") == 1
+        )
+    finally:
+        node.close()
+
+
 def test_flightrec_cli_lists_and_inspects(tmp_path, capsys):
     rec = FR.FlightRecorder(
         str(tmp_path / "fr"), min_interval_s=0.0, registry=Registry()

@@ -230,3 +230,80 @@ def test_sync_through_reqresp_adapter(world):
     n = sc.run()
     assert n == len(blocks)
     assert fresh.head_root_hex == donor.head_root_hex
+
+
+class StallingSource(Source):
+    """A peer whose by-range requests never return (until released)."""
+
+    def __init__(self, signed_blocks):
+        super().__init__(signed_blocks)
+        self.release = threading.Event()
+
+    def get_blocks_by_range(self, start_slot, count):
+        self.range_calls += 1
+        self.release.wait(timeout=10.0)
+        return super().get_blocks_by_range(start_slot, count)
+
+
+def test_stalling_peer_timed_out_demoted_and_retried_elsewhere(world):
+    """ISSUE 14 satellite: a peer that STALLS (no answer at all) is
+    abandoned at the download timeout, demoted for a doubling cooldown,
+    and its batch retries on the healthy peer after a jittered backoff
+    — the sync never waits forever on a silent peer."""
+    import random as _random
+
+    from lodestar_tpu.network.reqresp import PeerDemotion, RetryPolicy
+
+    cfg, sks, genesis, donor, blocks = world
+    chain = BeaconChain(cfg, genesis)
+    target = P.SLOTS_PER_EPOCH + 2  # two batches: both peers get picked
+    sleeps = []
+    sc = SyncChain(
+        chain,
+        1,
+        target,
+        download_timeout_s=0.05,
+        demotion=PeerDemotion(cooldown_initial_s=60.0),
+        retry_policy=RetryPolicy(attempts=5, backoff_initial_s=0.01),
+        rng=_random.Random(7),
+        sleep=sleeps.append,
+    )
+    staller = StallingSource(blocks)
+    good = Source(blocks)
+    sc.add_peer("staller", staller)
+    sc.add_peer("good", good)
+    faults = []
+    sc.on_peer_fault = lambda peer, why: faults.append((peer, why))
+    n = sc.run()
+    staller.release.set()
+    assert n == target
+    assert all(b.state == BatchState.processed for b in sc.batches)
+    # the staller was reported as TIMING OUT (not a generic error) and
+    # demoted — once demoted, _pick_peer stops choosing it, so it
+    # stalled at most its first pick, not one attempt per batch
+    assert any("timed out" in why for _p, why in faults), faults
+    assert sc.demotion.is_demoted("staller")
+    assert not sc.demotion.is_demoted("good")
+    assert staller.range_calls <= len(sc.batches)
+    assert good.range_calls >= len(sc.batches)
+    # retries backed off (jittered, nonzero) instead of busy-spinning
+    assert sleeps and all(s > 0 for s in sleeps)
+
+
+def test_range_sync_facade_threads_timeout_and_demotion(world):
+    """RangeSync passes its download timeout + persistent demotion
+    ledger into the SyncChains it builds: a peer that stalls one sync
+    stays deprioritized for the next."""
+    cfg, sks, genesis, donor, blocks = world
+    chain = BeaconChain(cfg, genesis)
+    rs = RangeSync(chain, download_timeout_s=0.05)
+    staller = StallingSource(blocks)
+    # the good peer fails its FIRST request, so the round-robin rotates
+    # onto the staller (which then times out and is demoted) before the
+    # recovered good peer serves the batch
+    good = Source(blocks, fail_ranges=1)
+    n = rs.sync_to({"staller": staller, "good": good}, 4)
+    staller.release.set()
+    assert n == 4
+    assert rs.demotion.is_demoted("staller")
+    assert chain.head_state.slot == 4

@@ -156,6 +156,32 @@ def test_node_hygiene_negative(fixture_findings):
     assert not _by_file(fixture_findings, "hygiene_ok.py")
 
 
+def test_device_dispatch_bypass_positive(fixture_findings):
+    """ISSUE 14 satellite: direct device-dispatch calls in bls/ async
+    bodies that bypass the breaker supervisor seam are flagged — both
+    the attribute form and the bare-imported form."""
+    hits = _by_file(fixture_findings, "dispatch_bad.py")
+    msgs = [f.message for f in hits if f.rule == "node-hygiene"]
+    assert any(
+        "verify_each_device_wire()" in m
+        and "bypasses the breaker supervisor seam" in m
+        for m in msgs
+    ), msgs
+    assert any("load_or_export()" in m for m in msgs), msgs
+    assert len(msgs) == 2, msgs
+
+
+def test_device_dispatch_bypass_allowlist(fixture_findings):
+    """The supervisor module itself (and kernels/) may dispatch
+    directly; sync functions are out of scope everywhere."""
+    hits = [
+        f
+        for f in _by_file(fixture_findings, "supervisor.py")
+        if f.rule == "node-hygiene"
+    ]
+    assert not hits, [f.message for f in hits]
+
+
 def test_metric_hygiene_positive(fixture_findings):
     hits = _by_file(fixture_findings, "metrics_bad.py")
     msgs = [f.message for f in hits if f.rule == "metric-hygiene"]
