@@ -121,6 +121,20 @@ def _breaker_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _memory_snapshot() -> dict:
+    """Aggregate state-plane governor state (ISSUE 15) — budget,
+    ledger bytes, evictions by tier, pressure episodes.  A bench round
+    that ran under memory pressure measured the evict-and-regenerate
+    path, not the warm caches; this field makes that visible in the
+    record itself.  Lazy + failure-proof like the breaker snapshot."""
+    try:
+        from lodestar_tpu.chain.memory_governor import memory_snapshot
+
+        return memory_snapshot()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not fail a run
+        return {"error": str(e)[:200]}
+
+
 def _slo_snapshot() -> dict:
     """The lodestar_slo_* breach counters from the process-global
     registry (ISSUE 12) — zeros unless an SLO engine ran in-process,
@@ -202,6 +216,7 @@ def _emit_failure(
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
                 "breaker": _breaker_snapshot(),
+                "memory": _memory_snapshot(),
                 "flight_record": _bench_flight_record(stage, detail),
             }
         ),
@@ -457,11 +472,95 @@ def _probe_state_roots() -> None:
         record.setdefault("vs_baseline", None)
         record["phases"] = _phase_snapshot()
         record["slo"] = _slo_snapshot()
+        record["memory"] = _memory_snapshot()
         print(json.dumps(record), flush=True)
     except ValueError:
         _emit_failure(
             "state-roots-probe", "unparseable probe output",
             metric="state_roots_per_s", unit="roots/s",
+        )
+
+
+# regen_under_pressure_states_per_s probe (ISSUE 15): fork-churn regen
+# throughput at budgets {unbounded, 0.5x, 0.25x of the working set} —
+# the throughput floor the governor's evict-and-regenerate ladder
+# guarantees under memory pressure.  Pure-CPU subprocess like the HTR
+# probe (the chain stack imports jax; the parent must not init a
+# backend before the TPU probe), run BEFORE the backend probe so the
+# record lands even when the tunnel is dead.
+BENCH_REGEN_TIMEOUT_S = float(os.environ.get("BENCH_REGEN_TIMEOUT", "420"))
+BENCH_REGEN_KEYS = int(os.environ.get("BENCH_REGEN_KEYS", "16"))
+BENCH_REGEN_SLOTS = int(os.environ.get("BENCH_REGEN_SLOTS", "12"))
+BENCH_REGEN_TOUCHES = int(os.environ.get("BENCH_REGEN_TOUCHES", "24"))
+
+
+def _emit_regen_skip(stage: str, detail: str) -> None:
+    _emit_failure(
+        stage,
+        detail,
+        metric="regen_under_pressure_states_per_s",
+        unit="states/s",
+    )
+
+
+def _probe_regen_pressure() -> None:
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "dev",
+        "microbench_regen.py",
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--json",
+                "--keys",
+                str(BENCH_REGEN_KEYS),
+                "--slots",
+                str(BENCH_REGEN_SLOTS),
+                "--touches",
+                str(BENCH_REGEN_TOUCHES),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=BENCH_REGEN_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _phase_mark("regen_pressure_probe", time.monotonic() - t0, ok=False)
+        _emit_regen_skip(
+            "regen-pressure-probe",
+            f"exceeded {BENCH_REGEN_TIMEOUT_S:.0f}s",
+        )
+        return
+    _phase_mark(
+        "regen_pressure_probe",
+        time.monotonic() - t0,
+        ok=p.returncode == 0,
+        rc=p.returncode,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    if p.returncode != 0 or not lines:
+        detail = (
+            (p.stderr or p.stdout).strip().splitlines()[-1]
+            if (p.stderr or p.stdout).strip()
+            else f"probe exited rc={p.returncode}"
+        )
+        _emit_regen_skip("regen-pressure-probe", detail)
+        return
+    try:
+        record = json.loads(lines[-1])
+        record.setdefault("vs_baseline", None)
+        record["phases"] = _phase_snapshot()
+        record["slo"] = _slo_snapshot()
+        record["memory"] = _memory_snapshot()
+        print(json.dumps(record), flush=True)
+    except ValueError:
+        _emit_regen_skip(
+            "regen-pressure-probe", "unparseable probe output"
         )
 
 
@@ -480,6 +579,9 @@ if _BENCH_PLATFORM not in ("tpu", "cpu"):
 
 if __name__ == "__main__" and os.environ.get("BENCH_HTR", "1") != "0":
     _probe_state_roots()
+
+if __name__ == "__main__" and os.environ.get("BENCH_REGEN", "1") != "0":
+    _probe_regen_pressure()
 
 if __name__ == "__main__" and _BENCH_PLATFORM == "tpu":
     # The probe is SELF-bounded (subprocess timeouts x retries); the
@@ -610,6 +712,7 @@ def main_wire():
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
                 "breaker": _breaker_snapshot(),
+                "memory": _memory_snapshot(),
             }
         )
     )
@@ -670,6 +773,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
                     "breaker": _breaker_snapshot(),
+                    "memory": _memory_snapshot(),
                 }
             ),
             flush=True,
@@ -741,6 +845,7 @@ def _probe_rlc(verifier, jobs) -> None:
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
                     "breaker": _breaker_snapshot(),
+                    "memory": _memory_snapshot(),
                 }
             ),
             flush=True,
@@ -938,6 +1043,7 @@ def _probe_pipeline(verifier) -> None:
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
                     "breaker": _breaker_snapshot(),
+                    "memory": _memory_snapshot(),
                 }
             ),
             flush=True,
@@ -1057,6 +1163,7 @@ def _probe_effective_atts(verifier) -> None:
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
                     "breaker": _breaker_snapshot(),
+                    "memory": _memory_snapshot(),
                 }
             ),
             flush=True,
@@ -1242,6 +1349,7 @@ def _probe_breaker_recovery(verifier) -> None:
                     "phases": _phase_snapshot(),
                     "slo": _slo_snapshot(),
                     "breaker": breaker_field,
+                    "memory": _memory_snapshot(),
                 }
             ),
             flush=True,
@@ -1285,6 +1393,7 @@ def main_decoded():
                 "phases": _phase_snapshot(),
                 "slo": _slo_snapshot(),
                 "breaker": _breaker_snapshot(),
+                "memory": _memory_snapshot(),
             }
         )
     )

@@ -1,0 +1,164 @@
+"""Fork-churn regen throughput under byte budgets (ISSUE 15).
+
+Builds a stub-signature BeaconChain, churns forks to grow the regen
+LRU working set, then times state touches (cache hit / rehydrate /
+replay-from-db, whatever the budget forces) at budgets {unbounded,
+0.5x, 0.25x of the measured working set}.  The headline value is
+states/s at the TIGHTEST budget — the throughput floor the governor's
+evict-and-regenerate ladder guarantees under memory pressure; the
+per-budget table shows what each squeeze costs in evictions and where
+the ledger peaked.
+
+Pure CPU (numpy + hashlib state machinery; signatures stubbed).
+bench.py runs this in a subprocess with JAX_PLATFORMS=cpu — the
+regen_under_pressure_states_per_s record.
+
+    python dev/microbench_regen.py --json --keys 16 --slots 12 --touches 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class _StubBls:
+    def verify_signature_sets(self, sets):
+        return True
+
+    def close(self):
+        pass
+
+
+def build_world(n_keys: int):
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}, genesis_time=0
+    )
+    pks = [
+        C.g1_compress(B.sk_to_pk(B.keygen(b"regen-bench-%d" % i)))
+        for i in range(n_keys)
+    ]
+    genesis = create_genesis_state(cfg, pks, genesis_time=0)
+    chain = BeaconChain(
+        cfg,
+        genesis,
+        db=BeaconDb(None),
+        bls_verifier=_StubBls(),
+        state_budget_bytes=1 << 60,  # effectively unbounded to start
+    )
+    return chain
+
+
+def churn(chain, slots: int):
+    """Head block + side-fork block per slot (the memory-squeeze
+    scenario's working-set generator)."""
+    from lodestar_tpu.chain.produce_block import produce_block
+
+    prev_head = chain.head_root_hex
+    roots = []
+    for slot in range(1, slots + 1):
+        for parent, graffiti in (
+            (chain.head_root_hex, b"\x00" * 32),
+            (prev_head, b"\x42" * 32),
+        ):
+            parent_state = chain.regen._get_post_state(parent)
+            block, _post = produce_block(
+                parent_state,
+                slot,
+                hashlib.sha256(b"regen-bench %d" % slot).digest() * 3,
+                graffiti=graffiti,
+            )
+            root = chain.process_block(
+                {"message": block, "signature": b"\x00" * 96}
+            )
+            roots.append(root.hex())
+            if parent == prev_head:
+                break  # same parent twice in slot 1: one block only
+        prev_head = chain.head_root_hex
+    return roots
+
+
+def timed_touches(chain, roots, touches: int):
+    """Round-robin post-state touches; every root must regenerate (the
+    zero-lost-results contract) — a wrong root is a hard failure."""
+    gov = chain.memory_governor
+    ev0 = dict(gov.evictions)
+    peak = gov.ledger.resident_bytes
+    t0 = time.perf_counter()
+    for i in range(touches):
+        root_hex = roots[i % len(roots)]
+        st = chain.regen._get_post_state(root_hex)
+        if st.hash_tree_root().hex() != chain.regen.block_state_roots.get(
+            root_hex, st.hash_tree_root().hex()
+        ):
+            raise AssertionError(f"regen diverged for {root_hex[:12]}")
+        peak = max(peak, gov.ledger.resident_bytes)
+    dt = time.perf_counter() - t0
+    gov.reconcile()
+    return {
+        "states_per_s": round(touches / dt, 2) if dt > 0 else None,
+        "evictions": {
+            tier: gov.evictions[tier] - ev0[tier]
+            for tier in ("demote", "evict")
+        },
+        "peak_ledger_bytes": int(peak),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=12)
+    ap.add_argument("--touches", type=int, default=24)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    chain = build_world(args.keys)
+    gov = chain.memory_governor
+    roots = churn(chain, args.slots)
+    working_set = gov.ledger.resident_bytes
+
+    budgets = {}
+    for label, budget in (
+        ("unbounded", 1 << 60),
+        ("0.5x", max(1, working_set // 2)),
+        ("0.25x", max(1, working_set // 4)),
+    ):
+        gov.set_budget(budget)
+        budgets[label] = timed_touches(chain, roots, args.touches)
+
+    record = {
+        "metric": "regen_under_pressure_states_per_s",
+        # the headline is the THROUGHPUT FLOOR: states/s at 0.25x
+        "value": budgets["0.25x"]["states_per_s"],
+        "unit": "states/s",
+        "working_set_bytes": int(working_set),
+        "touches_per_budget": args.touches,
+        "budgets": budgets,
+        "pressure_events": gov._pressure_events,
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        for k, v in record.items():
+            print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

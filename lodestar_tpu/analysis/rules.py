@@ -867,6 +867,333 @@ class MetricHygieneRule(Rule):
 
 # ---------------------------------------------------------------------------
 
+# cache-hygiene: the packages whose long-lived objects hold per-peer /
+# per-block / per-root maps — exactly where an unpruned dict survives
+# for the process lifetime (the `block_state_roots` bug class)
+_CACHE_DIRS = {"chain", "network", "bls"}
+# empty-container constructors that start a growable cache
+_EMPTY_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict", "deque"}
+# growth methods (an attribute nobody grows is state, not a cache)
+_CACHE_GROW_METHODS = {
+    "append",
+    "appendleft",
+    "add",
+    "setdefault",
+    "extend",
+    "insert",
+    "update",
+}
+# shrink/eviction methods — any one of these reachable on the
+# attribute counts as a bound
+_CACHE_SHRINK_METHODS = {
+    "pop",
+    "popitem",
+    "popleft",
+    "clear",
+    "remove",
+    "discard",
+}
+
+
+class CacheHygieneRule(Rule):
+    """Module- or instance-level dict/OrderedDict/list/set caches in
+    chain/, network/, and bls/ that GROW (subscript-assign, append,
+    add, setdefault, ...) but have no reachable bound: no shrink call
+    (pop/popitem/clear/del/remove), no reassignment outside the
+    initializer, no ``max_*``/capacity constructor argument.  This is
+    the ``StateRegenerator.block_state_roots`` bug class — populated on
+    every import, pruned never — caught statically."""
+
+    name = "cache-hygiene"
+    severity = "warning"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            parts = set(mod.modname.split("."))
+            if not (parts & _CACHE_DIRS):
+                continue
+            if mod.modname.split(".")[-1].startswith("test_"):
+                continue
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(mod, node, out)
+            self._check_module_level(mod, out)
+        return out
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _is_empty_container(value: ast.AST) -> bool:
+        if isinstance(value, ast.Dict) and not value.keys:
+            return True
+        if isinstance(value, (ast.List, ast.Set)) and not getattr(
+            value, "elts", None
+        ):
+            return True
+        if isinstance(value, ast.Call):
+            fn = value.func
+            name = (
+                fn.id
+                if isinstance(fn, ast.Name)
+                else fn.attr
+                if isinstance(fn, ast.Attribute)
+                else None
+            )
+            return name in _EMPTY_CTORS
+        return False
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        """'X' for a `self.X` expression, else None."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    @staticmethod
+    def _getattr_self_name(node: ast.AST) -> Optional[str]:
+        """'X' for `getattr(self, "X", ...)`, else None."""
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == "self"
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            return node.args[1].value
+        return None
+
+    @staticmethod
+    def _has_bound_param(cls: ast.ClassDef) -> bool:
+        """A `max_*`/capacity/limit constructor argument signals a
+        count-bounded cache (StateContextCache.max_states style)."""
+        for item in cls.body:
+            if (
+                isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and item.name == "__init__"
+            ):
+                names = [
+                    a.arg
+                    for a in (
+                        item.args.args
+                        + item.args.kwonlyargs
+                        + item.args.posonlyargs
+                    )
+                ]
+                return any(
+                    n.startswith("max")
+                    or n.endswith(("capacity", "limit", "cap", "maxlen"))
+                    for n in names
+                )
+        return False
+
+    def _check_class(
+        self, mod: Module, cls: ast.ClassDef, out: List[Finding]
+    ) -> None:
+        if self._has_bound_param(cls):
+            return
+        inits: dict = {}  # attr -> the initializing Assign node
+        assigns: dict = {}  # attr -> assignment count
+        grown: Set[str] = set()
+        shrunk: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = self._self_attr(tgt)
+                    if attr is not None:
+                        assigns[attr] = assigns.get(attr, 0) + 1
+                        if (
+                            attr not in inits
+                            and self._is_empty_container(node.value)
+                        ):
+                            inits[attr] = node
+                    elif isinstance(tgt, ast.Subscript):
+                        sub = self._self_attr(tgt.value)
+                        if sub is not None:
+                            grown.add(sub)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                attr = self._self_attr(node.target)
+                if attr is not None:
+                    assigns[attr] = assigns.get(attr, 0) + 1
+                    if attr not in inits and self._is_empty_container(
+                        node.value
+                    ):
+                        inits[attr] = node
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        sub = self._self_attr(tgt.value)
+                        if sub is not None:
+                            shrunk.add(sub)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                sub = self._self_attr(node.func.value)
+                if sub is not None:
+                    if node.func.attr in _CACHE_SHRINK_METHODS:
+                        shrunk.add(sub)
+                    elif node.func.attr in _CACHE_GROW_METHODS:
+                        grown.add(sub)
+        # alias-aware pass: `seen = self.X` / `seen = getattr(self,
+        # "X", ...)` followed by `del seen[k]` / `seen.pop(...)` is a
+        # bound on X (chain/validation.py's blob-sidecar pruning shape)
+        for fn in (
+            n
+            for n in ast.walk(cls)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ):
+            aliases: dict = {}
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    attr = self._self_attr(node.value) or (
+                        self._getattr_self_name(node.value)
+                    )
+                    if attr is not None:
+                        aliases[node.targets[0].id] = attr
+            if not aliases:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        if (
+                            isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in aliases
+                        ):
+                            shrunk.add(aliases[tgt.value.id])
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in aliases
+                ):
+                    if node.func.attr in _CACHE_SHRINK_METHODS:
+                        shrunk.add(aliases[node.func.value.id])
+                    elif node.func.attr in _CACHE_GROW_METHODS:
+                        grown.add(aliases[node.func.value.id])
+        for attr, node in inits.items():
+            if attr not in grown:
+                continue  # never grows: state, not a cache
+            if attr in shrunk:
+                continue  # shrink call reachable: bounded
+            if assigns.get(attr, 0) > 1:
+                continue  # reassigned outside the init: rebuilt/reset
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"`self.{attr}` in `{cls.name}` grows without a "
+                    f"reachable bound (no pop/del/clear/prune, no "
+                    f"reassignment, no max_* ctor arg) — the "
+                    f"block_state_roots bug class: prune it or bound it",
+                )
+            )
+
+    @staticmethod
+    def _name_events(tree) -> tuple:
+        """(grown, shrunk, reassigned) name sets over one scope body —
+        subscript-assign/del plus the grow/shrink method calls."""
+        grown: Set[str] = set()
+        shrunk: Set[str] = set()
+        reassigned: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        reassigned.add(tgt.id)
+                    elif isinstance(tgt, ast.Subscript) and isinstance(
+                        tgt.value, ast.Name
+                    ):
+                        grown.add(tgt.value.id)
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and isinstance(
+                        tgt.value, ast.Name
+                    ):
+                        shrunk.add(tgt.value.id)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                base = node.func.value
+                if isinstance(base, ast.Name):
+                    if node.func.attr in _CACHE_SHRINK_METHODS:
+                        shrunk.add(base.id)
+                    elif node.func.attr in _CACHE_GROW_METHODS:
+                        grown.add(base.id)
+        return grown, shrunk, reassigned
+
+    def _check_module_level(self, mod: Module, out: List[Finding]) -> None:
+        inits: dict = {}
+        assigns: dict = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        assigns[tgt.id] = assigns.get(tgt.id, 0) + 1
+                        if tgt.id not in inits and self._is_empty_container(
+                            node.value
+                        ):
+                            inits[tgt.id] = node
+        # evidence scoping: a function-LOCAL name that happens to match
+        # a module global must contribute nothing (its .pop() does not
+        # bound the global, its `x = {}` does not make the global
+        # unbounded); `global`-declared names attribute to the module.
+        top = ast.Module(
+            body=[
+                n
+                for n in mod.tree.body
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            ],
+            type_ignores=[],
+        )
+        grown, shrunk, _reassigned = self._name_events(top)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared_global: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+            f_grown, f_shrunk, f_reassigned = self._name_events(fn)
+            # a bare-name rebind makes the name function-local UNLESS
+            # declared global (where it counts as a module rebuild)
+            local = f_reassigned - declared_global
+            grown |= f_grown - local
+            shrunk |= f_shrunk - local
+            for name in f_reassigned & declared_global:
+                assigns[name] = assigns.get(name, 0) + 1
+        for name, node in inits.items():
+            if name not in grown or name in shrunk:
+                continue
+            if assigns.get(name, 0) > 1:
+                continue
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"module-level `{name}` grows without a reachable "
+                    f"bound (no pop/del/clear, never rebuilt) — a "
+                    f"process-lifetime cache in {mod.modname}: prune it "
+                    f"or bound it",
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+
 ALL_RULES = [
     KernelPurityRule(),
     GatherHazardRule(),
@@ -874,6 +1201,7 @@ ALL_RULES = [
     DtypeDisciplineRule(),
     MetricHygieneRule(),
     NodeHygieneRule(),
+    CacheHygieneRule(),
 ]
 
 RULE_NAMES = frozenset(r.name for r in ALL_RULES) | {

@@ -86,6 +86,13 @@ class DefaultHandlers:
             breaker = self.bls_service.breaker_status()
             if breaker is not None:
                 data["breaker"] = breaker
+        gov = getattr(self.chain, "memory_governor", None)
+        if gov is not None:
+            # the state-plane residency governor (ISSUE 15): budget,
+            # ledger bytes, ladder level, episode state — `status`
+            # above already reads `degraded` while a pressure episode
+            # is open (SLO degraded source)
+            data["memory"] = gov.status()
         return 200, {"data": data}
 
     def get_version(self, params, body):

@@ -85,6 +85,8 @@ class BeaconChain:
         emitter: Optional[ChainEventEmitter] = None,
         proposer_cache=None,
         kzg_setup=None,
+        state_budget_bytes: Optional[int] = None,
+        registry=None,
     ):
         self.config = config
         self.log = get_logger("chain")
@@ -136,6 +138,9 @@ class BeaconChain:
             )
         )
         self.anchor_root_hex = anchor_root.hex()
+        # head BEFORE the regen wiring below: the anchor-state add can
+        # fire a governor eviction wave whose pin provider reads it
+        self.head_root_hex = self.anchor_root_hex
         self.fork_choice = ForkChoice(
             ProtoArray(
                 self.anchor_root_hex,
@@ -143,10 +148,42 @@ class BeaconChain:
             ),
             justified_root=self.anchor_root_hex,
         )
-        self.regen = StateRegenerator(self.fork_choice, db)
+        # state-plane memory governance (ISSUE 15): a byte-budgeted
+        # residency governor over the regen LRU + checkpoint cache.
+        # `state_budget_bytes` overrides the env (None = read
+        # LODESTAR_TPU_STATE_BUDGET; <= 0 = disabled, the pre-governor
+        # count-based LRU bounds apply unchanged).
+        from .memory_governor import StateMemoryGovernor, budget_from_env
+
+        if state_budget_bytes is None:
+            budget = budget_from_env()
+        else:
+            budget = state_budget_bytes if state_budget_bytes > 0 else None
+        self.memory_governor = None
+        if budget is not None:
+            self.memory_governor = StateMemoryGovernor(
+                budget, config=config, registry=registry
+            )
+        self.regen = StateRegenerator(
+            self.fork_choice, db, governor=self.memory_governor
+        )
+        # pinned checkpoint keys (epoch, blockRoot hex) the governor
+        # must keep resident: the CHAIN-WIDE justified + finalized
+        # checkpoints.  Updated only inside the monotonic FFG branches
+        # below — a side-fork import's post-state carries STALE
+        # checkpoints and must not replace the canonical pins.
+        self._pin_justified = (
+            int(anchor_state.current_justified_checkpoint["epoch"]),
+            bytes(anchor_state.current_justified_checkpoint["root"]).hex(),
+        )
+        self._pin_finalized = (
+            int(anchor_state.finalized_checkpoint["epoch"]),
+            bytes(anchor_state.finalized_checkpoint["root"]).hex(),
+        )
+        if self.memory_governor is not None:
+            self.memory_governor.pinned_fn = self._governor_pins
         self.regen.on_imported_block(anchor_root, anchor_state)
 
-        self.head_root_hex = self.anchor_root_hex
         self._finalized_epoch = int(
             anchor_state.finalized_checkpoint["epoch"]
         )
@@ -164,6 +201,41 @@ class BeaconChain:
     def _block_type(self, slot: int):
         """Fork-aware block container (reference: config.getForkTypes)."""
         return self.config.get_fork_types(slot)[0]
+
+    # -- memory-governor pin provider (ISSUE 15) ---------------------------
+
+    def _governor_pins(self):
+        """(pinned state roots, pinned-checkpoint predicate): the set
+        the StateMemoryGovernor must NEVER evict — the head state, the
+        anchor, the justified block's post-state, and the proto array's
+        root node (every regen walk terminates there, so pinning it
+        makes NO_ANCHOR_STATE structurally impossible).  Checkpoint
+        entries pin when they are the justified/finalized checkpoints
+        or sit on the head root (incl. the next-slot proposal state
+        prepare_next_slot precomputes).  Reads only dict lookups — no
+        regen, no hashing."""
+        regen = self.regen
+        roots = set()
+        for block_hex in (
+            self.head_root_hex,
+            self.anchor_root_hex,
+            self.fork_choice.justified_root,
+        ):
+            state_root = regen.block_state_roots.get(block_hex)
+            if state_root is not None:
+                roots.add(state_root)
+        proto = self.fork_choice.proto
+        if proto.nodes:
+            state_root = regen.block_state_roots.get(proto.nodes[0].root)
+            if state_root is not None:
+                roots.add(state_root)
+        head_hex = self.head_root_hex
+        pinned_cp = {self._pin_justified, self._pin_finalized}
+
+        def cp_pinned(epoch: int, root_hex: str) -> bool:
+            return root_hex == head_hex or (epoch, root_hex) in pinned_cp
+
+        return roots, cp_pinned
 
     # -- head --------------------------------------------------------------
 
@@ -427,6 +499,13 @@ class BeaconChain:
         # filter + justified root as the chain justifies (reference
         # forkChoice.updateCheckpoints)
         jep = int(post.current_justified_checkpoint["epoch"])
+        if jep > self._pin_justified[0]:
+            # the governor's checkpoint pin advances MONOTONICALLY with
+            # the chain-wide justification — never regressed by a
+            # side-fork import's stale post-state
+            self._pin_justified = (
+                jep, bytes(post.current_justified_checkpoint["root"]).hex()
+            )
         if jep > self.fork_choice.proto.justified_epoch:
             self.fork_choice.proto.justified_epoch = jep
             jroot = post.current_justified_checkpoint["root"].hex()
@@ -437,6 +516,10 @@ class BeaconChain:
                 dict(post.current_justified_checkpoint),
             )
         fin = int(post.finalized_checkpoint["epoch"])
+        if fin > self._pin_finalized[0]:
+            self._pin_finalized = (
+                fin, bytes(post.finalized_checkpoint["root"]).hex()
+            )
         if fin > self._finalized_epoch:
             self._finalized_epoch = fin
             self.fork_choice.proto.finalized_epoch = fin
@@ -456,6 +539,11 @@ class BeaconChain:
                 # drop pre-finalized proto nodes (reference maybePrune;
                 # no-op below the prune threshold)
                 removed = self.fork_choice.prune(froot)
+                # regen bookkeeping rides the same sweep: the pruned
+                # nodes' block->state-root entries (and their cached
+                # states) can never anchor a regen again — before this,
+                # block_state_roots grew for the process lifetime
+                self.regen.on_finalized(removed)
                 for node in removed:
                     self._execution_block_hash.pop(node.root, None)
                     self.optimistic_roots.discard(node.root)

@@ -21,7 +21,7 @@ class Clock:
     def __init__(self, genesis_time: float = 0.0):
         self.genesis_time = genesis_time
         self._now = genesis_time
-        self._slot_listeners: List[Callable[[int], None]] = []
+        self._slot_listeners: List[Callable[[int], None]] = []  # tpulint: disable=cache-hygiene -- composition-time listener registry: grows only during node init, bounded by subsystem count
         self._last_emitted_slot = -1
 
     def on_slot(self, fn: Callable[[int], None]) -> None:

@@ -35,6 +35,13 @@ def _block_header_value(block: dict) -> dict:
 
 
 class LightClientServer:
+    # In-memory retention window in sync periods (~27h each on
+    # mainnet): the db keeps EVERY period's best update; serving an
+    # older one falls back there, so a node running for months holds a
+    # bounded map instead of one entry per period forever
+    # (cache-hygiene — the block_state_roots bug class).
+    MAX_MEMORY_PERIODS = 32
+
     def __init__(self, chain, db=None):
         self.chain = chain
         self.log = get_logger("chain/lightclient")
@@ -65,6 +72,7 @@ class LightClientServer:
                 light_client_update_from_value(value)
             )
             n += 1
+        self._prune_memory()  # only the newest window stays resident
         if n:
             self.log.info("light-client best updates restored", periods=n)
 
@@ -165,6 +173,7 @@ class LightClientServer:
         ):
             self.best_update_by_period[period] = update
             self._persist(period, update)
+            self._prune_memory()
         self.latest_optimistic_update = update
         if finalized_header is not None:
             self.latest_finality_update = update
@@ -172,8 +181,32 @@ class LightClientServer:
 
     # -- serving (reference: lightClient/index.ts getUpdate/getBootstrap) --
 
+    def _prune_memory(self) -> None:
+        while len(self.best_update_by_period) > self.MAX_MEMORY_PERIODS:
+            del self.best_update_by_period[min(self.best_update_by_period)]
+
     def get_update(self, period: int) -> Optional[LightClientUpdate]:
-        return self.best_update_by_period.get(period)
+        upd = self.best_update_by_period.get(period)
+        if upd is not None:
+            return upd
+        # older than the memory window: the db kept it
+        if self.db is None or not hasattr(
+            self.db, "light_client_best_update"
+        ):
+            return None
+        raw = self.db.light_client_best_update.get(
+            int(period).to_bytes(8, "big")
+        )
+        if raw is None:
+            return None
+        from ..network.reqresp_protocols import (
+            LightClientUpdateType,
+            light_client_update_from_value,
+        )
+
+        return light_client_update_from_value(
+            LightClientUpdateType.deserialize(raw)
+        )
 
     def get_finality_update(self) -> Optional[LightClientUpdate]:
         return self.latest_finality_update

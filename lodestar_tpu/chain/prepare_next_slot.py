@@ -69,6 +69,7 @@ class PrepareNextSlotScheduler:
         self.log = get_logger("chain/prepare_next_slot")
         self.prepared_epochs = 0
         self.payloads_prepared = 0
+        self.precomputes_skipped = 0
         self._last_prepared_slot = -1
 
     def on_head(self, _head_root: bytes, block_slot: int) -> None:
@@ -89,6 +90,19 @@ class PrepareNextSlotScheduler:
         # records but never dedups here: a same-slot re-fire means the
         # head CHANGED (reorg) and the prep must re-run on the new head
         self._last_prepared_slot = max(self._last_prepared_slot, next_slot)
+        # degradation-ladder rung 2 (ISSUE 15): the precompute is
+        # ADVISORY latency work that adds a full state to the caches —
+        # under sustained memory pressure the governor says skip it
+        # (the epoch transition then runs on demand, which is slower
+        # but does not fight the eviction waves)
+        governor = getattr(self.chain, "memory_governor", None)
+        if governor is not None and governor.skip_precompute():
+            self.precomputes_skipped += 1
+            self.log.warn(
+                "next-slot precompute skipped (memory pressure)",
+                slot=next_slot,
+            )
+            return
         try:
             advanced = self._advanced_state(next_slot)
             self._prepare_payload(next_slot, advanced)

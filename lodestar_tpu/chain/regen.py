@@ -42,12 +42,21 @@ class StateRegenerator:
         db,
         state_cache: Optional[StateContextCache] = None,
         checkpoint_cache: Optional[CheckpointStateCache] = None,
+        governor=None,
     ):
         self.fork_choice = fork_choice
         self.db = db
-        self.state_cache = state_cache or StateContextCache()
-        self.checkpoint_cache = checkpoint_cache or CheckpointStateCache()
-        # blockRoot(hex) -> stateRoot(hex), maintained on import
+        self.governor = governor  # StateMemoryGovernor or None
+        self.state_cache = state_cache or StateContextCache(governor=governor)
+        self.checkpoint_cache = checkpoint_cache or CheckpointStateCache(
+            governor=governor
+        )
+        if governor is not None:
+            governor.attach(self.state_cache, self.checkpoint_cache)
+        # blockRoot(hex) -> stateRoot(hex), maintained on import and
+        # PRUNED at finalization (chain.py's finalization hook calls
+        # on_finalized with the proto nodes the fork-choice prune
+        # removed) — before PR 15 this map grew for the process lifetime
         self.block_state_roots: Dict[str, str] = {}
         self.log = get_logger("chain/regen")
         self.replayed_blocks = 0
@@ -71,11 +80,36 @@ class StateRegenerator:
 
     def engine_bytes(self) -> int:
         """Live incremental-merkleization plane bytes across the cached
-        states, COW-shared planes counted once (ROADMAP: first step to
-        bounding warm-engine memory)."""
+        states, COW-shared planes counted once — the full O(live-states)
+        WALK.  Kept as the governor ledger's reconciliation oracle;
+        hot-path consumers read resident_bytes() instead."""
         from ..state_transition.state_root import state_root_engine_bytes
 
         return state_root_engine_bytes(self.live_states())
+
+    def resident_bytes(self) -> int:
+        """Engine plane bytes for metrics sampling — the same quantity
+        engine_bytes() measures, read from the governor's incremental
+        ledger when one is attached (O(1) — the old per-head-update
+        walk re-counted every plane), else the walk.  Spill bytes are
+        reported separately by the governor's own gauges."""
+        if self.governor is not None:
+            return self.governor.ledger.plane_bytes
+        return self.engine_bytes()
+
+    def on_finalized(self, removed_nodes) -> int:
+        """Finalization sweep: forget block->state-root entries (and
+        their cached states) for the proto nodes the fork-choice prune
+        removed — they are at/below finalization or on dead side forks
+        and can never anchor a regen again."""
+        dropped = 0
+        for node in removed_nodes:
+            root = getattr(node, "root", node)
+            state_root = self.block_state_roots.pop(root, None)
+            if state_root is not None:
+                dropped += 1
+                self.state_cache.delete(state_root)
+        return dropped
 
     # -- public API (reference regen.ts) -----------------------------------
 
@@ -151,6 +185,19 @@ class StateRegenerator:
             raise RegenError(
                 "NO_ANCHOR_STATE",
                 f"no cached ancestor state for {block_root_hex}",
+            )
+        if self.governor is not None and self.governor.regen_rejected(
+            len(to_replay)
+        ):
+            # degradation-ladder rung 3: under sustained memory
+            # pressure a deep-fork replay would evict exactly the
+            # states it is about to recreate — refuse instead of
+            # thrashing (typed, so callers can distinguish from a
+            # missing anchor)
+            raise RegenError(
+                "MEMORY_PRESSURE",
+                f"replay depth {len(to_replay)} exceeds the pressure "
+                f"bound {self.governor.replay_depth_bound}",
             )
 
         state = base_state

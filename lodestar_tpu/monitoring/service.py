@@ -104,6 +104,23 @@ class MonitoringService:
                 beacon["slo_last_breach_slot"] = status["last_breach_slot"]
             except Exception:  # noqa: BLE001 - stats are best-effort
                 pass
+        gov = getattr(self.chain, "memory_governor", None)
+        if gov is not None:
+            # state-plane residency governance (ISSUE 15): remote
+            # collectors see the budget/ledger/ladder the health
+            # endpoint's `memory` block serves, reduced to scalars
+            try:
+                mem = gov.status()
+                beacon["state_memory"] = {
+                    "budget_bytes": mem["budget_bytes"],
+                    "resident_bytes": mem["resident_bytes"],
+                    "spill_bytes": mem["spill_bytes"],
+                    "pressure_active": mem["pressure_active"],
+                    "pressure_events": mem["pressure_events"],
+                    "evictions": mem["evictions"],
+                }
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                pass
         if self.beacon_metrics is not None:
             bm = self.beacon_metrics
             beacon["block_import_seconds_total"] = float(

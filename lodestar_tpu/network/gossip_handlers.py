@@ -62,7 +62,7 @@ class GossipHandlers:
         # optional SlasherService: every VERIFIED attestation/aggregate/
         # block is ingested post-validation (slasher/service.py)
         self.slasher = None
-        self.results: Dict[str, Dict[str, int]] = {}
+        self.results: Dict[str, Dict[str, int]] = {}  # tpulint: disable=cache-hygiene -- verdict tallies keyed (topic kind, verdict): both key spaces are enum-bounded, values are counters
         self._last_pruned_slot = 0
         # deneb blob verification needs a KZG trusted setup; without one
         # the blob topics are not served

@@ -156,6 +156,10 @@ class PeerManager:
         would just error)."""
         self.reqresp.disconnect(peer_id)
         self.peers.pop(peer_id, None)
+        # the score book forgets departed peers too (bans are retained
+        # inside forget) — otherwise it grows one record per peer ever
+        # seen under churn (cache-hygiene)
+        self.score_book.forget(peer_id)
 
     @property
     def connected_peers(self) -> List[str]:
@@ -226,6 +230,11 @@ class PeerManager:
     def heartbeat(self) -> dict:
         """One maintenance pass; returns what it did (observability)."""
         actions = {"banned": [], "dialed": 0, "pruned": []}
+        # score-book hygiene: records untouched for hours (incl. the
+        # bans forget() retains) decay to irrelevance and drop here —
+        # without this, one record per banned identity EVER seen
+        # survives the process lifetime (cache-hygiene)
+        self.score_book.prune_stale()
         # 1. drop banned/disconnect-scored peers
         for pid in list(self.peers):
             state = self.score_book.state(pid)

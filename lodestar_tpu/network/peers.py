@@ -116,6 +116,36 @@ class PeerScoreBook:
         hand out pre-decay scores."""
         return {pid: self.score(pid) for pid in list(self._peers)}
 
+    # forget() retains any score at or below this: a sub-ban offender
+    # must keep accumulating toward the ban across reconnects (wiping
+    # at disconnect would let a flooder reset by cycling connections);
+    # near-zero records — the churn bulk — are dropped.
+    FORGET_RETENTION_SCORE = -1.0
+
+    def forget(self, peer_id: str) -> None:
+        """Drop a departed peer's record (PeerManager.forget calls
+        this) — without it the book grows one record per peer EVER
+        seen, the block_state_roots bug class under peer churn.
+        NEGATIVE records are retained: penalties must survive a
+        disconnect/reconnect cycle or the ban threshold is unreachable
+        (they still time-decay, and prune_stale drops the long tail)."""
+        rec = self._peers.get(peer_id)
+        if rec is not None and self.score(peer_id) > (
+            self.FORGET_RETENTION_SCORE
+        ):
+            self._peers.pop(peer_id, None)
+
+    def prune_stale(self, max_age_s: float = 6 * 3600.0) -> None:
+        """Drop records untouched for `max_age_s` — decayed to ~zero
+        and long past ban relevance (periodic heartbeat hygiene)."""
+        now = self._clock()
+        for pid in [
+            p
+            for p, rec in list(self._peers.items())
+            if now - rec.last_update > max_age_s
+        ]:
+            self._peers.pop(pid, None)
+
     # -- status handshake (peerManager.ts assertPeerRelevance) -------------
 
     def on_status(self, peer_id: str, status: PeerStatus) -> None:

@@ -425,6 +425,29 @@ class GossipPeerScorer:
                 del self._invalid_counts[key]
             else:
                 self._invalid_counts[key] = v
+        # the POSITIVE components decay too (gossipsub P1/P2: delivery
+        # counters decay by their per-interval factor and zero out
+        # below decay_to_zero).  Before this, both maps grew one entry
+        # per (peer, topic)/peer EVER seen — the block_state_roots bug
+        # class under peer churn (cache-hygiene).
+        for key in list(self._first_deliveries):
+            tp = self.params.topics.get(key[1])
+            decay_factor = (
+                tp.first_message_deliveries_decay
+                if tp is not None and tp.first_message_deliveries_decay
+                else d
+            )
+            v = self._first_deliveries[key] * decay_factor
+            if v < floor:
+                del self._first_deliveries[key]
+            else:
+                self._first_deliveries[key] = v
+        for pid in list(self._positive):
+            v = self._positive[pid] * d
+            if v < floor:
+                del self._positive[pid]
+            else:
+                self._positive[pid] = v
 
     def on_invalid_message(self, peer_id: str, topic: str) -> float:
         key = (peer_id, topic)

@@ -305,6 +305,10 @@ class FullBeaconNode:
             monitor=self.monitor,
             proposer_cache=self.proposer_cache,
             kzg_setup=opts.kzg_setup,
+            # the state-plane memory governor's metrics land in THIS
+            # node's registry (default-on; LODESTAR_TPU_STATE_BUDGET=0
+            # disables)
+            registry=self.registry,
         )
         # MEV builder wiring (reference: chain.ts executionBuilder)
         builder = opts.builder
@@ -639,6 +643,27 @@ class FullBeaconNode:
                         "breaker", sup.status
                     )
 
+            # state-plane memory governance (ISSUE 15): an open
+            # pressure episode reports `degraded` NOW (live source,
+            # like the breaker), the first eviction wave of an episode
+            # leaves one rate-limited flight bundle, and the per-slot
+            # time-series carries the residency ledger
+            gov = self.chain.memory_governor
+            if gov is not None:
+                slo = self.slo
+                self.slo.add_degraded_source(
+                    "state_memory", lambda: gov.pressure_active
+                )
+                gov.on_pressure = lambda info: slo.anomaly(
+                    "state_memory_pressure", info
+                )
+                sampler.add_gauge(
+                    "state_resident_bytes",
+                    lambda: float(gov.ledger.resident_bytes),
+                )
+                if self.flight_recorder is not None:
+                    self.flight_recorder.add_provider("memory", gov.status)
+
         # sync drivers (sources injected per peer/transport); range
         # downloads carry the stall deadline + persistent peer-demotion
         # ledger (network/reqresp.py PeerDemotion)
@@ -741,6 +766,11 @@ class FullBeaconNode:
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
         self.clock.on_slot(self.prepare_scheduler.on_slot)
+        if self.chain.memory_governor is not None:
+            # episode close + gauge refresh + epoch-cadence ledger
+            # reconcile ride the slot tick (SLO-independent: the
+            # governor must close episodes even in minimal compositions)
+            self.clock.on_slot(self.chain.memory_governor.on_slot)
         if self.slasher is not None:
             # per-slot batch flush (earlier flushes trigger at max_batch)
             self.clock.on_slot(self.slasher.on_clock_slot)

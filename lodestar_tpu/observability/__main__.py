@@ -110,6 +110,15 @@ def _cmd_health(args) -> int:
                 f"{breaker['trips']} | degraded "
                 f"{breaker['time_in_degraded_s']:.1f}s"
             )
+        mem = data.get("memory")
+        if mem and mem.get("budget_bytes"):
+            print(
+                f"state memory: {mem['resident_bytes'] / 2**20:.1f} MiB"
+                f" / {mem['budget_bytes'] / 2**20:.1f} MiB budget | "
+                f"level {mem['pressure_level']} | episodes "
+                f"{mem['pressure_events']} | evictions "
+                f"{mem['evictions']['demote']}d/{mem['evictions']['evict']}e"
+            )
         fr = data.get("flight_recorder")
         if fr:
             print(

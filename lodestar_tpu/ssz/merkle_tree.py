@@ -101,6 +101,22 @@ class ChunkTree:
             total += lvl.nbytes
         return total
 
+    def planes(self) -> List[np.ndarray]:
+        """The live per-level node-plane arrays, leaf plane first.  The
+        residency ledger (chain/memory_governor.py) enumerates these by
+        id() for COW-aware byte accounting — the same identity space
+        plane_bytes() dedupes on."""
+        return list(self._levels)
+
+    def release(self) -> None:
+        """Free every node plane (tier-1 demotion).  The tree forgets
+        its leaves, so the next update()/apply() rebuilds cold — one
+        full merkleization, bit-identical roots (the same cold path a
+        fresh tree pays)."""
+        self.count = 0
+        self._levels = [np.zeros((0, 32), _U8) for _ in range(self.depth + 1)]
+        self._shared = False
+
     # -- geometry ----------------------------------------------------------
 
     def _rows_at(self, level: int) -> int:

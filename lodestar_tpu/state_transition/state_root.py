@@ -103,6 +103,9 @@ class _PackedCell:
     def plane_bytes(self, seen: set) -> int:
         return self.tree.plane_bytes(seen)
 
+    def planes(self):
+        return self.tree.planes()
+
 
 class _ValidatorsCell:
     """Per-validator container roots, batch-hashed for dirty rows only.
@@ -148,13 +151,29 @@ class _ValidatorsCell:
             self._shared = False
 
     def plane_bytes(self, seen: set) -> int:
-        """Tree node planes + the cached pubkey-root plane (it shares
-        COW across clones like the trees do)."""
+        """Tree node planes + the cached pubkey-root plane + the
+        per-validator diff columns (all COW-shared across clones until
+        the first owning mutation; the columns are a second full copy
+        of the registry's numeric columns, same magnitude as the state's
+        own — an owned engine that omitted them would under-count by
+        ~7x8n bytes).  The pubkeys/creds pointer lists stay uncounted:
+        their elements are shared bytes objects and the list copies are
+        pointer-sized."""
         total = self.tree.plane_bytes(seen)
-        if id(self.pk_roots) not in seen:
-            seen.add(id(self.pk_roots))
-            total += self.pk_roots.nbytes
+        for arr in self._aux_planes():
+            if id(arr) not in seen:
+                seen.add(id(arr))
+                total += arr.nbytes
         return total
+
+    def _aux_planes(self):
+        out = [self.pk_roots]
+        if self.cols is not None:
+            out.extend(self.cols.values())
+        return out
+
+    def planes(self):
+        return self.tree.planes() + self._aux_planes()
 
     @staticmethod
     def _list_mismatches(cached: List[bytes], current: List[bytes], m: int):
@@ -357,6 +376,28 @@ class StateRootEngine:
         for cell in self.cells.values():
             total += cell.plane_bytes(seen)
         return total
+
+    def iter_planes(self):
+        """Every live node-plane array this engine holds (the exact set
+        plane_bytes() walks, in the same id() identity space) — the
+        residency ledger's per-state enumeration.  O(fields x levels)
+        attribute reads, no hashing."""
+        yield from self.validators.planes()
+        for cell in self.cells.values():
+            yield from cell.planes()
+
+    def release_planes(self) -> int:
+        """Tier-1 demotion (chain/memory_governor.py): free every
+        ChunkTree node plane, the pubkey-root plane, the validators
+        diff columns, and the serialize memos.  Returns the plane bytes
+        freed.  The next hash_tree_root() through this engine rebuilds
+        cold — one full merkleization, bit-identical by the PR 3
+        incremental==full equivalence."""
+        freed = self.engine_bytes()
+        self.validators = _ValidatorsCell()
+        self.cells = {}
+        self.memo = {}
+        return freed
 
 
 def state_root_engine_bytes(states) -> int:

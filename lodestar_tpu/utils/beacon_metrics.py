@@ -83,7 +83,8 @@ class BeaconMetrics:
         # checkpoint cache; COW-shared planes counted once)
         self.state_root_engine_bytes = g(
             "lodestar_state_root_engine_bytes",
-            "Live ChunkTree plane bytes across cached states' engines",
+            "Live engine bytes (node planes + validator diff columns) "
+            "across cached states, COW counted once",
         )
         # peers (peer manager)
         self.peers_connected = g("libp2p_peers", "Connected peer count")
@@ -128,7 +129,13 @@ class BeaconMetrics:
                 self.op_pool_proposer_slashings.set(
                     chain.op_pool.num_proposer_slashings()
                 )
-                self.state_root_engine_bytes.set(chain.regen.engine_bytes())
+                # governor ledger when attached (O(1) incremental read);
+                # the full seen-set walk this used to pay per head
+                # update survives as the ledger's reconciliation oracle
+                # (regen.engine_bytes, tests/test_memory_governor.py)
+                self.state_root_engine_bytes.set(
+                    chain.regen.resident_bytes()
+                )
             except Exception:  # noqa: BLE001 — sampling is best-effort
                 pass
 

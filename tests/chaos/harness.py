@@ -521,12 +521,22 @@ def publish_block(
 
 
 def publish_attestations(
-    world, ref_chain, slot: int, quiet=(), aggregates: bool = True
+    world,
+    ref_chain,
+    slot: int,
+    quiet=(),
+    aggregates: bool = True,
+    individuals: bool = True,
 ) -> int:
     """Every committee member (minus `quiet`) attests over gossip; the
     first member aggregates (block production packs the aggregated
     pool, so justification needs this leg).  Publisher ids are the
-    OWNING node names, so bus partitions apply to validator traffic."""
+    OWNING node names, so bus partitions apply to validator traffic.
+    `individuals=False` publishes only the aggregates — the consensus-
+    relevant leg — which long soak scenarios use to keep N-epoch
+    real-crypto runs inside the slow-tier budget (each node otherwise
+    pays one pairing per member per slot for subnet copies that never
+    feed the pools)."""
     from lodestar_tpu import types as T
     from lodestar_tpu.crypto import bls as B
     from lodestar_tpu.crypto import curves as C
@@ -561,6 +571,8 @@ def publish_attestations(
                 v, data
             )
             member_sigs[pos] = sig
+            if not individuals:
+                continue
             att = {
                 "aggregation_bits": [p == pos for p in range(len(committee))],
                 "data": data,
@@ -661,6 +673,240 @@ class LedgerSource:
 def close_devnet(world) -> None:
     for n in world["nodes"].values():
         n.close()
+
+
+# ---------------------------------------------------------------------------
+# the state-plane world (memory-squeeze scenarios, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+class _StubBlsService:
+    """Always-true signature service: the squeeze scenarios stress the
+    STATE plane (regen, caches, the governor's ladder); with a service
+    injected the chain skips in-STF signature checks — the exact
+    contract regen replay already runs under."""
+
+    def verify_signature_sets(self, sets):
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class StateWorld:
+    """BeaconChain + StateMemoryGovernor + SLO engine + flight
+    recorder, wired the way node.py wires them (degraded source,
+    pressure anomaly -> rate-limited bundle, governor on the slot
+    tick) — the state-plane analog of FloodWorld.  Fork churn is
+    scripted: each slot imports a head block plus (optionally) a
+    competing side-fork block on the previous head, which keeps extra
+    branch states resident exactly like a real churn burst."""
+
+    GRAFFITI_FORK = b"\x42" * 32
+
+    def __init__(
+        self,
+        flightrec_dir,
+        seed: int = 0,
+        n_keys: int = 16,
+        budget_bytes=None,
+        db_path=None,
+    ):
+        from lodestar_tpu.chain.chain import BeaconChain
+        from lodestar_tpu.config import (
+            MAINNET_CHAIN_CONFIG,
+            create_chain_config,
+        )
+        from lodestar_tpu.crypto import bls as B
+        from lodestar_tpu.crypto import curves as C
+        from lodestar_tpu.db import BeaconDb
+        from lodestar_tpu.observability.timeseries import (
+            MetricsSampler,
+            TimeSeriesRing,
+        )
+        from lodestar_tpu.params import ForkName
+        from lodestar_tpu.state_transition import create_genesis_state
+        from lodestar_tpu.utils.metrics import Registry
+
+        self.seed = int(seed)
+        self.cfg = create_chain_config(
+            MAINNET_CHAIN_CONFIG,
+            fork_epochs={ForkName.altair: 0},
+            genesis_time=0,
+        )
+        # real pubkey points (genesis decompresses them for the sync
+        # committee); every SIGNATURE stays stubbed — the scenarios
+        # stress the state plane, not the pairing
+        pks = [
+            C.g1_compress(B.sk_to_pk(B.keygen(b"squeeze-%d" % i)))
+            for i in range(n_keys)
+        ]
+        genesis = create_genesis_state(self.cfg, pks, genesis_time=0)
+        self.registry = Registry()
+        self.db = BeaconDb(db_path)
+        self.chain = BeaconChain(
+            self.cfg,
+            genesis,
+            db=self.db,
+            bls_verifier=_StubBlsService(),
+            state_budget_bytes=budget_bytes,
+            registry=self.registry,
+        )
+        self.governor = self.chain.memory_governor
+        self.clock = Clock(genesis_time=0.0)
+        self.recorder = FlightRecorder(
+            str(flightrec_dir), registry=self.registry
+        )
+        ring = TimeSeriesRing()
+        sampler = MetricsSampler(ring)
+        if self.governor is not None:
+            self.recorder.add_provider("memory", self.governor.status)
+            sampler.add_gauge(
+                "state_resident_bytes",
+                lambda: float(self.governor.ledger.resident_bytes),
+            )
+        self.slo = SloEngine(
+            self.clock, registry=self.registry, recorder=self.recorder,
+            sampler=sampler,
+        )
+        # node.py's governor wiring, reproduced verbatim
+        if self.governor is not None:
+            gov = self.governor
+            self.slo.add_degraded_source(
+                "state_memory", lambda: gov.pressure_active
+            )
+            gov.on_pressure = lambda info: self.slo.anomaly(
+                "state_memory_pressure", info
+            )
+            self.clock.on_slot(gov.on_slot)
+        self.clock.on_slot(self.slo.on_slot)
+        self.chain.on_import_complete = self.slo.on_block_imported
+        self._slot = 0
+        self._prev_head = self.chain.head_root_hex
+        # block_root_hex -> expected post-state root hex: the
+        # never-evicted twin ledger every regen result checks against
+        self.expected_roots = {
+            self.chain.anchor_root_hex: genesis.hash_tree_root().hex()
+        }
+
+    # -- drivers -----------------------------------------------------------
+
+    def tick_slot(self) -> int:
+        from lodestar_tpu import params
+
+        self._slot += 1
+        self.clock.set_time(self._slot * params.SECONDS_PER_SLOT)
+        return self._slot
+
+    def _attestations_for(self, parent_root_hex: str):
+        """Full-participation attestations voting the parent block as
+        head (fake signatures — the stub service accepts, the STF skips
+        sig checks): enough FFG weight to justify and finalize, so the
+        scenarios can exercise the finalization sweeps."""
+        from lodestar_tpu import params as _p
+        from lodestar_tpu.state_transition.accessors import (
+            get_beacon_committee,
+            get_block_root_at_slot,
+            get_committee_count_per_slot,
+        )
+        from lodestar_tpu.state_transition.util import (
+            compute_epoch_at_slot,
+        )
+
+        post = self.chain.regen._get_post_state(parent_root_hex)
+        slot = int(post.slot)
+        if slot == 0:
+            return []
+        head_root = bytes.fromhex(parent_root_hex)
+        epoch = compute_epoch_at_slot(slot)
+        start = epoch * _p.ACTIVE_PRESET.SLOTS_PER_EPOCH
+        target_root = (
+            head_root
+            if start >= slot
+            else get_block_root_at_slot(post, start)
+        )
+        atts = []
+        for index in range(get_committee_count_per_slot(post, epoch)):
+            committee = get_beacon_committee(post, slot, index)
+            atts.append(
+                {
+                    "aggregation_bits": [True] * len(committee),
+                    "data": {
+                        "slot": slot,
+                        "index": index,
+                        "beacon_block_root": head_root,
+                        "source": dict(post.current_justified_checkpoint),
+                        "target": {"epoch": epoch, "root": target_root},
+                    },
+                    "signature": bytes([0xC0]) + b"\x00" * 95,
+                }
+            )
+        return atts
+
+    def _produce_on(
+        self, parent_root_hex: str, slot: int, graffiti, attest=False
+    ):
+        import hashlib as _hl
+
+        from lodestar_tpu.chain.produce_block import produce_block
+
+        parent_state = self.chain.regen._get_post_state(parent_root_hex)
+        randao = (
+            _hl.sha256(b"squeeze randao %d" % slot).digest() * 3
+        )
+        block, _post = produce_block(
+            parent_state,
+            slot,
+            randao,
+            graffiti=graffiti,
+            attestations=(
+                self._attestations_for(parent_root_hex) if attest else None
+            ),
+        )
+        return {"message": block, "signature": b"\x00" * 96}
+
+    def churn_slot(
+        self, slot: int, fork: bool = True, attest: bool = False
+    ) -> dict:
+        """Import one head block (+ one side-fork block on the previous
+        head when `fork`).  Returns deterministic import stats."""
+        prev_head = self.chain.head_root_hex
+        signed = self._produce_on(prev_head, slot, b"\x00" * 32, attest)
+        root = self.chain.process_block(signed)
+        self.expected_roots[root.hex()] = (
+            signed["message"]["state_root"].hex()
+        )
+        forked = False
+        if fork and self._prev_head != prev_head:
+            signed2 = self._produce_on(
+                self._prev_head, slot, self.GRAFFITI_FORK
+            )
+            root2 = self.chain.process_block(signed2)
+            self.expected_roots[root2.hex()] = (
+                signed2["message"]["state_root"].hex()
+            )
+            forked = True
+        self._prev_head = prev_head
+        return {"slot": slot, "forked": forked}
+
+    def warm_checkpoint(self, epoch: int) -> None:
+        """Populate the checkpoint cache on the head chain (the entry
+        attestation validation would create)."""
+        self.chain.regen.get_checkpoint_state(
+            {"epoch": epoch, "root": self.chain.get_head_root()}
+        )
+
+    def verify_regen(self, block_root_hex: str) -> bool:
+        """Regen the block's post-state (possibly rehydrating a spill
+        or replaying from db) and check it against the never-evicted
+        twin's recorded root — bit-identical or bust."""
+        st = self.chain.regen._get_post_state(block_root_hex)
+        return (
+            st.hash_tree_root().hex() == self.expected_roots[block_root_hex]
+        )
+
+    def close(self) -> None:
+        self.db.close()
 
 
 def heads(world) -> dict:

@@ -1,0 +1,137 @@
+"""Chaos scenario: mass-equivocation wave (ROADMAP scenario-diversity
+item).
+
+Dozens of proposers sign competing blocks inside a single epoch — the
+coordinated-slashing-event shape, far past the one-offender fork storm.
+Every node must convict every offender through the live gossip stack
+(duplicate-proposer verification -> slasher), the proposer slashings
+must land on chain (16-per-block cap forces multi-block inclusion)
+until every offender's state.slashed flips everywhere, the BLS breaker
+must never trip (equivocation is valid-signature traffic, not a device
+fault), and slasher memory must stay bounded by its configured window.
+
+Attestations are deliberately sparse here: the wave targets the
+proposer plane, and block-only traffic keeps the 3-node x 64-validator
+x real-crypto cost inside the slow tier.  Justification-under-storm is
+fork_storm's assertion; bounded conviction at scale is this one's.
+"""
+
+import pytest
+
+from lodestar_tpu.state_transition.accessors import (
+    get_beacon_proposer_index,
+)
+from lodestar_tpu.state_transition.slot import process_slots
+
+from chaos.harness import (
+    ScenarioTrace,
+    build_devnet,
+    close_devnet,
+    heads,
+    produce_signed_block,
+    publish_block,
+    set_clocks,
+)
+
+SEED = 2424
+N_KEYS = 64
+TARGET_OFFENDERS = 24  # two dozen equivocators in one epoch
+
+
+@pytest.mark.slow
+def test_mass_equivocation_wave_convicts_all_offenders():
+    from lodestar_tpu import params
+    from lodestar_tpu.bls.supervisor import breaker_snapshot
+    from lodestar_tpu.validator import ValidatorStore
+
+    trace = ScenarioTrace(SEED)
+    world = build_devnet(3, n_keys=N_KEYS)
+    names, nodes = world["names"], world["nodes"]
+    ref = nodes[names[0]].chain
+    cfg = world["cfg"]
+    P = params.ACTIVE_PRESET
+
+    offenders = set()
+    try:
+        # the wave: through epoch 0 every not-yet-caught proposer
+        # double-signs until two dozen distinct offenders exist; the
+        # chain keeps marching while slashings accumulate on it
+        total_slots = P.SLOTS_PER_EPOCH + 16  # wave epoch + inclusion tail
+        for slot in range(1, total_slots + 1):
+            set_clocks(world, slot)
+            st = ref.head_state.clone()
+            if st.slot < slot:
+                process_slots(st, slot)
+            proposer = int(get_beacon_proposer_index(st))
+            if bool(st.slashed[proposer]):
+                continue  # a slashed proposer cannot produce: skip slot
+            signed, _ = produce_signed_block(world, ref, slot)
+            competing = None
+            in_wave = slot <= P.SLOTS_PER_EPOCH
+            if (
+                in_wave
+                and len(offenders) < TARGET_OFFENDERS
+                and proposer not in offenders
+            ):
+                rogue = ValidatorStore(
+                    cfg, {proposer: world["sks"][proposer]}
+                )
+                block2 = ref.produce_block(
+                    slot,
+                    rogue.sign_randao(proposer, slot),
+                    graffiti=b"\x66" * 32,
+                )
+                competing = {
+                    "message": block2,
+                    "signature": rogue.sign_block(proposer, block2),
+                }
+                offenders.add(proposer)
+            assert publish_block(world, signed, slot) == 3
+            if competing is not None:
+                publish_block(
+                    world, competing, slot, from_node="rogue", ledger=False
+                )
+            # per-slot convergence holds through the whole wave
+            assert len(set(heads(world).values())) == 1, slot
+        trace.emit(
+            "wave",
+            offenders=len(offenders),
+            converged=True,
+        )
+        # dozens, not a handful — a thin epoch would gut the scenario
+        assert len(offenders) >= 12, len(offenders)
+
+        for name, node in nodes.items():
+            # every node convicted EVERY offender
+            st = node.slasher.status()
+            assert st["detections"]["double_propose"] >= len(offenders), (
+                name,
+                st["detections"],
+            )
+            head = node.chain.head_state
+            for v in sorted(offenders):
+                assert bool(head.slashed[v]), (name, v)
+            # bounded slasher memory: the records and queue stay inside
+            # the configured window — a 24-offender wave must not grow
+            # state past what one epoch of traffic implies
+            assert st["queue_length"] == 0, (name, st["queue_length"])
+            assert st["proposer_records"] <= 4 * total_slots, (
+                name,
+                st["proposer_records"],
+            )
+            assert st["span_history_length"] == 4096, name
+        # the breaker never tripped: equivocation is consensus traffic,
+        # not a device fault
+        breaker = breaker_snapshot()
+        assert breaker["trips"] == 0, breaker
+        for name, node in nodes.items():
+            assert not any(
+                node.slo.status()["degraded_sources"].values()
+            ), name
+        trace.emit(
+            "convicted",
+            all_slashed=True,
+            breaker_trips=int(breaker["trips"]),
+        )
+    finally:
+        close_devnet(world)

@@ -1,0 +1,35 @@
+"""cache-hygiene positives: unbounded caches in a chain/ module."""
+
+from collections import OrderedDict
+
+# module-level cache grown in a function, never shrunk or rebuilt
+_SEEN_ROOTS = {}
+
+
+def remember(root, value):
+    _SEEN_ROOTS[root] = value
+
+
+def unrelated_local():
+    # a LOCAL dict named like the global, pruned: must NOT bound the
+    # module-level _SEEN_ROOTS above (scoping regression case)
+    _SEEN_ROOTS = {"x": 1}
+    _SEEN_ROOTS.pop("x")
+    return _SEEN_ROOTS
+
+
+class BlockIndex:
+    """The block_state_roots shape: populated per import, pruned never."""
+
+    def __init__(self):
+        self.block_map = {}  # grows in on_block, no bound anywhere
+        self.recent = []  # appended forever
+        self.ordered = OrderedDict()  # setdefault-grown, never popped
+
+    def on_block(self, root, state_root):
+        self.block_map[root] = state_root
+        self.recent.append(root)
+        self.ordered.setdefault(root, state_root)
+
+    def lookup(self, root):
+        return self.block_map.get(root)

@@ -1,0 +1,83 @@
+"""cache-hygiene negatives: every growth here has a reachable bound."""
+
+# module-level: grown AND pruned
+_WINDOW = {}
+
+
+def record(slot, value):
+    _WINDOW[slot] = value
+    for old in [s for s in _WINDOW if s < slot - 8]:
+        del _WINDOW[old]
+
+
+# module-level: grown, rebuilt via a `global` reassignment
+_RESETTABLE = {}
+
+
+def fill(key, value):
+    _RESETTABLE[key] = value
+
+
+def reset():
+    global _RESETTABLE
+    _RESETTABLE = {}
+
+
+class BoundedLru:
+    """Count-bounded via a max_* constructor argument."""
+
+    def __init__(self, max_entries=64):
+        self.max_entries = max_entries
+        self.entries = {}
+
+    def add(self, key, value):
+        self.entries[key] = value
+
+
+class PrunedMap:
+    """Shrink methods reachable on the attribute itself."""
+
+    def __init__(self):
+        self.seen = {}
+        self.queue = []
+
+    def add(self, key):
+        self.seen[key] = True
+        self.queue.append(key)
+
+    def prune(self, horizon):
+        for key in [k for k in self.seen if k < horizon]:
+            self.seen.pop(key)
+        self.queue.clear()
+
+
+class AliasPruned:
+    """Pruned through a local alias (the chain/validation.py shape)."""
+
+    def __init__(self):
+        self.lazy_seen = {}
+
+    def add(self, key, slot):
+        self.lazy_seen[key] = slot
+
+    def prune(self, horizon):
+        seen = getattr(self, "lazy_seen", None)
+        if seen:
+            for key in [k for k, s in seen.items() if s < horizon]:
+                del seen[key]
+
+
+class Rebuilt:
+    """Reassigned outside the initializer: rebuilt, not unbounded."""
+
+    def __init__(self):
+        self.pending = []
+        self.plain_state = {}  # never grown: state, not a cache
+
+    def add(self, item):
+        self.pending.append(item)
+
+    def drain(self):
+        out = self.pending
+        self.pending = []
+        return out

@@ -228,6 +228,31 @@ def test_effective_atts_metric_direction_registered(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_regen_pressure_metric_direction_registered(tmp_path, capsys):
+    """ISSUE 15 satellite: `regen_under_pressure_states_per_s` is a
+    throughput floor — a drop beyond threshold exits 1 even when the
+    archived cells lost their unit (the registry pins states/s)."""
+    m = "regen_under_pressure_states_per_s"
+    assert bench_compare._METRIC_UNITS[m] == "states/s"
+    drop = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 20.0}]),  # no unit
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 8.0}]),
+    ]
+    assert bench_compare.main(drop + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+    rise = [
+        _round(tmp_path / "BENCH_r03.json",
+               tail_records=[{"metric": m, "value": 8.0,
+                              "unit": "states/s"}]),
+        _round(tmp_path / "BENCH_r04.json",
+               tail_records=[{"metric": m, "value": 20.0}]),
+    ]
+    assert bench_compare.main(rise + ["--threshold", "0.05"]) == 0
+    capsys.readouterr()
+
+
 def test_unitless_time_metric_direction_resolved_by_registry(tmp_path, capsys):
     """A unit-less bls_rlc_bisect_seconds GROWTH still gates (the
     registry knows it is lower-is-better)."""

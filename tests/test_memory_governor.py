@@ -1,0 +1,663 @@
+"""StateMemoryGovernor — ledger reconciliation, the demotion ladder,
+pins, and the degradation rungs (ISSUE 15).
+
+The ledger's incremental COW-aware accounting is checked against the
+ground-truth walk (`state_root_engine_bytes` over the live cache
+states) after every operation of randomized add/evict/clone/demote/
+touch interleavings — the oracle the old per-head-update metric paid on
+every sample.  The ladder property: ANY interleaving of touch/demote/
+spill/evict/regen yields `hash_tree_root` bit-identical to the
+never-evicted twin, and pinned states survive an adversarial budget of
+approximately zero.
+"""
+
+import hashlib
+import itertools
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.memory_governor import (
+    DEFAULT_BUDGET_BYTES,
+    SpilledState,
+    StateMemoryGovernor,
+    budget_from_env,
+    memory_snapshot,
+)
+from lodestar_tpu.chain.regen import RegenError, StateRegenerator
+from lodestar_tpu.chain.state_cache import (
+    CheckpointStateCache,
+    StateContextCache,
+)
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.state_transition import create_genesis_state
+from lodestar_tpu.state_transition.state_root import (
+    state_root_engine_bytes,
+)
+from lodestar_tpu.utils.metrics import Registry
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+
+
+@pytest.fixture(scope="module")
+def genesis(cfg):
+    pks = [
+        C.g1_compress(B.sk_to_pk(B.keygen(b"gov-%d" % i)))
+        for i in range(N_KEYS)
+    ]
+    st = create_genesis_state(cfg, pks, genesis_time=3)
+    st.hash_tree_root()  # warm the engine
+    return st
+
+
+def _governed(cfg, budget):
+    gov = StateMemoryGovernor(budget, config=cfg, registry=Registry())
+    sc = StateContextCache(governor=gov)
+    cc = CheckpointStateCache(governor=gov)
+    gov.attach(sc, cc)
+    return gov, sc, cc
+
+
+def _walk(sc, cc) -> int:
+    return state_root_engine_bytes(
+        itertools.chain(sc.states(), cc.states())
+    )
+
+
+def _mutated(rng, parent, salt: int):
+    st = parent.clone()
+    st.balances[int(rng.integers(0, st.num_validators))] += np.uint64(
+        1 + salt
+    )
+    st.slot = int(st.slot) + 1
+    return st, st.hash_tree_root().hex()
+
+
+def _run_interleaving(cfg, genesis, seed, ops, budget):
+    """Drive `ops` random ledger operations; after EVERY op the
+    incremental ledger must equal the walk, and at the end every
+    cache-visible state must hash to its recorded twin root."""
+    rng = np.random.default_rng(seed)
+    gov, sc, cc = _governed(cfg, budget)
+    twins = {}  # root hex -> the never-evicted state object
+    # (hash_tree_root also re-warms the shared fixture's engine if an
+    # earlier test's demotion released its planes)
+    g_root = genesis.hash_tree_root().hex()
+    twins[g_root] = genesis
+    sc.add_with_root(g_root, genesis)
+    evicted = []
+    for i in range(ops):
+        roots = sorted(twins)
+        op = rng.integers(0, 6)
+        if op == 0 or len(sc) == 0:  # add a mutated child
+            parent = twins[roots[int(rng.integers(0, len(roots)))]]
+            st, rhex = _mutated(rng, parent, i)
+            twins[rhex] = st
+            sc.add_with_root(rhex, st)
+        elif op == 1:  # touch (rehydrates a spill)
+            sc.get(roots[int(rng.integers(0, len(roots)))])
+        elif op == 2:  # demote (forced tier 1)
+            gov.demote_state(roots[int(rng.integers(0, len(roots)))])
+        elif op == 3:  # evict
+            victim = roots[int(rng.integers(0, len(roots)))]
+            if victim in sc._map:
+                sc.delete(victim)
+                evicted.append(victim)
+        elif op == 4:  # regen: an evicted root replays back in
+            if evicted:
+                back = evicted.pop()
+                sc.add_with_root(back, twins[back])
+        else:  # checkpoint add (same object, second cache)
+            rhex = roots[int(rng.integers(0, len(roots)))]
+            cc.add(
+                {"epoch": int(i % 4), "root": bytes.fromhex(rhex)},
+                twins[rhex],
+            )
+        assert gov.ledger.plane_bytes == _walk(sc, cc), (i, op)
+    # the ladder property: everything still visible hashes bit-identical
+    for rhex in list(sc._map):
+        got = sc.get(rhex)
+        assert got.hash_tree_root().hex() == rhex
+    for (epoch, rhex) in list(cc._map):
+        got = cc.get({"epoch": epoch, "root": bytes.fromhex(rhex)})
+        assert got.hash_tree_root().hex() == rhex
+    # the hash sweep above built engines IN PLACE on rehydrated cache
+    # objects (planes their snapshots predate) — the per-tick reconcile
+    # is the documented healer for exactly that drift class
+    gov.reconcile()
+    assert gov.ledger.plane_bytes == _walk(sc, cc)
+    return gov
+
+
+def test_ledger_matches_walk_randomized(cfg, genesis):
+    _run_interleaving(cfg, genesis, seed=7, ops=40, budget=1 << 40)
+
+
+def test_ladder_property_under_tight_budget(cfg, genesis):
+    """Same interleaving with the budget squeezing the whole time:
+    auto-demote/evict interleave with the scripted ops and roots stay
+    bit-identical."""
+    genesis.hash_tree_root()  # re-warm: an earlier demotion may have
+    # released the shared fixture's planes (the external-holder design)
+    gov = _run_interleaving(
+        cfg, genesis, seed=11, ops=40,
+        budget=genesis._root_engine.engine_bytes() // 2,
+    )
+    assert sum(gov.evictions.values()) > 0
+
+
+@pytest.mark.slow
+def test_ledger_matches_walk_randomized_long(cfg, genesis):
+    for seed in (1, 2, 3):
+        _run_interleaving(cfg, genesis, seed=seed, ops=200, budget=1 << 40)
+
+
+def test_cow_shared_planes_counted_once(cfg, genesis):
+    genesis.hash_tree_root()  # re-warm the shared fixture
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    g_root = genesis.hash_tree_root().hex()
+    sc.add_with_root(g_root, genesis)
+    solo = gov.ledger.plane_bytes
+    # a clone shares every plane COW: adding it must cost ~nothing
+    clone = genesis.clone()
+    clone.hash_tree_root()
+    sc.add_with_root("ff" * 32, clone)
+    assert gov.ledger.plane_bytes < solo * 1.05
+    assert gov.ledger.plane_bytes == _walk(sc, cc)
+
+
+def test_pinned_states_survive_adversarial_budget(cfg, genesis):
+    genesis.hash_tree_root()  # re-warm the shared fixture
+    rng = np.random.default_rng(3)
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    g_root = genesis.hash_tree_root().hex()
+    sc.add_with_root(g_root, genesis)
+    others = []
+    for i in range(5):
+        st, rhex = _mutated(rng, genesis, i)
+        sc.add_with_root(rhex, st)
+        others.append(rhex)
+    gov.pinned_fn = lambda: ({g_root}, lambda _e, _r: False)
+    gov.set_budget(1)  # ~zero: everything unpinned must go
+    # the pinned state is still LIVE (never spilled, never evicted)
+    assert isinstance(sc._map[g_root], type(genesis))
+    assert sc.get(g_root) is genesis
+    for rhex in others:
+        assert rhex not in sc._map
+    assert gov.ledger.plane_bytes == _walk(sc, cc)
+    # and it still hashes correctly
+    assert sc.get(g_root).hash_tree_root().hex() == g_root
+
+
+def test_degradation_rungs_escalate_and_restore(cfg, genesis):
+    rng = np.random.default_rng(5)
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    base_epochs = cc.max_epochs
+    g_root = genesis.hash_tree_root().hex()
+    sc.add_with_root(g_root, genesis)
+    # pin EVERYTHING: eviction can never converge -> strain climbs
+    gov.pinned_fn = lambda: (set(sc._map.keys()), lambda _e, _r: True)
+    gov.set_budget(1)
+    assert gov.pressure_active
+    assert gov.pressure_level == 1
+    assert cc.max_epochs == max(2, base_epochs // 2)  # rung 1
+    assert not gov.skip_precompute()
+    st, rhex = _mutated(rng, genesis, 0)
+    sc.add_with_root(rhex, st)  # wave 2
+    assert gov.skip_precompute()  # rung 2
+    assert not gov.regen_rejected(10**6)
+    st2, rhex2 = _mutated(rng, st, 1)
+    sc.add_with_root(rhex2, st2)  # wave 3
+    assert gov.pressure_level == 3
+    assert gov.regen_rejected(gov.replay_depth_bound + 1)  # rung 3
+    assert not gov.regen_rejected(gov.replay_depth_bound)
+    # relief: a big budget resets strain; a quiet compliant tick closes
+    # the episode and restores the checkpoint window
+    gov.set_budget(1 << 40)
+    gov.on_slot(1)
+    assert not gov.pressure_active
+    assert gov.pressure_level == 0
+    assert cc.max_epochs == base_epochs
+    # exactly one pressure episode was counted
+    assert gov._pressure_events == 1
+
+
+def test_pressure_callback_fires_once_per_episode(cfg, genesis):
+    events = []
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    gov.on_pressure = events.append
+    rng = np.random.default_rng(9)
+    g_root = genesis.hash_tree_root().hex()
+    sc.add_with_root(g_root, genesis)
+    gov.set_budget(gov.ledger.plane_bytes // 2)
+    for i in range(4):  # more waves inside the same episode
+        st, rhex = _mutated(rng, genesis, i)
+        sc.add_with_root(rhex, st)
+    assert len(events) == 1
+    assert events[0]["budget_bytes"] == gov.budget
+    # close the episode, squeeze again -> a SECOND episode, one event.
+    # Two ticks: the first absorbs the wave's eviction count (a tick
+    # right after evictions is not "quiet"), the second closes.
+    gov.set_budget(1 << 40)
+    gov.on_slot(1)
+    gov.on_slot(2)
+    assert not gov.pressure_active
+    # repopulate (the first squeeze drained everything unpinned — and
+    # demotion RELEASED the shared object's planes, so re-warm the
+    # engine first), then squeeze again -> a second episode, one event
+    genesis.hash_tree_root()
+    sc.add_with_root(g_root, genesis)
+    gov.set_budget(1)
+    assert len(events) == 2
+
+
+def test_regen_rejects_with_typed_memory_pressure_error(cfg, genesis):
+    """Rung 3 end-to-end through StateRegenerator: a deep replay under
+    sustained pressure raises RegenError("MEMORY_PRESSURE") — typed, so
+    callers can tell it from a missing anchor."""
+    from lodestar_tpu.chain.produce_block import produce_block
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.fork_choice import ForkChoice, ProtoArray
+
+    g_root = T.BeaconBlockHeader.hash_tree_root(
+        dict(
+            genesis.latest_block_header,
+            state_root=genesis.hash_tree_root(),
+        )
+    ).hex()
+    fork_choice = ForkChoice(
+        ProtoArray(finalized_root=g_root), justified_root=g_root
+    )
+    db = BeaconDb(None)
+    gov = StateMemoryGovernor(1 << 40, config=cfg, registry=Registry())
+    regen = StateRegenerator(fork_choice, db, governor=gov)
+    regen.block_state_roots[g_root] = genesis.hash_tree_root().hex()
+    regen.state_cache.add_with_root(genesis.hash_tree_root().hex(), genesis)
+
+    state = genesis
+    roots = [g_root]
+    for slot in range(1, 5):
+        block, post = produce_block(
+            state, slot, hashlib.sha256(b"mp%d" % slot).digest() * 3
+        )
+        root = T.BeaconBlockAltair.hash_tree_root(block)
+        fork_choice.on_block(slot, root.hex(), block["parent_root"].hex())
+        db.put_block(root, {"message": block, "signature": b"\x00" * 96})
+        regen.on_imported_block(root, post)
+        state = post
+        roots.append(root.hex())
+    # evict the whole tail so a regen of the tip must replay 4 blocks
+    for rhex in roots[1:]:
+        regen.state_cache.delete(regen.block_state_roots[rhex])
+    gov._strain = 3  # sustained pressure
+    gov.replay_depth_bound = 2
+    with pytest.raises(RegenError) as err:
+        regen.get_block_slot_state(roots[-1], 4)
+    assert err.value.code == "MEMORY_PRESSURE"
+    # relief lifts the rejection and the replay works, bit-identical
+    gov._strain = 0
+    st = regen.get_block_slot_state(roots[-1], 4)
+    assert st.hash_tree_root().hex() == regen.block_state_roots[roots[-1]]
+
+
+def test_regen_on_finalized_prunes_block_state_roots(cfg, genesis):
+    """Unit leg of the unbounded-growth fix: on_finalized forgets the
+    pruned nodes' entries and their cached states."""
+    from lodestar_tpu.fork_choice import ForkChoice, ProtoArray
+
+    class Node:
+        def __init__(self, root):
+            self.root = root
+
+    g_root = "aa" * 32
+    fork_choice = ForkChoice(
+        ProtoArray(finalized_root=g_root), justified_root=g_root
+    )
+    regen = StateRegenerator(fork_choice, None)
+    regen.block_state_roots[g_root] = genesis.hash_tree_root().hex()
+    regen.state_cache.add_with_root(genesis.hash_tree_root().hex(), genesis)
+    dead = []
+    for i in range(6):
+        st = genesis.clone()
+        st.slot = i + 1
+        rhex = st.hash_tree_root().hex()
+        block_hex = bytes([i + 1]).hex() * 32
+        regen.block_state_roots[block_hex] = rhex
+        regen.state_cache.add_with_root(rhex, st)
+        dead.append(Node(block_hex))
+    before = len(regen.block_state_roots)
+    assert regen.on_finalized(dead) == 6
+    assert len(regen.block_state_roots) == before - 6
+    assert g_root in regen.block_state_roots
+    assert len(regen.state_cache) == 1  # only genesis remains
+
+
+def test_budget_env_parsing(monkeypatch):
+    monkeypatch.delenv("LODESTAR_TPU_STATE_BUDGET", raising=False)
+    assert budget_from_env() == DEFAULT_BUDGET_BYTES
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "0")
+    assert budget_from_env() is None  # the escape hatch
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "1234")
+    assert budget_from_env() == 1234
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "512m")
+    assert budget_from_env() == 512 << 20
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "2g")
+    assert budget_from_env() == 2 << 30
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "64k")
+    assert budget_from_env() == 64 << 10
+    monkeypatch.setenv("LODESTAR_TPU_STATE_BUDGET", "garbage")
+    assert budget_from_env() == DEFAULT_BUDGET_BYTES  # fail safe
+
+
+def test_memory_snapshot_aggregates(cfg, genesis):
+    genesis.hash_tree_root()  # re-warm the shared fixture
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    sc.add_with_root(genesis.hash_tree_root().hex(), genesis)
+    snap = memory_snapshot()
+    assert snap["governors"] >= 1
+    assert snap["resident_bytes"] >= gov.ledger.resident_bytes > 0
+    assert set(snap["evictions"]) == {"demote", "evict"}
+
+
+def test_release_planes_rebuilds_bit_identical(genesis):
+    """The tier-1 spill primitive: release_planes frees every node
+    plane (engine_bytes -> 0) and the next hash rebuilds cold to the
+    SAME root; ChunkTree.release behaves identically at tree level."""
+    st = genesis.clone()
+    st.balances[0] += np.uint64(7)
+    root = st.hash_tree_root()
+    engine = st._root_engine
+    assert engine.engine_bytes() > 0
+    freed = engine.release_planes()
+    assert freed > 0
+    assert engine.engine_bytes() == 0
+    assert st.hash_tree_root() == root  # cold rebuild, bit-identical
+    # tree-level twin
+    from lodestar_tpu.ssz import ChunkTree
+
+    plane = np.arange(4 * 32, dtype=np.uint8).reshape(4, 32)
+    tree = ChunkTree(8)
+    tree.update(plane)
+    r = tree.root
+    tree.release()
+    assert tree.plane_bytes() == 0 and tree.count == 0
+    tree.update(plane)
+    assert tree.root == r
+
+
+def test_demote_releases_unshared_planes(cfg, genesis):
+    """_try_demote actively releases the outgoing engine's planes when
+    no other ledger entry shares them — a lingering external reference
+    to the demoted object must not pin the node planes."""
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    st = genesis.clone()
+    st.balances[1] += np.uint64(3)
+    rhex = st.hash_tree_root().hex()
+    sc.add_with_root(rhex, st)
+    held = st  # an external holder surviving the demotion
+    assert gov.demote_state(rhex)
+    assert held._root_engine.engine_bytes() == 0  # planes freed NOW
+    # and the held object still hashes correctly (cold rebuild)
+    assert held.hash_tree_root().hex() == rhex
+    # the cache side rehydrates bit-identical too
+    assert sc.get(rhex).hash_tree_root().hex() == rhex
+
+
+def test_rehydration_enforces_budget(cfg, genesis):
+    """A read burst over spilled entries re-books ledger bytes — the
+    budget must bind at rehydration time, not only at add/tick."""
+    rng = np.random.default_rng(21)
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    roots = []
+    for i in range(4):
+        st, rhex = _mutated(rng, genesis, i)
+        sc.add_with_root(rhex, st)
+        roots.append(rhex)
+    for rhex in roots:
+        gov.demote_state(rhex)
+    budget = max(1, gov.ledger.resident_bytes + (1 << 20))
+    gov.set_budget(budget)
+    # touching every spill would rebuild the full working set; the
+    # rehydration-path enforce keeps residency at the budget instead
+    for rhex in roots:
+        st = sc.get(rhex)
+        if st is not None:
+            assert st.hash_tree_root().hex() == rhex
+    assert gov.ledger.resident_bytes <= budget
+
+
+def test_checkpoint_pins_survive_side_fork_imports(tmp_path):
+    """A side-fork import's post-state carries STALE justified/
+    finalized checkpoints — the governor's checkpoint pins must stay
+    on the chain-wide (monotonic) values, not last-import-wins."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from chaos.harness import StateWorld
+
+    world = StateWorld(tmp_path / "fr", seed=4)
+    try:
+        chain = world.chain
+        old_parent = None
+        for _ in range(3 * P.SLOTS_PER_EPOCH + 2):
+            slot = world.tick_slot()
+            world.churn_slot(slot, fork=False, attest=True)
+            if slot == 2:
+                old_parent = chain.head_root_hex  # an epoch-0 ancestor
+        assert chain._pin_justified[0] >= 1  # justification progressed
+        pinned_before = (chain._pin_justified, chain._pin_finalized)
+        # a deep side-fork block on the epoch-0 ancestor: its post-state
+        # carries STALE (epoch-0) checkpoints; importing it must not
+        # clobber the canonical pins (last-import-wins would)
+        side = world._produce_on(old_parent, slot + 1, b"\x55" * 32)
+        chain.process_block(side)
+        assert (chain._pin_justified, chain._pin_finalized) == pinned_before
+        # and the pins match the chain-wide justification
+        assert chain._pin_justified[0] == int(
+            chain.head_state.current_justified_checkpoint["epoch"]
+        )
+        assert chain._pin_finalized[0] == chain._finalized_epoch
+    finally:
+        world.close()
+
+
+def test_checkpoint_epoch_prune_respects_pins(cfg, genesis):
+    """The count-based epoch window must not evict pinned checkpoint
+    entries (the non-governor eviction path): pinned keys survive
+    prune_epoch and the add-time window loop stops at them."""
+    gov, sc, cc = _governed(cfg, 1 << 40)
+    cc.max_epochs = 2
+    pinned_root = b"\xaa" * 32
+    gov.pinned_fn = lambda: (
+        set(),
+        lambda e, r: (e, r) == (0, pinned_root.hex()),
+    )
+    cc.add({"epoch": 0, "root": pinned_root}, genesis)
+    for epoch in (1, 2, 3, 4):
+        cc.add({"epoch": epoch, "root": b"\xbb" * 32}, genesis.clone())
+    # the pinned epoch-0 entry is still there; unpinned old epochs went
+    assert cc.get({"epoch": 0, "root": pinned_root}) is genesis
+    assert cc.get({"epoch": 1, "root": b"\xbb" * 32}) is None
+    # and prune_finalized cannot remove it either
+    cc.prune_finalized(4)
+    assert cc.get({"epoch": 0, "root": pinned_root}) is genesis
+
+
+def test_engine_diff_columns_are_counted(genesis):
+    """An OWNED engine's validator diff columns (_ValidatorsCell.cols —
+    a second full copy of the numeric registry columns) count in both
+    the walk and the ledger; a COW clone shares them for free."""
+    st = genesis.clone()
+    st.balances[2] += np.uint64(5)
+    st.hash_tree_root()
+    engine = st._root_engine
+    cols = engine.validators.cols
+    assert cols, "hashing must have materialized the diff columns"
+    col_ids = {id(a) for a in cols.values()}
+    plane_ids = {id(p) for p in engine.iter_planes()}
+    assert col_ids <= plane_ids  # enumerated for the ledger
+    # and the walk counts them (engine_bytes >= the raw column sum)
+    assert engine.engine_bytes() >= sum(a.nbytes for a in cols.values())
+
+
+def test_peer_score_book_forget_retains_penalties():
+    """forget() on disconnect drops churn records but RETAINS negative
+    scores — a flooder cycling connections must keep accumulating
+    toward the ban instead of resetting to a clean slate."""
+    from lodestar_tpu.network.peers import PeerAction, PeerScoreBook
+
+    book = PeerScoreBook(clock=lambda: 1000.0)
+    book.apply_action("flooder", PeerAction.low_tolerance)  # negative
+    assert book.score("flooder") < -1.0
+    before = book.score("flooder")
+    book.forget("flooder")
+    assert abs(book.score("flooder") - before) < 1e-6  # retained
+    # a churned near-zero peer IS dropped
+    book.score("bystander")  # creates a clean record
+    assert "bystander" in book._peers
+    book.forget("bystander")
+    assert "bystander" not in book._peers
+
+
+def test_spilled_state_marker_is_inert():
+    sp = SpilledState(b"\x01" * 10, "ab" * 32)
+    assert len(sp) == 10
+    assert getattr(sp, "_root_engine", None) is None
+
+
+# -- bench probe stubs (ISSUE 15 satellite) ---------------------------------
+
+
+def _quiet_bench(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_FLIGHT_RECORDER", None)
+    monkeypatch.setattr(bench, "_FLIGHTREC_ON", False)
+    monkeypatch.delenv("BENCH_FLIGHTREC_DIR", raising=False)
+    return bench
+
+
+def test_bench_regen_probe_timeout_emits_skip(capsys, monkeypatch):
+    """A dead probe leaves a typed skip record (value null, skipped
+    true, the metric/unit pair bench_compare expects), never a hang or
+    a measured zero."""
+    import json
+    import subprocess
+
+    bench = _quiet_bench(monkeypatch)
+
+    def boom(*_a, **_k):
+        raise subprocess.TimeoutExpired(cmd="microbench_regen", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", boom)
+    bench._probe_regen_pressure()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "regen_under_pressure_states_per_s"
+    assert rec["unit"] == "states/s"
+    assert rec["value"] is None and rec["skipped"] is True
+    assert "memory" in rec  # every record carries the memory snapshot
+
+
+def test_bench_regen_probe_forwards_child_record(capsys, monkeypatch):
+    import json
+
+    bench = _quiet_bench(monkeypatch)
+    child = {
+        "metric": "regen_under_pressure_states_per_s",
+        "value": 10.2,
+        "unit": "states/s",
+        "working_set_bytes": 123,
+        "budgets": {
+            "unbounded": {"states_per_s": 100.0},
+            "0.5x": {"states_per_s": 20.0},
+            "0.25x": {"states_per_s": 10.2},
+        },
+    }
+
+    class P:
+        returncode = 0
+        stdout = json.dumps(child) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: P)
+    bench._probe_regen_pressure()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 10.2
+    assert rec["budgets"]["0.25x"]["states_per_s"] == 10.2
+    assert rec.get("skipped") is None
+    # parent-side snapshots attach like every other bench record
+    for field in ("phases", "slo", "memory", "vs_baseline"):
+        assert field in rec
+
+
+def test_bench_regen_probe_child_failure_emits_skip(capsys, monkeypatch):
+    import json
+
+    bench = _quiet_bench(monkeypatch)
+
+    class P:
+        returncode = 3
+        stdout = ""
+        stderr = "boom: no such chain"
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: P)
+    bench._probe_regen_pressure()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True and rec["value"] is None
+    assert "boom" in rec["error"]
+
+
+def test_bench_failure_records_carry_memory_snapshot(capsys, monkeypatch):
+    import json
+
+    bench = _quiet_bench(monkeypatch)
+    bench._emit_failure("run", "stub failure")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "memory" in rec
+    assert set(rec["memory"]["evictions"]) == {"demote", "evict"}
+
+
+@pytest.mark.slow
+def test_microbench_regen_real_run():
+    """The dev script end-to-end at toy scale: a parseable record with
+    all three budget legs and a positive throughput floor."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dev",
+        "microbench_regen.py",
+    )
+    p = subprocess.run(
+        [sys.executable, script, "--json", "--keys", "8", "--slots", "6",
+         "--touches", "8"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(
+        [l for l in p.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert rec["metric"] == "regen_under_pressure_states_per_s"
+    assert rec["value"] > 0
+    assert set(rec["budgets"]) == {"unbounded", "0.5x", "0.25x"}
+    assert rec["budgets"]["0.25x"]["evictions"]["evict"] >= 0
+    assert rec["working_set_bytes"] > 0

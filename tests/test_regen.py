@@ -161,6 +161,42 @@ def test_checkpoint_cache_pruning():
     assert len(cache) == 0
 
 
+def test_block_state_roots_pruned_across_finalized_epochs(tmp_path):
+    """ISSUE 15 satellite: `block_state_roots` used to grow one entry
+    per imported block for the process lifetime.  Driving a chain
+    through finalization (full fake-signature participation, the
+    test_beacon_state idiom) must shrink the map in the finalization
+    sweep — it tracks the live proto nodes, not every block ever seen."""
+    from chaos.harness import StateWorld
+
+    world = StateWorld(tmp_path / "fr", seed=2)
+    try:
+        chain = world.chain
+        # prune on every finalization (the default 256-node threshold
+        # defers the sweep far past this test's horizon)
+        chain.fork_choice.proto.prune_threshold = 0
+        peak = 0
+        final_slot = None
+        for _ in range(5 * P.SLOTS_PER_EPOCH):
+            slot = world.tick_slot()
+            world.churn_slot(slot, fork=False, attest=True)
+            peak = max(peak, len(chain.regen.block_state_roots))
+            if chain._finalized_epoch >= 2:
+                final_slot = slot
+                break
+        assert final_slot is not None, "chain never finalized"
+        live = len(chain.regen.block_state_roots)
+        # the sweep dropped the pre-finalization tail...
+        assert live < peak
+        # ...down to exactly the surviving proto nodes (+ nothing else)
+        assert live == len(chain.fork_choice.proto.nodes)
+        # and regen still works across the pruned boundary: the head
+        # regenerates bit-identical from what remains
+        assert world.verify_regen(chain.head_root_hex)
+    finally:
+        world.close()
+
+
 def test_queued_regen(imported_chain):
     _, regen, roots, posts = imported_chain
     q = QueuedStateRegenerator(regen)

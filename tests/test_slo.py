@@ -633,7 +633,10 @@ def test_full_node_wires_breaker_into_slo_and_recorder(tmp_path):
     try:
         assert node.slo is not None and node.flight_recorder is not None
         assert node.slo.status()["degraded_sources"] == {
-            "bls_breaker": False
+            "bls_breaker": False,
+            # the state-plane memory governor registers alongside the
+            # breaker (ISSUE 15); no pressure episode is open here
+            "state_memory": False,
         }
         # review fix: the production node arms the range-sync stall
         # deadline (a silent peer cannot wedge the sync worker)

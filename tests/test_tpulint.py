@@ -182,6 +182,26 @@ def test_device_dispatch_bypass_allowlist(fixture_findings):
     assert not hits, [f.message for f in hits]
 
 
+def test_cache_hygiene_positive(fixture_findings):
+    """ISSUE 15 satellite: unbounded module/instance-level containers
+    in chain/network/bls modules — the block_state_roots bug class —
+    are flagged: the module-level dict plus all three class attrs."""
+    hits = _by_file(fixture_findings, "cache_bad.py")
+    msgs = [f.message for f in hits if f.rule == "cache-hygiene"]
+    assert any("module-level `_SEEN_ROOTS`" in m for m in msgs), msgs
+    assert any("`self.block_map`" in m for m in msgs), msgs
+    assert any("`self.recent`" in m for m in msgs), msgs
+    assert any("`self.ordered`" in m for m in msgs), msgs
+    assert len(msgs) == 4, msgs
+
+
+def test_cache_hygiene_negative(fixture_findings):
+    """Bounded shapes stay silent: max_* ctor arg, direct shrink
+    methods, alias-based pruning (incl. the getattr form), rebuild-by-
+    reassignment, and never-grown plain state."""
+    assert not _by_file(fixture_findings, "cache_ok.py")
+
+
 def test_metric_hygiene_positive(fixture_findings):
     hits = _by_file(fixture_findings, "metrics_bad.py")
     msgs = [f.message for f in hits if f.rule == "metric-hygiene"]
