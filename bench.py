@@ -405,16 +405,47 @@ def _arm_watchdog() -> None:
 # state_roots_per_s probe: synthetic large state, mutate-k-per-slot
 # cadence (dev/microbench_htr.py).  Pure-CPU in a subprocess with
 # JAX_PLATFORMS=cpu, run BEFORE the TPU backend probe so the record
-# lands even when the tunnel is dead and the BLS headline skips.
+# lands even when the tunnel is dead and the BLS headline skips.  The
+# DEVICE variant (--backend jax -> state_roots_per_s_device, ISSUE 16)
+# runs the same cadence through the hash forest and is ordered AFTER
+# the backend probe: its subprocess inits the real backend, so a dead
+# tunnel must surface as that probe's skip record, never a hang.
 BENCH_HTR_TIMEOUT_S = float(os.environ.get("BENCH_HTR_TIMEOUT", "420"))
 BENCH_HTR_VALIDATORS = int(os.environ.get("BENCH_HTR_VALIDATORS", "100000"))
+BENCH_HTR_DEVICE_TIMEOUT_S = float(
+    os.environ.get("BENCH_HTR_DEVICE_TIMEOUT", "600")
+)
 
 
-def _probe_state_roots() -> None:
+def _probe_state_roots(backend: str = "host") -> None:
+    metric = (
+        "state_roots_per_s_device"
+        if backend == "jax"
+        else "state_roots_per_s"
+    )
+    stage = (
+        "state-roots-device-probe"
+        if backend == "jax"
+        else "state-roots-probe"
+    )
+    phase = (
+        "state_roots_device_probe"
+        if backend == "jax"
+        else "state_roots_probe"
+    )
+    timeout = (
+        BENCH_HTR_DEVICE_TIMEOUT_S
+        if backend == "jax"
+        else BENCH_HTR_TIMEOUT_S
+    )
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "dev", "microbench_htr.py"
     )
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ)
+    if backend != "jax" or _BENCH_PLATFORM == "cpu":
+        # the host probe never touches a device; the device probe only
+        # stays on the CPU jax backend when the whole bench does
+        env["JAX_PLATFORMS"] = "cpu"
     t0 = time.monotonic()
     try:
         p = subprocess.run(
@@ -422,6 +453,8 @@ def _probe_state_roots() -> None:
                 sys.executable,
                 script,
                 "--json",
+                "--backend",
+                backend,
                 "--validators",
                 str(BENCH_HTR_VALIDATORS),
                 "--slots",
@@ -431,22 +464,20 @@ def _probe_state_roots() -> None:
             ],
             capture_output=True,
             text=True,
-            timeout=BENCH_HTR_TIMEOUT_S,
+            timeout=timeout,
             env=env,
         )
     except subprocess.TimeoutExpired:
-        _phase_mark(
-            "state_roots_probe", time.monotonic() - t0, ok=False
-        )
+        _phase_mark(phase, time.monotonic() - t0, ok=False)
         _emit_failure(
-            "state-roots-probe",
-            f"exceeded {BENCH_HTR_TIMEOUT_S:.0f}s",
-            metric="state_roots_per_s",
+            stage,
+            f"exceeded {timeout:.0f}s",
+            metric=metric,
             unit="roots/s",
         )
         return
     _phase_mark(
-        "state_roots_probe",
+        phase,
         time.monotonic() - t0,
         ok=p.returncode == 0,
         rc=p.returncode,
@@ -458,17 +489,15 @@ def _probe_state_roots() -> None:
             if (p.stderr or p.stdout).strip()
             else f"probe exited rc={p.returncode}"
         )
-        _emit_failure(
-            "state-roots-probe", detail,
-            metric="state_roots_per_s", unit="roots/s",
-        )
+        _emit_failure(stage, detail, metric=metric, unit="roots/s")
         return
     try:
         record = json.loads(lines[-1])
         # keep the record schema uniform with every other bench emit:
         # {metric, value, unit, vs_baseline, phases} (no baseline is
         # defined for state roots — the old full recompute is reported
-        # alongside)
+        # alongside; the device record additionally carries the "htr"
+        # dispatch-accounting snapshot the microbench embeds)
         record.setdefault("vs_baseline", None)
         record["phases"] = _phase_snapshot()
         record["slo"] = _slo_snapshot()
@@ -476,8 +505,8 @@ def _probe_state_roots() -> None:
         print(json.dumps(record), flush=True)
     except ValueError:
         _emit_failure(
-            "state-roots-probe", "unparseable probe output",
-            metric="state_roots_per_s", unit="roots/s",
+            stage, "unparseable probe output",
+            metric=metric, unit="roots/s",
         )
 
 
@@ -583,11 +612,25 @@ if __name__ == "__main__" and os.environ.get("BENCH_HTR", "1") != "0":
 if __name__ == "__main__" and os.environ.get("BENCH_REGEN", "1") != "0":
     _probe_regen_pressure()
 
+# CPU platform: the device-backend HTR probe runs on the CPU jax
+# backend right after the host probe (no tunnel to gate on)
+if (
+    __name__ == "__main__"
+    and _BENCH_PLATFORM == "cpu"
+    and os.environ.get("BENCH_HTR_DEVICE", "1") != "0"
+):
+    _probe_state_roots(backend="jax")
+
 if __name__ == "__main__" and _BENCH_PLATFORM == "tpu":
     # The probe is SELF-bounded (subprocess timeouts x retries); the
     # watchdog arms AFTER it so probe retries cannot eat the deadline
     # budget of a run that would finish.
     _probe_backend()
+    # device-backend HTR probe: only after the tunnel is confirmed
+    # alive (its subprocess inits the real backend); self-bounded, so
+    # still ahead of the watchdog
+    if os.environ.get("BENCH_HTR_DEVICE", "1") != "0":
+        _probe_state_roots(backend="jax")
     _arm_watchdog()
 
 import numpy as np

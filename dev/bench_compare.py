@@ -167,6 +167,10 @@ _METRIC_UNITS = {
     # time metric — growth beyond threshold regresses
     "bls_device_fault_recovery_seconds": "s",
     "state_roots_per_s": "roots/s",
+    # ISSUE 16: the same mutate-k-per-slot cadence with the device
+    # merkleization backend (kernels/sha256.py hash forest) — roots/s,
+    # higher is better
+    "state_roots_per_s_device": "roots/s",
     # ISSUE 15: fork-churn regen throughput at 0.25x budget — the
     # evict-and-regenerate floor; throughput, higher is better
     "regen_under_pressure_states_per_s": "states/s",

@@ -11,14 +11,25 @@ deltas, the occasional slash), plus the per-slot bookkeeping writes
   - speedup               : the ratio (the acceptance bar is >=10x at
                             >=100k validators)
 
-Pure CPU (JAX_PLATFORMS=cpu; nothing here touches a device), so it
-reports even when the TPU tunnel is dead — bench.py runs it as a
-subprocess for its `state_roots_per_s` probe (--json emits the one-line
-record bench.py forwards).
+`--backend jax` routes merkleization through the device hash forest
+(kernels/sha256.py via ssz/device_backend.py) and reports the metric
+as `state_roots_per_s_device` with an "htr" dispatch-accounting
+snapshot — per-slot device dispatches, bytes — so the O(k log n)
+per-slot claim is checkable from the record alone.  The default host
+backend stays pure CPU (JAX_PLATFORMS=cpu; nothing touches a device),
+so it reports even when the TPU tunnel is dead — bench.py runs both as
+subprocesses for its `state_roots_per_s` / `state_roots_per_s_device`
+probes (--json emits the one-line record bench.py forwards).
+
+`--derive-cutoff` instead measures the native-batch vs hashlib
+crossover for ssz/hasher.py::hash_pairs and prints the recommended
+LODESTAR_TPU_SHA_NATIVE_CUTOFF (the shipped default of 4 came from
+this mode on the 1-core driver host).
 
 Usage:
   python dev/microbench_htr.py [--validators N] [--slots K]
                                [--touched M] [--full-reps R] [--json]
+                               [--backend {host,jax}] [--derive-cutoff]
 """
 
 from __future__ import annotations
@@ -103,7 +114,19 @@ def mutate_slot(st, rng, touched: int) -> None:
     )
 
 
-def run(n_validators: int, slots: int, touched: int, full_reps: int):
+def _htr_snapshot() -> dict:
+    from lodestar_tpu.ssz.device_backend import device_memory_snapshot
+
+    return device_memory_snapshot()
+
+
+def run(
+    n_validators: int,
+    slots: int,
+    touched: int,
+    full_reps: int,
+    backend: str = "host",
+):
     rng = np.random.default_rng(42)
     st = build_state(n_validators)
 
@@ -112,10 +135,13 @@ def run(n_validators: int, slots: int, touched: int, full_reps: int):
     t_cold = time.perf_counter() - t0
 
     # sanity: incremental == full on the live state (cheap insurance —
-    # a benchmark of a wrong root is worse than no benchmark)
+    # a benchmark of a wrong root is worse than no benchmark); the full
+    # recompute goes through the same hash_pairs_plane seam, so under
+    # --backend jax this also proves device == host bit-identity
     full = st._container().hash_tree_root(st.to_value())
     assert root == full, "incremental root != full recompute"
 
+    d0 = _htr_snapshot().get("dispatches", 0) if backend == "jax" else 0
     t0 = time.perf_counter()
     for _ in range(slots):
         mutate_slot(st, rng, touched)
@@ -129,16 +155,89 @@ def run(n_validators: int, slots: int, touched: int, full_reps: int):
     t_full = time.perf_counter() - t0
     full_rps = full_reps / t_full
 
-    return {
-        "metric": "state_roots_per_s",
+    out = {
+        "metric": (
+            "state_roots_per_s_device"
+            if backend == "jax"
+            else "state_roots_per_s"
+        ),
         "value": round(incremental_rps, 2),
         "unit": "roots/s",
+        "backend": backend,
         "validators": n_validators,
         "touched_per_slot": touched,
         "slots": slots,
         "cold_build_s": round(t_cold, 3),
         "full_roots_per_s": round(full_rps, 4),
         "speedup_vs_full": round(incremental_rps / full_rps, 2),
+    }
+    if backend == "jax":
+        snap = _htr_snapshot()
+        snap["dispatches_per_slot"] = round(
+            (snap.get("dispatches", 0) - d0) / max(1, slots), 2
+        )
+        out["htr"] = snap
+    return out
+
+
+# -- native-cutoff derivation (ssz/hasher.py) --------------------------------
+
+
+def derive_cutoff(reps: int = 2000) -> dict:
+    """Measure the pair count where the native batch hasher overtakes
+    the hashlib loop; the winner-by-n table justifies hasher._CUTOFF."""
+    import hashlib
+
+    from lodestar_tpu.ssz import hasher
+
+    if not hasher.native_available():
+        return {
+            "metric": "sha_native_cutoff",
+            "value": None,
+            "note": "native batch hasher not built (make -C lodestar_tpu/native)",
+        }
+    import ctypes
+
+    rng = np.random.default_rng(7)
+    table = {}
+    cutoff = None
+    for n in (1, 2, 3, 4, 6, 8, 12, 16, 32):
+        data = rng.integers(0, 256, 64 * n, dtype=np.uint8).tobytes()
+
+        def native():
+            out = ctypes.create_string_buffer(32 * n)
+            hasher._native.sha256_hash_pairs(data, out, n)
+            return out.raw
+
+        def pure():
+            sha = hashlib.sha256
+            mv = memoryview(data)
+            return b"".join(
+                sha(mv[i * 64 : i * 64 + 64]).digest() for i in range(n)
+            )
+
+        assert native() == pure(), "native batch hasher mismatch"
+        times = []
+        for f in (native, pure):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                f()
+            times.append((time.perf_counter() - t0) / reps)
+        table[n] = {
+            "native_us": round(times[0] * 1e6, 3),
+            "hashlib_us": round(times[1] * 1e6, 3),
+        }
+        if cutoff is None and times[0] <= times[1]:
+            cutoff = n
+    return {
+        "metric": "sha_native_cutoff",
+        "value": cutoff,
+        "current_default": hasher._CUTOFF,
+        "per_n_us": table,
+        "note": (
+            "export LODESTAR_TPU_SHA_NATIVE_CUTOFF to override "
+            "ssz/hasher.py's default"
+        ),
     }
 
 
@@ -149,10 +248,32 @@ def main() -> int:
     ap.add_argument("--touched", type=int, default=256)
     ap.add_argument("--full-reps", type=int, default=3)
     ap.add_argument(
+        "--backend",
+        choices=("host", "jax"),
+        default="host",
+        help="merkleization backend: host hash_pairs or the device "
+        "hash forest (ssz/device_backend.py)",
+    )
+    ap.add_argument(
+        "--derive-cutoff",
+        action="store_true",
+        help="measure the hasher's native-vs-hashlib crossover instead",
+    )
+    ap.add_argument(
         "--json", action="store_true", help="one JSON line only (bench probe)"
     )
     args = ap.parse_args()
-    out = run(args.validators, args.slots, args.touched, args.full_reps)
+    if args.derive_cutoff:
+        out = derive_cutoff()
+        print(json.dumps(out) if args.json else json.dumps(out, indent=2))
+        return 0
+    if args.backend == "jax":
+        # must precede any lodestar import that resolves the backend
+        os.environ["LODESTAR_TPU_HTR_BACKEND"] = "jax"
+    out = run(
+        args.validators, args.slots, args.touched, args.full_reps,
+        backend=args.backend,
+    )
     if args.json:
         print(json.dumps(out), flush=True)
     else:

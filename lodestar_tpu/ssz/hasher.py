@@ -43,6 +43,23 @@ def native_available() -> bool:
     return _native is not None
 
 
+def _native_cutoff() -> int:
+    """Minimum pair count routed to the native batch path.  Below it the
+    ctypes call overhead beats the per-hash win; the default is measured
+    by dev/microbench_htr.py --derive-cutoff, overridable with
+    LODESTAR_TPU_SHA_NATIVE_CUTOFF."""
+    env = os.environ.get("LODESTAR_TPU_SHA_NATIVE_CUTOFF")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return 4
+
+
+_CUTOFF = _native_cutoff()
+
+
 def hash_pairs(data: bytes) -> bytes:
     """Hash consecutive 64-byte blocks: one tree level in one call.
 
@@ -51,12 +68,15 @@ def hash_pairs(data: bytes) -> bytes:
     """
     n = len(data) // 64
     assert len(data) == 64 * n
-    if _native is not None and n >= 4:
+    if _native is not None and n >= _CUTOFF:
         out = ctypes.create_string_buffer(32 * n)
         _native.sha256_hash_pairs(data, out, n)
         return out.raw
     sha = hashlib.sha256
-    return b"".join(sha(data[i * 64 : i * 64 + 64]).digest() for i in range(n))
+    # memoryview slices borrow the buffer — the old bytes-slice-per-pair
+    # fallback copied every 64-byte block before hashing it
+    mv = memoryview(data)
+    return b"".join(sha(mv[i * 64 : i * 64 + 64]).digest() for i in range(n))
 
 
 def digest(data: bytes) -> bytes:

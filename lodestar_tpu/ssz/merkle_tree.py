@@ -37,10 +37,30 @@ def _ceil_div2(n: int) -> int:
     return (n + 1) >> 1
 
 
+def _device():
+    """The opt-in device merkleization backend (None = host-only).
+    Resolved lazily per call: the breaker may close it mid-process and
+    tests install/clear it explicitly."""
+    from . import device_backend
+
+    return device_backend.maybe_backend()
+
+
 def hash_pairs_plane(pairs: np.ndarray) -> np.ndarray:
-    """Batched sibling hashing over a (n, 64) uint8 plane -> (n, 32)."""
+    """Batched sibling hashing over a (n, 64) uint8 plane -> (n, 32).
+
+    This is the pluggable level-hash seam: with the device backend
+    installed (LODESTAR_TPU_HTR_BACKEND=jax) levels at or above its
+    row cutoff dispatch to the TPU SHA-256 kernel; everything else —
+    and every device fault — takes the host hash_pairs path, which is
+    bit-identical by construction."""
     if pairs.size == 0:
         return np.zeros((0, 32), _U8)
+    backend = _device()
+    if backend is not None:
+        rows = backend.hash_level(pairs)
+        if rows is not None:
+            return rows
     out = hash_pairs(pairs.tobytes())
     return np.frombuffer(out, _U8).reshape(-1, 32)
 
@@ -201,6 +221,8 @@ class ChunkTree:
             idx = idx[keep]
             rows = rows[keep]
             self._levels[0][idx] = rows
+        if idx.size and self._apply_device_sweep(idx):
+            return
         for level in range(self.depth):
             if idx.size == 0:
                 break
@@ -222,6 +244,75 @@ class ChunkTree:
             self._ensure_capacity(level + 1, _ceil_div2(live))
             self._levels[level + 1][parents] = parent_rows
             idx = parents
+
+    def _apply_device_sweep(self, idx: np.ndarray) -> bool:
+        """Hash every dirty path in ONE device dispatch (the forest
+        sweep kernel).  Only taken when the dirty batch fits the sweep
+        lane bucket — the per-slot shape; cold builds and bulk updates
+        go through the per-level loop (whose hash_pairs_plane seam
+        still uses the device at the big buckets).  Returns False for
+        any reason the host loop should run instead; planes are only
+        written on a fully successful sweep, so a mid-sweep device
+        fault leaves the tree untouched for the host path."""
+        backend = _device()
+        if backend is None or self.depth == 0:
+            return False
+        from ..kernels.sha256 import HTR_SWEEP_LANES, pairs_to_blocks
+
+        lanes = HTR_SWEEP_LANES
+        if idx.size > lanes:
+            return False
+        k = self.depth
+        pairs = np.zeros((k, lanes, 16), np.uint32)
+        dst_lane = np.full((k, lanes), lanes, np.int32)
+        dst_half = np.zeros((k, lanes), np.int32)
+        level_parents: List[np.ndarray] = []
+        cur = idx
+        for level in range(k):
+            live = self._rows_at(level)
+            # growth: the stored plane may not cover freshly appended
+            # nodes yet — grow it with zero rows.  Every never-computed
+            # row a pair lane reads is, by construction, a dirty parent
+            # of the previous level, so the kernel's on-device scatter
+            # overwrites it before hashing.
+            self._ensure_capacity(level, live)
+            parents = np.unique(cur >> 1)
+            if parents.size > lanes:
+                return False
+            li = parents << 1
+            ri = li + 1
+            plane = self._levels[level]
+            pp = np.zeros((parents.size, 64), _U8)
+            pp[:, :32] = plane[li]
+            in_range = ri < live
+            if in_range.any():
+                pp[in_range, 32:] = plane[ri[in_range]]
+            if (~in_range).any():
+                pp[~in_range, 32:] = np.frombuffer(_ZERO_HASHES[level], _U8)
+            pairs[level, : parents.size] = pairs_to_blocks(pp)
+            level_parents.append(parents)
+            cur = parents
+        # level l's output digests (nodes at level l+1) overwrite the
+        # stale halves in level l+1's pair plane ON DEVICE: lane =
+        # position of the node's parent among that level's parents,
+        # half = the node's sibling side
+        for level in range(k - 1):
+            src = level_parents[level]
+            nxt = level_parents[level + 1]
+            dst_lane[level, : src.size] = np.searchsorted(
+                nxt, src >> 1
+            ).astype(np.int32)
+            dst_half[level, : src.size] = (src & 1).astype(np.int32)
+        sizes = [p.size for p in level_parents]
+        out = backend.sweep(pairs, dst_lane, dst_half, sizes)
+        if out is None:
+            return False
+        for level, parents in enumerate(level_parents):
+            self._ensure_capacity(
+                level + 1, _ceil_div2(self._rows_at(level))
+            )
+            self._levels[level + 1][parents] = out[level]
+        return True
 
     # -- root --------------------------------------------------------------
 

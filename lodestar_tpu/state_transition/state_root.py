@@ -46,6 +46,15 @@ from ..ssz.core import _mix_in_length
 P = params.ACTIVE_PRESET
 _U8 = np.uint8
 
+
+def _htr_device():
+    """The opt-in device merkleization backend (None = PR 3 host path).
+    Imported lazily — the state-root engine must stay importable on
+    hosts without jax."""
+    from ..ssz import device_backend
+
+    return device_backend.maybe_backend()
+
 # numeric validator-record columns in Validator-container chunk order
 _VAL_COLS = (
     ("effective_balance", 2),
@@ -243,23 +252,42 @@ class _ValidatorsCell:
 
         if dirty.size:
             d = dirty.size
-            blk = np.zeros((d, 8, 32), _U8)
-            blk[:, 0] = self.pk_roots[dirty]
-            blk[:, 1] = np.frombuffer(
+            cred_rows = np.frombuffer(
                 b"".join(state.withdrawal_credentials[int(i)] for i in dirty),
                 _U8,
             ).reshape(-1, 32)
-            for name, chunk in _VAL_COLS:
-                blk[:, chunk, :8] = (
-                    np.ascontiguousarray(getattr(state, name)[dirty], "<u8")
-                    .view(_U8)
-                    .reshape(-1, 8)
+            vroots = None
+            backend = _htr_device()
+            if backend is not None:
+                # leaf packing + the fixed 8-chunk subtree in ONE device
+                # dispatch (kernels/sha256.validator_roots_device); any
+                # fault degrades to the host packing below, bit-identical
+                vroots = backend.validator_roots(
+                    self.pk_roots[dirty],
+                    cred_rows,
+                    [
+                        np.ascontiguousarray(getattr(state, name)[dirty])
+                        for name, _chunk in _VAL_COLS
+                    ],
+                    state.slashed[dirty],
                 )
-            blk[:, 3, 0] = state.slashed[dirty].astype(_U8)
-            # three batched levels: 8 chunks -> 4 -> 2 -> 1 root per row
-            lvl = hash_pairs_plane(blk.reshape(d * 4, 64))
-            lvl = hash_pairs_plane(lvl.reshape(d * 2, 64))
-            vroots = hash_pairs_plane(lvl.reshape(d, 64))
+            if vroots is None:
+                blk = np.zeros((d, 8, 32), _U8)
+                blk[:, 0] = self.pk_roots[dirty]
+                blk[:, 1] = cred_rows
+                for name, chunk in _VAL_COLS:
+                    blk[:, chunk, :8] = (
+                        np.ascontiguousarray(
+                            getattr(state, name)[dirty], "<u8"
+                        )
+                        .view(_U8)
+                        .reshape(-1, 8)
+                    )
+                blk[:, 3, 0] = state.slashed[dirty].astype(_U8)
+                # three batched levels: 8 chunks -> 4 -> 2 -> 1 root per row
+                lvl = hash_pairs_plane(blk.reshape(d * 4, 64))
+                lvl = hash_pairs_plane(lvl.reshape(d * 2, 64))
+                vroots = hash_pairs_plane(lvl.reshape(d, 64))
             if cold:
                 self.tree.reset(vroots)
             else:

@@ -1,0 +1,246 @@
+"""Chaos scenario: device fault mid-merkle-sweep (the ISSUE 16 leg).
+
+With the breaker enabled, an injected device fault during the per-slot
+incremental state-root cadence must cost ZERO roots — every slot's
+root stays bit-identical to the merkleize_chunks oracle, carried by
+the host hash path while the breaker is open.  The SLO engine must
+report `degraded` then `ok` through the breaker source, exactly ONE
+flight bundle must be written for the trip, and the sweep must return
+to device dispatch after the canary re-probe — all deterministic under
+a fixed seed and reproducible through the harness's record/replay.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.chain.clock import Clock
+from lodestar_tpu.observability import flight_recorder as FR
+from lodestar_tpu.observability.flight_recorder import FlightRecorder
+from lodestar_tpu.observability.slo import SloEngine
+from lodestar_tpu.ssz import ChunkTree
+from lodestar_tpu.ssz import device_backend as DB
+from lodestar_tpu.utils.metrics import Registry
+
+from chaos.harness import FakeClock, ScenarioTrace, assert_replay
+
+pytest.importorskip("jax")
+
+from lodestar_tpu.bls.supervisor import DeviceSupervisor  # noqa: E402
+
+pytestmark = pytest.mark.smoke
+
+SEED = 4242
+LIMIT = 1 << 10  # depth-10 tree: a real multi-level sweep plan
+
+
+class HtrWorld:
+    """DeviceMerkleBackend + breaker + SLO engine + flight recorder,
+    wired the way node.py wires the BLS breaker (degraded source, trip
+    anomaly -> rate-limited bundle) — the state-root analog of
+    FloodWorld."""
+
+    def __init__(self, flightrec_dir, seed: int = 0, backoff_s: float = 2.0):
+        self.fake = FakeClock()
+        self.registry = Registry()
+        self.supervisor = DeviceSupervisor(
+            registry=self.registry,
+            clock=self.fake,
+            auto_probe=False,
+            backoff_initial_s=backoff_s,
+            enabled=True,
+            rng=random.Random(seed),
+        )
+        self.backend = DB.DeviceMerkleBackend(
+            supervisor=self.supervisor,
+            registry=self.registry,
+            min_level_rows=1,
+            use_export=False,
+        )
+        DB.set_backend(self.backend)
+        self.clock = Clock(genesis_time=0.0)
+        self.recorder = FlightRecorder(
+            str(flightrec_dir), registry=self.registry
+        )
+        self.recorder.add_provider("breaker", self.supervisor.status)
+        self.slo = SloEngine(
+            self.clock, registry=self.registry, recorder=self.recorder
+        )
+        # node.py's breaker wiring pattern, applied to the HTR plane
+        self.slo.add_degraded_source("htr_breaker", self.supervisor.is_open)
+        self.supervisor.on_trip = lambda info: self.slo.anomaly(
+            "htr_breaker_trip", info
+        )
+        self.supervisor.on_recover = lambda info: self.slo.anomaly(
+            "htr_breaker_recovery", info
+        )
+        self.clock.on_slot(self.slo.on_slot)
+        self._slot = 0
+        self.rng = np.random.default_rng(seed)
+        self.tree = ChunkTree(LIMIT)
+        # 384 leaves: the whole cold build fits one sweep dispatch
+        # (every level's parent count <= HTR_SWEEP_LANES)
+        self.leaves = self.rng.integers(
+            0, 256, (384, 32), dtype=np.uint8
+        )
+
+    # -- drivers -----------------------------------------------------------
+
+    def tick_slot(self) -> int:
+        from lodestar_tpu import params
+
+        self._slot += 1
+        self.clock.set_time(self._slot * params.SECONDS_PER_SLOT)
+        return self._slot
+
+    def slot_sweep(self, touched: int) -> dict:
+        """One slot's worth of leaf churn + incremental re-root.
+        Returns the zero-lost-roots summary: the root, whether it
+        matches the host merkleize oracle, and whether the device
+        carried it."""
+        idx = self.rng.integers(0, self.leaves.shape[0], touched)
+        self.leaves[idx] = self.rng.integers(
+            0, 256, (touched, 32), dtype=np.uint8
+        )
+        before = self.backend.dispatches
+        self.tree.update(self.leaves)
+        return {
+            "root": self.tree.root.hex(),
+            "oracle_ok": self.tree.root == self.tree.full_root_reference(),
+            "device_dispatched": self.backend.dispatches > before,
+        }
+
+    def close(self) -> None:
+        DB.reset_backend()
+
+
+def _run(trace, fr_dir):
+    world = HtrWorld(fr_dir, seed=trace.seed)
+    try:
+        # cold build: the whole dirty plane, one device round-trip
+        world.tree.update(world.leaves)
+        trace.emit(
+            "cold_build",
+            root=world.tree.root.hex(),
+            oracle_ok=world.tree.root == world.tree.full_root_reference(),
+            dispatches=world.backend.dispatches,
+            breaker=world.supervisor.status()["state"],
+        )
+        s = world.slot_sweep(24)
+        trace.emit("healthy", **s)
+        world.tick_slot()
+        trace.emit("slo_healthy", status=world.slo.status()["status"])
+
+        # the fault lands MID-cadence: the next sweep's dispatch fails,
+        # the breaker trips, the host per-level loop carries the root
+        world.backend.fault = "backend"
+        s = world.slot_sweep(24)
+        trace.emit(
+            "during_fault",
+            **s,
+            breaker=world.supervisor.status()["state"],
+            host_fallback_used=(
+                world.supervisor.m_host_fallback_sets.value > 0
+            ),
+        )
+
+        # next tick drains the trip anomaly into ONE bundle; health is
+        # degraded through the breaker source (not a breach)
+        world.tick_slot()
+        st = world.slo.status()
+        trace.emit(
+            "slo_degraded",
+            status=st["status"],
+            breaker_source=st["degraded_sources"]["htr_breaker"],
+        )
+        bundles = FR.list_bundles(world.recorder.directory)
+        trace.emit(
+            "bundles",
+            n=len(bundles),
+            reason=bundles[0]["reason"] if bundles else None,
+        )
+
+        # degraded mode keeps roots flowing, still bit-identical
+        s = world.slot_sweep(16)
+        trace.emit("degraded_sweep", **s)
+
+        # heal the device; the canary is not due before the backoff
+        world.backend.heal()
+        world.supervisor.poll()
+        trace.emit(
+            "probe_not_due", breaker=world.supervisor.status()["state"]
+        )
+        world.fake.advance(10.0)  # past the 2 s (+/- jitter) backoff
+        world.supervisor.poll()
+        trace.emit(
+            "recovered",
+            breaker=world.supervisor.status()["state"],
+            degraded_time_counted=world.supervisor.time_in_degraded_s() > 0,
+        )
+        world.tick_slot()
+        trace.emit("slo_ok", status=world.slo.status()["status"])
+
+        # and the sweep actually dispatches to the device again
+        s = world.slot_sweep(16)
+        trace.emit("device_resumed", **s)
+    finally:
+        world.close()
+
+
+def test_htr_device_fault_mid_sweep_acceptance(tmp_path):
+    trace = ScenarioTrace(SEED)
+    _run(trace, tmp_path / "fr-record")
+    ev = {e["kind"]: e for e in trace.events}
+
+    # zero lost roots: every stage's root matches the host oracle
+    for stage in ("cold_build", "healthy", "during_fault",
+                  "degraded_sweep", "device_resumed"):
+        assert ev[stage]["oracle_ok"] is True, (stage, ev[stage])
+    assert ev["cold_build"]["dispatches"] == 1
+    assert ev["cold_build"]["breaker"] == "closed"
+    assert ev["healthy"]["device_dispatched"] is True
+    assert ev["slo_healthy"]["status"] == "ok"
+    # the trip: breaker open, host path carried the root
+    assert ev["during_fault"]["breaker"] == "open"
+    assert ev["during_fault"]["device_dispatched"] is False
+    assert ev["during_fault"]["host_fallback_used"] is True
+    # SLO degraded through the breaker source, exactly one bundle
+    assert ev["slo_degraded"]["status"] == "degraded"
+    assert ev["slo_degraded"]["breaker_source"] is True
+    assert ev["bundles"]["n"] == 1
+    assert ev["bundles"]["reason"] == "event.htr_breaker_trip"
+    assert ev["degraded_sweep"]["device_dispatched"] is False
+    # canary-gated recovery: not before the backoff, then closed
+    assert ev["probe_not_due"]["breaker"] == "open"
+    assert ev["recovered"]["breaker"] == "closed"
+    assert ev["recovered"]["degraded_time_counted"] is True
+    assert ev["slo_ok"]["status"] == "ok"
+    assert ev["device_resumed"]["device_dispatched"] is True
+
+    # record/replay: the saved scenario reproduces bit-for-bit
+    record = trace.save(tmp_path / "scenario_htr_device_fault.json")
+    assert_replay(record, lambda t: _run(t, tmp_path / "fr-replay"))
+
+
+def test_htr_bundle_carries_breaker_status(tmp_path):
+    """The flight bundle written on an HTR trip includes the breaker
+    provider's status payload, with the sweep seam and the classified
+    outcome attributed."""
+    world = HtrWorld(tmp_path / "fr", seed=7)
+    try:
+        world.tree.update(world.leaves)
+        world.backend.fault = "bad_output"
+        s = world.slot_sweep(8)
+        assert s["oracle_ok"] is True  # host carried it anyway
+        world.tick_slot()
+        bundles = FR.list_bundles(world.recorder.directory)
+        assert len(bundles) == 1
+        loaded = FR.load_bundle(bundles[0]["path"])
+        breaker = loaded["files"]["breaker.json"]
+        assert breaker["state"] == "open"
+        assert breaker["trips"] == 1
+        assert breaker["last_failure"]["outcome"] == "bad_output"
+        assert breaker["last_failure"]["seam"] == "htr_forest_sweep"
+    finally:
+        world.close()

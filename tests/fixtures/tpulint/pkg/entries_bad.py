@@ -43,3 +43,40 @@ register_entry(
     _rlc_each_builder,
     sources=("pkg.extmod",),  # BAD: pkg.extdep missing
 )
+
+
+# bucketed-entry positives: a dynamic (unreadable) bucket table, an
+# empty one, and a misordered one.  Sources are complete so ONLY the
+# bucket finding fires per entry.
+def bucketed_entry(name, builder, buckets, source=None, sources=None):
+    """Stand-in bucketed registry (the rule matches the call by name)."""
+
+
+def _make_buckets():
+    return (128, 512)
+
+
+def _bucketed_builder(bucket):
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+bucketed_entry(
+    "fixture_bucketed_dynamic",
+    _bucketed_builder,
+    buckets=_make_buckets(),  # BAD: not statically resolvable
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+bucketed_entry(
+    "fixture_bucketed_empty",
+    _bucketed_builder,
+    buckets=(),  # BAD: no buckets to pre-trace
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+bucketed_entry(
+    "fixture_bucketed_misordered",
+    _bucketed_builder,
+    buckets=(512, 128),  # BAD: not strictly increasing
+    sources=("pkg.extmod", "pkg.extdep"),
+)

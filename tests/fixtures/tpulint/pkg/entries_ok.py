@@ -53,3 +53,42 @@ register_entry(
     _rlc_each_builder,
     sources=("pkg.extmod", "pkg.extdep"),
 )
+
+
+# bucketed-entry negatives: every statically-readable bucket-table
+# spelling resolves (call-site literal with arithmetic, a module-level
+# constant, and a constant imported from ANOTHER module) and a
+# well-formed strictly-increasing table produces no findings.
+def bucketed_entry(name, builder, buckets, source=None, sources=None):
+    """Stand-in bucketed registry (the rule matches the call by name)."""
+
+
+from .extmod import SPAN_BUCKETS  # noqa: E402
+
+_LOCAL_BUCKETS = (128,) + (512, 2048)
+
+
+def _bucketed_builder(bucket):
+    from .extmod import span_specs
+
+    return span_specs()
+
+
+bucketed_entry(
+    "fixture_bucketed_literal_ok",
+    _bucketed_builder,
+    buckets=(64, 2 * 128),
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+bucketed_entry(
+    "fixture_bucketed_const_ok",
+    _bucketed_builder,
+    buckets=_LOCAL_BUCKETS,
+    sources=("pkg.extmod", "pkg.extdep"),
+)
+bucketed_entry(
+    "fixture_bucketed_imported_ok",
+    _bucketed_builder,
+    buckets=SPAN_BUCKETS,
+    sources=("pkg.extmod", "pkg.extdep"),
+)

@@ -4,6 +4,10 @@ import jax.numpy as jnp
 
 from .extdep import SENTINEL
 
+# a shape-bucket table another module's bucketed_entry call can name
+# (the engine must resolve it cross-module, arithmetic included)
+SPAN_BUCKETS = (2 * 8, 64, 512)
+
 
 def span_fn(mins, maxs):
     return jnp.minimum(mins, jnp.int32(SENTINEL)), maxs

@@ -228,6 +228,31 @@ def test_effective_atts_metric_direction_registered(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_state_roots_device_metric_direction_registered(tmp_path, capsys):
+    """ISSUE 16 satellite: `state_roots_per_s_device` is a throughput
+    metric — a drop beyond threshold exits 1, a rise exits 0, even when
+    archived cells lost their unit (the registry pins roots/s)."""
+    m = "state_roots_per_s_device"
+    assert bench_compare._METRIC_UNITS[m] == "roots/s"
+    drop = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 50.0}]),  # no unit
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 20.0}]),
+    ]
+    assert bench_compare.main(drop + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+    rise = [
+        _round(tmp_path / "BENCH_r03.json",
+               tail_records=[{"metric": m, "value": 20.0,
+                              "unit": "roots/s"}]),
+        _round(tmp_path / "BENCH_r04.json",
+               tail_records=[{"metric": m, "value": 50.0}]),
+    ]
+    assert bench_compare.main(rise + ["--threshold", "0.05"]) == 0
+    capsys.readouterr()
+
+
 def test_regen_pressure_metric_direction_registered(tmp_path, capsys):
     """ISSUE 15 satellite: `regen_under_pressure_states_per_s` is a
     throughput floor — a drop beyond threshold exits 1 even when the

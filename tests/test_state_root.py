@@ -317,3 +317,31 @@ def test_regen_engine_bytes_walks_the_lru():
     # clones cached under other roots share planes COW: no double count
     regen.state_cache.add_with_root("ff" * 32, st.clone())
     assert regen.engine_bytes() == first
+
+
+def test_randomized_equivalence_device_backend():
+    """The full BeaconState engine under the DEVICE merkleization
+    backend (ISSUE 16): the same randomized mutation surface, every
+    root bit-identical to the cold full recompute — with the device
+    actually carrying sweeps, not silently gated out."""
+    pytest.importorskip("jax")
+    from lodestar_tpu.bls.supervisor import DeviceSupervisor
+    from lodestar_tpu.ssz import device_backend as DB
+    from lodestar_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    backend = DB.DeviceMerkleBackend(
+        supervisor=DeviceSupervisor(
+            registry=reg, auto_probe=False, enabled=True
+        ),
+        registry=reg,
+        min_level_rows=1,
+        use_export=False,
+    )
+    DB.set_backend(backend)
+    try:
+        _run_equivalence(n_validators=32, steps=12, seed=16)
+        assert backend.dispatches > 0
+        assert backend.supervisor.status()["state"] == "closed"
+    finally:
+        DB.reset_backend()

@@ -251,6 +251,63 @@ def test_fingerprint_completeness_negative(fixture_findings):
     assert not _by_file(fixture_findings, "entries_ok.py")
 
 
+def test_bucket_coverage_positive(fixture_findings):
+    """bucketed_entry tables that can't be audited offline are errors:
+    dynamic, empty, and misordered tables each fire exactly one
+    bucket finding on their own entry."""
+    hits = _by_file(fixture_findings, "entries_bad.py")
+    msgs = [
+        f.message for f in hits if f.rule == "fingerprint-completeness"
+    ]
+    dyn = [m for m in msgs if "fixture_bucketed_dynamic" in m]
+    assert dyn == [m for m in dyn if "not statically resolvable" in m]
+    assert len(dyn) == 1, msgs
+    empty = [m for m in msgs if "fixture_bucketed_empty" in m]
+    assert len(empty) == 1 and "empty bucket table" in empty[0], msgs
+    mis = [m for m in msgs if "fixture_bucketed_misordered" in m]
+    assert len(mis) == 1 and "strictly increasing" in mis[0], msgs
+
+
+def test_bucket_tables_resolve_statically():
+    """The clean fixtures' three bucket-table spellings (call-site
+    literal with arithmetic, local module constant built by tuple
+    concatenation, constant imported from another module) all resolve
+    to the runtime values."""
+    from lodestar_tpu.analysis.engine import Project
+
+    p = Project()
+    p.load_paths([str(FIXTURES)])
+    by_name = {e.name: e for e in p.export_entries}
+    assert by_name["fixture_bucketed_literal_ok"].buckets == (64, 256)
+    assert by_name["fixture_bucketed_const_ok"].buckets == (128, 512, 2048)
+    assert by_name["fixture_bucketed_imported_ok"].buckets == (16, 64, 512)
+    # plain register_entry sites carry no bucket table at all
+    assert by_name["fixture_span_update_ok"].buckets is None
+    assert not by_name["fixture_span_update_ok"].unresolved_buckets
+
+
+def test_repo_bucket_tables_match_runtime_registry():
+    """The shipped bucketed entries' statically-resolved tables must
+    equal what kernels/export_cache.py registers at import (the lint
+    gate audits exactly the shapes export_registered pre-traces)."""
+    from lodestar_tpu.analysis.engine import Project
+    from lodestar_tpu.kernels import export_cache as EC
+
+    p = Project()
+    p.load_paths([str(REPO / "lodestar_tpu")])
+    static = {
+        e.name: e.buckets
+        for e in p.export_entries
+        if e.buckets is not None
+    }
+    runtime = EC.entry_buckets()
+    assert static == runtime, (static, runtime)
+    # the HTR acceptance shapes: all four headline pair buckets
+    assert static["htr_hash_pairs"] == (
+        128 * 1024, 512 * 1024, 1024 * 1024, 2 * 1024 * 1024,
+    )
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
