@@ -593,6 +593,81 @@ def _probe_regen_pressure() -> None:
         )
 
 
+# proofs_per_s probe (ISSUE 17): light-client horde serving throughput
+# against the proof plane — bundle/plane/host source accounting and the
+# bundle-cache hit rate ride the record.  Pure-CPU subprocess like the
+# regen probe, run BEFORE the backend probe.
+BENCH_PROOFS_TIMEOUT_S = float(os.environ.get("BENCH_PROOFS_TIMEOUT", "420"))
+BENCH_PROOFS_KEYS = int(os.environ.get("BENCH_PROOFS_KEYS", "16"))
+BENCH_PROOFS_SLOTS = int(os.environ.get("BENCH_PROOFS_SLOTS", "8"))
+BENCH_PROOFS_CLIENTS = int(os.environ.get("BENCH_PROOFS_CLIENTS", "8"))
+BENCH_PROOFS_ROUNDS = int(os.environ.get("BENCH_PROOFS_ROUNDS", "6"))
+
+
+def _emit_proofs_skip(stage: str, detail: str) -> None:
+    _emit_failure(stage, detail, metric="proofs_per_s", unit="proofs/s")
+
+
+def _probe_proofs() -> None:
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "dev",
+        "microbench_proofs.py",
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--json",
+                "--keys",
+                str(BENCH_PROOFS_KEYS),
+                "--slots",
+                str(BENCH_PROOFS_SLOTS),
+                "--clients",
+                str(BENCH_PROOFS_CLIENTS),
+                "--rounds",
+                str(BENCH_PROOFS_ROUNDS),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=BENCH_PROOFS_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _phase_mark("proofs_probe", time.monotonic() - t0, ok=False)
+        _emit_proofs_skip(
+            "proofs-probe", f"exceeded {BENCH_PROOFS_TIMEOUT_S:.0f}s"
+        )
+        return
+    _phase_mark(
+        "proofs_probe",
+        time.monotonic() - t0,
+        ok=p.returncode == 0,
+        rc=p.returncode,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    if p.returncode != 0 or not lines:
+        detail = (
+            (p.stderr or p.stdout).strip().splitlines()[-1]
+            if (p.stderr or p.stdout).strip()
+            else f"probe exited rc={p.returncode}"
+        )
+        _emit_proofs_skip("proofs-probe", detail)
+        return
+    try:
+        record = json.loads(lines[-1])
+        record.setdefault("vs_baseline", None)
+        record["phases"] = _phase_snapshot()
+        record["slo"] = _slo_snapshot()
+        record["memory"] = _memory_snapshot()
+        print(json.dumps(record), flush=True)
+    except ValueError:
+        _emit_proofs_skip("proofs-probe", "unparseable probe output")
+
+
 if __name__ == "__main__":
     # the driver invocation records failure bundles by default
     # (./flightrec_bench or BENCH_FLIGHTREC_DIR); in-process stub
@@ -611,6 +686,9 @@ if __name__ == "__main__" and os.environ.get("BENCH_HTR", "1") != "0":
 
 if __name__ == "__main__" and os.environ.get("BENCH_REGEN", "1") != "0":
     _probe_regen_pressure()
+
+if __name__ == "__main__" and os.environ.get("BENCH_PROOFS", "1") != "0":
+    _probe_proofs()
 
 # CPU platform: the device-backend HTR probe runs on the CPU jax
 # backend right after the host probe (no tunnel to gate on)

@@ -174,6 +174,12 @@ _METRIC_UNITS = {
     # ISSUE 15: fork-churn regen throughput at 0.25x budget — the
     # evict-and-regenerate floor; throughput, higher is better
     "regen_under_pressure_states_per_s": "states/s",
+    # ISSUE 17: light-client horde serving off the proof plane —
+    # throughput, higher is better
+    "proofs_per_s": "proofs/s",
+    # bundle-cache hit rate rides its own metric in comparisons
+    # (ratio 0..1, higher is better)
+    "proof_bundle_hit_rate": "ratio",
 }
 
 

@@ -1,0 +1,195 @@
+"""Light-client horde proof-serving throughput (ISSUE 17).
+
+Builds a stub-signature BeaconChain with full sync participation so the
+LightClientServer produces plane-served updates, then drives a
+synthetic horde of light clients through the ProofService with mixed
+request shapes (bootstrap / updates-by-range / optimistic / state
+proofs).  Two timed phases:
+
+  - warm: bundle cache + warm engine planes serving (the steady state
+    a head-following horde sees) — the headline proofs/s,
+  - host: bundle cache disabled and engine planes released (the
+    post-eviction worst case) — the floor the fallback path guarantees.
+
+The record carries per-source counters (bundle / plane / host) and the
+bundle-cache hit rate, so regressions in ANY serving tier surface even
+when the headline holds.
+
+Pure CPU (numpy + hashlib state machinery; signatures stubbed).
+bench.py runs this in a subprocess with JAX_PLATFORMS=cpu — the
+proofs_per_s record.
+
+    python dev/microbench_proofs.py --json --keys 16 --slots 8 \
+        --clients 8 --rounds 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class _StubBls:
+    def verify_signature_sets(self, sets):
+        return True
+
+    def close(self):
+        pass
+
+
+STATE_PROOF_SHAPES = [
+    [["finalized_checkpoint", "root"]],
+    [["slot"], ["next_sync_committee"]],
+    [["balances", "0"], ["finalized_checkpoint", "epoch"], ["slot"]],
+]
+
+
+def build_world(n_keys: int):
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.light_client_server import LightClientServer
+    from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+    from lodestar_tpu.crypto import bls as B
+    from lodestar_tpu.crypto import curves as C
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.proofs import ProofService
+    from lodestar_tpu.state_transition import create_genesis_state
+
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}, genesis_time=0
+    )
+    pks = [
+        C.g1_compress(B.sk_to_pk(B.keygen(b"proofs-bench-%d" % i)))
+        for i in range(n_keys)
+    ]
+    genesis = create_genesis_state(cfg, pks, genesis_time=0)
+    chain = BeaconChain(
+        cfg,
+        genesis,
+        db=BeaconDb(None),
+        bls_verifier=_StubBls(),
+        state_budget_bytes=1 << 60,
+    )
+    lc = LightClientServer(chain)
+    service = ProofService(
+        chain, light_client_server=lc, governor=chain.memory_governor
+    )
+    return chain, lc, service
+
+
+def churn(chain, slots: int):
+    """Head blocks with FULL sync participation (fake signature — the
+    stub verifier owns crypto): every import produces an update."""
+    from lodestar_tpu import params
+    from lodestar_tpu.chain.produce_block import produce_block
+
+    P = params.ACTIVE_PRESET
+    for slot in range(1, slots + 1):
+        parent_state = chain.regen._get_post_state(chain.head_root_hex)
+        block, _post = produce_block(
+            parent_state,
+            slot,
+            hashlib.sha256(b"proofs-bench %d" % slot).digest() * 3,
+            sync_aggregate={
+                "sync_committee_bits": [True] * P.SYNC_COMMITTEE_SIZE,
+                "sync_committee_signature": bytes([0xC0]) + b"\x00" * 95,
+            },
+        )
+        chain.process_block({"message": block, "signature": b"\x00" * 96})
+
+
+def horde_round(chain, service, clients: int) -> int:
+    """One horde pass of mixed request shapes; returns requests served."""
+    head_root = chain.get_head_root()
+    head_state = chain.head_state
+    served = 0
+    for i in range(clients):
+        shape = i % 4
+        if shape == 0:
+            served += service.bootstrap(head_root) is not None
+        elif shape == 1:
+            served += len(service.light_client_updates(0, 2))
+        elif shape == 2:
+            served += service.optimistic_update() is not None
+        else:
+            paths = STATE_PROOF_SHAPES[i % len(STATE_PROOF_SHAPES)]
+            service.state_proof_data(head_state, paths)
+            served += 1
+    return served
+
+
+def timed_horde(chain, service, clients: int, rounds: int) -> dict:
+    src0 = dict(service.sources)
+    served = 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        served += horde_round(chain, service, clients)
+    dt = time.perf_counter() - t0
+    return {
+        "proofs_per_s": round(served / dt, 2) if dt > 0 else None,
+        "served": served,
+        "sources": {
+            k: service.sources[k] - src0[k] for k in service.sources
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    chain, lc, service = build_world(args.keys)
+    churn(chain, args.slots)
+
+    warm = timed_horde(chain, service, args.clients, args.rounds)
+    hit_rate = service.cache.stats()["hit_rate"]
+
+    # host floor: disable the bundle tier and release every engine's
+    # planes — each request pays the container_branch host pass
+    service.cache.max_entries = 0
+    service.cache.drain()
+    for entry in chain.regen.state_cache.states():
+        engine = getattr(entry, "_root_engine", None)
+        if engine is not None:
+            engine.release_planes()
+    host = timed_horde(chain, service, args.clients, max(1, args.rounds // 2))
+
+    record = {
+        "metric": "proofs_per_s",
+        # the headline is the steady state a head-following horde sees
+        "value": warm["proofs_per_s"],
+        "unit": "proofs/s",
+        "hit_rate": round(hit_rate, 4) if hit_rate is not None else None,
+        "warm": warm,
+        "host_floor": host,
+        "production": {
+            "updates": lc.produced,
+            "plane_proofs": lc.plane_proofs,
+            "host_proofs": lc.host_proofs,
+        },
+        "clients": args.clients,
+        "rounds": args.rounds,
+        "cache": service.cache.stats(),
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        for k, v in record.items():
+            print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -916,7 +916,7 @@ class MetricHygieneRule(Rule):
 # cache-hygiene: the packages whose long-lived objects hold per-peer /
 # per-block / per-root maps — exactly where an unpruned dict survives
 # for the process lifetime (the `block_state_roots` bug class)
-_CACHE_DIRS = {"chain", "network", "bls"}
+_CACHE_DIRS = {"chain", "network", "bls", "proofs"}
 # empty-container constructors that start a growable cache
 _EMPTY_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict", "deque"}
 # growth methods (an attribute nobody grows is state, not a cache)

@@ -40,6 +40,7 @@ class DefaultHandlers:
         slasher=None,
         slo=None,
         flight_recorder=None,
+        proof_service=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -63,6 +64,10 @@ class DefaultHandlers:
         self.slasher = slasher  # SlasherService for the status route
         self.slo = slo  # SloEngine for the lodestar health route
         self.flight_recorder = flight_recorder  # bundle inventory
+        # ProofService: bundle/plane-first serving for the light_client
+        # and proof namespaces; handlers keep their own host paths as
+        # the no-service fallback
+        self.proof_service = proof_service
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -1319,6 +1324,11 @@ class DefaultHandlers:
                 raise ValueError("not 32 bytes")
         except ValueError as e:
             return 400, {"message": f"invalid block root: {e}"}
+        if self.proof_service is not None:
+            data = self.proof_service.bootstrap(root)
+            if data is None:
+                return 404, {"message": "no bootstrap for root"}
+            return 200, {"data": data}
         boot = self.light_client_server.get_bootstrap(root)
         if boot is None:
             return 404, {"message": "no bootstrap for root"}
@@ -1333,6 +1343,8 @@ class DefaultHandlers:
             return err
         start = int(params.get("start_period", 0))
         count = min(int(params.get("count", 1)), 128)
+        if self.proof_service is not None:
+            return 200, self.proof_service.light_client_updates(start, count)
         out = []
         for period in range(start, start + count):
             upd = self.light_client_server.get_update(period)
@@ -1356,6 +1368,11 @@ class DefaultHandlers:
         err = self._need_lc()
         if err:
             return err
+        if self.proof_service is not None:
+            data = self.proof_service.finality_update()
+            if data is None:
+                return 404, {"message": "no finality update available"}
+            return 200, {"data": data}
         upd = self.light_client_server.get_finality_update()
         if upd is None:
             return 404, {"message": "no finality update available"}
@@ -1365,6 +1382,11 @@ class DefaultHandlers:
         err = self._need_lc()
         if err:
             return err
+        if self.proof_service is not None:
+            data = self.proof_service.optimistic_update()
+            if data is None:
+                return 404, {"message": "no optimistic update available"}
+            return 200, {"data": data}
         upd = self.light_client_server.get_optimistic_update()
         if upd is None:
             return 404, {"message": "no optimistic update available"}
@@ -1505,29 +1527,35 @@ class DefaultHandlers:
         err = self._need_chain()
         if err:
             return err
-        path = params.get("paths", "")
-        parts = [p for p in path.split(".") if p]
-        if not parts:
+        raw = params.get("paths", "")
+        # comma-separated dotted paths; one path keeps the original
+        # single-proof shape, several add a proofs list + multiproof
+        paths = [
+            [p for p in spec.split(".") if p]
+            for spec in raw.split(",")
+            if spec.strip(".")
+        ]
+        if not paths:
             return 400, {"message": "paths query parameter required"}
-        from ..ssz.core import container_branch
-
         st, err = self._head_only_state(params["state_id"])
         if err:
             return err
         try:
-            leaf, branch, depth, index = container_branch(
-                st._container(), st.to_value(), parts
-            )
+            if self.proof_service is not None:
+                return 200, {
+                    "data": self.proof_service.state_proof_data(st, paths)
+                }
+            from ..ssz.core import container_branches
+
+            proofs = container_branches(st._container(), st.to_value(), paths)
         except (KeyError, ValueError, TypeError) as e:
             return 400, {"message": f"bad path: {e}"}
+        from ..proofs.service import ProofService
+
         return 200, {
-            "data": {
-                "leaf": "0x" + leaf.hex(),
-                "branch": ["0x" + b.hex() for b in branch],
-                "depth": depth,
-                "index": index,
-                "state_root": "0x" + st.hash_tree_root().hex(),
-            }
+            "data": ProofService._render_proofs(
+                paths, proofs, st.hash_tree_root()
+            )
         }
 
     # -- keymanager namespace (reference: api/src/keymanager/routes.ts;

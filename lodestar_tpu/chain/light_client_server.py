@@ -11,10 +11,12 @@ persistent-merkle-tree getSingleProof analog).
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import Dict, Optional
 
 from .. import params
 from ..light_client.lightclient import LightClientUpdate, sync_period
+from ..proofs.plane_reader import state_multiproof, state_proof
 from ..ssz.core import container_branch, container_branches
 from ..state_transition.state import BeaconStateAltair
 from ..types import BeaconBlockBodyAltair, BeaconBlockHeader
@@ -49,6 +51,10 @@ class LightClientServer:
         self.latest_finality_update: Optional[LightClientUpdate] = None
         self.latest_optimistic_update: Optional[LightClientUpdate] = None
         self.produced = 0
+        # proof-source accounting: branches read off warm engine planes
+        # (O(log n), zero re-hash) vs the container_branch host pass
+        self.plane_proofs = 0
+        self.host_proofs = 0
         # per-period best updates survive restarts (reference:
         # db/repositories/lightclientBestUpdate.ts)
         self.db = db if db is not None else getattr(chain, "db", None)
@@ -122,17 +128,29 @@ class LightClientServer:
                     attested_state.hash_tree_root()
                 )
 
-        # one field-root pass serves both proofs (the validator-registry
-        # merkleization dominates; see ssz.container_branches)
-        state_value = attested_state.to_value()
+        # plane-first: both branches straight off the warm engine planes
+        # (zero re-hash), under a residency lease so the read cannot
+        # race the governor demoting the attested state mid-extraction
+        lc_paths = [["next_sync_committee"], ["finalized_checkpoint", "root"]]
+        proofs = None
+        if attested_state._container() is BeaconStateAltair:
+            with self._lease(parent_hex):
+                proofs = state_multiproof(attested_state, lc_paths)
+        if proofs is not None:
+            self.plane_proofs += 1
+        else:
+            # host fall-through: one field-root pass serves both proofs
+            # (the validator-registry merkleization dominates; see
+            # ssz.container_branches)
+            state_value = attested_state.to_value()
+            proofs = container_branches(
+                BeaconStateAltair, state_value, lc_paths
+            )
+            self.host_proofs += 1
         (
             (_leaf, nsc_branch, _nd, _ni),
             (_froot, fin_branch, _fd, _fi),
-        ) = container_branches(
-            BeaconStateAltair,
-            state_value,
-            [["next_sync_committee"], ["finalized_checkpoint", "root"]],
-        )
+        ) = proofs
 
         finalized_header = None
         finality_branch = None
@@ -223,12 +241,29 @@ class LightClientServer:
             return None
         header = _block_header_value(signed["message"])
         state = self.chain.regen._get_post_state(block_root.hex())
-        state_value = state.to_value()
-        _leaf, branch, depth, index = container_branch(
-            BeaconStateAltair, state_value, ["current_sync_committee"]
-        )
+        proof = None
+        if state._container() is BeaconStateAltair:
+            with self._lease(block_root.hex()):
+                proof = state_proof(state, ["current_sync_committee"])
+        if proof is not None:
+            self.plane_proofs += 1
+            _leaf, branch, _depth, _index = proof
+        else:
+            state_value = state.to_value()
+            _leaf, branch, _depth, _index = container_branch(
+                BeaconStateAltair, state_value, ["current_sync_committee"]
+            )
+            self.host_proofs += 1
         return {
             "header": header,
             "current_sync_committee": dict(state.current_sync_committee),
             "current_sync_committee_branch": branch,
         }
+
+    def _lease(self, root_hex: str):
+        """Residency lease on the state-cache entry backing a plane
+        read (no-op when the chain has no governor)."""
+        gov = getattr(self.chain, "memory_governor", None)
+        if gov is None or not hasattr(gov, "lease"):
+            return nullcontext()
+        return gov.lease(("state", root_hex))

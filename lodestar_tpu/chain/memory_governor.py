@@ -62,6 +62,7 @@ from __future__ import annotations
 import os
 import threading
 import weakref
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import params
@@ -336,7 +337,8 @@ def memory_snapshot() -> dict:
         "plane_bytes": 0,
         "column_bytes": 0,
         "spill_bytes": 0,
-        "evictions": {"demote": 0, "evict": 0},
+        "aux_bytes": 0,
+        "evictions": {"demote": 0, "evict": 0, "drain": 0},
         "pressure_events": 0,
         "pressure_active": False,
     }
@@ -351,8 +353,9 @@ def memory_snapshot() -> dict:
         out["plane_bytes"] += st["plane_bytes"]
         out["column_bytes"] += st["column_bytes"]
         out["spill_bytes"] += st["spill_bytes"]
-        for tier in ("demote", "evict"):
-            out["evictions"][tier] += st["evictions"][tier]
+        out["aux_bytes"] += st.get("aux_bytes", 0)
+        for tier in ("demote", "evict", "drain"):
+            out["evictions"][tier] += st["evictions"].get(tier, 0)
         out["pressure_events"] += st["pressure_events"]
         out["pressure_active"] |= st["pressure_active"]
     # the device merkleization plane (ssz/device_backend.py): transient
@@ -403,7 +406,14 @@ class StateMemoryGovernor:
         self._strain = 0  # consecutive waves that ended over budget
         self._evictions_since_tick = 0
         self._base_cp_epochs: Optional[int] = None
-        self.evictions = {"demote": 0, "evict": 0}
+        self.evictions = {"demote": 0, "evict": 0, "drain": 0}
+        # aux drainables (proof-bundle caches): byte-accounted into the
+        # budget and emptied FIRST under squeeze — bundles rebuild for
+        # one request each, live states cost a replay
+        self._aux: Dict[str, object] = {}
+        # residency leases: (kind, ...) ledger keys the eviction waves
+        # must skip while a plane read is mid-extraction
+        self._leases: Dict[tuple, int] = {}
 
         r = registry or global_registry()
         self.m_budget = r.gauge(
@@ -437,6 +447,56 @@ class StateMemoryGovernor:
         self.state_cache = state_cache
         self.checkpoint_cache = checkpoint_cache
         self._base_cp_epochs = checkpoint_cache.max_epochs
+
+    # -- aux drainables (proofs/bundle_cache.py) -----------------------------
+
+    def register_aux(self, name: str, cache) -> None:
+        """Register a drainable cache: must expose ``resident_bytes()``
+        and ``drain(target_bytes) -> freed_bytes``.  Its bytes count
+        against the budget, and under squeeze it drains BEFORE any live
+        state demotes."""
+        with self._lock:
+            self._aux[name] = cache
+        self.enforce()
+
+    def unregister_aux(self, name: str) -> None:
+        with self._lock:
+            self._aux.pop(name, None)
+
+    @staticmethod
+    def _aux_bytes_one(cache) -> int:
+        try:
+            return int(cache.resident_bytes())
+        except Exception:  # noqa: BLE001 — a broken aux cache counts
+            # zero rather than wedging enforcement
+            return 0
+
+    def _aux_bytes(self) -> int:
+        return sum(self._aux_bytes_one(c) for c in self._aux.values())
+
+    # -- residency leases ----------------------------------------------------
+
+    @contextmanager
+    def lease(self, *keys):
+        """Hold the given ledger keys (e.g. ``("state", root_hex)``)
+        out of eviction candidacy for the duration — a proof read
+        mid-extraction must not race its state's demotion.  Reentrant
+        and thread-safe; a lease guards candidacy only (its bytes still
+        count against the budget)."""
+        norm = [tuple(k) for k in keys]
+        with self._lock:
+            for k in norm:
+                self._leases[k] = self._leases.get(k, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                for k in norm:
+                    n = self._leases.get(k, 0) - 1
+                    if n <= 0:
+                        self._leases.pop(k, None)
+                    else:
+                        self._leases[k] = n
 
     # -- cache hooks (called by state_cache.py under normal operation) ------
 
@@ -521,9 +581,9 @@ class StateMemoryGovernor:
         entries remain.  Returns wave stats (None = nothing to do)."""
         fire_pressure = None
         with self._lock:
-            if self.budget is None or self.state_cache is None:
+            if self.budget is None:
                 return None
-            if self.ledger.resident_bytes <= self.budget:
+            if self.ledger.resident_bytes + self._aux_bytes() <= self.budget:
                 self._strain = 0
                 return None
             if not self._episode_active:
@@ -535,13 +595,23 @@ class StateMemoryGovernor:
                     "budget_bytes": self.budget,
                     "episode": self._pressure_events,
                 }
+            stats = {"demote": 0, "evict": 0, "drain": 0}
+            # aux drainables empty FIRST: after this pass either the
+            # whole budget overage was absorbed by the caches, or they
+            # are empty and the waves below run on ledger bytes alone
+            self._drain_aux(stats)
             pinned_roots, cp_pinned = self._pins()
-            stats = {"demote": 0, "evict": 0}
-            if pinned_roots is not None:
+            if (
+                pinned_roots is not None
+                and self.state_cache is not None
+                and self.ledger.resident_bytes > self.budget
+            ):
                 self._demote_wave(pinned_roots, cp_pinned, stats)
                 if self.ledger.resident_bytes > self.budget:
                     self._evict_wave(pinned_roots, cp_pinned, stats)
-            over = self.ledger.resident_bytes > self.budget
+            over = (
+                self.ledger.resident_bytes + self._aux_bytes() > self.budget
+            )
             if over:
                 self._strain += 1
                 self._escalate()
@@ -561,16 +631,42 @@ class StateMemoryGovernor:
                 self.log.warn("on_pressure hook failed", error=str(e))
         return result
 
+    def _drain_aux(self, stats: dict) -> None:
+        """Drain every registered aux cache down to the budget headroom
+        the ledger leaves it (0 when the ledger alone is over budget)."""
+        for name, cache in list(self._aux.items()):
+            others = sum(
+                self._aux_bytes_one(c)
+                for n, c in self._aux.items()
+                if n != name
+            )
+            target = max(
+                0, self.budget - self.ledger.resident_bytes - others
+            )
+            if self._aux_bytes_one(cache) <= target:
+                continue
+            try:
+                freed = cache.drain(target)
+            except Exception as e:  # noqa: BLE001 — a broken aux cache
+                # must not wedge the eviction path
+                self.log.warn("aux drain failed", cache=name, error=str(e))
+                continue
+            if freed:
+                self._book("drain", stats)
+
     def _candidates(self, pinned_roots, cp_pinned):
         """Cold-first eviction order: state-LRU oldest first (stale
-        fork tips), then checkpoint entries oldest-epoch first."""
+        fork tips), then checkpoint entries oldest-epoch first.
+        Leased entries (a proof read mid-extraction) are skipped."""
         for root_hex in list(self.state_cache._map.keys()):
-            if root_hex in pinned_roots:
+            if root_hex in pinned_roots or (
+                ("state", root_hex) in self._leases
+            ):
                 continue
             yield ("state", root_hex), root_hex, None
         cp_keys = sorted(self.checkpoint_cache._map.keys())
         for key in cp_keys:
-            if cp_pinned(key[0], key[1]):
+            if cp_pinned(key[0], key[1]) or ("cp",) + key in self._leases:
                 continue
             yield ("cp",) + key, None, key
 
@@ -748,7 +844,8 @@ class StateMemoryGovernor:
             self._reconcile_locked()
             over = (
                 self.budget is not None
-                and self.ledger.resident_bytes > self.budget
+                and self.ledger.resident_bytes + self._aux_bytes()
+                > self.budget
             )
         if over:
             # reconcile surfaced planes the adds never booked — the
@@ -777,7 +874,10 @@ class StateMemoryGovernor:
             if (
                 self._episode_active
                 and quiet
-                and (self.budget is None or resident <= self.budget)
+                and (
+                    self.budget is None
+                    or resident + self._aux_bytes() <= self.budget
+                )
             ):
                 self._episode_active = False
                 self._strain = 0
@@ -851,11 +951,13 @@ class StateMemoryGovernor:
                 "plane_bytes": self.ledger.plane_bytes,
                 "column_bytes": self.ledger.column_bytes,
                 "spill_bytes": self.ledger.spill_bytes,
+                "aux_bytes": self._aux_bytes(),
                 "pinned_bytes": self.m_pinned.value,
                 "pressure_active": self._episode_active,
                 "pressure_level": self.pressure_level,
                 "pressure_events": self._pressure_events,
                 "replay_depth_bound": self.replay_depth_bound,
                 "evictions": dict(self.evictions),
+                "leases": len(self._leases),
                 "entries": {"live": live, "spilled": spilled},
             }

@@ -347,6 +347,16 @@ class FullBeaconNode:
             )
         self.fork_choice = self.chain.fork_choice
         self.light_client_server = LightClientServer(self.chain)
+        # proof-serving data plane: bundle-first light-client + state
+        # proofs, cache registered with the memory governor as a
+        # drainable auxiliary (ISSUE 17)
+        from .proofs import ProofService
+
+        self.proof_service = ProofService(
+            self.chain,
+            light_client_server=self.light_client_server,
+            governor=self.chain.memory_governor,
+        )
         self.archiver = Archiver(self.chain)
 
         # slasher: gossip-fed detection -> op pool (reference deploys
@@ -664,6 +674,17 @@ class FullBeaconNode:
                 if self.flight_recorder is not None:
                     self.flight_recorder.add_provider("memory", gov.status)
 
+            # proof-serving plane: per-source counters + bundle-cache
+            # residency ride the same observability rails
+            if self.proof_service is not None:
+                svc = self.proof_service
+                sampler.add_gauge(
+                    "proof_bundle_bytes",
+                    lambda: float(svc.cache.resident_bytes()),
+                )
+                if self.flight_recorder is not None:
+                    self.flight_recorder.add_provider("proofs", svc.status)
+
         # sync drivers (sources injected per peer/transport); range
         # downloads carry the stall deadline + persistent peer-demotion
         # ledger (network/reqresp.py PeerDemotion)
@@ -771,6 +792,9 @@ class FullBeaconNode:
             # reconcile ride the slot tick (SLO-independent: the
             # governor must close episodes even in minimal compositions)
             self.clock.on_slot(self.chain.memory_governor.on_slot)
+        if self.proof_service is not None:
+            # period-rollover batch pre-render of light-client bundles
+            self.clock.on_slot(self.proof_service.on_slot)
         if self.slasher is not None:
             # per-slot batch flush (earlier flushes trigger at max_batch)
             self.clock.on_slot(self.slasher.on_clock_slot)
@@ -841,6 +865,7 @@ class FullBeaconNode:
                     slasher=self.slasher,
                     slo=self.slo,
                     flight_recorder=self.flight_recorder,
+                    proof_service=self.proof_service,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)

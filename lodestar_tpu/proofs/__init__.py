@@ -1,0 +1,38 @@
+"""Proof-serving data plane — the read surface over the state-root engine.
+
+The state-root engine (state_transition/state_root.py) keeps every
+internal Merkle plane of the hot state resident in `ChunkTree`s.  This
+package turns those warm planes from a cost center into a product
+surface:
+
+  - `plane_reader`: single- and multi-leaf Merkle proofs as O(log n)
+    plane READS with zero re-hashing, returning None when planes are
+    not resident (callers fall through to the `container_branch` host
+    path — a cold or evicted plane can never produce a wrong or
+    missing proof);
+  - `bundle_cache`: a bounded LRU of per-checkpoint proof bundles,
+    byte-accounted into the memory governor (under squeeze it drains
+    BEFORE live states demote);
+  - `service`: the `ProofService` serving `/eth/v1/beacon/light_client/*`
+    and `/eth/v0/beacon/proof/state/*` bundle-first, plane-second,
+    host-last, with per-source accounting.
+"""
+
+from .bundle_cache import ProofBundleCache, estimate_bytes
+from .plane_reader import (
+    pack_multiproof,
+    state_multiproof,
+    state_proof,
+    verify_multiproof,
+)
+from .service import ProofService
+
+__all__ = [
+    "ProofBundleCache",
+    "ProofService",
+    "estimate_bytes",
+    "pack_multiproof",
+    "state_multiproof",
+    "state_proof",
+    "verify_multiproof",
+]

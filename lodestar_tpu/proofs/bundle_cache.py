@@ -1,0 +1,157 @@
+"""Bounded LRU of per-checkpoint proof bundles.
+
+Serving a light-client horde means answering the SAME few questions
+thousands of times per head: the sync-committee update for a period,
+the latest finality/optimistic proof, a handful of state-field proofs.
+The bundle cache memoizes the fully rendered answers, keyed
+(kind, key) — ("lc_update", period), ("finality", head), ("bootstrap",
+block_root), ("state_proof", (head, paths)) — and is invalidated per
+kind when the head moves or a better update lands.
+
+Hygiene contract (tpulint cache-hygiene, which gates this package):
+bounded by BOTH entry count and bytes, LRU-evicted at the bound,
+invalidated on events, and DRAINABLE by the memory governor — under
+squeeze `StateMemoryGovernor` empties this cache (cheap to rebuild,
+one request each) before any live state demotes (expensive to replay).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+
+def estimate_bytes(payload, _depth: int = 0) -> int:
+    """Rough deep byte estimate of a cached payload — the governor's
+    accounting currency.  Exact footprints do not matter; RELATIVE
+    drain pressure and a sane total do."""
+    if _depth > 8:
+        return 64
+    if payload is None or isinstance(payload, (bool, int, float)):
+        return 8
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return len(payload) + 32
+    if isinstance(payload, str):
+        return len(payload) + 48
+    if isinstance(payload, dict):
+        return 64 + sum(
+            estimate_bytes(k, _depth + 1) + estimate_bytes(v, _depth + 1)
+            for k, v in payload.items()
+        )
+    if isinstance(payload, (list, tuple, set, frozenset)):
+        return 56 + sum(estimate_bytes(v, _depth + 1) for v in payload)
+    d = getattr(payload, "__dict__", None)
+    if d is not None:
+        return 64 + estimate_bytes(d, _depth + 1)
+    return 64
+
+
+class ProofBundleCache:
+    """LRU keyed (kind, key), bounded by entries AND bytes, thread-safe
+    (the API server and the chain's event callbacks both touch it)."""
+
+    def __init__(self, max_entries: int = 512, max_bytes: int = 16 << 20):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._map: "OrderedDict[Tuple[str, Any], Tuple[Any, int]]" = (
+            OrderedDict()
+        )
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evicted = 0  # LRU-bound evictions
+        self.invalidated = 0  # event-driven invalidations
+        self.drained = 0  # governor-driven drops
+
+    def get(self, kind: str, key) -> Optional[Any]:
+        with self._lock:
+            entry = self._map.get((kind, key))
+            if entry is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end((kind, key))
+            self.hits += 1
+            return entry[0]
+
+    def peek(self, kind: str, key) -> Optional[Any]:
+        """get() without touching LRU order or hit/miss stats — the
+        period-rollover warmer's presence check."""
+        with self._lock:
+            entry = self._map.get((kind, key))
+            return None if entry is None else entry[0]
+
+    def put(self, kind: str, key, payload, nbytes: Optional[int] = None):
+        size = int(nbytes) if nbytes is not None else estimate_bytes(payload)
+        with self._lock:
+            old = self._map.pop((kind, key), None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._map[(kind, key)] = (payload, size)
+            self._bytes += size
+            self.insertions += 1
+            while self._map and (
+                len(self._map) > self.max_entries
+                or self._bytes > self.max_bytes
+            ):
+                _, (_, freed) = self._map.popitem(last=False)
+                self._bytes -= freed
+                self.evicted += 1
+
+    def invalidate(self, kind: Optional[str] = None, key=None) -> int:
+        """Drop one entry (kind+key), every entry of `kind`, or
+        everything (no arguments).  Returns entries dropped."""
+        with self._lock:
+            if kind is None:
+                n = len(self._map)
+                self._map.clear()
+                self._bytes = 0
+            elif key is not None:
+                entry = self._map.pop((kind, key), None)
+                n = 0 if entry is None else 1
+                if entry is not None:
+                    self._bytes -= entry[1]
+            else:
+                doomed = [k for k in self._map if k[0] == kind]
+                for k in doomed:
+                    self._bytes -= self._map.pop(k)[1]
+                n = len(doomed)
+            self.invalidated += n
+            return n
+
+    # -- governor seam (StateMemoryGovernor.register_aux) -------------------
+
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def drain(self, target_bytes: int = 0) -> int:
+        """Evict LRU-first until resident bytes <= target — the squeeze
+        hook: bundles are cheap to rebuild (one request each), so the
+        cache empties before any live state demotes.  Returns bytes
+        freed."""
+        floor = max(0, int(target_bytes))
+        freed = 0
+        with self._lock:
+            while self._map and self._bytes > floor:
+                _, (_, size) = self._map.popitem(last=False)
+                self._bytes -= size
+                freed += size
+                self.drained += 1
+        return freed
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._map),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else None,
+                "insertions": self.insertions,
+                "evicted": self.evicted,
+                "invalidated": self.invalidated,
+                "drained": self.drained,
+            }

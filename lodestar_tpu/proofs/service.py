@@ -1,0 +1,309 @@
+"""ProofService — the bundle-first, plane-second, host-last proof server.
+
+Reference: packages/beacon-node/src/api/impl/lightclient/index.ts and
+api/impl/proof/index.ts, which answer every light-client request by
+re-walking the persistent merkle tree.  Here the answers are layered by
+cost instead:
+
+  1. **bundle** — the fully rendered JSON payload from the
+     `ProofBundleCache` (a dict lookup; a light-client horde asks the
+     SAME few questions thousands of times per head),
+  2. **plane** — O(log n) sibling reads off the warm state-root engine
+     (`proofs.plane_reader`), zero re-hashing,
+  3. **host** — the `container_branch`/`container_branches` fallback,
+     which ALWAYS completes, so a cold cache and an evicted plane can
+     only cost latency, never correctness.
+
+Every answer increments exactly one source counter; the bench and the
+chaos harness assert on that accounting.  The cache registers with the
+memory governor as a drainable auxiliary: under squeeze the bundles go
+first, live states last.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+from typing import List, Optional, Sequence
+
+from ..light_client.lightclient import sync_period
+from ..ssz.core import container_branches
+from ..utils.logger import get_logger
+from .bundle_cache import ProofBundleCache
+from .plane_reader import pack_multiproof, state_multiproof
+
+# period-rollover warmer: how many trailing periods to pre-render
+WARM_PERIODS = 2
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+class ProofService:
+    """Serves light-client payloads and state-field Merkle proofs.
+
+    Wired between the API handlers and the `LightClientServer`: the
+    handlers delegate here first and keep their own paths as the
+    no-service fallback.  Subscribes to chain events for cache
+    invalidation (head movement stales finality/optimistic/state
+    proofs; a better update stales its period's bundle)."""
+
+    def __init__(
+        self,
+        chain,
+        light_client_server=None,
+        governor=None,
+        cache: Optional[ProofBundleCache] = None,
+        max_bundle_entries: int = 512,
+        max_bundle_bytes: int = 16 << 20,
+    ):
+        self.chain = chain
+        self.lc = light_client_server
+        self.governor = governor
+        self.log = get_logger("proofs/service")
+        self.cache = (
+            cache
+            if cache is not None
+            else ProofBundleCache(
+                max_entries=max_bundle_entries, max_bytes=max_bundle_bytes
+            )
+        )
+        # per-source answer accounting (fixed key set, counters only)
+        self.sources = {"bundle": 0, "plane": 0, "host": 0}
+        self.batch_generated = 0  # period-rollover pre-renders
+        self._last_period: Optional[int] = None
+        if governor is not None and hasattr(governor, "register_aux"):
+            governor.register_aux("proof_bundles", self.cache)
+        emitter = getattr(chain, "emitter", None) if chain is not None else None
+        if emitter is not None:
+            # deferred import: this module is reachable from chain/
+            # submodules via the package __init__
+            from ..chain.emitter import ChainEvent
+
+            emitter.on(ChainEvent.head, self._on_head)
+            emitter.on(ChainEvent.light_client_update, self._on_lc_update)
+
+    # -- invalidation ------------------------------------------------------
+
+    def _on_head(self, root: bytes, slot: int) -> None:
+        # head-anchored payloads are stale the moment the head moves
+        self.cache.invalidate("finality")
+        self.cache.invalidate("optimistic")
+        self.cache.invalidate("state_proof")
+
+    def _on_lc_update(self, update) -> None:
+        # a better update may have replaced this period's best; the
+        # latest finality/optimistic payloads certainly changed
+        period = sync_period(int(update.attested_header["slot"]))
+        self.cache.invalidate("lc_update", period)
+        self.cache.invalidate("finality")
+        self.cache.invalidate("optimistic")
+
+    # -- rendering helpers (the api/server.py response shapes) -------------
+
+    def _version(self, slot: int) -> str:
+        config = getattr(self.chain, "config", None)
+        if config is None:
+            return "altair"
+        return config.get_fork_name(int(slot)).value
+
+    def _render_update(self, upd) -> dict:
+        from ..api.encoding import to_json
+        from ..network.reqresp_protocols import (
+            LightClientUpdateType,
+            light_client_update_to_value,
+        )
+
+        return to_json(
+            LightClientUpdateType, light_client_update_to_value(upd)
+        )
+
+    def _update_item(self, upd) -> dict:
+        slot = int(upd.attested_header["slot"])
+        return {
+            "version": self._version(slot),
+            "data": self._render_update(upd),
+        }
+
+    # -- light-client serving ----------------------------------------------
+
+    def light_client_updates(self, start: int, count: int) -> List[dict]:
+        """Rendered {version, data} items for [start, start+count) —
+        periods without a best update are skipped (API contract)."""
+        out: List[dict] = []
+        for period in range(int(start), int(start) + int(count)):
+            item = self.cache.get("lc_update", period)
+            if item is not None:
+                self.sources["bundle"] += 1
+                out.append(item)
+                continue
+            upd = self.lc.get_update(period) if self.lc is not None else None
+            if upd is None:
+                continue
+            item = self._update_item(upd)
+            # attribution: the expensive branch extraction happened at
+            # production time (LightClientServer counts plane vs host);
+            # a fresh render here is a host-side pass
+            self.sources["host"] += 1
+            self.cache.put("lc_update", period, item)
+            out.append(item)
+        return out
+
+    def finality_update(self) -> Optional[dict]:
+        return self._latest("finality", "get_finality_update")
+
+    def optimistic_update(self) -> Optional[dict]:
+        return self._latest("optimistic", "get_optimistic_update")
+
+    def _latest(self, kind: str, getter: str) -> Optional[dict]:
+        item = self.cache.get(kind, "latest")
+        if item is not None:
+            self.sources["bundle"] += 1
+            return item
+        if self.lc is None:
+            return None
+        upd = getattr(self.lc, getter)()
+        if upd is None:
+            return None
+        item = self._render_update(upd)
+        self.sources["host"] += 1
+        self.cache.put(kind, "latest", item)
+        return item
+
+    def bootstrap(self, block_root: bytes) -> Optional[dict]:
+        """Rendered LightClientBootstrap for a trusted block root."""
+        key = bytes(block_root)
+        item = self.cache.get("bootstrap", key)
+        if item is not None:
+            self.sources["bundle"] += 1
+            return item
+        if self.lc is None:
+            return None
+        planes_before = getattr(self.lc, "plane_proofs", 0)
+        boot = self.lc.get_bootstrap(key)
+        if boot is None:
+            return None
+        from ..api.encoding import to_json
+        from ..network.reqresp_protocols import LightClientBootstrapType
+
+        item = to_json(LightClientBootstrapType, boot)
+        if getattr(self.lc, "plane_proofs", 0) > planes_before:
+            self.sources["plane"] += 1
+        else:
+            self.sources["host"] += 1
+        self.cache.put("bootstrap", key, item)
+        return item
+
+    # -- state-field proofs -------------------------------------------------
+
+    def state_proof_data(self, state, paths: Sequence[Sequence[str]]) -> dict:
+        """Response payload for /eth/v0/beacon/proof/state.
+
+        One path keeps the original single-proof shape ({leaf, branch,
+        depth, index, state_root}); several paths add a proofs list and
+        the deduped descending multiproof.  Raises KeyError/ValueError/
+        TypeError on a bad path (the handler's 400)."""
+        paths = [list(p) for p in paths]
+        root_hex = getattr(self.chain, "head_root_hex", "")
+        key = (root_hex, tuple(".".join(str(s) for s in p) for p in paths))
+        item = self.cache.get("state_proof", key)
+        if item is not None:
+            self.sources["bundle"] += 1
+            return item
+        with self._lease(root_hex):
+            proofs = state_multiproof(state, paths)
+        if proofs is not None:
+            self.sources["plane"] += 1
+        else:
+            # host path raises on a bad path — the plane reader returns
+            # None for those, so errors surface exactly once, here
+            proofs = container_branches(
+                state._container(), state.to_value(), paths
+            )
+            self.sources["host"] += 1
+        state_root = state.hash_tree_root()
+        item = self._render_proofs(paths, proofs, state_root)
+        self.cache.put("state_proof", key, item)
+        return item
+
+    @staticmethod
+    def _render_proofs(paths, proofs, state_root: bytes) -> dict:
+        rendered = [
+            {
+                "path": ".".join(str(s) for s in path),
+                "leaf": _hex(leaf),
+                "branch": [_hex(b) for b in branch],
+                "depth": depth,
+                "index": index,
+            }
+            for path, (leaf, branch, depth, index) in zip(paths, proofs)
+        ]
+        if len(proofs) == 1:
+            one = dict(rendered[0])
+            del one["path"]
+            one["state_root"] = _hex(state_root)
+            return one
+        packed = pack_multiproof(proofs)
+        return {
+            "state_root": _hex(state_root),
+            "proofs": rendered,
+            "multiproof": {
+                "leaves": [
+                    {"gindex": str(g), "node": _hex(n)}
+                    for g, n in packed["leaves"].items()
+                ],
+                "helpers": [
+                    {"gindex": str(g), "node": _hex(n)}
+                    for g, n in packed["helpers"]
+                ],
+            },
+        }
+
+    def _lease(self, root_hex: str):
+        gov = self.governor
+        if gov is None:
+            gov = getattr(self.chain, "memory_governor", None)
+        if gov is None or not hasattr(gov, "lease") or not root_hex:
+            return nullcontext()
+        return gov.lease(("state", root_hex))
+
+    # -- period rollover batch generation ----------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        """At a sync-period rollover, pre-render the trailing periods'
+        best updates into the bundle cache so the first horde request
+        after the boundary is a bundle hit, not a render stampede."""
+        period = sync_period(int(slot))
+        if period == self._last_period:
+            return
+        first_tick = self._last_period is None
+        self._last_period = period
+        if first_tick or self.lc is None:
+            return
+        warmed = 0
+        for p in range(max(0, period - WARM_PERIODS), period):
+            if self.cache.peek("lc_update", p) is not None:
+                continue
+            upd = self.lc.get_update(p)
+            if upd is None:
+                continue
+            self.cache.put("lc_update", p, self._update_item(upd))
+            warmed += 1
+        if warmed:
+            self.batch_generated += warmed
+            self.log.info(
+                "light-client bundles pre-rendered",
+                period=period,
+                warmed=warmed,
+            )
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        total = sum(self.sources.values())
+        return {
+            "requests": total,
+            "sources": dict(self.sources),
+            "batch_generated": self.batch_generated,
+            "cache": self.cache.stats(),
+        }

@@ -327,6 +327,31 @@ class ChunkTree:
             return bytes(32)
         return bytes(self._levels[0][index])
 
+    def branch(self, index: int) -> List[bytes]:
+        """Sibling path for leaf `index`, bottom-up — O(depth) plane
+        READS, zero hashing (the proof-serving read path,
+        proofs/plane_reader.py).  Valid for any index inside the padded
+        leaf space: siblings beyond the live count come from the
+        zero-hash table, the same padding rule update() hashes under,
+        so the path verifies against `self.root` even in the padding
+        region."""
+        if not (0 <= index < _next_pow2(self.limit_chunks)):
+            raise IndexError(
+                f"leaf index {index} outside padded leaf space "
+                f"{_next_pow2(self.limit_chunks)}"
+            )
+        out: List[bytes] = []
+        pos = index
+        for level in range(self.depth):
+            sib = pos ^ 1
+            plane = self._levels[level]
+            if sib < self._rows_at(level):
+                out.append(bytes(plane[sib]))
+            else:
+                out.append(_ZERO_HASHES[level])
+            pos >>= 1
+        return out
+
     # -- reference check ---------------------------------------------------
 
     def full_root_reference(self, chunks: Optional[Sequence[bytes]] = None) -> bytes:

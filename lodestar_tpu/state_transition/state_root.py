@@ -97,9 +97,13 @@ class _PackedCell:
     def __init__(self, limit_chunks: int, mixin: bool):
         self.tree = ChunkTree(limit_chunks)
         self.mixin = mixin
+        # element count as of the last root() — the mixin length a
+        # plane-read proof must append (proofs/plane_reader.py)
+        self.length = 0
 
     def root(self, plane: np.ndarray, length: int) -> bytes:
         self.tree.update(plane)
+        self.length = length
         r = self.tree.root
         return _mix_in_length(r, length) if self.mixin else r
 
@@ -107,6 +111,7 @@ class _PackedCell:
         out = _PackedCell.__new__(_PackedCell)
         out.tree = self.tree.clone()
         out.mixin = self.mixin
+        out.length = self.length
         return out
 
     def plane_bytes(self, seen: set) -> int:
@@ -323,12 +328,16 @@ class StateRootEngine:
         self.cells: Dict[str, _PackedCell] = {}
         # fname -> (serialized bytes, root) for every non-columnar field
         self.memo: Dict[str, tuple] = {}
+        # top-level tree over the per-field root chunks — the root-most
+        # planes the proof-serving plane reads field branches from
+        self.top: Optional[ChunkTree] = None
 
     def clone(self) -> "StateRootEngine":
         out = StateRootEngine.__new__(StateRootEngine)
         out.validators = self.validators.clone()
         out.cells = {k: v.clone() for k, v in self.cells.items()}
         out.memo = dict(self.memo)
+        out.top = self.top.clone() if self.top is not None else None
         return out
 
     # -- mutation-surface hints (performance only, never correctness) ------
@@ -393,7 +402,26 @@ class StateRootEngine:
             self._field_root(state, fname, ftype)
             for fname, ftype in container.fields
         ]
-        return merkleize_chunks(chunks)
+        # ChunkTree(n) pads to the same next-pow2 leaf count
+        # merkleize_chunks(chunks) does, so the root is bit-identical —
+        # but the internal planes stay resident for O(log n) field
+        # branches (proofs/plane_reader.py)
+        top = self.top
+        if top is None or top.limit_chunks != len(chunks):
+            top = self.top = ChunkTree(len(chunks))
+        top.update(np.frombuffer(b"".join(chunks), _U8).reshape(-1, 32))
+        return top.root
+
+    def leaf_cell(self, fname: str):
+        """(tree, length, mixin) for a ChunkTree-backed field as of the
+        last hash_tree_root(), or None for memo-backed fields."""
+        if fname == "validators":
+            v = self.validators
+            return (v.tree, v.count, True)
+        cell = self.cells.get(fname)
+        if cell is None:
+            return None
+        return (cell.tree, cell.length, cell.mixin)
 
     def engine_bytes(self, seen: Optional[set] = None) -> int:
         """Live ChunkTree plane bytes held by this engine.  Thread one
@@ -403,6 +431,8 @@ class StateRootEngine:
         total = self.validators.plane_bytes(seen)
         for cell in self.cells.values():
             total += cell.plane_bytes(seen)
+        if self.top is not None:
+            total += self.top.plane_bytes(seen)
         return total
 
     def iter_planes(self):
@@ -413,6 +443,8 @@ class StateRootEngine:
         yield from self.validators.planes()
         for cell in self.cells.values():
             yield from cell.planes()
+        if self.top is not None:
+            yield from self.top.planes()
 
     def release_planes(self) -> int:
         """Tier-1 demotion (chain/memory_governor.py): free every
@@ -425,6 +457,7 @@ class StateRootEngine:
         self.validators = _ValidatorsCell()
         self.cells = {}
         self.memo = {}
+        self.top = None
         return freed
 
 

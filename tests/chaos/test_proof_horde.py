@@ -1,0 +1,297 @@
+"""Chaos scenario: memory squeeze under a light-client horde (ISSUE 17
+acceptance).
+
+A churn phase with participating sync aggregates makes the
+LightClientServer produce plane-served updates; a synthetic horde of
+light clients then hammers the ProofService with mixed request shapes
+(bootstrap / updates-by-range / optimistic / state proofs).  The budget
+is tightened mid-horde: the governor must drain the proof-bundle cache
+FIRST (the "drain" ladder tier fires before any state demotes for the
+aux bytes), the service degrades to host-path serving with ZERO wrong
+proofs (every branch still verifies against its anchoring root), the
+SLO reports exactly one degraded source for the episode, and the whole
+scenario replays bit-for-bit from its trace.
+"""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu import types as T
+from lodestar_tpu.chain.light_client_server import LightClientServer
+from lodestar_tpu.light_client.lightclient import (
+    NEXT_SYNC_COMMITTEE_DEPTH,
+    NEXT_SYNC_COMMITTEE_INDEX,
+)
+from lodestar_tpu.observability import flight_recorder as FR
+from lodestar_tpu.proofs import ProofService, verify_multiproof
+from lodestar_tpu.ssz import is_valid_merkle_branch
+
+from chaos.harness import ScenarioTrace, StateWorld, assert_replay
+
+pytestmark = pytest.mark.smoke
+
+P = params.ACTIVE_PRESET
+SEED = 1701
+CHURN_SLOTS = 6
+HORDE_CLIENTS = 8
+
+STATE_PROOF_SHAPES = [
+    [["finalized_checkpoint", "root"]],
+    [["slot"], ["next_sync_committee"]],
+    [["balances", "0"], ["finalized_checkpoint", "epoch"], ["slot"]],
+]
+
+
+def _sync_block(world, slot):
+    """A head block with FULL sync participation (fake signature — the
+    world's stub verifier owns crypto) so the LightClientServer
+    produces an update for it."""
+    from lodestar_tpu.chain.produce_block import produce_block
+
+    parent_hex = world.chain.head_root_hex
+    parent_state = world.chain.regen._get_post_state(parent_hex)
+    randao = hashlib.sha256(b"horde randao %d" % slot).digest() * 3
+    block, _post = produce_block(
+        parent_state,
+        slot,
+        randao,
+        sync_aggregate={
+            "sync_committee_bits": [True] * P.SYNC_COMMITTEE_SIZE,
+            "sync_committee_signature": bytes([0xC0]) + b"\x00" * 95,
+        },
+    )
+    signed = {"message": block, "signature": b"\x00" * 96}
+    root = world.chain.process_block(signed)
+    world.expected_roots[root.hex()] = block["state_root"].hex()
+    return root
+
+
+def _verify_update(upd) -> bool:
+    """The light client's own acceptance math: the produced
+    next-sync-committee branch must bind to the attested state root."""
+    leaf = T.SyncCommittee.hash_tree_root(upd.next_sync_committee)
+    return is_valid_merkle_branch(
+        leaf,
+        upd.next_sync_committee_branch,
+        NEXT_SYNC_COMMITTEE_DEPTH,
+        NEXT_SYNC_COMMITTEE_INDEX,
+        upd.attested_header["state_root"],
+    )
+
+
+def _verify_state_proof_data(data, root) -> bool:
+    """Every proof in a state_proof_data payload verifies against the
+    reported state root (single- and multi-path shapes)."""
+    if data["state_root"] != "0x" + root.hex():
+        return False
+    singles = data["proofs"] if "proofs" in data else [data]
+    for p in singles:
+        ok = is_valid_merkle_branch(
+            bytes.fromhex(p["leaf"][2:]),
+            [bytes.fromhex(b[2:]) for b in p["branch"]],
+            p["depth"],
+            p["index"],
+            root,
+        )
+        if not ok:
+            return False
+    if "multiproof" in data:
+        leaves = {
+            int(x["gindex"]): bytes.fromhex(x["node"][2:])
+            for x in data["multiproof"]["leaves"]
+        }
+        helpers = [
+            (int(x["gindex"]), bytes.fromhex(x["node"][2:]))
+            for x in data["multiproof"]["helpers"]
+        ]
+        if not verify_multiproof(leaves, helpers, root):
+            return False
+    return True
+
+
+def _horde_round(world, service, trace, label):
+    """One pass of the synthetic horde: each client issues a mixed
+    request shape; every served proof is verified.  Emits one event
+    with the wrong-proof count (must be 0) and the served totals."""
+    lc = service.lc
+    head_root = world.chain.get_head_root()
+    head_state = world.chain.head_state
+    state_root = head_state.hash_tree_root()
+    wrong = served = 0
+    for i in range(HORDE_CLIENTS):
+        shape = i % 4
+        if shape == 0:  # bootstrap from the trusted head root
+            boot = service.bootstrap(head_root)
+            if boot is not None:
+                served += 1
+                host = lc.get_bootstrap(head_root)
+                leaf = T.SyncCommittee.hash_tree_root(
+                    host["current_sync_committee"]
+                )
+                if not is_valid_merkle_branch(
+                    leaf,
+                    host["current_sync_committee_branch"],
+                    NEXT_SYNC_COMMITTEE_DEPTH,
+                    NEXT_SYNC_COMMITTEE_INDEX - 1,
+                    bytes(host["header"]["state_root"]),
+                ):
+                    wrong += 1
+        elif shape == 1:  # updates by range
+            items = service.light_client_updates(0, 2)
+            for _item in items:
+                served += 1
+            if not all(
+                _verify_update(lc.get_update(p))
+                for p in lc.best_update_by_period
+            ):
+                wrong += 1
+        elif shape == 2:  # optimistic (finality pre-finalization: 404)
+            item = service.optimistic_update()
+            if item is not None:
+                served += 1
+                if not _verify_update(lc.get_optimistic_update()):
+                    wrong += 1
+            if service.finality_update() is not None:
+                served += 1
+        else:  # state-field proofs, rotating shapes
+            paths = STATE_PROOF_SHAPES[i % len(STATE_PROOF_SHAPES)]
+            data = service.state_proof_data(head_state, paths)
+            served += 1
+            if not _verify_state_proof_data(data, state_root):
+                wrong += 1
+    trace.emit(
+        label,
+        served=served,
+        wrong_proofs=wrong,
+        sources=dict(service.sources),
+        cache_entries=service.cache.stats()["entries"],
+    )
+
+
+def _run(trace, fr_dir):
+    world = StateWorld(fr_dir, seed=trace.seed)
+    gov = world.governor
+    assert gov is not None, "governor must be default-on"
+    lc = LightClientServer(world.chain)
+    service = ProofService(
+        world.chain, light_client_server=lc, governor=gov
+    )
+    try:
+        # phase 1: churn with full sync participation -> the
+        # LightClientServer extracts branches off the warm planes
+        for _ in range(CHURN_SLOTS):
+            slot = world.tick_slot()
+            _sync_block(world, slot)
+        trace.emit(
+            "produced",
+            updates=lc.produced,
+            plane_proofs=lc.plane_proofs,
+            host_proofs=lc.host_proofs,
+            aux_accounted=gov.status()["aux_bytes"] >= 0,
+        )
+
+        # phase 2: horde A against the warm plane + filling bundles,
+        # then again so repeats hit the bundle tier
+        _horde_round(world, service, trace, "horde_warm")
+        _horde_round(world, service, trace, "horde_repeat")
+
+        # phase 3: the squeeze — budget to half the CURRENT total; the
+        # bundle cache must drain before any live state demotes
+        working_set = gov.ledger.resident_bytes
+        bundle_bytes = service.cache.resident_bytes()
+        budget = working_set // 2
+        gov.set_budget(budget)
+        st = world.slo.status()
+        degraded = [
+            k for k, v in st["degraded_sources"].items() if v
+        ]
+        trace.emit(
+            "squeeze",
+            bundle_bytes_before=bundle_bytes > 0,
+            cache_drained=service.cache.resident_bytes() == 0,
+            drain_tier_fired=gov.evictions["drain"] > 0,
+            within_budget=(
+                gov.ledger.resident_bytes + service.cache.resident_bytes()
+                <= budget
+            ),
+            episode_open=gov.pressure_active,
+            slo_status=st["status"],
+            degraded_sources=degraded,
+        )
+
+        # phase 4: horde B under pressure — bundles are gone, old
+        # states may be demoted; everything re-serves (host tier rises)
+        # and still verifies
+        host_before = service.sources["host"]
+        _horde_round(world, service, trace, "horde_squeezed")
+        trace.emit(
+            "degraded_serving",
+            host_grew=service.sources["host"] > host_before,
+            total_plane=service.sources["plane"] + lc.plane_proofs,
+        )
+
+        # phase 5: quiet ticks close the episode; one bundle for the
+        # whole squeeze
+        world.tick_slot()
+        world.tick_slot()
+        st = world.slo.status()
+        bundles = FR.list_bundles(world.recorder.directory)
+        trace.emit(
+            "settled",
+            slo_status=st["status"],
+            episode_open=gov.pressure_active,
+            pressure_events=gov._pressure_events,
+            flight_bundles=len(bundles),
+            bundle_reason=bundles[0]["reason"] if bundles else None,
+        )
+    finally:
+        world.close()
+
+
+def test_proof_horde_memory_squeeze(tmp_path):
+    trace = ScenarioTrace(SEED)
+    _run(trace, tmp_path / "fr-record")
+    ev = {e["kind"]: e for e in trace.events}
+
+    # churn produced plane-served updates (zero host fallbacks while
+    # the engines are warm)
+    assert ev["produced"]["updates"] == CHURN_SLOTS
+    assert ev["produced"]["plane_proofs"] == CHURN_SLOTS
+    assert ev["produced"]["host_proofs"] == 0
+    assert ev["produced"]["aux_accounted"] is True
+
+    # horde A: zero wrong proofs; the repeat round served bundles
+    assert ev["horde_warm"]["wrong_proofs"] == 0
+    assert ev["horde_warm"]["served"] > 0
+    assert ev["horde_warm"]["sources"]["plane"] > 0
+    assert ev["horde_repeat"]["wrong_proofs"] == 0
+    assert ev["horde_repeat"]["sources"]["bundle"] > 0
+    assert ev["horde_repeat"]["cache_entries"] > 0
+
+    # the squeeze: bundles drained FIRST and completely, the drain
+    # ladder tier fired, total residency (ledger + aux) converged
+    assert ev["squeeze"]["bundle_bytes_before"] is True
+    assert ev["squeeze"]["cache_drained"] is True
+    assert ev["squeeze"]["drain_tier_fired"] is True
+    assert ev["squeeze"]["within_budget"] is True
+    assert ev["squeeze"]["episode_open"] is True
+    # exactly ONE degraded source reports the whole episode
+    assert ev["squeeze"]["slo_status"] == "degraded"
+    assert ev["squeeze"]["degraded_sources"] == ["state_memory"]
+
+    # horde B: still zero wrong proofs, host tier absorbed the misses
+    assert ev["horde_squeezed"]["wrong_proofs"] == 0
+    assert ev["degraded_serving"]["host_grew"] is True
+
+    # the episode closed, health returned, one flight bundle
+    assert ev["settled"]["slo_status"] == "ok"
+    assert ev["settled"]["episode_open"] is False
+    assert ev["settled"]["pressure_events"] == 1
+    assert ev["settled"]["flight_bundles"] == 1
+    assert ev["settled"]["bundle_reason"] == "event.state_memory_pressure"
+
+    # record/replay: the saved scenario reproduces bit-for-bit
+    record = trace.save(tmp_path / "scenario_proof_horde.json")
+    assert_replay(record, lambda t: _run(t, tmp_path / "fr-replay"))

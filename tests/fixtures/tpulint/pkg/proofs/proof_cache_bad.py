@@ -1,0 +1,19 @@
+"""cache-hygiene positives in a proofs/ module: a proof-bundle cache
+that memoizes rendered payloads per (kind, key) and never evicts,
+invalidates, or drains — every head adds entries for the process
+lifetime."""
+
+
+class UnboundedBundleCache:
+    """Bundle map grown per request, no bound, no invalidation."""
+
+    def __init__(self):
+        self.bundles = {}  # (kind, key) -> payload, grows forever
+        self.recent_keys = []  # appended per request, never trimmed
+
+    def put(self, kind, key, payload):
+        self.bundles[(kind, key)] = payload
+        self.recent_keys.append((kind, key))
+
+    def get(self, kind, key):
+        return self.bundles.get((kind, key))

@@ -1,0 +1,35 @@
+"""cache-hygiene negatives in a proofs/ module: the bundle-cache
+contract done right — bounded at insert, invalidated on events, and
+drainable by a governor."""
+
+from collections import OrderedDict
+
+
+class GovernedBundleCache:
+    """Count-bounded via a max_* constructor argument AND drainable."""
+
+    def __init__(self, max_entries=128):
+        self.max_entries = max_entries
+        self.bundles = OrderedDict()
+
+    def put(self, kind, key, payload):
+        self.bundles[(kind, key)] = payload
+        while len(self.bundles) > self.max_entries:
+            self.bundles.popitem(last=False)
+
+    def drain(self):
+        self.bundles.clear()
+
+
+class InvalidatedBundles:
+    """Shrink methods reachable on the attribute itself."""
+
+    def __init__(self):
+        self.by_kind = {}
+
+    def put(self, kind, key, payload):
+        self.by_kind[(kind, key)] = payload
+
+    def invalidate(self, kind):
+        for k in [k for k in self.by_kind if k[0] == kind]:
+            self.by_kind.pop(k)

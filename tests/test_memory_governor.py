@@ -369,7 +369,7 @@ def test_memory_snapshot_aggregates(cfg, genesis):
     snap = memory_snapshot()
     assert snap["governors"] >= 1
     assert snap["resident_bytes"] >= gov.ledger.resident_bytes > 0
-    assert set(snap["evictions"]) == {"demote", "evict"}
+    assert set(snap["evictions"]) == {"demote", "evict", "drain"}
 
 
 def test_release_planes_rebuilds_bit_identical(genesis):
@@ -627,7 +627,7 @@ def test_bench_failure_records_carry_memory_snapshot(capsys, monkeypatch):
     bench._emit_failure("run", "stub failure")
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "memory" in rec
-    assert set(rec["memory"]["evictions"]) == {"demote", "evict"}
+    assert set(rec["memory"]["evictions"]) == {"demote", "evict", "drain"}
 
 
 @pytest.mark.slow

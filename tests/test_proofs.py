@@ -1,0 +1,511 @@
+"""Proof-serving data plane (proofs/): plane reads vs the host oracle.
+
+The load-bearing invariant: every proof served off the warm engine
+planes is BIT-IDENTICAL to `container_branch`/`container_branches`,
+and every situation the planes cannot serve returns None (never a
+wrong proof) so the host path completes the request.
+"""
+
+import random
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.memory_governor import StateMemoryGovernor
+from lodestar_tpu.config import MAINNET_CHAIN_CONFIG, create_chain_config
+from lodestar_tpu.crypto import bls as B
+from lodestar_tpu.crypto import curves as C
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.proofs import (
+    ProofBundleCache,
+    ProofService,
+    estimate_bytes,
+    pack_multiproof,
+    state_multiproof,
+    state_proof,
+    verify_multiproof,
+)
+from lodestar_tpu.ssz import is_valid_merkle_branch
+from lodestar_tpu.ssz.core import container_branch, container_branches
+from lodestar_tpu.state_transition import create_genesis_state, process_slots
+from lodestar_tpu.utils.metrics import Registry
+
+P = params.ACTIVE_PRESET
+N_KEYS = 16
+
+
+@pytest.fixture(scope="module")
+def warm_state():
+    cfg = create_chain_config(
+        MAINNET_CHAIN_CONFIG, fork_epochs={ForkName.altair: 0}
+    )
+    pks = [
+        C.g1_compress(B.sk_to_pk(B.keygen(b"proofs-%d" % i)))
+        for i in range(N_KEYS)
+    ]
+    state = create_genesis_state(cfg, pks, genesis_time=7)
+    process_slots(state, 3)  # populate block/state root history
+    state.hash_tree_root()  # warm the engine planes
+    return state
+
+
+# paths the planes serve directly: top-level leaves, packed-cell chunk
+# indices (with and without a length mix-in), and nested memo fields
+PLANE_PATHS = [
+    ["slot"],
+    ["genesis_time"],
+    ["validators"],
+    ["balances"],
+    ["current_sync_committee"],
+    ["next_sync_committee"],
+    ["finalized_checkpoint"],
+    ["finalized_checkpoint", "root"],
+    ["finalized_checkpoint", "epoch"],
+    ["latest_block_header", "state_root"],
+    ["fork", "current_version"],
+    ["balances", "0"],
+    ["validators", "3"],
+    ["block_roots", "5"],
+    ["state_roots", "0"],
+    ["randao_mixes", "7"],
+    ["slashings", "0"],
+    ["inactivity_scores", "0"],
+    ["previous_epoch_participation", "0"],
+]
+
+
+def test_plane_proofs_bit_identical_to_host(warm_state):
+    st = warm_state
+    value = st.to_value()
+    ctype = st._container()
+    root = st.hash_tree_root()
+    for path in PLANE_PATHS:
+        got = state_proof(st, path)
+        assert got is not None, f"plane path unservable: {path}"
+        want = container_branch(ctype, value, path)
+        assert got == want, f"mismatch at {path}"
+        leaf, branch, depth, index = got
+        assert is_valid_merkle_branch(leaf, branch, depth, index, root), path
+
+
+def test_multiproof_matches_container_branches(warm_state):
+    st = warm_state
+    paths = [
+        ["next_sync_committee"],
+        ["finalized_checkpoint", "root"],
+        ["current_sync_committee"],
+    ]
+    got = state_multiproof(st, paths)
+    assert got is not None
+    want = container_branches(st._container(), st.to_value(), paths)
+    assert got == want
+
+
+def test_plane_proof_random_chunk_indices(warm_state):
+    """Random leaf indices across each packed field's PADDED leaf
+    space — beyond-live indices prove zero chunks, exactly like the
+    host oracle."""
+    st = warm_state
+    value = st.to_value()
+    ctype = st._container()
+    root = st.hash_tree_root()
+    rng = random.Random(17)
+    engine = st._root_engine
+    for fname in (
+        "balances",
+        "validators",
+        "block_roots",
+        "randao_mixes",
+        "slashings",
+        "inactivity_scores",
+    ):
+        tree, _length, _mixin = engine.leaf_cell(fname)
+        pad = 1 << tree.depth
+        for ci in {0, pad - 1, rng.randrange(pad), rng.randrange(pad)}:
+            path = [fname, str(ci)]
+            got = state_proof(st, path)
+            assert got is not None, path
+            assert got == container_branch(ctype, value, path), path
+            leaf, branch, depth, index = got
+            assert is_valid_merkle_branch(
+                leaf, branch, depth, index, root
+            ), path
+
+
+def test_plane_proof_stays_current_after_mutation(warm_state):
+    """Advance the state (dirty tracking -> incremental resync): plane
+    proofs must follow the NEW root, still bit-identical to host."""
+    st = warm_state.clone()
+    process_slots(st, int(st.slot) + 2)
+    root = st.hash_tree_root()
+    for path in (["slot"], ["state_roots", "1"], ["latest_block_header"]):
+        got = state_proof(st, path)
+        assert got is not None
+        assert got == container_branch(st._container(), st.to_value(), path)
+        leaf, branch, depth, index = got
+        assert is_valid_merkle_branch(leaf, branch, depth, index, root)
+
+
+def test_unservable_paths_return_none_not_wrong(warm_state):
+    st = warm_state
+    # unknown field, deep path into a packed cell, out-of-tree index
+    assert state_proof(st, ["no_such_field"]) is None
+    assert state_proof(st, ["balances", "0", "x"]) is None
+    engine = st._root_engine
+    tree, _, _ = engine.leaf_cell("balances")
+    assert state_proof(st, ["balances", str(1 << tree.depth)]) is None
+    # all-or-nothing multiproof: one bad path fails the whole batch
+    assert state_multiproof(st, [["slot"], ["no_such_field"]]) is None
+    # expected-root mismatch (serving a stale snapshot is worse than
+    # falling through)
+    assert state_proof(st, ["slot"], expected_root=b"\x00" * 32) is None
+
+
+def test_released_planes_fall_through_to_host(warm_state):
+    """The post-eviction contract: a state whose engine planes were
+    released (the governor's demote path calls release_planes) serves
+    None from the plane reader while the host path still completes."""
+    st = warm_state.clone()
+    st.hash_tree_root()
+    assert state_proof(st, ["slot"]) is not None
+    st._root_engine.release_planes()
+    assert state_proof(st, ["slot"]) is None
+    st2 = warm_state.clone()
+    st2._root_engine = None  # fully evicted engine
+    assert state_proof(st2, ["slot"]) is None
+    # host oracle still serves the request
+    leaf, branch, depth, index = container_branch(
+        st2._container(), st2.to_value(), ["slot"]
+    )
+    assert is_valid_merkle_branch(
+        leaf, branch, depth, index, st2.hash_tree_root()
+    )
+
+
+def test_full_htr_mode_stale_engine_returns_none(warm_state, monkeypatch):
+    """LODESTAR_TPU_HTR=full bypasses the engine: after a mutation the
+    planes are stale, and the reader must refuse to serve them."""
+    st = warm_state.clone()
+    st.hash_tree_root()
+    monkeypatch.setenv("LODESTAR_TPU_HTR", "full")
+    process_slots(st, int(st.slot) + 1)
+    assert state_proof(st, ["slot"]) is None
+
+
+# -- descending multiproof ---------------------------------------------------
+
+
+def test_multiproof_pack_dedupes_and_verifies(warm_state):
+    st = warm_state
+    paths = [
+        ["finalized_checkpoint", "root"],
+        ["finalized_checkpoint", "epoch"],
+        ["next_sync_committee"],
+        ["slot"],
+    ]
+    proofs = state_multiproof(st, paths)
+    assert proofs is not None
+    packed = pack_multiproof(proofs)
+    total_branch_nodes = sum(len(b) for _, b, _, _ in proofs)
+    # shared ancestry (two checkpoint leaves, common upper levels) must
+    # dedupe: strictly fewer helper nodes than the naive concatenation
+    assert len(packed["helpers"]) < total_branch_nodes
+    # descending gindex order
+    helper_g = [g for g, _ in packed["helpers"]]
+    assert helper_g == sorted(helper_g, reverse=True)
+    leaf_g = list(packed["leaves"])
+    assert leaf_g == sorted(leaf_g, reverse=True)
+    root = st.hash_tree_root()
+    assert verify_multiproof(packed["leaves"], packed["helpers"], root)
+    # tampered leaf fails (bit-flip: some genesis leaves are all-zero)
+    bad = dict(packed["leaves"])
+    g0 = next(iter(bad))
+    bad[g0] = bytes(b ^ 0xFF for b in bad[g0])
+    assert not verify_multiproof(bad, packed["helpers"], root)
+    # incomplete helper set fails closed, does not raise
+    assert not verify_multiproof(
+        packed["leaves"], packed["helpers"][:-1], root
+    )
+
+
+# -- bundle cache ------------------------------------------------------------
+
+
+def test_bundle_cache_bounds_and_lru():
+    c = ProofBundleCache(max_entries=3, max_bytes=1 << 20)
+    for i in range(4):
+        c.put("k", i, {"v": i})
+    assert c.get("k", 0) is None  # LRU-evicted at the entry bound
+    assert c.get("k", 3) == {"v": 3}
+    assert c.evicted == 1
+    # byte bound: one oversized payload evicts the rest
+    c2 = ProofBundleCache(max_entries=100, max_bytes=200)
+    c2.put("k", "small", "x")
+    c2.put("k", "big", b"\x00" * 500, nbytes=500)
+    assert c2.resident_bytes() <= 500  # small one evicted first
+    assert c2.get("k", "small") is None
+
+
+def test_bundle_cache_invalidate_and_peek():
+    c = ProofBundleCache()
+    c.put("lc_update", 1, "a")
+    c.put("lc_update", 2, "b")
+    c.put("finality", "latest", "c")
+    assert c.invalidate("lc_update", 1) == 1
+    assert c.invalidate("lc_update") == 1  # the remaining period
+    assert c.get("finality", "latest") == "c"
+    hits, misses = c.hits, c.misses
+    assert c.peek("finality", "latest") == "c"
+    assert (c.hits, c.misses) == (hits, misses)  # peek leaves stats alone
+    assert c.invalidate() == 1  # drop everything
+    assert c.resident_bytes() == 0
+
+
+def test_bundle_cache_drain_and_stats():
+    c = ProofBundleCache()
+    for i in range(10):
+        c.put("k", i, b"\x00" * 100, nbytes=100)
+    assert c.resident_bytes() == 1000
+    freed = c.drain(target_bytes=250)
+    assert freed == 800 and c.resident_bytes() == 200
+    assert c.drained == 8
+    assert c.get("k", 9) is not None  # LRU drained first, MRU survives
+    s = c.stats()
+    assert s["entries"] == 2 and s["bytes"] == 200
+    assert c.drain() == 200 and c.resident_bytes() == 0
+
+
+def test_estimate_bytes_shapes():
+    assert estimate_bytes(b"\x00" * 100) == 132
+    assert estimate_bytes({"a": [1, 2]}) > estimate_bytes({"a": []})
+    assert estimate_bytes(None) == 8
+
+
+# -- governor integration: aux drain + leases --------------------------------
+
+
+class _FakeDrainable:
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+        self.drain_calls = []
+
+    def resident_bytes(self):
+        return self.nbytes
+
+    def drain(self, target_bytes=0):
+        self.drain_calls.append(target_bytes)
+        freed = max(0, self.nbytes - target_bytes)
+        self.nbytes -= freed
+        return freed
+
+
+def test_governor_drains_aux_before_states():
+    gov = StateMemoryGovernor(1000, registry=Registry())
+    aux = _FakeDrainable(1500)
+    gov.register_aux("proof_bundles", aux)  # triggers enforce
+    assert aux.nbytes <= 1000  # drained down to the budget
+    assert gov.evictions["drain"] == 1
+    assert gov.status()["aux_bytes"] == aux.nbytes
+    gov.unregister_aux("proof_bundles")
+    assert gov.status()["aux_bytes"] == 0
+
+
+def test_governor_aux_under_budget_not_drained():
+    gov = StateMemoryGovernor(1 << 20, registry=Registry())
+    aux = _FakeDrainable(100)
+    gov.register_aux("proof_bundles", aux)
+    gov.enforce()
+    assert aux.drain_calls == []  # no squeeze, no drain
+    assert gov.evictions["drain"] == 0
+
+
+def test_governor_lease_refcounts():
+    gov = StateMemoryGovernor(None, registry=Registry())
+    key = ("state", "ab" * 32)
+    with gov.lease(key):
+        assert gov.status()["leases"] == 1
+        with gov.lease(key):  # reentrant
+            assert gov.status()["leases"] == 1
+    assert gov.status()["leases"] == 0
+
+
+# -- ProofService ------------------------------------------------------------
+
+
+class _StubUpdate:
+    def __init__(self, slot):
+        self.attested_header = {"slot": slot}
+
+
+class _StubLc:
+    def __init__(self):
+        self.updates = {}
+        self.plane_proofs = 0
+        self.get_update_calls = 0
+
+    def get_update(self, period):
+        self.get_update_calls += 1
+        return self.updates.get(period)
+
+    def get_finality_update(self):
+        return self.updates.get("finality")
+
+    def get_optimistic_update(self):
+        return self.updates.get("optimistic")
+
+
+class _StubChain:
+    def __init__(self):
+        from lodestar_tpu.chain.emitter import ChainEventEmitter
+
+        self.emitter = ChainEventEmitter()
+        self.config = None
+        self.head_root_hex = "cd" * 32
+        self.memory_governor = None
+
+
+@pytest.fixture()
+def svc():
+    chain = _StubChain()
+    lc = _StubLc()
+    service = ProofService(chain, light_client_server=lc)
+    # rendering needs real LightClientUpdate values; these unit tests
+    # cover routing/caching/accounting, so stub the renderer
+    service._render_update = lambda upd: {
+        "slot": str(upd.attested_header["slot"])
+    }
+    return chain, lc, service
+
+
+def test_service_update_serving_and_invalidation(svc):
+    from lodestar_tpu.chain.emitter import ChainEvent
+    from lodestar_tpu.light_client.lightclient import sync_period
+
+    chain, lc, service = svc
+    period_slots = P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * P.SLOTS_PER_EPOCH
+    lc.updates[0] = _StubUpdate(5)
+    lc.updates[2] = _StubUpdate(2 * period_slots + 1)
+    out = service.light_client_updates(0, 4)
+    assert len(out) == 2  # empty periods skipped
+    assert out[0] == {"version": "altair", "data": {"slot": "5"}}
+    assert service.sources == {"bundle": 0, "plane": 0, "host": 2}
+    out2 = service.light_client_updates(0, 4)
+    assert out2 == out
+    assert service.sources["bundle"] == 2  # both served from bundles
+    # a better update for period 0 invalidates exactly that bundle
+    upd = _StubUpdate(7)
+    assert sync_period(7) == 0
+    lc.updates[0] = upd
+    chain.emitter.emit(ChainEvent.light_client_update, upd)
+    out3 = service.light_client_updates(0, 4)
+    assert out3[0]["data"] == {"slot": "7"}
+    assert service.sources["host"] == 3  # period 0 re-rendered, 2 cached
+
+
+def test_service_latest_and_head_invalidation(svc):
+    from lodestar_tpu.chain.emitter import ChainEvent
+
+    chain, lc, service = svc
+    assert service.finality_update() is None  # nothing produced yet
+    lc.updates["finality"] = _StubUpdate(9)
+    lc.updates["optimistic"] = _StubUpdate(11)
+    assert service.finality_update() == {"slot": "9"}
+    assert service.finality_update() == {"slot": "9"}
+    assert service.optimistic_update() == {"slot": "11"}
+    assert service.sources["bundle"] == 1
+    chain.emitter.emit(ChainEvent.head, b"\x01" * 32, 12)
+    stats_before = service.cache.stats()["entries"]
+    assert stats_before == 0  # head event dropped both latest bundles
+    assert service.finality_update() == {"slot": "9"}
+    assert service.sources["host"] == 3
+
+
+def test_service_period_rollover_warming(svc):
+    chain, lc, service = svc
+    period_slots = P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * P.SLOTS_PER_EPOCH
+    lc.updates[0] = _StubUpdate(3)
+    service.on_slot(1)  # first tick just anchors the period
+    assert service.batch_generated == 0
+    service.on_slot(period_slots + 1)  # rollover into period 1
+    assert service.batch_generated == 1
+    assert service.cache.peek("lc_update", 0) is not None
+    calls = lc.get_update_calls
+    service.on_slot(period_slots + 2)  # same period: no re-warm
+    assert lc.get_update_calls == calls
+    st = service.status()
+    assert st["batch_generated"] == 1
+    assert set(st["sources"]) == {"bundle", "plane", "host"}
+
+
+def test_service_state_proofs_plane_then_bundle(warm_state):
+    chain = _StubChain()
+    service = ProofService(chain)
+    paths = [["slot"], ["finalized_checkpoint", "root"]]
+    data = service.state_proof_data(warm_state, paths)
+    assert service.sources["plane"] == 1
+    root = warm_state.hash_tree_root()
+    assert data["state_root"] == "0x" + root.hex()
+    assert len(data["proofs"]) == 2
+    for p in data["proofs"]:
+        assert is_valid_merkle_branch(
+            bytes.fromhex(p["leaf"][2:]),
+            [bytes.fromhex(b[2:]) for b in p["branch"]],
+            p["depth"],
+            p["index"],
+            root,
+        )
+    leaves = {
+        int(x["gindex"]): bytes.fromhex(x["node"][2:])
+        for x in data["multiproof"]["leaves"]
+    }
+    helpers = [
+        (int(x["gindex"]), bytes.fromhex(x["node"][2:]))
+        for x in data["multiproof"]["helpers"]
+    ]
+    assert verify_multiproof(leaves, helpers, root)
+    # second request: the rendered bundle
+    assert service.state_proof_data(warm_state, paths) == data
+    assert service.sources["bundle"] == 1
+    # single path keeps the original response shape
+    one = service.state_proof_data(warm_state, [["slot"]])
+    assert set(one) == {"leaf", "branch", "depth", "index", "state_root"}
+    # bad path raises for the handler's 400
+    with pytest.raises((KeyError, ValueError, TypeError)):
+        service.state_proof_data(warm_state, [["nope"]])
+
+
+def test_service_state_proofs_host_fallback(warm_state):
+    chain = _StubChain()
+    service = ProofService(chain)
+    st = warm_state.clone()
+    st._root_engine = None  # evicted: plane reader refuses
+    data = service.state_proof_data(st, [["slot"]])
+    assert service.sources == {"bundle": 0, "plane": 0, "host": 1}
+    assert is_valid_merkle_branch(
+        bytes.fromhex(data["leaf"][2:]),
+        [bytes.fromhex(b[2:]) for b in data["branch"]],
+        data["depth"],
+        data["index"],
+        st.hash_tree_root(),
+    )
+
+
+def test_service_bootstrap_attribution(svc, monkeypatch):
+    chain, lc, service = svc
+    import lodestar_tpu.api.encoding as encoding
+
+    monkeypatch.setattr(encoding, "to_json", lambda _t, v: dict(v))
+    boots = {b"\x01" * 32: {"who": 1}, b"\x02" * 32: {"who": 2}}
+
+    def get_bootstrap(root):
+        lc.plane_proofs += 1 if root == b"\x01" * 32 else 0
+        return boots.get(root)
+
+    lc.get_bootstrap = get_bootstrap
+    assert service.bootstrap(b"\x01" * 32) == {"who": 1}
+    assert service.sources["plane"] == 1
+    assert service.bootstrap(b"\x02" * 32) == {"who": 2}
+    assert service.sources["host"] == 1
+    assert service.bootstrap(b"\x01" * 32) == {"who": 1}  # bundle hit
+    assert service.sources["bundle"] == 1
+    assert service.bootstrap(b"\x03" * 32) is None  # unknown root -> 404

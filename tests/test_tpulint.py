@@ -202,6 +202,23 @@ def test_cache_hygiene_negative(fixture_findings):
     assert not _by_file(fixture_findings, "cache_ok.py")
 
 
+def test_cache_hygiene_covers_proofs_dir(fixture_findings):
+    """ISSUE 17 satellite: the proofs/ package joined the cache-hygiene
+    gate — an unbounded proof-bundle memo (grown per request, never
+    evicted/invalidated/drained) is exactly the bug class."""
+    hits = _by_file(fixture_findings, "proof_cache_bad.py")
+    msgs = [f.message for f in hits if f.rule == "cache-hygiene"]
+    assert any("`self.bundles`" in m for m in msgs), msgs
+    assert any("`self.recent_keys`" in m for m in msgs), msgs
+    assert len(msgs) == 2, msgs
+
+
+def test_cache_hygiene_proofs_negative(fixture_findings):
+    """The governed shapes (max_* bound + drain, event invalidation)
+    stay silent — the contract ProofBundleCache itself follows."""
+    assert not _by_file(fixture_findings, "proof_cache_ok.py")
+
+
 def test_metric_hygiene_positive(fixture_findings):
     hits = _by_file(fixture_findings, "metrics_bad.py")
     msgs = [f.message for f in hits if f.rule == "metric-hygiene"]
