@@ -633,7 +633,12 @@ class StateMemoryGovernor:
 
     def _drain_aux(self, stats: dict) -> None:
         """Drain every registered aux cache down to the budget headroom
-        the ledger leaves it (0 when the ledger alone is over budget)."""
+        the ledger leaves it (0 when the ledger alone is over budget).
+
+        The 'drain' tier is booked in ENTRIES freed, matching the
+        cache's own `drained` counter when it exposes one (read as a
+        before/after delta); a cache without that counter books one
+        per draining pass."""
         for name, cache in list(self._aux.items()):
             others = sum(
                 self._aux_bytes_one(c)
@@ -645,6 +650,7 @@ class StateMemoryGovernor:
             )
             if self._aux_bytes_one(cache) <= target:
                 continue
+            before = getattr(cache, "drained", None)
             try:
                 freed = cache.drain(target)
             except Exception as e:  # noqa: BLE001 — a broken aux cache
@@ -652,7 +658,15 @@ class StateMemoryGovernor:
                 self.log.warn("aux drain failed", cache=name, error=str(e))
                 continue
             if freed:
-                self._book("drain", stats)
+                after = getattr(cache, "drained", None)
+                entries = (
+                    after - before
+                    if isinstance(before, int)
+                    and isinstance(after, int)
+                    and after > before
+                    else 1
+                )
+                self._book("drain", stats, entries)
 
     def _candidates(self, pinned_roots, cp_pinned):
         """Cold-first eviction order: state-LRU oldest first (stale
@@ -787,11 +801,11 @@ class StateMemoryGovernor:
                 self.ledger.drop(lkey)
                 self._book("evict", stats)
 
-    def _book(self, tier: str, stats: dict) -> None:
-        stats[tier] += 1
-        self.evictions[tier] += 1
-        self._evictions_since_tick += 1
-        self.m_evictions.inc(tier, 1.0)
+    def _book(self, tier: str, stats: dict, n: int = 1) -> None:
+        stats[tier] += n
+        self.evictions[tier] += n
+        self._evictions_since_tick += n
+        self.m_evictions.inc(tier, float(n))
 
     def _escalate(self) -> None:
         """Rung 1: shrink the checkpoint-cache epoch window (future

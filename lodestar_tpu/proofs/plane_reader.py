@@ -33,7 +33,6 @@ leaves (sibling overlap grows with path locality), and
 
 from __future__ import annotations
 
-import heapq
 from typing import Dict, List as PyList, Optional, Sequence, Tuple
 
 from ..ssz.core import (
@@ -219,22 +218,46 @@ def pack_multiproof(proofs: Sequence[Proof]) -> dict:
 
 def verify_multiproof(leaves, helpers, root: bytes) -> bool:
     """Fold a packed multiproof bottom-up (descending gindex order) and
-    compare against `root`.  False on a mismatch OR an incomplete node
-    set — never raises on malformed input."""
-    nodes: Dict[int, bytes] = dict(leaves)
-    for g, node in helpers:
-        nodes[g] = node
-    heap = [-g for g in nodes]
-    heapq.heapify(heap)
-    while heap:
-        g = -heapq.heappop(heap)
-        if g <= 1:
-            continue
-        parent = g >> 1
-        if parent in nodes:
-            continue  # sibling already folded this pair (or a leaf sits there)
-        if (g ^ 1) not in nodes:
+    compare against `root`.  False on a mismatch OR a malformed node
+    set — never raises on malformed input.
+
+    Fails CLOSED against helper placement attacks: a helper whose
+    gindex sits ON any leaf's path to the root (including at a leaf's
+    own gindex) would shadow the honest recomputation and let a forged
+    leaf verify, so any such helper — or a duplicate, or one whose
+    sibling is off every leaf path (it could never be consumed) — is
+    rejected outright.  Every on-path internal node is recomputed from
+    its two children, so each leaf is consumed by digests on an
+    unbroken path to gindex 1; when one requested leaf is an ancestor
+    of another, its claimed value must MATCH the value recomputed from
+    below."""
+    try:
+        leaf_map = {int(g): bytes(n) for g, n in dict(leaves).items()}
+        helper_list = [(int(g), bytes(n)) for g, n in helpers]
+        want = bytes(root)
+    except (TypeError, ValueError):
+        return False
+    if not leaf_map or any(g < 1 for g in leaf_map):
+        return False
+    on_path = set()
+    for g in leaf_map:
+        while g >= 1:
+            on_path.add(g)
+            g >>= 1
+    nodes: Dict[int, bytes] = dict(leaf_map)
+    for g, node in helper_list:
+        if g in nodes or g in on_path or (g ^ 1) not in on_path:
             return False
-        nodes[parent] = digest(nodes[g & ~1] + nodes[g | 1])
-        heapq.heappush(heap, -parent)
-    return nodes.get(1) == bytes(root)
+        nodes[g] = node
+    # descending gindex order: children always exceed their parent, so
+    # both child values are final before the parent folds
+    for parent in sorted({g >> 1 for g in on_path if g > 1}, reverse=True):
+        left = nodes.get(2 * parent)
+        right = nodes.get(2 * parent + 1)
+        if left is None or right is None:
+            return False
+        node = digest(left + right)
+        if parent in leaf_map and nodes[parent] != node:
+            return False  # a claimed leaf that is another leaf's ancestor
+        nodes[parent] = node
+    return nodes.get(1) == want

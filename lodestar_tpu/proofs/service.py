@@ -204,14 +204,35 @@ class ProofService:
         the deduped descending multiproof.  Raises KeyError/ValueError/
         TypeError on a bad path (the handler's 400)."""
         paths = [list(p) for p in paths]
-        root_hex = getattr(self.chain, "head_root_hex", "")
-        key = (root_hex, tuple(".".join(str(s) for s in p) for p in paths))
-        item = self.cache.get("state_proof", key)
-        if item is not None:
-            self.sources["bundle"] += 1
-            return item
-        with self._lease(root_hex):
-            proofs = state_multiproof(state, paths)
+        with self._lease(getattr(self.chain, "head_root_hex", "")):
+            # plane residency BEFORE touching the root: hash_tree_root
+            # on an engineless (spilled/evicted) state rebuilds its
+            # engine as a side effect, and the evicted -> host
+            # degradation contract must not be masked by that rebuild
+            engine = getattr(state, "_root_engine", None)
+            planes_warm = (
+                engine is not None and getattr(engine, "top", None) is not None
+            )
+            # key the bundle on the PROVED state's own root, never the
+            # head root read at call time: if the head advances between
+            # the handler resolving its state and this call, a head key
+            # would file the old state's proofs under the NEW head —
+            # right after _on_head invalidated that key — and serve
+            # them stale until the next head event
+            state_root = state.hash_tree_root()
+            key = (
+                _hex(state_root),
+                tuple(".".join(str(s) for s in p) for p in paths),
+            )
+            item = self.cache.get("state_proof", key)
+            if item is not None:
+                self.sources["bundle"] += 1
+                return item
+            proofs = (
+                state_multiproof(state, paths, expected_root=state_root)
+                if planes_warm
+                else None
+            )
         if proofs is not None:
             self.sources["plane"] += 1
         else:
@@ -221,7 +242,6 @@ class ProofService:
                 state._container(), state.to_value(), paths
             )
             self.sources["host"] += 1
-        state_root = state.hash_tree_root()
         item = self._render_proofs(paths, proofs, state_root)
         self.cache.put("state_proof", key, item)
         return item

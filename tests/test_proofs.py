@@ -228,6 +228,70 @@ def test_multiproof_pack_dedupes_and_verifies(warm_state):
     )
 
 
+def test_multiproof_rejects_on_path_helpers():
+    """Forged leaves must not verify by planting helpers ON the leaf
+    paths (which would shadow the honest recomputation): the verifier
+    rejects any helper at a leaf's gindex or an ancestor of one, and
+    any helper it could never consume."""
+    from lodestar_tpu.ssz.hasher import digest
+
+    n4, n5, n6, n7 = (bytes([i]) * 32 for i in (4, 5, 6, 7))
+    n2, n3 = digest(n4 + n5), digest(n6 + n7)
+    root = digest(n2 + n3)
+    fake = b"\xaa" * 32
+    # honest round-trip as the baseline
+    assert verify_multiproof({4: n4, 5: n5}, [(3, n3)], root)
+    # helper at the leaves' shared ancestor short-circuits the fold:
+    # forged leaves would verify against the real root
+    assert not verify_multiproof(
+        {4: fake, 5: fake}, [(2, n2), (3, n3)], root
+    )
+    # helper at a leaf's own gindex must not shadow the leaf
+    assert not verify_multiproof(
+        {4: fake}, [(4, n4), (5, n5), (3, n3)], root
+    )
+    # helper the fold could never consume (sibling off every leaf path)
+    assert not verify_multiproof({4: n4, 5: n5}, [(3, n3), (6, n6)], root)
+    # duplicate helper gindex
+    assert not verify_multiproof({4: n4, 5: n5}, [(3, n3), (3, n3)], root)
+    # no leaves at all
+    assert not verify_multiproof({}, [(2, n2), (3, n3)], root)
+
+
+def test_multiproof_verifies_ancestor_leaves():
+    """A requested leaf that is an ancestor of another requested leaf
+    is still verified — its claimed value must match the value
+    recomputed from the deeper leaf, in BOTH directions."""
+    from lodestar_tpu.ssz.hasher import digest
+
+    n4, n5, n3 = bytes([4]) * 32, bytes([5]) * 32, bytes([3]) * 32
+    n2 = digest(n4 + n5)
+    root = digest(n2 + n3)
+    fake = b"\xbb" * 32
+    assert verify_multiproof({2: n2, 4: n4}, [(5, n5), (3, n3)], root)
+    # forged ancestor leaf, honest deeper leaf
+    assert not verify_multiproof({2: fake, 4: n4}, [(5, n5), (3, n3)], root)
+    # honest ancestor leaf, forged deeper leaf
+    assert not verify_multiproof({2: n2, 4: fake}, [(5, n5), (3, n3)], root)
+
+
+def test_multiproof_pack_ancestor_leaf_roundtrip(warm_state):
+    """pack_multiproof output with one requested path an ancestor of
+    another still round-trips through the strict verifier, and forging
+    either leaf fails."""
+    st = warm_state
+    paths = [["finalized_checkpoint"], ["finalized_checkpoint", "root"]]
+    proofs = state_multiproof(st, paths)
+    assert proofs is not None
+    packed = pack_multiproof(proofs)
+    root = st.hash_tree_root()
+    assert verify_multiproof(packed["leaves"], packed["helpers"], root)
+    for g in packed["leaves"]:
+        bad = dict(packed["leaves"])
+        bad[g] = bytes(b ^ 0xFF for b in bad[g])
+        assert not verify_multiproof(bad, packed["helpers"], root), g
+
+
 # -- bundle cache ------------------------------------------------------------
 
 
