@@ -253,6 +253,21 @@ def _emit_effective_skip(stage: str, detail: str) -> None:
     )
 
 
+def _emit_aggfwd_skip(stage: str, detail: str) -> None:
+    """Aggregate-forward probe failure skips BOTH of its metrics (the
+    _emit_rlc_skip convention: a missing record reads as 'old bench
+    without the probe', a skip record as 'probe present, run unusable')."""
+    _emit_failure(
+        stage,
+        detail,
+        metric="gossip_bytes_per_verified_att",
+        unit="bytes/att",
+    )
+    _emit_failure(
+        stage, detail, metric="aggregate_forward_factor", unit="ratio"
+    )
+
+
 def _probe_backend() -> None:
     """Initialize the TPU backend in THROWAWAY subprocesses with hard
     timeouts, so an unresponsive axon tunnel is diagnosed instead of
@@ -316,6 +331,10 @@ def _probe_backend() -> None:
         _emit_pipeline_skip("backend-init-probe", last or "probe failed")
         if os.environ.get("BENCH_PREAGG", "1") != "0":
             _emit_effective_skip(
+                "backend-init-probe", last or "probe failed"
+            )
+        if os.environ.get("BENCH_AGGFWD", "1") != "0":
+            _emit_aggfwd_skip(
                 "backend-init-probe", last or "probe failed"
             )
     sys.exit(1)
@@ -843,6 +862,8 @@ def main_wire():
         _probe_pipeline(verifier)
         if os.environ.get("BENCH_PREAGG", "1") != "0":
             _probe_effective_atts(verifier)
+        if os.environ.get("BENCH_AGGFWD", "1") != "0":
+            _probe_aggregate_forward(verifier)
     if os.environ.get("BENCH_BREAKER", "1") != "0":
         _probe_breaker_recovery(verifier)
 
@@ -1293,6 +1314,230 @@ def _probe_effective_atts(verifier) -> None:
         _emit_effective_skip("preagg-probe", f"{type(e).__name__}: {e}")
 
 
+# -- aggregate-forward probe (ISSUE 19) -------------------------------------
+# The preagg flood again, but with an AggregateForwarder on the layer
+# hook and an in-memory bus downstream: every verified multi-member
+# layer re-publishes as ONE packed SignedAggregateAndProof instead of
+# its members' individual subnet messages.  Reports the tentpole's two
+# numbers with the headline's skip/null semantics:
+#   gossip_bytes_per_verified_att — downstream bytes carried per
+#     distinct verified attestation (packs + raw forwards for any
+#     attestation no pack covered; lower is better),
+#   aggregate_forward_factor — the raw-sync downstream cost for the
+#     same attestations divided by the aggregate-forward cost (the
+#     acceptance criteria bound this at >= 3).
+
+
+def _probe_aggregate_forward(verifier) -> None:
+    t_stage0 = time.monotonic()
+    try:
+        from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
+        from lodestar_tpu.bls.verifier import VerifyOptions
+        from lodestar_tpu.network.forwarding import (
+            AggregateForwarder,
+            aggfwd_enabled,
+        )
+        from lodestar_tpu.network.gossip import (
+            GossipTopicName,
+            InMemoryGossipBus,
+            encode_message,
+            topic_string,
+        )
+        from lodestar_tpu.types import Attestation
+
+        if not getattr(verifier, "_use_rlc", True):
+            _emit_aggfwd_skip(
+                "aggfwd-probe", "LODESTAR_TPU_BLS_RLC=0: RLC disabled"
+            )
+            return
+        if os.environ.get(
+            "LODESTAR_TPU_BLS_PREAGG", "1"
+        ).strip().lower() in ("0", "false", "no", "off"):
+            _emit_aggfwd_skip(
+                "aggfwd-probe", "LODESTAR_TPU_BLS_PREAGG=0: stage disabled"
+            )
+            return
+        if not aggfwd_enabled():
+            _emit_aggfwd_skip(
+                "aggfwd-probe",
+                "LODESTAR_TPU_BLS_AGGFWD=0: aggregate-forward disabled",
+            )
+            return
+        sks = [GTB.keygen(b"bench-%d" % i) for i in range(DISTINCT)]
+        roots = [
+            b"aggfwd subnet root %d" % s for s in range(BENCH_PREAGG_SUBNETS)
+        ]
+        att = _att_factory(verifier, sks, roots)
+        pipeline = BlsVerificationPipeline(verifier)
+        if pipeline._agg is None:
+            _emit_aggfwd_skip(
+                "aggfwd-probe", "verifier cannot aggregate (no stage)"
+            )
+            pipeline.close()
+            return
+
+        # the downstream side: an in-memory bus with one subscriber
+        # counting what actually crosses the wire
+        digest = b"\xbe\x4c\x19\x00"
+        bus = InMemoryGossipBus()
+        agg_topic = topic_string(
+            digest, GossipTopicName.beacon_aggregate_and_proof
+        )
+        downstream = {"msgs": 0, "bytes": 0}
+
+        def _rx(_topic, payload):
+            downstream["msgs"] += 1
+            downstream["bytes"] += len(payload)
+
+        bus.subscribe("bench-downstream", agg_topic, _rx)
+        fwd = AggregateForwarder(
+            bus=bus, node_id="bench-self", fork_digest=digest
+        )
+        committee = tuple(range(len(verifier.table)))
+        zero = b"\x00" * 32
+        for s, root in enumerate(roots):
+            fwd.register_root(
+                root,
+                0,
+                {
+                    "slot": 0,
+                    "index": s,
+                    "beacon_block_root": zero,
+                    "source": {"epoch": 0, "root": zero},
+                    "target": {"epoch": 0, "root": zero},
+                },
+                committee,
+            )
+        pipeline.set_layer_forward(fwd.on_layer_verified)
+
+        # what the raw-sync path forwards downstream per attestation: one
+        # encoded single-bit Attestation gossip message (committee-width
+        # bits, so the size is the honest apples-to-apples baseline).
+        # The signature must be INCOMPRESSIBLE like a real G2 point — an
+        # all-zero placeholder would let snappy flatter the baseline
+        import hashlib as _hashlib
+
+        opaque_sig = b"".join(
+            _hashlib.sha256(b"aggfwd raw sig %d" % i).digest()
+            for i in range(3)
+        )
+        raw_single = {
+            "aggregation_bits": [i == 0 for i in range(len(committee))],
+            "data": {
+                "slot": 0,
+                "index": 0,
+                "beacon_block_root": zero,
+                "source": {"epoch": 0, "root": zero},
+                "target": {"epoch": 0, "root": zero},
+            },
+            "signature": opaque_sig,
+        }
+        raw_att_bytes = len(encode_message(Attestation.serialize(raw_single)))
+
+        # warm on a DISJOINT root namespace (same rule as the preagg
+        # probe): unregistered warm roots hit the forwarder's skip path,
+        # never its publish path
+        warm_roots = [
+            b"aggfwd warm root %d" % s for s in range(BENCH_PREAGG_SUBNETS)
+        ]
+        verifier.messages.get_many(roots + warm_roots)
+        warm_att = _att_factory(verifier, sks, warm_roots)
+        warm = [warm_att(j) for j in range(128)]
+        assert pipeline.verify_signature_sets(
+            warm, VerifyOptions(batchable=True)
+        ), "aggfwd warmup failed verification"
+        base = fwd.stats_snapshot()
+
+        distinct = max(1, BENCH_PREAGG_ATTS // BENCH_PREAGG_DUP)
+        verdicts, dt, crit_lat = _drive_flood(
+            pipeline, att, distinct, BENCH_PREAGG_WAVES, dup=BENCH_PREAGG_DUP
+        )
+        stats = fwd.stats_snapshot()
+        pipeline.close()
+        n_ok = sum(1 for v in verdicts if v)
+        _phase_mark(
+            "aggfwd_probe",
+            time.monotonic() - t_stage0,
+            ok=n_ok == len(verdicts),
+            atts=len(verdicts),
+        )
+        if n_ok != len(verdicts):
+            _emit_aggfwd_skip(
+                "aggfwd-probe",
+                f"{len(verdicts) - n_ok} valid atts failed verification",
+            )
+            return
+        published = stats["published"] - base["published"]
+        packed_bytes = stats["bytes_published"] - base["bytes_published"]
+        covered = stats["members_forwarded"] - base["members_forwarded"]
+        if published <= 0:
+            _emit_aggfwd_skip(
+                "aggfwd-probe", "forwarder published no packed layers"
+            )
+            return
+        # distinct standard-lane singles the flood submitted: replay
+        # _drive_flood's j sequence (per-wave singles, +2 critical) and
+        # count distinct (validator, root) messages — the att factory
+        # wraps at table capacity, so large floods repeat earlier
+        # messages byte-for-byte, and duplicates are seen-cache hits in
+        # BOTH modes (neither forwards them)
+        capacity = len(verifier.table)
+        per_wave = max(1, distinct // BENCH_PREAGG_WAVES)
+        singles = set()
+        j = 0
+        for _wave in range(BENCH_PREAGG_WAVES):
+            for _ in range(per_wave):
+                singles.add((j % capacity, j % len(roots)))
+                j += 1
+            j += 2  # the wave's critical-lane submissions
+        n_atts = len(singles)
+        uncovered = max(0, n_atts - covered)
+        raw_bytes = raw_att_bytes * n_atts
+        aggfwd_bytes = packed_bytes + raw_att_bytes * uncovered
+        bytes_per_att = aggfwd_bytes / n_atts
+        factor = raw_bytes / aggfwd_bytes
+        p99 = _flood_p99(crit_lat)
+        common = {
+            "raw_bytes_per_att": raw_att_bytes,
+            "packs_published": published,
+            "atts_covered_by_packs": covered,
+            "atts_uncovered": uncovered,
+            "downstream_msgs": downstream["msgs"],
+            "downstream_bytes": downstream["bytes"],
+            "critical_p99_submit_to_verdict_s": (
+                round(p99, 4) if p99 is not None else None
+            ),
+            "phases": _phase_snapshot(),
+            "slo": _slo_snapshot(),
+            "breaker": _breaker_snapshot(),
+            "memory": _memory_snapshot(),
+        }
+        print(
+            json.dumps(
+                {
+                    "metric": "gossip_bytes_per_verified_att",
+                    "value": round(bytes_per_att, 2),
+                    "unit": "bytes/att",
+                    "vs_baseline": None,
+                    **common,
+                }
+            ),
+            flush=True,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "aggregate_forward_factor",
+                    "value": round(factor, 4),
+                    "unit": "ratio",
+                    "vs_baseline": None,
+                    **common,
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 — probe failures emit a skip record
+        _emit_aggfwd_skip("aggfwd-probe", f"{type(e).__name__}: {e}")
 
 
 def build_decoded_inputs():

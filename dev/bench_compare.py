@@ -148,8 +148,9 @@ def build_table(paths: List[str]) -> dict:
 
 
 # units where a SMALLER value is the better one (wall-clock probes like
-# bls_rlc_bisect_seconds) — the regression gate inverts for these
-_LOWER_IS_BETTER_UNITS = {"s", "seconds", "ms", "us"}
+# bls_rlc_bisect_seconds, downstream bytes like
+# gossip_bytes_per_verified_att) — the regression gate inverts for these
+_LOWER_IS_BETTER_UNITS = {"s", "seconds", "ms", "us", "bytes/att"}
 
 # authoritative unit registry for metrics whose archived records might
 # predate (or drop) the "unit" field — keeps the regression gate
@@ -180,6 +181,13 @@ _METRIC_UNITS = {
     # bundle-cache hit rate rides its own metric in comparisons
     # (ratio 0..1, higher is better)
     "proof_bundle_hit_rate": "ratio",
+    # ISSUE 19: downstream gossip bytes carried per distinct verified
+    # attestation with aggregate-forward on — bytes regress UP (a rise
+    # beyond threshold exits 1)
+    "gossip_bytes_per_verified_att": "bytes/att",
+    # ISSUE 19: raw-sync downstream cost / aggregate-forward cost for
+    # the same flood (ratio, higher is better; acceptance bounds >= 3)
+    "aggregate_forward_factor": "ratio",
 }
 
 

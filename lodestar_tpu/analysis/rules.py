@@ -66,6 +66,11 @@ node-hygiene (warning; bare except is error)
     `dump_chrome_trace`, `trace_summary`) count too: opening
     `trace_span` in async code is fine (cheap, O(1)), but draining or
     serializing the trace ring inline is file IO + an O(ring) walk.
+    Under network/ specifically (ISSUE 19): no SYNCHRONOUS VERDICT
+    WAITS inside `async def` handler bodies — `.result()` on a verify
+    future or a direct `verify_signature_sets*` call blocks the
+    handler on the device round-trip; the forward/score decision is a
+    DeferredVerdict continuation (network/forwarding.py).
 """
 
 from __future__ import annotations
@@ -596,6 +601,18 @@ _BREAKER_DIRS = {"bls", "network", "chain"}
 # modules allowed to touch dispatch directly: the supervisor itself
 # (it IS the seam) and anything under kernels/ (the dispatch layer)
 _BREAKER_EXEMPT_PARTS = {"supervisor", "kernels"}
+# synchronous verdict waits in network/ async handler bodies (ISSUE
+# 19): now that subnet verdicts are deferred, blocking a handler on a
+# verify future (`.result()`) or calling the verifier synchronously
+# re-serializes the event loop on the device round-trip — the
+# forward/score decision belongs in a DeferredVerdict continuation
+# (network/forwarding.py).  Scoped to network/ only: bls/ service
+# internals legitimately join their own futures on worker threads.
+_SYNC_VERDICT_DIRS = {"network"}
+_SYNC_VERIFY_FNS = {
+    "verify_signature_sets",
+    "verify_signature_sets_individually",
+}
 
 
 class NodeHygieneRule(Rule):
@@ -625,6 +642,7 @@ class NodeHygieneRule(Rule):
             check_dispatch = bool(parts & _BREAKER_DIRS) and not (
                 parts & _BREAKER_EXEMPT_PARTS
             )
+            check_verdict = bool(parts & _SYNC_VERDICT_DIRS)
             for info in mod.functions.values():
                 if not isinstance(info.node, ast.AsyncFunctionDef):
                     continue
@@ -643,6 +661,20 @@ class NodeHygieneRule(Rule):
                                 f"a thread",
                             )
                         )
+                    wait = self._sync_verdict_wait(node)
+                    if check_verdict and wait:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"synchronous verdict wait `{wait}` "
+                                f"inside async `{info.qualname}` blocks "
+                                f"the handler on the device round-trip "
+                                f"— make the forward/score decision a "
+                                f"DeferredVerdict continuation "
+                                f"(network/forwarding.py)",
+                            )
+                        )
                     dispatch = self._device_dispatch_call(node)
                     if check_dispatch and dispatch:
                         out.append(
@@ -658,6 +690,18 @@ class NodeHygieneRule(Rule):
                             )
                         )
         return out
+
+    @staticmethod
+    def _sync_verdict_wait(node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "result":
+                return ".result()"
+            if fn.attr in _SYNC_VERIFY_FNS:
+                return f"{fn.attr}()"
+        if isinstance(fn, ast.Name) and fn.id in _SYNC_VERIFY_FNS:
+            return f"{fn.id}()"
+        return None
 
     @staticmethod
     def _device_dispatch_call(node: ast.Call) -> Optional[str]:

@@ -260,6 +260,26 @@ class ApiClient:
             raise
         return from_json(Attestation, payload["data"])
 
+    def get_packed_aggregate(self, slot: int, attestation_data_root: bytes):
+        """Aggregate-forward data plane (lodestar namespace): the best
+        verified packed layer for (slot, data root), or None — callers
+        fall back to get_aggregate_attestation."""
+        from ..types import Attestation
+        from .encoding import from_json
+
+        try:
+            payload = self._request(
+                "GET",
+                "/eth/v1/lodestar/packed_aggregate"
+                f"?slot={slot}"
+                f"&attestation_data_root=0x{attestation_data_root.hex()}",
+            )
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return from_json(Attestation, payload["data"])
+
     def publish_aggregate_and_proofs(self, signed_aggregates: list):
         from ..types import SignedAggregateAndProof
         from .encoding import to_json

@@ -135,6 +135,12 @@ ROUTES: Tuple[Route, ...] = (
     Route(
         "GET", "/eth/v1/validator/aggregate_attestation", "get_aggregate_attestation"
     ),
+    # aggregate-forward data plane (ISSUE 19): the best verified packed
+    # layer for (slot, data root) — a lodestar-namespace extension, not
+    # a standard beacon-API route
+    Route(
+        "GET", "/eth/v1/lodestar/packed_aggregate", "get_packed_aggregate"
+    ),
     Route(
         "POST",
         "/eth/v1/validator/aggregate_and_proofs",

@@ -41,6 +41,7 @@ class DefaultHandlers:
         slo=None,
         flight_recorder=None,
         proof_service=None,
+        aggregate_forwarder=None,
     ):
         self.version = version
         self.genesis_time = genesis_time
@@ -68,6 +69,10 @@ class DefaultHandlers:
         # and proof namespaces; handlers keep their own host paths as
         # the no-service fallback
         self.proof_service = proof_service
+        # AggregateForwarder (network/forwarding.py): the aggregation
+        # duty's packed-aggregate source — already-summed verified
+        # layers instead of per-insert pool re-aggregation
+        self.aggregate_forwarder = aggregate_forwarder
 
     def get_health(self, params, body):
         return 200, None  # healthy; 206 while syncing in a full node
@@ -851,6 +856,25 @@ class DefaultHandlers:
         if agg is None:
             return 404, {"message": "no matching aggregate"}
         return 200, {"data": to_json(Attestation, agg)}
+
+    def get_packed_aggregate(self, params, body):
+        """GET /eth/v1/lodestar/packed_aggregate — the aggregate-forward
+        data plane's best verified pack for (slot, attestation data
+        root): an already-summed disjoint layer the device verified,
+        so the aggregation duty skips re-aggregating raw pool entries
+        (network/forwarding.py; 404 falls back to the pool path)."""
+        if self.aggregate_forwarder is None:
+            return 404, {"message": "aggregate forwarding not enabled"}
+        from ..types import Attestation
+        from .encoding import to_json
+
+        pack = self.aggregate_forwarder.get_packed_aggregate(
+            int(params["slot"]),
+            bytes.fromhex(params["attestation_data_root"][2:]),
+        )
+        if pack is None:
+            return 404, {"message": "no verified pack for root"}
+        return 200, {"data": to_json(Attestation, pack)}
 
     def publish_aggregate_and_proofs(self, params, body):
         err = self._need_chain()

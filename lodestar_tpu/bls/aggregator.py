@@ -153,6 +153,11 @@ class PreVerifyAggregator:
     ):
         self._pipeline = pipeline
         self._lane_wait = lane_wait_s
+        # aggregate-forward hook (ISSUE 19, network/forwarding.py):
+        # `fn(wire, n_members)` fires OUTSIDE the pipeline lock for
+        # every VERIFIED materialized multi-member layer — the network
+        # plane re-packs it onto the aggregate topic
+        self.on_layer_verified = None
         # List[List[bytes]] -> List[Optional[bytes]]: the G2 point-add of
         # each group's compressed signatures (TpuBlsVerifier's device/
         # host implementation, or a test stub's oracle)
@@ -383,6 +388,7 @@ class PreVerifyAggregator:
         members = getattr(job, "agg_members", None) or []
         exc = fut.exception() if fut.done() else None
         attribute: List[Tuple[Optional[str], Optional[str]]] = []
+        forward: Optional[Tuple[WireSignatureSet, int]] = None
         with self._pipeline._lock:
             if exc is not None:
                 for c in members:
@@ -393,6 +399,20 @@ class PreVerifyAggregator:
                     self._record_seen_locked(c, True)
                     for target in c.targets:
                         self._credit_locked(target, True)
+                if len(members) > 1 and len(job.sets) == 1:
+                    # a materialized multi-member layer VERIFIED: its
+                    # union set is a re-publishable pack.  Mark the
+                    # aggregated (root, indices, signature) in the
+                    # seen-map too — an echoed copy of our own pack (or
+                    # the same pack from a peer) serves with zero
+                    # device work
+                    union = job.sets[0]
+                    self._seen[union.dedupe_key()] = True
+                    self._seen.move_to_end(union.dedupe_key())
+                    while len(self._seen) > SEEN_VERDICTS:
+                        self._seen.popitem(last=False)
+                    if self.on_layer_verified is not None:
+                        forward = (union, len(members))
             elif len(members) <= 1:
                 for c in members:
                     self._record_seen_locked(c, False)
@@ -428,6 +448,13 @@ class PreVerifyAggregator:
                     self.scorer.on_invalid_message(peer, topic)
                 except Exception:  # noqa: BLE001 — scoring must never
                     pass  # break verdict delivery
+        if forward is not None:
+            # re-publication is an optimization running on the resolver
+            # thread: a forwarder fault must never break verdict fan-out
+            try:
+                self.on_layer_verified(forward[0], forward[1])
+            except Exception:  # noqa: BLE001
+                pass
         self.drain()
 
     def _record_seen_locked(self, c: _Contribution, verdict: bool) -> None:

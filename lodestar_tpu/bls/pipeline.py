@@ -217,6 +217,15 @@ class BlsVerificationPipeline(BlsVerifierService):
         if self._agg is not None:
             self._agg.scorer = scorer
 
+    def set_layer_forward(self, fn) -> None:
+        """Late-bind the aggregate-forward hook (ISSUE 19):
+        `fn(wire, n_members)` fires for every VERIFIED materialized
+        multi-member layer — the network plane's AggregateForwarder
+        re-packs it onto the aggregate topic.  No-op when the
+        aggregation stage is off."""
+        if self._agg is not None:
+            self._agg.on_layer_verified = fn
+
     def verify_signature_sets_async(self, sets, opts=None):
         fut = super().verify_signature_sets_async(sets, opts)
         if self._agg is not None and self._agg._deferred:

@@ -97,11 +97,19 @@ class GossipValidators:
         # raw-verifier call — they coalesce with other critical sets
         # and can never be starved behind subnet-attestation bucket
         # fill (ISSUE 12 satellite, the PR 11 ROADMAP leftover).
-        # Subnet attestations stay on the raw verifier: their verdict
-        # gates the synchronous gossip forward decision, and the
-        # standard lane's 250 ms window is not a price this call site
-        # can pay per message.
+        # Subnet attestations ride the STANDARD lane asynchronously
+        # (validate_attestation_async): the forward/score decision is a
+        # DeferredVerdict continuation fired on verdict resolution, so
+        # the 250 ms coalescing window (and the pre-verify aggregation
+        # stage behind it) no longer blocks the gossip loop — the
+        # ISSUE 19 tentpole clearing the PR 13 leftover.  The sync path
+        # below remains for service-less compositions and the
+        # LODESTAR_TPU_BLS_AGGFWD=0 escape hatch.
         self.service = bls_service
+        # optional network/forwarding.AggregateForwarder: attestation
+        # pre-checks register (signing root -> committee) so verified
+        # disjoint layers can re-pack onto the aggregate topic
+        self.forwarder = None
         # wall-clock slot source (the node's Clock).  Without one the
         # head slot is the fallback — degraded when the head lags (fresh
         # messages beyond head+1 are ignored), so live compositions
@@ -206,12 +214,14 @@ class GossipValidators:
 
     # -- beacon_attestation_{subnet} (reference: validation/attestation.ts)
 
-    def validate_attestation(
+    def _attestation_prechecks(
         self, attestation: dict, subnet: Optional[int] = None
-    ) -> dict:
-        """Unaggregated attestation: exactly one bit, correct subnet,
-        fresh attester, known root, valid signature.  Returns the
-        indexed attestation."""
+    ):
+        """Everything `validate_attestation` checks BEFORE the
+        signature (raising GossipValidationError exactly as the sync
+        path) — shared by the sync and async-deferred entry points so
+        the LODESTAR_TPU_BLS_AGGFWD=0 hatch stays bit-for-bit.
+        Returns (view, indexed, attester, epoch, signature set)."""
         data = attestation["data"]
         self._check_slot_window(int(data["slot"]))
         # p2p spec: attestation.data.target.epoch == epoch of the slot.
@@ -257,16 +267,194 @@ class GossipValidators:
         if self.seen_attesters.is_known(epoch, attester):
             _ignore(f"attester {attester} already seen in epoch {epoch}")
         self._check_block_known(data["beacon_block_root"])
-        self._verify([get_indexed_attestation_signature_set(view, indexed)])
-        # post-verdict effects (race guard: re-check then mark)
+        sset = get_indexed_attestation_signature_set(view, indexed)
+        return view, indexed, attester, epoch, sset
+
+    def _attestation_accept_effects(
+        self, attestation: dict, attester: int, epoch: int
+    ) -> bool:
+        """Post-verdict side effects in their current order (race
+        guard: re-check then mark).  False when a duplicate won the
+        race while verifying (caller IGNOREs)."""
         if self.seen_attesters.is_known(epoch, attester):
-            _ignore("attester seen while verifying")
+            return False
         self.seen_attesters.add(epoch, attester)
         self.chain.add_attestation(attestation)
         self.chain.fork_choice.on_attestation(
-            int(attester), epoch, bytes(data["beacon_block_root"]).hex()
+            int(attester),
+            epoch,
+            bytes(attestation["data"]["beacon_block_root"]).hex(),
         )
+        return True
+
+    def validate_attestation(
+        self, attestation: dict, subnet: Optional[int] = None
+    ) -> dict:
+        """Unaggregated attestation: exactly one bit, correct subnet,
+        fresh attester, known root, valid signature.  Returns the
+        indexed attestation."""
+        _view, indexed, attester, epoch, sset = self._attestation_prechecks(
+            attestation, subnet
+        )
+        self._verify([sset])
+        if not self._attestation_accept_effects(attestation, attester, epoch):
+            _ignore("attester seen while verifying")
         return indexed
+
+    def validate_attestation_async(
+        self,
+        attestation: dict,
+        subnet: Optional[int] = None,
+        on_accept=None,
+        on_suppressed=None,
+    ):
+        """Asynchronously verdict-gated attestation validation (ISSUE 19
+        tentpole): the pre-checks run synchronously — raising
+        GossipValidationError exactly like the sync path — then the
+        signature rides the pipeline's STANDARD lane (coalescing window
+        + pre-verify aggregation) and the forward/score decision
+        becomes a continuation on the returned DeferredVerdict.
+
+        `on_accept(indexed)` fires after the accept-side effects (the
+        handler's slasher ingestion); `on_suppressed(attestation)`
+        fires when a duplicate won the seen-cache race while verifying
+        (the handler's suppressed-double-vote recovery).  Requires a
+        wired bls service."""
+        from ..network.forwarding import DeferredVerdict
+
+        _view, indexed, attester, epoch, sset = self._attestation_prechecks(
+            attestation, subnet
+        )
+        data = attestation["data"]
+        slot = int(data["slot"])
+        if self.forwarder is not None:
+            try:
+                committee = self._committee(slot, int(data["index"]))
+                self.forwarder.register_root(
+                    sset.signing_root, slot, data, committee
+                )
+            except GossipValidationError:
+                pass  # no committee cache: validation proceeds, the
+                # layer just cannot re-pack for this root
+        deferred = DeferredVerdict(slot=slot)
+        # NOTE: no peer_id/topic in the options — on the deferred path
+        # the REJECT charge flows through the bus's verdict
+        # continuation (scorer.on_verdict), and double-charging via the
+        # aggregator's own attribution would square the P4 penalty
+        fut = self.service.verify_signature_sets_async(
+            [sset], VerifyOptions(batchable=True)
+        )
+
+        def _on_verdict(f):
+            try:
+                ok = f.result()
+            except Exception:
+                # pipeline shutdown / device fault: not the sender's
+                # fault — never REJECT-score on an internal error
+                deferred.resolve(GossipAction.IGNORE)
+                return
+            if not ok:
+                deferred.resolve(GossipAction.REJECT)
+                return
+            try:
+                if not self._attestation_accept_effects(
+                    attestation, attester, epoch
+                ):
+                    if on_suppressed is not None:
+                        on_suppressed(attestation)
+                    deferred.resolve(GossipAction.IGNORE)
+                    return
+                if on_accept is not None:
+                    on_accept(indexed)
+            except Exception:  # noqa: BLE001 — the signature VERIFIED;
+                # an internal pool/fork-choice fault must not
+                # REJECT-score the honest forwarding peer
+                deferred.resolve(GossipAction.IGNORE)
+                return
+            deferred.resolve(None)
+
+        fut.add_done_callback(_on_verdict)
+        return deferred
+
+    # -- packed aggregate-forward re-publications (ISSUE 19) ---------------
+
+    def validate_packed_aggregate(self, signed_agg: dict):
+        """A PACKED_AGGREGATOR_INDEX re-publication (network/
+        forwarding.py): an upstream node's verified disjoint-index
+        layer re-packed onto the aggregate topic.  The selection proof
+        and outer signature are zero-byte sentinels — only the inner
+        aggregated attestation signature is meaningful, and this node
+        re-verifies it itself (through the standard lane, where the
+        pre-verify aggregation seen-map usually serves the verdict for
+        free).  Returns a DeferredVerdict (possibly already
+        resolved)."""
+        from ..network.forwarding import DeferredVerdict
+
+        msg = signed_agg["message"]
+        aggregate = msg["aggregate"]
+        data = aggregate["data"]
+        slot = int(data["slot"])
+        self._check_slot_window(slot)
+        if int(data["target"]["epoch"]) != slot // params.SLOTS_PER_EPOCH:
+            _reject("target epoch does not match attestation slot")
+        if not any(aggregate["aggregation_bits"]):
+            _reject("empty aggregation bits")
+        self._check_block_known(data["beacon_block_root"])
+        view = self._view()
+        try:
+            indexed = view.get_indexed_attestation(aggregate)
+        except Exception as e:
+            _reject(f"no committee: {e}")
+        epoch = int(data["target"]["epoch"])
+        attesters = [int(i) for i in indexed["attesting_indices"]]
+        if all(self.seen_attesters.is_known(epoch, a) for a in attesters):
+            _ignore("all packed attesters already seen")
+        sset = get_indexed_attestation_signature_set(view, indexed)
+        deferred = DeferredVerdict(slot=slot)
+        root_hex = bytes(data["beacon_block_root"]).hex()
+
+        def _apply_ok():
+            for a in attesters:
+                if not self.seen_attesters.is_known(epoch, a):
+                    self.seen_attesters.add(epoch, a)
+                    self.chain.fork_choice.on_attestation(a, epoch, root_hex)
+            self.chain.add_aggregate(signed_agg)
+
+        # a pack built from contributions this node also verified is an
+        # exact (root, indices, signature) seen-map hit: zero device work
+        served = None
+        lookup = getattr(self.service, "preagg_verdict", None)
+        if lookup is not None:
+            served = lookup(sset)
+        if served is not None:
+            if served:
+                _apply_ok()
+                deferred.resolve(None)
+            else:
+                deferred.resolve(GossipAction.REJECT)
+            return deferred
+        fut = self.service.verify_signature_sets_async(
+            [sset], VerifyOptions(batchable=True)
+        )
+
+        def _on_verdict(f):
+            try:
+                ok = f.result()
+            except Exception:
+                deferred.resolve(GossipAction.IGNORE)
+                return
+            if not ok:
+                deferred.resolve(GossipAction.REJECT)
+                return
+            try:
+                _apply_ok()
+            except Exception:  # noqa: BLE001 — verified; internal
+                deferred.resolve(GossipAction.IGNORE)  # faults never
+                return  # REJECT-score the forwarding peer
+            deferred.resolve(None)
+
+        fut.add_done_callback(_on_verdict)
+        return deferred
 
     # -- beacon_aggregate_and_proof (reference: aggregateAndProof.ts) ------
 

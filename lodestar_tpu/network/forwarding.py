@@ -1,0 +1,425 @@
+"""Aggregate-forward gossip: deferred forward verdicts + packed
+re-publication (ISSUE 19 tentpole).
+
+PR 13's PreVerifyAggregator spends the aggregated-signature-gossip
+insight (arXiv:1911.04698) only on OUR verification cost — every
+downstream peer still receives and verifies the full flood of
+overlapping subnet attestations, and the committee-consensus
+measurements (arXiv:2302.00418) locate per-message signature work as
+exactly what caps node count.  This module moves the win into the
+network plane, in two coupled pieces:
+
+  - **Deferred forward verdicts.**  Subnet attestation handlers no
+    longer block on the raw verifier for the gossip forward/score
+    decision: validation returns a `DeferredVerdict` and the signature
+    rides the pipeline's standard lane (coalescing + pre-verify
+    aggregation), with the forward/score decision a continuation fired
+    on verdict resolution.  `DeferredForwardQueue` (owned by the
+    NetworkProcessor) bounds the number of in-flight deferrals with
+    per-slot expiry — a verdict resolving after its slot's forward
+    window DROPS instead of forwarding a stale attestation, and a
+    backpressure shed releases its deferred slot while charging the
+    publisher (gossipsub P7, like any other shed).
+  - **Aggregate-forward.**  Every verified multi-member disjoint-index
+    layer the PreVerifyAggregator produces is re-packed into a
+    `SignedAggregateAndProof`-shaped message under the reserved
+    `PACKED_AGGREGATOR_INDEX` sentinel and re-published on the
+    aggregate topic: downstream peers receive — and verify — ONE
+    aggregated set per (root, layer) instead of dozens of overlapping
+    singles.  The bus marks the publisher as having seen its own
+    message id, so a re-published pack never echoes back for
+    re-verification and is never charged to a peer.
+
+Soundness (README "Aggregate-forward gossip"): only layers the device
+already VERIFIED are re-published, their index sets are pairwise
+disjoint within a layer by construction (plan_disjoint_gathers), and
+receivers re-verify the packed signature themselves — the pack is a
+bandwidth/verification optimization, never a trust assertion.
+
+Escape hatch: `LODESTAR_TPU_BLS_AGGFWD=0` restores the raw-sync subnet
+handler behaviour bit-for-bit (no deferrals, no re-publication).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.logger import get_logger
+
+# Reserved aggregator-index sentinel for re-published packed layers: no
+# real validator can hold uint64-max (VALIDATOR_REGISTRY_LIMIT is 2^40),
+# so receivers can dispatch packed messages without ambiguity and nodes
+# running with aggregate-forward disabled REJECT them naturally (the
+# sentinel is never in any committee).
+PACKED_AGGREGATOR_INDEX = (1 << 64) - 1
+
+# DeferredForwardQueue bounds: in-flight deferrals (the standard lane
+# resolves within its 250 ms window, so steady state is far below this)
+# and how many slots a deferral may outlive its attestation's slot.
+MAX_DEFERRED_FORWARDS = 4096
+DEFERRED_EXPIRY_SLOTS = 1
+
+# AggregateForwarder bounds: registered (signing root -> committee)
+# entries and retained best packs, both pruned per clock slot.
+MAX_REGISTERED_ROOTS = 8192
+MAX_RETAINED_PACKS = 512
+PACK_RETAIN_SLOTS = 2
+# a 1-member "layer" carries no bandwidth win — never re-publish it
+MIN_PACK_MEMBERS = 2
+
+
+def aggfwd_enabled() -> bool:
+    """`LODESTAR_TPU_BLS_AGGFWD` gate (default on) — same off-value
+    grammar as the PIPELINE/PREAGG hatches."""
+    env = os.environ.get("LODESTAR_TPU_BLS_AGGFWD", "1")
+    return env.strip().lower() not in ("0", "false", "no", "off")
+
+
+class DeferredVerdict:
+    """A gossip verdict that resolves later (None = ACCEPT, else the
+    GossipAction), with continuations fired on resolution.
+
+    The bus duck-types on `on_resolve` (gossip.py): a handler returning
+    one of these has its sender scored when the verdict lands instead
+    of at delivery time.  `drop(reason)` — slot expiry, backpressure
+    shed — wins over resolution: a dropped deferral NEVER fires its
+    continuations, so a late verdict neither forwards a stale
+    attestation nor scores its sender.  Callbacks always run OUTSIDE
+    the internal lock, on whichever thread resolves/registers last.
+    """
+
+    __slots__ = ("slot", "_lock", "_callbacks", "_resolved", "verdict",
+                 "dropped", "drop_reason")
+
+    def __init__(self, slot: Optional[int] = None):
+        self.slot = slot
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable] = []
+        self._resolved = False
+        self.verdict = None
+        self.dropped = False
+        self.drop_reason: Optional[str] = None
+
+    def on_resolve(self, fn: Callable) -> None:
+        """Register `fn(verdict)`; fires immediately when the verdict
+        already landed (and the deferral was not dropped first)."""
+        with self._lock:
+            if not self._resolved:
+                self._callbacks.append(fn)
+                return
+            fire = not self.dropped
+        if fire:
+            fn(self.verdict)
+
+    def resolve(self, verdict) -> None:
+        """Idempotent; the first resolution wins.  Fires continuations
+        unless the deferral was dropped first."""
+        with self._lock:
+            if self._resolved:
+                return
+            self._resolved = True
+            self.verdict = verdict
+            callbacks, self._callbacks = self._callbacks, []
+            fire = not self.dropped
+        if fire:
+            for fn in callbacks:
+                fn(verdict)
+
+    def drop(self, reason: str) -> bool:
+        """Mark dropped BEFORE resolution: continuations never fire.
+        Returns False when the verdict already landed (too late)."""
+        with self._lock:
+            if self._resolved or self.dropped:
+                return False
+            self.dropped = True
+            self.drop_reason = reason
+            self._callbacks = []
+            return True
+
+    @property
+    def resolved(self) -> bool:
+        with self._lock:
+            return self._resolved
+
+
+class _DeferredEntry:
+    __slots__ = ("deferred", "slot", "peer_id", "topic")
+
+    def __init__(self, deferred, slot, peer_id, topic):
+        self.deferred = deferred
+        self.slot = slot
+        self.peer_id = peer_id
+        self.topic = topic
+
+
+class DeferredForwardQueue:
+    """Bounded registry of in-flight DeferredVerdicts with per-slot
+    expiry (the NetworkProcessor owns one; reference analogue: the
+    processor's awaiting-reprocess parking, index.ts:281-299).
+
+      - normal resolution removes the entry (a cleanup continuation is
+        registered FIRST, so it runs before any scoring continuation),
+      - `on_clock_slot` drops entries older than DEFERRED_EXPIRY_SLOTS
+        past their attestation slot — a late verdict then resolves into
+        nothing instead of forwarding a stale attestation,
+      - at capacity the OLDEST entry is shed: its deferral drops (slot
+        released) and the shed charges the publisher through the
+        scorer's backpressure penalty (gossipsub P7), exactly like a
+        gossip-queue overflow drop.
+    """
+
+    def __init__(
+        self,
+        scorer=None,
+        max_entries: int = MAX_DEFERRED_FORWARDS,
+        expiry_slots: int = DEFERRED_EXPIRY_SLOTS,
+    ):
+        self.scorer = scorer
+        self.max_entries = max_entries
+        self.expiry_slots = expiry_slots
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, _DeferredEntry]" = OrderedDict()
+        self._next_key = 0
+        self.stats = {"registered": 0, "fired": 0, "expired": 0, "shed": 0}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def register(
+        self,
+        deferred: DeferredVerdict,
+        slot: Optional[int] = None,
+        peer_id: Optional[str] = None,
+        topic: Optional[str] = None,
+    ) -> None:
+        if slot is None:
+            slot = deferred.slot
+        shed: List[_DeferredEntry] = []
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._entries[key] = _DeferredEntry(deferred, slot, peer_id, topic)
+            self.stats["registered"] += 1
+            while len(self._entries) > self.max_entries:
+                _k, entry = self._entries.popitem(last=False)
+                self.stats["shed"] += 1
+                shed.append(entry)
+
+        def _cleanup(_verdict, key=key):
+            with self._lock:
+                if self._entries.pop(key, None) is not None:
+                    self.stats["fired"] += 1
+
+        deferred.on_resolve(_cleanup)
+        for entry in shed:
+            entry.deferred.drop("shed")
+            self._charge_shed(entry)
+
+    def _charge_shed(self, entry: _DeferredEntry) -> None:
+        if self.scorer is None or entry.peer_id is None:
+            return
+        try:
+            self.scorer.on_backpressure_drop(entry.peer_id, entry.topic)
+        except Exception:  # noqa: BLE001 — scoring must never break
+            pass  # verdict bookkeeping
+
+    def on_clock_slot(self, slot: int) -> None:
+        """Expire deferrals whose attestation slot fell out of the
+        forward window (slot-less entries never expire — they are
+        bounded by the shed cap)."""
+        expired: List[_DeferredEntry] = []
+        with self._lock:
+            for key in list(self._entries):
+                entry = self._entries[key]
+                if entry.slot is not None and entry.slot + self.expiry_slots < slot:
+                    del self._entries[key]
+                    self.stats["expired"] += 1
+                    expired.append(entry)
+        for entry in expired:
+            entry.deferred.drop("expired")
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.stats)
+
+
+class _RootMeta:
+    __slots__ = ("slot", "data", "data_root", "committee")
+
+    def __init__(self, slot, data, data_root, committee):
+        self.slot = slot
+        self.data = data
+        self.data_root = data_root
+        self.committee = committee
+
+
+class AggregateForwarder:
+    """Re-packs verified disjoint-index layers into aggregate-topic
+    publications and serves the best pack to the local aggregation duty.
+
+    `register_root` is called from attestation validation pre-checks
+    (the committee lookup already happened there); `on_layer_verified`
+    is the PreVerifyAggregator's success hook (bls/aggregator.py,
+    invoked OUTSIDE the pipeline lock) — it maps the layer's validator
+    indices back onto the registered committee's aggregation bits,
+    wraps the already-summed signature as a PACKED_AGGREGATOR_INDEX
+    `SignedAggregateAndProof`, and publishes.  The bus marks the
+    publisher seen for its own message id at publish time, so the pack
+    never echoes back (the self-publish seen-cache rule).
+    """
+
+    def __init__(self, bus=None, node_id: Optional[str] = None,
+                 fork_digest: Optional[bytes] = None):
+        self.bus = bus
+        self.node_id = node_id
+        self.fork_digest = fork_digest
+        self.log = get_logger("network/forwarding")
+        self._lock = threading.Lock()
+        self._roots: "OrderedDict[bytes, _RootMeta]" = OrderedDict()
+        # (slot, data_root) -> (member count, attestation value) — the
+        # largest verified pack per vote, the aggregation duty's source
+        self._packs: "OrderedDict[Tuple[int, bytes], Tuple[int, dict]]" = (
+            OrderedDict()
+        )
+        self.stats = {
+            "published": 0,
+            "bytes_published": 0,
+            "members_forwarded": 0,
+            "skipped": 0,
+        }
+
+    # -- registration (validation pre-checks) ------------------------------
+
+    def register_root(
+        self, signing_root: bytes, slot: int, data: dict, committee
+    ) -> None:
+        from ..types import AttestationData
+
+        key = bytes(signing_root)
+        with self._lock:
+            if key in self._roots:
+                self._roots.move_to_end(key)
+                return
+            data_root = bytes(AttestationData.hash_tree_root(data))
+            self._roots[key] = _RootMeta(
+                int(slot), data, data_root, tuple(int(v) for v in committee)
+            )
+            while len(self._roots) > MAX_REGISTERED_ROOTS:
+                self._roots.popitem(last=False)
+
+    # -- the publish hook (PreVerifyAggregator success path) ---------------
+
+    def on_layer_verified(self, wire, n_members: int) -> None:
+        """`wire` is the verified layer's aggregated WireSignatureSet
+        (disjoint validator indices, summed signature)."""
+        if n_members < MIN_PACK_MEMBERS:
+            return
+        with self._lock:
+            meta = self._roots.get(bytes(wire.signing_root))
+        if meta is None:
+            # not an attestation root this node registered (e.g. a
+            # foreign wire set routed through the stage) — nothing to
+            # re-publish
+            with self._lock:
+                self.stats["skipped"] += 1
+            return
+        indices = set(int(i) for i in wire.indices)
+        committee_set = set(meta.committee)
+        if not indices <= committee_set:
+            with self._lock:
+                self.stats["skipped"] += 1
+            return
+        bits = [v in indices for v in meta.committee]
+        attestation = {
+            "aggregation_bits": bits,
+            "data": meta.data,
+            "signature": bytes(wire.signature),
+        }
+        with self._lock:
+            key = (meta.slot, meta.data_root)
+            best = self._packs.get(key)
+            if best is None or best[0] < len(indices):
+                self._packs[key] = (len(indices), attestation)
+                self._packs.move_to_end(key)
+            while len(self._packs) > MAX_RETAINED_PACKS:
+                self._packs.popitem(last=False)
+        self._publish(attestation, len(indices))
+
+    def _publish(self, attestation: dict, n_members: int) -> None:
+        if self.bus is None or self.node_id is None or self.fork_digest is None:
+            return
+        from ..types import SignedAggregateAndProof
+        from .gossip import GossipTopicName, encode_message, topic_string
+
+        signed = {
+            "message": {
+                "aggregator_index": PACKED_AGGREGATOR_INDEX,
+                "aggregate": attestation,
+                "selection_proof": b"\x00" * 96,
+            },
+            "signature": b"\x00" * 96,
+        }
+        try:
+            payload = encode_message(
+                SignedAggregateAndProof.serialize(signed)
+            )
+            topic = topic_string(
+                self.fork_digest, GossipTopicName.beacon_aggregate_and_proof
+            )
+            # publish marks this node as having seen its own message id,
+            # so the pack never comes back for re-verification and no
+            # peer is ever charged for it
+            self.bus.publish(self.node_id, topic, payload)
+        except Exception as e:  # noqa: BLE001 — re-publication is an
+            # optimization; a transport fault must never break verdict
+            # delivery on the resolver thread
+            self.log.warn("aggregate-forward publish failed", error=str(e))
+            return
+        with self._lock:
+            self.stats["published"] += 1
+            self.stats["bytes_published"] += len(payload)
+            self.stats["members_forwarded"] += n_members
+
+    # -- the consume side (validator aggregation duty) ---------------------
+
+    def get_packed_aggregate(
+        self, slot: int, data_root: bytes
+    ) -> Optional[dict]:
+        """Largest verified pack for (slot, data_root), or None — the
+        aggregation duty consumes the already-summed layer instead of
+        re-aggregating raw pool entries."""
+        with self._lock:
+            entry = self._packs.get((int(slot), bytes(data_root)))
+            return entry[1] if entry is not None else None
+
+    def on_clock_slot(self, slot: int) -> None:
+        with self._lock:
+            for key in [
+                k for k, m in self._roots.items()
+                if m.slot + PACK_RETAIN_SLOTS < slot
+            ]:
+                del self._roots[key]
+            for key in [
+                k for k in self._packs
+                if k[0] + PACK_RETAIN_SLOTS < slot
+            ]:
+                del self._packs[key]
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.stats)
+
+
+__all__ = [
+    "AggregateForwarder",
+    "DeferredForwardQueue",
+    "DeferredVerdict",
+    "PACKED_AGGREGATOR_INDEX",
+    "MAX_DEFERRED_FORWARDS",
+    "DEFERRED_EXPIRY_SLOTS",
+    "aggfwd_enabled",
+]

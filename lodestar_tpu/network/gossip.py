@@ -119,7 +119,7 @@ class InMemoryGossipBus:
         from collections import deque
 
         self.seen_cap = seen_cap
-        # topic -> [(node_id, handler, scorer-or-None)]
+        # topic -> [(node_id, handler, scorer-or-None, wants_peer)]
         self._subs: Dict[str, List[Tuple[str, Callable, object]]] = defaultdict(list)
         self._seen: Dict[str, set] = defaultdict(set)
         self._seen_order: Dict[str, "deque"] = defaultdict(deque)
@@ -192,10 +192,45 @@ class InMemoryGossipBus:
         while len(order) > self.seen_cap:
             seen.discard(order.popleft())
 
+    @staticmethod
+    def _accepts_peer(handler: Callable) -> bool:
+        """Does the handler take a third REQUIRED positional arg (the
+        publisher id)?  Decided ONCE at subscribe time — deferred-verdict
+        sheds charge the publisher through such handlers; plain
+        `(topic, data)` handlers keep working unchanged.  Defaulted
+        params never count: a closure-bound capture (`lambda t, d, n=n`)
+        must not have its binding clobbered by the publisher id —
+        handlers whose peer slot carries a default (GossipHandlers.handle's
+        `peer_id=None`) opt in with `subscribe(..., wants_peer=True)`."""
+        import inspect
+
+        try:
+            sig = inspect.signature(handler)
+        except (TypeError, ValueError):
+            return False
+        if any(
+            p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()
+        ):
+            return True
+        required = [
+            p
+            for p in sig.parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.default is p.empty
+        ]
+        return len(required) >= 3
+
     def subscribe(
-        self, node_id: str, topic: str, handler: Callable, scorer=None
+        self,
+        node_id: str,
+        topic: str,
+        handler: Callable,
+        scorer=None,
+        wants_peer: Optional[bool] = None,
     ) -> None:
-        self._subs[topic].append((node_id, handler, scorer))
+        if wants_peer is None:
+            wants_peer = self._accepts_peer(handler)
+        self._subs[topic].append((node_id, handler, scorer, wants_peer))
 
     def unsubscribe(self, node_id: str, topic: str) -> None:
         self._subs[topic] = [
@@ -215,7 +250,7 @@ class InMemoryGossipBus:
         # not echo back (gossipsub inserts published ids into seenCache)
         self._mark_seen(from_node, msg_id)
         delivered = 0
-        for node_id, handler, scorer in list(self._subs[topic]):
+        for node_id, handler, scorer, wants_peer in list(self._subs[topic]):
             if node_id == from_node:
                 continue
             if scorer is not None and scorer.is_banned(from_node):
@@ -231,11 +266,27 @@ class InMemoryGossipBus:
                 continue
             self._mark_seen(node_id, msg_id)
             try:
-                verdict = handler(topic, data)
+                if wants_peer:
+                    verdict = handler(topic, data, from_node)
+                else:
+                    verdict = handler(topic, data)
                 delivered += 1
                 self.delivered += 1
                 if scorer is not None:
-                    scorer.on_verdict(from_node, topic, verdict)
+                    on_resolve = getattr(verdict, "on_resolve", None)
+                    if on_resolve is not None:
+                        # asynchronously verdict-gated (ISSUE 19): the
+                        # sender is scored when the verdict lands; a
+                        # dropped deferral (slot expiry, shed) never
+                        # fires, so a late verdict neither forwards nor
+                        # scores
+                        on_resolve(
+                            lambda v, fn=from_node, t=topic, s=scorer: (
+                                s.on_verdict(fn, t, v)
+                            )
+                        )
+                    else:
+                        scorer.on_verdict(from_node, topic, verdict)
             except Exception as e:  # noqa: BLE001 - subscriber isolation
                 self.log.warn(
                     "gossip handler failed", topic=topic, error=str(e)

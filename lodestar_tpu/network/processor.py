@@ -138,6 +138,15 @@ class NetworkProcessor:
         # slot -> root -> [messages awaiting that block]
         self._awaiting: Dict[int, Dict[str, List[PendingGossipMessage]]] = {}
         self._awaiting_count = 0
+        # deferred forward verdicts (ISSUE 19, network/forwarding.py):
+        # subnet attestation forward/score decisions awaiting their
+        # pipeline verdict, bounded + expired per slot like _awaiting —
+        # a verdict resolving after its slot's forward window drops
+        # instead of forwarding a stale attestation, and a shed charges
+        # the publisher (P7) exactly like a queue-overflow drop
+        from .forwarding import DeferredForwardQueue
+
+        self.deferred_forwards = DeferredForwardQueue(scorer=scorer)
 
     # -- ingress (reference: onPendingGossipsubMessage, index.ts:194-241) --
 
@@ -197,6 +206,8 @@ class NetworkProcessor:
     def on_clock_slot(self, slot: int) -> None:
         self.current_slot = slot
         self._backpressure_reported = False  # re-arm the trip hook
+        # late deferred verdicts drop before anything else this slot
+        self.deferred_forwards.on_clock_slot(slot)
         # awaiting messages are pruned every slot (reference: index.ts:281-299)
         for s in list(self._awaiting):
             if s < slot:

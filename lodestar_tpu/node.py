@@ -467,6 +467,21 @@ class FullBeaconNode:
             # the pipeline's high-water backpressure holds the pull loop
             scorer=self.scorer,
         )
+        # aggregate-forward gossip (ISSUE 19): deferred subnet verdicts
+        # are bounded/expired by the processor's queue, and verified
+        # disjoint layers re-pack onto the aggregate topic
+        self.handlers.deferred_forwards = self.processor.deferred_forwards
+        self.forwarder = None
+        if self.handlers.aggfwd and hasattr(self.bls, "set_layer_forward"):
+            from .network.forwarding import AggregateForwarder
+
+            self.forwarder = AggregateForwarder(
+                bus=opts.gossip_bus,
+                node_id=opts.node_id,
+                fork_digest=config.fork_digest(self.chain.head_state.slot),
+            )
+            self.handlers.set_forwarder(self.forwarder)
+            self.bls.set_layer_forward(self.forwarder.on_layer_verified)
 
         # slot-anchored SLO engine + flight recorder (ISSUE 12): the
         # engine evaluates the protocol's per-slot deadlines from the
@@ -786,6 +801,9 @@ class FullBeaconNode:
             self.clock.on_slot(lambda _s: self.scorer.decay())
         self.clock.on_slot(lambda _s: self.fork_choice.on_tick_slot())
         self.clock.on_slot(self.handlers.on_clock_slot)
+        if self.forwarder is not None:
+            # registered roots + retained packs prune per slot
+            self.clock.on_slot(self.forwarder.on_clock_slot)
         self.clock.on_slot(self.prepare_scheduler.on_slot)
         if self.chain.memory_governor is not None:
             # episode close + gauge refresh + epoch-cadence ledger
@@ -866,6 +884,7 @@ class FullBeaconNode:
                     slo=self.slo,
                     flight_recorder=self.flight_recorder,
                     proof_service=self.proof_service,
+                    aggregate_forwarder=self.forwarder,
                 )
             api_handlers.on_subnet_policy_change = _push_subnet_policy
             self.api = BeaconApiServer(api_handlers, port=opts.api_port)
@@ -874,8 +893,11 @@ class FullBeaconNode:
     def _process_gossip_message(self, msg) -> None:
         """Processor worker: full SSZ gossip messages dispatch through
         the per-topic handlers (msg.topic is a topic string; msg.data
-        the raw wire bytes)."""
-        self.handlers.handle(msg.topic, msg.data)
+        the raw wire bytes; peer_id attributes deferred-verdict sheds
+        to the publisher)."""
+        self.handlers.handle(
+            msg.topic, msg.data, peer_id=getattr(msg, "peer_id", None)
+        )
 
     def start(self) -> None:
         if self.slasher is not None:

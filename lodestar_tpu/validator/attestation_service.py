@@ -134,9 +134,22 @@ class AttestationService:
                 continue  # no duty publishes during the watch window
             if not is_aggregator(duty.get("committee_length", 1), proof):
                 continue
-            aggregate = self.api.get_aggregate_attestation(
-                slot, AttestationData.hash_tree_root(data)
-            )
+            data_root = AttestationData.hash_tree_root(data)
+            # aggregate-forward (ISSUE 19): prefer the already-summed
+            # verified layer from the node's forwarder — the pool path
+            # re-aggregates raw entries with a G2 point-add per insert,
+            # which the device already paid for once
+            aggregate = None
+            packed = getattr(self.api, "get_packed_aggregate", None)
+            if packed is not None:
+                try:
+                    aggregate = packed(slot, data_root)
+                except Exception:  # noqa: BLE001 — an optional-route
+                    aggregate = None  # miss must not break the duty
+            if aggregate is None:
+                aggregate = self.api.get_aggregate_attestation(
+                    slot, data_root
+                )
             if aggregate is None:
                 continue
             message = {

@@ -1,0 +1,315 @@
+"""Chaos scenario: an adversarial aggregator attacks the
+aggregate-forward plane (ISSUE 19).
+
+The adversary ships contributions designed to poison the pre-verify
+aggregation layers that feed the re-publication path: a forged
+signature on a FRESH committee index (lands inside the honest layer and
+poisons its sum) and a forged signature OVERLAPPING an honest index
+(forced into its own layer by the disjointness planner).  Three
+guarantees, all replay-asserted:
+
+  1. contributor-wise bisection isolates both forgeries — every honest
+     attestation still verifies (zero lost) and each forgery charges
+     its publisher through the scorer;
+  2. the surviving honest sub-layer STILL re-publishes as a packed
+     aggregate, each honest index appears in at most one pack (zero
+     double-forwarded), the publisher never sees its own pack echo
+     back, and an echoed copy of the pack serves from the preagg
+     seen-map with zero device work;
+  3. a deferral flood past the deferred-forward queue's capacity sheds
+     the adversary's entries and charges it on the gossipsub BEHAVIOUR
+     penalty (P7) — honest peers stay unpenalized.
+"""
+
+import hashlib
+import time
+
+import pytest
+
+from lodestar_tpu.bls.pipeline import BlsVerificationPipeline
+from lodestar_tpu.bls.signature_set import WireSignatureSet
+from lodestar_tpu.bls.verifier import VerifyOptions
+from lodestar_tpu.network.forwarding import (
+    PACKED_AGGREGATOR_INDEX,
+    AggregateForwarder,
+    DeferredForwardQueue,
+    DeferredVerdict,
+)
+from lodestar_tpu.network.gossip import (
+    GossipTopicName,
+    InMemoryGossipBus,
+    decode_message,
+    topic_string,
+)
+from lodestar_tpu.network.scoring import GossipPeerScorer, PeerScoreParams
+
+from chaos.harness import ChaosVerifier, ScenarioTrace, assert_replay, chaos_sig
+
+pytestmark = pytest.mark.smoke
+
+SEED = 1909
+DIGEST = b"\x19\x09\x00\x01"
+ROOT = b"adversarial aggregator root 32by"
+COMMITTEE = (0, 1, 2, 3, 9)
+SLOT = 1
+
+
+def _token(payload: bytes) -> bytes:
+    """A 96-byte signature token that PASSES the aggregator's cheap
+    wire parse (compression bit set, x coordinate < p) — chaos_sig's
+    raw digests do not, and an unparsable signature short-circuits to
+    a False verdict before ever reaching a layer."""
+    b = bytearray(96)
+    b[0] = 0x80
+    b[1:33] = hashlib.sha256(payload).digest()
+    return bytes(b)
+
+
+def agg_sig(root: bytes, indices) -> bytes:
+    """THE valid (parse-ok) signature for (root, indices) under this
+    scenario's oracle — the aggregation-plane analogue of chaos_sig."""
+    return _token(b"agg-sig" + bytes(root) + bytes(list(indices)))
+
+
+class ChaosSumVerifier(ChaosVerifier):
+    """ChaosVerifier + an agg_sig-consistent oracle G2 sum, so the
+    pre-verify aggregation stage (and the aggregate-forward hook behind
+    it) runs over the oracle: summing all-valid member signatures
+    yields exactly agg_sig(root, concatenated indices) — the token the
+    device/host truth accepts for the union set — while any invalid
+    member poisons the sum (the almost-sure behaviour of real point
+    addition)."""
+
+    def __init__(self, capacity: int = 64):
+        super().__init__(capacity=capacity)
+        self.oracle = {}  # signature token -> (root, indices, ok)
+        self.sum_calls = 0
+
+    def _truth(self, s) -> bool:
+        if isinstance(s, WireSignatureSet):
+            return s.signature == agg_sig(s.signing_root, s.indices)
+        return super()._truth(s)
+
+    def sig(self, root, indices, ok=True) -> bytes:
+        if ok:
+            s = agg_sig(root, indices)
+        else:  # forged: parse-valid bytes the truth accepts for nothing
+            s = _token(b"forged" + bytes(root) + bytes(list(indices)))
+        self.oracle[s] = (bytes(root), tuple(indices), bool(ok))
+        return s
+
+    def aggregate_wire_signatures(self, groups):
+        self.sum_calls += len(groups)
+        out = []
+        for g in groups:
+            infos = [self.oracle.get(bytes(s)) for s in g]
+            if any(i is None for i in infos):
+                out.append(None)
+                continue
+            root = infos[0][0]
+            idx = tuple(i for info in infos for i in info[1])
+            if all(i[2] for i in infos) and all(i[0] == root for i in infos):
+                out.append(agg_sig(root, idx))
+            else:  # a poisoned sum: parse-valid, accepted by nothing
+                out.append(_token(b"poisoned-sum" + root + bytes(list(idx))))
+        return out
+
+
+class ScorerSpy:
+    def __init__(self):
+        self.charged = []
+
+    def on_invalid_message(self, peer, topic):
+        self.charged.append((peer, topic))
+
+
+def _data(slot=SLOT):
+    zero = b"\x00" * 32
+    return {
+        "slot": slot,
+        "index": 0,
+        "beacon_block_root": zero,
+        "source": {"epoch": 0, "root": zero},
+        "target": {"epoch": 0, "root": zero},
+    }
+
+
+def _wait_for(pred, timeout=20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _run_adversarial_aggregator(trace: ScenarioTrace) -> None:
+    verifier = ChaosSumVerifier()
+    spy = ScorerSpy()
+    # a wide coalescing window: all six contributions must land in ONE
+    # stage flush regardless of cold-start jitter, or the layer split
+    # (and therefore the trace) would depend on wall-clock timing
+    pipe = BlsVerificationPipeline(
+        verifier, preagg=True, standard_wait_ms=250.0, scorer=spy
+    )
+    bus = InMemoryGossipBus()
+    agg_topic = topic_string(
+        DIGEST, GossipTopicName.beacon_aggregate_and_proof
+    )
+    received = []
+    echoes = []
+    bus.subscribe("downstream", agg_topic, lambda t, d: received.append(d))
+    bus.subscribe("self", agg_topic, lambda t, d: echoes.append(d))
+    fwd = AggregateForwarder(bus=bus, node_id="self", fork_digest=DIGEST)
+    fwd.register_root(ROOT, SLOT, _data(), COMMITTEE)
+    pipe.set_layer_forward(fwd.on_layer_verified)
+    try:
+        # -- leg 1: the poisoned flood --------------------------------
+        # honest contributions on indices 0..3, then the two attacks:
+        # a forgery on the FRESH index 9 (packs into the honest layer,
+        # poisons its sum) and a forgery OVERLAPPING index 0 (the
+        # disjointness planner exiles it to its own layer)
+        futures = []
+        for i in range(4):
+            ws = WireSignatureSet.single(i, ROOT, verifier.sig(ROOT, (i,)))
+            futures.append(
+                (
+                    f"honest-{i}",
+                    True,
+                    pipe.verify_signature_sets_async(
+                        [ws],
+                        VerifyOptions(
+                            batchable=True,
+                            peer_id=f"honest-{i}",
+                            topic="beacon_attestation",
+                        ),
+                    ),
+                )
+            )
+        for label, idx in (("fresh", 9), ("overlap", 0)):
+            ws = WireSignatureSet.single(
+                idx, ROOT, verifier.sig(ROOT, (idx,), ok=False)
+            )
+            futures.append(
+                (
+                    f"adversary/{label}",
+                    False,
+                    pipe.verify_signature_sets_async(
+                        [ws],
+                        VerifyOptions(
+                            batchable=True,
+                            peer_id="adversary",
+                            topic="beacon_attestation",
+                        ),
+                    ),
+                )
+            )
+        mismatches = []
+        for label, expected, fut in futures:
+            if fut.result(timeout=30.0) != expected:
+                mismatches.append(label)
+        # the surviving honest sub-layer re-publishes asynchronously on
+        # the resolver thread — wait for the pack to land downstream
+        assert _wait_for(lambda: len(received) >= 1)
+        packs = []
+        seen_indices = []
+        for payload in received:
+            from lodestar_tpu import types as T
+
+            signed = T.SignedAggregateAndProof.deserialize(
+                decode_message(payload)
+            )
+            assert (
+                int(signed["message"]["aggregator_index"])
+                == PACKED_AGGREGATOR_INDEX
+            )
+            bits = list(signed["message"]["aggregate"]["aggregation_bits"])
+            members = [v for v, b in zip(COMMITTEE, bits) if b]
+            packs.append(members)
+            seen_indices.extend(members)
+        trace.emit(
+            "forgery_isolated",
+            submitted=len(futures),
+            mismatches=mismatches,
+            charges=sorted({"%s:%s" % c for c in spy.charged}),
+            bisections=pipe.agg_stats()["bisections"],
+            packs=sorted(packs),
+            double_forwarded=len(seen_indices) - len(set(seen_indices)),
+            self_echoes=len(echoes),
+        )
+
+        # -- leg 2: the echoed pack serves from the seen-map ----------
+        pack = packs[0]
+        union = WireSignatureSet.aggregate(
+            tuple(pack), ROOT, agg_sig(ROOT, tuple(pack))
+        )
+        jobs_before = verifier.device_jobs
+        served = pipe.preagg_verdict(union)
+        trace.emit(
+            "echo_served",
+            served=bool(served),
+            device_jobs_spent=verifier.device_jobs - jobs_before,
+        )
+
+        # -- leg 3: deferral flood -> shed -> P7 ----------------------
+        scorer = GossipPeerScorer(
+            PeerScoreParams(
+                behaviour_penalty_weight=-100.0,
+                behaviour_penalty_threshold=2.0,
+                behaviour_penalty_decay=0.2,
+                decay_to_zero=0.01,
+            )
+        )
+        queue = DeferredForwardQueue(scorer=scorer, max_entries=2)
+        honest_deferred = DeferredVerdict(slot=SLOT)
+        queue.register(
+            honest_deferred,
+            peer_id="honest-0",
+            topic="beacon_attestation_0",
+        )
+        honest_deferred.resolve(None)  # resolves inside the window
+        for _ in range(5):
+            queue.register(
+                DeferredVerdict(slot=SLOT),
+                peer_id="adversary",
+                topic="beacon_attestation_0",
+            )
+        trace.emit(
+            "shed_charges_p7",
+            in_flight=len(queue),
+            shed=queue.stats_snapshot()["shed"],
+            adversary_penalized=scorer.behaviour_penalty("adversary") > 0,
+            honest_penalized=scorer.behaviour_penalty("honest-0") > 0,
+        )
+    finally:
+        pipe.close()
+
+
+def test_adversarial_aggregator_isolated_charged_replayed(tmp_path):
+    trace = ScenarioTrace(SEED)
+    _run_adversarial_aggregator(trace)
+    forgery, echo, shed = trace.events
+
+    # every honest attestation verified, both forgeries rejected
+    assert forgery["mismatches"] == []
+    # bisection ran and the charges hit ONLY the adversary's publisher
+    assert forgery["bisections"] >= 1
+    assert forgery["charges"] == ["adversary:beacon_attestation"]
+    # the honest sub-layer still re-packed; no index forwarded twice,
+    # and the publisher never saw its own pack echo back
+    assert forgery["packs"] and all(
+        len(p) >= 2 for p in forgery["packs"]
+    )
+    assert forgery["double_forwarded"] == 0
+    assert forgery["self_echoes"] == 0
+
+    # an echoed copy of our own pack costs zero device work
+    assert echo["served"] is True and echo["device_jobs_spent"] == 0
+
+    # the flood shed charged the adversary on P7, honest peers clean
+    assert shed["shed"] == 3 and shed["in_flight"] == 2
+    assert shed["adversary_penalized"] is True
+    assert shed["honest_penalized"] is False
+
+    record = trace.save(tmp_path / "scenario_adversarial_aggregator.json")
+    assert_replay(record, _run_adversarial_aggregator)

@@ -33,3 +33,15 @@ async def flush_traces(obs, path):
 
 def write_chrome_trace(path):  # stand-in for the observability sink
     return path
+
+
+async def handle_attestation(verifier, pipeline, ws, opts):
+    fut = pipeline.verify_signature_sets_async([ws], opts)
+    verdict = fut.result()  # BAD: sync verdict wait in async handler
+    ok = verifier.verify_signature_sets([ws], opts)  # BAD: sync verify
+    also = verify_signature_sets_individually([ws])  # BAD: bare import
+    return verdict and ok and also
+
+
+def verify_signature_sets_individually(sets):  # stand-in for the bare
+    return [True] * len(sets)  # import form

@@ -29,3 +29,16 @@ async def traced_poll(peer, trace_span):
     # opening a span in async code is fine — only the SINKS block
     with trace_span("network.poll", peer=str(peer)):
         await peer.send(b"ping")
+
+
+def handle_attestation_sync(verifier, ws, opts):
+    # sync verify in a SYNC function (the AGGFWD=0 escape hatch's
+    # raw-sync handler path): fine
+    return verifier.verify_signature_sets([ws], opts)
+
+
+async def handle_attestation_deferred(pipeline, deferred, ws, opts):
+    # the async seam: submit, register the continuation, never block
+    fut = pipeline.verify_signature_sets_async([ws], opts)
+    fut.add_done_callback(lambda f: deferred.resolve(None))
+    await pipeline.flush_soon()

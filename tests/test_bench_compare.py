@@ -278,6 +278,46 @@ def test_regen_pressure_metric_direction_registered(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_aggregate_forward_metric_directions_registered(tmp_path, capsys):
+    """ISSUE 19 satellite: `gossip_bytes_per_verified_att` regresses UP
+    (bytes are lower-is-better — a rise beyond threshold exits 1, a
+    drop exits 0, even unit-less via the registry) while
+    `aggregate_forward_factor` is a ratio — a drop regresses."""
+    m = "gossip_bytes_per_verified_att"
+    assert bench_compare._METRIC_UNITS[m] == "bytes/att"
+    assert "bytes/att" in bench_compare._LOWER_IS_BETTER_UNITS
+    grow = [
+        _round(tmp_path / "BENCH_r01.json",
+               tail_records=[{"metric": m, "value": 100.0,
+                              "unit": "bytes/att"}]),
+        _round(tmp_path / "BENCH_r02.json",
+               tail_records=[{"metric": m, "value": 400.0,
+                              "unit": "bytes/att"}]),
+    ]
+    assert bench_compare.main(grow + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+    # the same ratio the other way round is the improvement the ISSUE
+    # 19 tentpole buys; unit-less cells resolve through the registry
+    shrink = [
+        _round(tmp_path / "BENCH_r03.json",
+               tail_records=[{"metric": m, "value": 400.0}]),  # no unit
+        _round(tmp_path / "BENCH_r04.json",
+               tail_records=[{"metric": m, "value": 100.0}]),
+    ]
+    assert bench_compare.main(shrink + ["--threshold", "0.05"]) == 0
+    capsys.readouterr()
+    f = "aggregate_forward_factor"
+    assert bench_compare._METRIC_UNITS[f] == "ratio"
+    factor_drop = [
+        _round(tmp_path / "BENCH_r05.json",
+               tail_records=[{"metric": f, "value": 6.0, "unit": "ratio"}]),
+        _round(tmp_path / "BENCH_r06.json",
+               tail_records=[{"metric": f, "value": 2.0}]),  # unit-less
+    ]
+    assert bench_compare.main(factor_drop + ["--threshold", "0.05"]) == 1
+    capsys.readouterr()
+
+
 def test_unitless_time_metric_direction_resolved_by_registry(tmp_path, capsys):
     """A unit-less bls_rlc_bisect_seconds GROWTH still gates (the
     registry knows it is lower-is-better)."""

@@ -704,6 +704,138 @@ def test_bench_effective_probe_happy_path_emits_record(capsys, monkeypatch):
     assert "slo" in rec
 
 
+def test_bench_aggfwd_probe_skip_semantics(capsys):
+    """ISSUE 19 satellite: a broken probe run skips BOTH aggregate-
+    forward metrics with null values (never a measured zero)."""
+    import json
+
+    import bench
+
+    class Broken:
+        _use_rlc = True
+        table = []
+
+    bench._probe_aggregate_forward(Broken())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 2
+    assert [r["metric"] for r in recs] == [
+        "gossip_bytes_per_verified_att",
+        "aggregate_forward_factor",
+    ]
+    assert all(r["value"] is None and r["skipped"] for r in recs)
+    assert recs[0]["unit"] == "bytes/att" and recs[1]["unit"] == "ratio"
+    assert all("aggfwd-probe" in r["error"] for r in recs)
+
+
+def test_bench_aggfwd_probe_respects_escape_hatches(capsys, monkeypatch):
+    import json
+
+    import bench
+
+    class RlcOff:
+        _use_rlc = False
+
+    bench._probe_aggregate_forward(RlcOff())
+
+    class On:
+        _use_rlc = True
+
+    monkeypatch.setenv("LODESTAR_TPU_BLS_PREAGG", "0")
+    bench._probe_aggregate_forward(On())
+    monkeypatch.delenv("LODESTAR_TPU_BLS_PREAGG")
+    monkeypatch.setenv("LODESTAR_TPU_BLS_AGGFWD", "0")
+    bench._probe_aggregate_forward(On())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    # three hatches x two metric records each, all skips
+    assert len(recs) == 6 and all(r["skipped"] for r in recs)
+    assert "RLC disabled" in recs[0]["error"]
+    assert "stage disabled" in recs[2]["error"]
+    assert "aggregate-forward disabled" in recs[4]["error"]
+
+
+def test_bench_aggfwd_probe_happy_path_emits_records(capsys, monkeypatch):
+    """The probe's flood end-to-end with the stub verifier: packed
+    re-publication measured downstream, bytes-per-verified-att emitted,
+    and the aggregate-forward factor meeting the >= 3 acceptance bound
+    against the raw-sync baseline."""
+    import json
+
+    import bench
+
+    stub = StubAggVerifier()
+
+    def _verdict(s):
+        o = stub.oracle.get(s.signature)
+        return bool(o is not None and o[0] == s.signing_root and o[2])
+
+    stub._verdict = _verdict
+
+    def agg(groups):
+        out = []
+        for g in groups:
+            infos = [stub.oracle.get(s) for s in g]
+            if any(i is None for i in infos):
+                out.append(None)
+                continue
+            out.append(stub.sig(infos[0][0], (), all(i[2] for i in infos)))
+        return out
+
+    class FakeMessages:
+        def get_many(self, roots):
+            return [None] * len(roots)
+
+    class FakeVerifier:
+        _use_rlc = True
+        table = list(range(512))
+        messages = FakeMessages()
+        metrics = stub.metrics
+        max_job_sets = 512
+        aggregate_wire_signatures = staticmethod(agg)
+        verify_signature_sets = stub.verify_signature_sets
+        begin_job = stub.begin_job
+        finish_job = stub.finish_job
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(bench, "BENCH_PREAGG_ATTS", 256)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_SUBNETS", 4)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_DUP", 2)
+    monkeypatch.setattr(bench, "BENCH_PREAGG_WAVES", 2)
+    monkeypatch.setattr(bench.GTB, "keygen", lambda seed: seed)
+    monkeypatch.setattr(bench.GTB, "sign", lambda sk, root: (sk, root))
+    monkeypatch.setattr(
+        bench.GCC, "g2_compress", lambda pt: stub.sig(pt[1], (), True)
+    )
+
+    bench._probe_aggregate_forward(FakeVerifier())
+    recs = [
+        json.loads(l)
+        for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 2, recs
+    by_metric = {r["metric"]: r for r in recs}
+    bpa = by_metric["gossip_bytes_per_verified_att"]
+    assert bpa.get("skipped") is None and bpa["unit"] == "bytes/att"
+    assert 0 < bpa["value"] < bpa["raw_bytes_per_att"]
+    factor = by_metric["aggregate_forward_factor"]
+    assert factor.get("skipped") is None and factor["unit"] == "ratio"
+    assert factor["value"] >= 3.0
+    # every pack published crossed the in-memory wire exactly once
+    assert factor["downstream_msgs"] == factor["packs_published"] > 0
+    assert factor["atts_covered_by_packs"] > 0
+    assert "slo" in factor and "critical_p99_submit_to_verdict_s" in factor
+
+
 # -- slow tier: real crypto + real kernels -----------------------------------
 
 

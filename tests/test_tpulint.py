@@ -152,6 +152,25 @@ def test_node_hygiene_positive(fixture_findings):
     assert any("write_chrome_trace()" in m for m in msgs), msgs
 
 
+def test_node_hygiene_sync_verdict_waits(fixture_findings):
+    """ISSUE 19 satellite: synchronous verdict waits in network/ async
+    handler bodies — `.result()` on a verify future plus both forms of
+    a direct blocking verify call — are flagged toward the
+    DeferredVerdict continuation seam."""
+    hits = _by_file(fixture_findings, "hygiene_bad.py")
+    msgs = [
+        f.message
+        for f in hits
+        if f.rule == "node-hygiene" and "synchronous verdict wait" in f.message
+    ]
+    assert any(".result()" in m for m in msgs), msgs
+    assert any("verify_signature_sets()" in m for m in msgs), msgs
+    assert any(
+        "verify_signature_sets_individually()" in m for m in msgs
+    ), msgs
+    assert all("DeferredVerdict continuation" in m for m in msgs), msgs
+
+
 def test_node_hygiene_negative(fixture_findings):
     assert not _by_file(fixture_findings, "hygiene_ok.py")
 
