@@ -6,13 +6,21 @@
 #                            # lodestar_tpu/ plus dev/+tests/, with
 #                            # tests/fixtures/tpulint exempt — it holds
 #                            # the intentional rule violations)
-#   dev/lint.sh --changed    # only findings in git-touched files (fast
-#                            # local iteration; full tree still parsed
-#                            # so cross-module rules keep context)
+#   dev/lint.sh --changed    # pre-push mode: only NEW findings in
+#                            # git-touched files, against a baseline
+#                            # lint of each file's HEAD revision —
+#                            # pre-existing debt in a file you edited
+#                            # does not fail the push (hidden count on
+#                            # stderr).  Full tree still parsed so
+#                            # cross-module rules keep context.  Hook:
+#                            #   ln -s ../../dev/lint.sh \
+#                            #     .git/hooks/pre-push  # add --changed
 #   dev/lint.sh --json ...   # machine output
+#   dev/lint.sh --sarif ...  # SARIF 2.1.0 (CI/code-review annotation)
+#   dev/lint.sh --profile-rules ...  # per-rule timings on stderr
 #   dev/lint.sh path ...     # explicit paths
 #
-# Exit: 0 clean, 1 findings, 2 usage error.
+# Exit: 0 clean, 1 findings (--changed: NEW findings), 2 usage error.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -10,7 +10,8 @@ silently.  This package encodes those invariants as static rules and
 runs them on every tier-1 pass (tests/test_tpulint.py).
 
 Usage:
-    python -m lodestar_tpu.analysis [--json] [--changed] [paths]
+    python -m lodestar_tpu.analysis [--json|--sarif] [--changed]
+                                    [--profile-rules] [paths]
 
 Suppressions are inline, with a mandatory reason:
     x = TABLE[idx]  # tpulint: disable=gather-hazard -- host-side numpy
@@ -24,6 +25,7 @@ from .engine import (  # noqa: F401
     analyze,
     render_findings,
     findings_to_json,
+    findings_to_sarif,
 )
 from .rules import ALL_RULES, RULE_NAMES  # noqa: F401
 
@@ -33,6 +35,7 @@ __all__ = [
     "analyze",
     "render_findings",
     "findings_to_json",
+    "findings_to_sarif",
     "ALL_RULES",
     "RULE_NAMES",
 ]

@@ -1,0 +1,1236 @@
+"""tpulint concurrency tier — lock graphs, guarded-by, async safety.
+
+The verification data plane (PRs 11-19) is deeply concurrent: pipeline
+accumulator Conditions, supervisor watchdog threads, governor tick vs
+API-thread reads, DeferredVerdict continuations with a documented
+"callbacks fire outside the lock" contract.  Those invariants used to
+live only in prose; this module makes them statically enforced on top
+of the engine's per-module index.
+
+Three rules share one interprocedural ``ConcurrencyIndex``:
+
+lock-order (error)
+    Builds a lock-acquisition graph — lock objects resolved through
+    ``self._lock``-style attributes (including base classes and
+    attr-typed neighbours like ``self._pipeline._lock``) and
+    module-level constants; acquisition edges come from nested ``with``
+    scopes and from direct calls made while holding a lock (the
+    callee's transitive acquisitions).  Cycles are reported as
+    potential deadlocks; re-acquiring a plain (non-reentrant)
+    ``threading.Lock`` already held on the same call path is a
+    self-deadlock.  ``RLock``/``Condition`` are reentrant and exempt
+    from the self-acquire check.
+
+guarded-by (warning)
+    Infers guarded-by sets: an attribute whose non-``__init__`` writes
+    consistently happen under one class-owned lock is "guarded by" that
+    lock; a lock-free read or write of it in a method reachable from a
+    DIFFERENT thread/task root (spawned thread, executor submit,
+    future done-callback, clock-tick callback, async handler, external
+    caller) is a race finding.  Lock context propagates into private
+    helpers whose every resolvable call site holds the lock, so the
+    repo's ``*_locked`` convention checks out instead of flooding.
+
+async-lock-safety (error)
+    The contracts the soundness ledgers document: no blocking call
+    (device dispatch, ``.result()``, file IO, ``time.sleep``) while
+    holding a threading lock; no user-callback invocation (``on_*``
+    hooks, callback ctor params, future ``set_result``/``set_exception``
+    — done-callbacks run synchronously) inside a ``with lock:`` body;
+    no threading lock acquired at all where the acquiring frame is a
+    coroutine.
+
+Known blind spots (by design — name-based, never-imported analysis):
+locks passed as function arguments are untracked (the helper acquires
+an unknowable lock; no false edges either); ``lock.acquire()`` /
+``lock.release()`` call pairs outside ``with`` are invisible; lambda
+and nested-def bodies do not inherit the lexical lock context (they
+are deferred work — exactly why the swap-and-fire callback pattern
+stays clean); guard inference only binds attributes to locks defined
+in the same class hierarchy, so cross-object guards (the aggregator's
+fields guarded by the pipeline's Condition) are documented, not
+enforced.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, FunctionInfo, Module, Project
+
+# (owner, name): owner is "mod:Class" for instance locks, "mod" for
+# module-level locks
+LockId = Tuple[str, str]
+
+_LOCK_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+}
+# reentrant (or not-a-mutex) kinds: self-acquire on the same path is
+# legal — threading.Condition wraps an RLock by default
+_REENTRANT = {"rlock", "condition", "semaphore"}
+
+# blocking sinks while holding a lock (async-lock-safety); device
+# dispatch entry points mirror rules._DEVICE_DISPATCH_FNS (kept local:
+# rules.py imports this module)
+_BLOCKING_ATTRS = {
+    "result": "`.result()` (synchronous future wait)",
+    "exception": "`.exception()` (synchronous future wait)",
+    "block_until_ready": "`.block_until_ready()`",
+    "read_text": "file IO (`.read_text()`)",
+    "write_text": "file IO (`.write_text()`)",
+    "read_bytes": "file IO (`.read_bytes()`)",
+    "write_bytes": "file IO (`.write_bytes()`)",
+}
+_DEVICE_DISPATCH = {
+    "verify_each_device",
+    "verify_each_device_wire",
+    "verify_batch_device",
+    "verify_batch_device_wire",
+    "verify_batch_device_wire_grouped",
+    "aggregate_g2_sum_device",
+    "load_or_export",
+    "export_and_save",
+}
+_CLOCK_METHOD_NAMES = {"on_slot", "on_clock_slot", "on_tick_slot"}
+
+
+def _is_callback_name(name: str) -> bool:
+    """User-callback naming convention: `on_*` hooks and `*_cb` /
+    `*_callback` / `*_hook` params.  A bare Callable annotation is NOT
+    enough — time sources (`clock: Callable[[], float]`) and key
+    functions are utility callables, fine to invoke under a lock."""
+    return name.startswith("on_") or name.endswith(
+        ("_cb", "_callback", "_hook")
+    )
+
+
+@dataclass
+class ClassInfo:
+    key: str  # "mod:Qualname"
+    modname: str
+    qualname: str
+    node: ast.ClassDef
+    base_keys: List[str] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fn key
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class
+    callback_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class Access:
+    owner: str  # root class key the attribute lives on
+    attr: str
+    is_store: bool
+    node: ast.AST
+    held: Tuple[LockId, ...]
+
+
+@dataclass
+class Acquire:
+    lock: LockId
+    kind: str
+    node: ast.AST
+    held_before: Tuple[LockId, ...]
+
+
+@dataclass
+class CallSite:
+    callee: str  # fn key
+    node: ast.AST
+    held: Tuple[LockId, ...]
+
+
+@dataclass
+class Event:
+    etype: str  # "await" | "blocking" | "callback" | "settle"
+    desc: str
+    node: ast.AST
+    held: Tuple[LockId, ...]
+
+
+@dataclass
+class FnScan:
+    info: FunctionInfo
+    cls: Optional[ClassInfo]
+    is_async: bool
+    acquires: List[Acquire] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    accesses: List[Access] = field(default_factory=list)
+    events: List[Event] = field(default_factory=list)
+    # `*_locked`-suffix method with no resolvable caller: assumed to run
+    # under an unknowable caller-held lock — excluded from inference
+    assume_held_unknown: bool = False
+
+
+class ConcurrencyIndex:
+    """Shared lock/thread-root model, built once per Project and reused
+    by all three concurrency rules (cached on the project)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.classes: Dict[str, ClassInfo] = {}
+        self.module_locks: Dict[LockId, str] = {}  # id -> kind
+        self.lock_kinds: Dict[LockId, str] = {}
+        self.lock_sites: Dict[LockId, Tuple[str, int]] = {}  # modname, line
+        self.scans: Dict[str, FnScan] = {}  # fn key -> scan
+        self.context_locks: Dict[str, FrozenSet[LockId]] = {}
+        self.tags: Dict[str, FrozenSet[str]] = {}  # fn key -> root tags
+        self._method_class: Dict[str, ClassInfo] = {}  # fn key -> class
+        self._build()
+
+    # -- construction -------------------------------------------------------
+
+    def _build(self) -> None:
+        for modname in sorted(self.project.modules):
+            mod = self.project.modules[modname]
+            self._collect_classes(mod)
+            self._collect_module_locks(mod)
+        for cls in self.classes.values():
+            self._collect_class_details(cls)
+        for modname in sorted(self.project.modules):
+            mod = self.project.modules[modname]
+            for qual in mod.functions:
+                info = mod.functions[qual]
+                self.scans[info.key] = self._scan_function(mod, info)
+        self._compute_context_locks()
+        self._compute_root_tags()
+
+    def _collect_classes(self, mod: Module) -> None:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            key = f"{mod.modname}:{node.name}"
+            cls = ClassInfo(
+                key=key, modname=mod.modname, qualname=node.name, node=node
+            )
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fqual = f"{node.name}.{item.name}"
+                    if fqual in mod.functions:
+                        fkey = mod.functions[fqual].key
+                        cls.methods[item.name] = fkey
+                        self._method_class[fkey] = cls
+            self.classes[key] = cls
+
+    def _resolve_class_name(
+        self, mod: Module, name: str
+    ) -> Optional[str]:
+        if f"{mod.modname}:{name}" in self.classes:
+            return f"{mod.modname}:{name}"
+        fi = mod.from_imports.get(name)
+        if fi is not None:
+            src_mod, orig = fi
+            if f"{src_mod}:{orig}" in self.classes:
+                return f"{src_mod}:{orig}"
+        return None
+
+    def _resolve_class_expr(
+        self, mod: Module, expr: ast.AST
+    ) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self._resolve_class_name(mod, expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            target_mod = mod.module_aliases.get(expr.value.id)
+            if target_mod and f"{target_mod}:{expr.attr}" in self.classes:
+                return f"{target_mod}:{expr.attr}"
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            # string annotation: "BlsVerificationPipeline"
+            return self._resolve_class_name(
+                mod, expr.value.split(".")[-1].strip()
+            )
+        return None
+
+    def _lock_ctor_kind(self, mod: Module, expr: ast.AST) -> Optional[str]:
+        if not isinstance(expr, ast.Call):
+            return None
+        fn = expr.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if mod.module_aliases.get(fn.value.id) == "threading":
+                return _LOCK_KINDS.get(fn.attr)
+        if isinstance(fn, ast.Name):
+            fi = mod.from_imports.get(fn.id)
+            if fi is not None and fi[0] == "threading":
+                return _LOCK_KINDS.get(fi[1])
+        return None
+
+    def _collect_module_locks(self, mod: Module) -> None:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = self._lock_ctor_kind(mod, node.value)
+            if kind is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    lid = (mod.modname, t.id)
+                    self.module_locks[lid] = kind
+                    self.lock_kinds[lid] = kind
+                    self.lock_sites[lid] = (mod.modname, node.lineno)
+
+    def _collect_class_details(self, cls: ClassInfo) -> None:
+        mod = self.project.modules[cls.modname]
+        # resolvable base classes (single-inheritance chain is what the
+        # repo uses; multiple resolvable bases are all recorded)
+        for b in cls.node.bases:
+            bk = self._resolve_class_expr(mod, b)
+            if bk:
+                cls.base_keys.append(bk)
+        # lock attrs, attr types and callback attrs from method bodies
+        # (locks are conventionally created in __init__, but any method
+        # assigning `self.X = threading.Lock()` declares one)
+        init_key = cls.methods.get("__init__")
+        init_info = self.project.function(init_key) if init_key else None
+        param_anns: Dict[str, Optional[ast.AST]] = {}
+        if init_info is not None:
+            a = init_info.node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                param_anns[arg.arg] = arg.annotation
+        for mname, fkey in cls.methods.items():
+            info = self.project.function(fkey)
+            if info is None:
+                continue
+            for node in Project._fn_body_nodes(info):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    kind = self._lock_ctor_kind(mod, node.value)
+                    if kind is not None:
+                        cls.lock_attrs[t.attr] = kind
+                        lid = (cls.key, t.attr)
+                        self.lock_kinds[lid] = kind
+                        self.lock_sites[lid] = (cls.modname, node.lineno)
+                        continue
+                    if mname != "__init__":
+                        continue
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        ck = self._resolve_class_expr(mod, v.func)
+                        if ck:
+                            cls.attr_types[t.attr] = ck
+                    elif isinstance(v, ast.Name):
+                        pname = v.id
+                        if pname in param_anns:
+                            ann = param_anns[pname]
+                            ck = (
+                                self._resolve_class_expr(mod, ann)
+                                if ann is not None
+                                else None
+                            )
+                            if ck:
+                                cls.attr_types[t.attr] = ck
+                            elif _is_callback_name(pname):
+                                cls.callback_attrs.add(t.attr)
+
+    # -- MRO-ish helpers ----------------------------------------------------
+
+    def mro(self, key: str) -> List[ClassInfo]:
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+        work = [key]
+        while work:
+            k = work.pop(0)
+            if k in seen:
+                continue
+            seen.add(k)
+            cls = self.classes.get(k)
+            if cls is None:
+                continue
+            out.append(cls)
+            work.extend(cls.base_keys)
+        return out
+
+    def root_class(self, key: str) -> str:
+        """Base-most resolvable ancestor: a subclass and its base share
+        one instance attribute namespace, so accesses group there."""
+        chain = self.mro(key)
+        return chain[-1].key if chain else key
+
+    def lock_attr_of(self, class_key: str, attr: str) -> Optional[LockId]:
+        for cls in self.mro(class_key):
+            if attr in cls.lock_attrs:
+                return (cls.key, attr)
+        return None
+
+    def attr_type_of(self, class_key: str, attr: str) -> Optional[str]:
+        for cls in self.mro(class_key):
+            if attr in cls.attr_types:
+                return cls.attr_types[attr]
+        return None
+
+    def method_of(self, class_key: str, name: str) -> Optional[str]:
+        for cls in self.mro(class_key):
+            if name in cls.methods:
+                return cls.methods[name]
+        return None
+
+    def callback_attr_of(self, class_key: str, attr: str) -> bool:
+        for cls in self.mro(class_key):
+            if attr in cls.callback_attrs:
+                return True
+        # hook attrs assigned from outside (node.py: `sup.on_trip = …`)
+        # follow the on_* naming convention and are not methods
+        return attr.startswith("on_") and self.method_of(
+            class_key, attr
+        ) is None
+
+    def lock_name(self, lid: LockId) -> str:
+        owner, attr = lid
+        if ":" in owner:
+            return f"{owner.split(':', 1)[1]}.{attr}"
+        return f"{owner.rsplit('.', 1)[-1]}.{attr}"
+
+    # -- per-function scan --------------------------------------------------
+
+    def _scan_function(self, mod: Module, info: FunctionInfo) -> FnScan:
+        cls = self._method_class.get(info.key)
+        scan = FnScan(
+            info=info,
+            cls=cls,
+            is_async=isinstance(info.node, ast.AsyncFunctionDef),
+        )
+        mname = info.qualname.rsplit(".", 1)[-1]
+        if mname.endswith("_locked"):
+            scan.assume_held_unknown = True  # cleared if callers resolve
+        local_binds = Project.local_binds(info)
+        # one-level local typing: `p = self._pipeline` lets later
+        # `p._lock` / `p._pending` resolve through the attr-type table
+        local_types: Dict[str, str] = {}
+        local_callbacks: Set[str] = set()
+        param_anns: Dict[str, Optional[ast.AST]] = {}
+        a = info.node.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            param_anns[arg.arg] = arg.annotation
+        consumed: Set[int] = set()
+
+        def chain_parts(node: ast.AST) -> Optional[Tuple[str, List[str]]]:
+            """Unfold `base.a.b…` into (base name, [a, b, …])."""
+            parts: List[str] = []
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                consumed.add(id(cur))
+                cur = cur.value
+            if not isinstance(cur, ast.Name):
+                return None
+            parts.reverse()
+            return (cur.id, parts)
+
+        def owner_for(base: str, parts: List[str]) -> Optional[str]:
+            """Class key owning parts[-1], walking attr types."""
+            if base == "self" and cls is not None:
+                cur: Optional[str] = cls.key
+            elif base in local_types:
+                cur = local_types[base]
+            else:
+                return None
+            for attr in parts[:-1]:
+                cur = self.attr_type_of(cur, attr)
+                if cur is None:
+                    return None
+            return cur
+
+        def resolve_lock(expr: ast.AST) -> Optional[Tuple[LockId, str]]:
+            if isinstance(expr, ast.Name):
+                if expr.id in local_binds:
+                    return None
+                lid = (mod.modname, expr.id)
+                if lid in self.module_locks:
+                    return (lid, self.module_locks[lid])
+                fi = mod.from_imports.get(expr.id)
+                if fi is not None:
+                    lid = (fi[0], fi[1])
+                    if lid in self.module_locks:
+                        return (lid, self.module_locks[lid])
+                return None
+            if isinstance(expr, ast.Attribute):
+                cp = chain_parts(expr)
+                if cp is None:
+                    return None
+                base, parts = cp
+                if base not in ("self",) and base not in local_types:
+                    # module-attr lock: `mod_alias._METRICS_LOCK`
+                    if len(parts) == 1:
+                        target = mod.module_aliases.get(base)
+                        if target:
+                            lid = (target, parts[0])
+                            if lid in self.module_locks:
+                                return (lid, self.module_locks[lid])
+                    return None
+                owner = owner_for(base, parts)
+                if owner is None:
+                    return None
+                lid = self.lock_attr_of(owner, parts[-1])
+                if lid is not None:
+                    return (lid, self.lock_kinds[lid])
+            return None
+
+        def resolve_call(node: ast.Call) -> Optional[str]:
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                cp = chain_parts(fn)
+                if cp is not None:
+                    base, parts = cp
+                    owner = owner_for(base, parts)
+                    if owner is not None:
+                        return self.method_of(owner, parts[-1])
+            return self.project.resolve_callee(mod, info, fn)
+
+        def classify_call(node: ast.Call, held) -> None:
+            fn = node.func
+            # future settlement: done-callbacks run synchronously on
+            # the settling thread, i.e. under any lock currently held
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                "set_result",
+                "set_exception",
+            ):
+                scan.events.append(
+                    Event(
+                        "settle",
+                        f"`.{fn.attr}()` settles a future",
+                        node,
+                        held,
+                    )
+                )
+                return
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in _BLOCKING_ATTRS:
+                    scan.events.append(
+                        Event(
+                            "blocking", _BLOCKING_ATTRS[fn.attr], node, held
+                        )
+                    )
+                    return
+                if fn.attr == "sleep" and isinstance(fn.value, ast.Name):
+                    if mod.module_aliases.get(fn.value.id) == "time":
+                        scan.events.append(
+                            Event("blocking", "`time.sleep()`", node, held)
+                        )
+                        return
+                if fn.attr in _DEVICE_DISPATCH:
+                    scan.events.append(
+                        Event(
+                            "blocking",
+                            f"device dispatch `{fn.attr}()`",
+                            node,
+                            held,
+                        )
+                    )
+                    return
+                # user-callback hooks: `self.on_drop(…)` where on_drop
+                # is a callback attr / non-method on_* hook
+                cp = chain_parts(fn)
+                if cp is not None:
+                    base, parts = cp
+                    owner = owner_for(base, parts)
+                    if owner is not None and self.callback_attr_of(
+                        owner, parts[-1]
+                    ):
+                        scan.events.append(
+                            Event(
+                                "callback",
+                                f"user callback `{parts[-1]}`",
+                                node,
+                                held,
+                            )
+                        )
+                        return
+            if isinstance(fn, ast.Name):
+                name = fn.id
+                if name in _DEVICE_DISPATCH and name not in local_binds:
+                    scan.events.append(
+                        Event(
+                            "blocking",
+                            f"device dispatch `{name}()`",
+                            node,
+                            held,
+                        )
+                    )
+                    return
+                if name == "open" and name not in local_binds:
+                    scan.events.append(
+                        Event("blocking", "file IO (`open()`)", node, held)
+                    )
+                    return
+                if name in local_callbacks or (
+                    name in param_anns and _is_callback_name(name)
+                ):
+                    scan.events.append(
+                        Event(
+                            "callback", f"user callback `{name}`", node, held
+                        )
+                    )
+
+        def record_chain(
+            base: str,
+            parts: List[str],
+            node: ast.AST,
+            held,
+            final_store: bool,
+        ) -> None:
+            """Record an access per resolvable chain level; only the
+            outermost attribute can be a store."""
+            if base == "self" and cls is not None:
+                cur: Optional[str] = cls.key
+            elif base in local_types:
+                cur = local_types[base]
+            else:
+                return
+            for i, attr in enumerate(parts):
+                if cur is None:
+                    break
+                scan.accesses.append(
+                    Access(
+                        owner=self.root_class(cur),
+                        attr=attr,
+                        is_store=final_store and i == len(parts) - 1,
+                        node=node,
+                        held=held,
+                    )
+                )
+                cur = self.attr_type_of(cur, attr)
+
+        def record_accesses(node: ast.AST, held) -> None:
+            if id(node) in consumed or not isinstance(node, ast.Attribute):
+                return
+            cp = chain_parts(node)
+            if cp is None:
+                return
+            base, parts = cp
+            record_chain(
+                base,
+                parts,
+                node,
+                held,
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+            )
+
+        def visit(node: ast.AST, held: Tuple[LockId, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs are deferred work: no lexical lock context
+                for d in node.decorator_list:
+                    visit(d, held)
+                return
+            if isinstance(node, ast.Lambda):
+                return  # lambda bodies run later, outside the lock
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    visit(item.context_expr, new_held)
+                    rl = resolve_lock(item.context_expr)
+                    if rl is not None:
+                        lid, kind = rl
+                        scan.acquires.append(
+                            Acquire(lid, kind, item.context_expr, new_held)
+                        )
+                        new_held = new_held + (lid,)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, new_held)
+                for s in node.body:
+                    visit(s, new_held)
+                return
+            if isinstance(node, ast.Await):
+                scan.events.append(Event("await", "`await`", node, held))
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+                if isinstance(t, ast.Name):
+                    cp = (
+                        chain_parts(v)
+                        if isinstance(v, ast.Attribute)
+                        else None
+                    )
+                    if cp is not None:
+                        base, parts = cp
+                        owner = owner_for(base, parts)
+                        if owner is not None:
+                            ck = self.attr_type_of(owner, parts[-1])
+                            if ck is not None:
+                                local_types[t.id] = ck
+                            elif self.callback_attr_of(owner, parts[-1]):
+                                local_callbacks.add(t.id)
+            if isinstance(node, ast.Call):
+                callee = resolve_call(node)
+                if callee is not None:
+                    scan.calls.append(CallSite(callee, node, held))
+                classify_call(node, held)
+                # the receiver of a method call is an access too
+                # (`self._items.popleft()` reads — and mutates — _items)
+                if isinstance(node.func, ast.Attribute):
+                    cp = chain_parts(node.func)
+                    if cp is not None:
+                        record_chain(
+                            cp[0], cp[1][:-1], node.func, held, False
+                        )
+            record_accesses(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in info.node.body:
+            visit(stmt, ())
+        return scan
+
+    # -- context locks (call-site lock propagation) -------------------------
+
+    def _compute_context_locks(self) -> None:
+        """A PRIVATE method whose every resolvable call site holds lock
+        L runs under L (the `_flush_bucket_locked` convention).  Public
+        methods never inherit context — external callers are unknown."""
+        context: Dict[str, FrozenSet[LockId]] = {}
+        for _round in range(3):
+            incoming: Dict[str, List[FrozenSet[LockId]]] = {}
+            for key, scan in self.scans.items():
+                eff = frozenset(context.get(key, frozenset()))
+                for cs in scan.calls:
+                    held = frozenset(cs.held) | eff
+                    incoming.setdefault(cs.callee, []).append(held)
+            new_context: Dict[str, FrozenSet[LockId]] = {}
+            for key, scan in self.scans.items():
+                mname = scan.info.qualname.rsplit(".", 1)[-1]
+                if not mname.startswith("_") or mname.startswith("__"):
+                    continue
+                sites = incoming.get(key)
+                if not sites:
+                    continue
+                inter = frozenset.intersection(*sites)
+                if inter:
+                    new_context[key] = inter
+            if new_context == context:
+                break
+            context = new_context
+        self.context_locks = context
+        for key, scan in self.scans.items():
+            if key in context:
+                scan.assume_held_unknown = False
+
+    def effective_held(self, scan: FnScan, held) -> FrozenSet[LockId]:
+        return frozenset(held) | self.context_locks.get(
+            scan.info.key, frozenset()
+        )
+
+    # -- thread/task-root classification ------------------------------------
+
+    def _fn_ref_key(
+        self, mod: Module, scope: Optional[FunctionInfo], expr: ast.AST
+    ) -> Optional[str]:
+        """Resolve a function REFERENCE (Thread target, submit arg,
+        done-callback) to a FunctionInfo key, through attr-typed
+        chains (`self.chain.governor.on_slot`)."""
+        if isinstance(expr, ast.Attribute):
+            parts: List[str] = []
+            cur: ast.AST = expr
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            parts.reverse()
+            if isinstance(cur, ast.Name) and cur.id == "self":
+                cls = (
+                    self._method_class.get(scope.key)
+                    if scope is not None
+                    else None
+                )
+                if cls is None:
+                    return None
+                owner: Optional[str] = cls.key
+                for attr in parts[:-1]:
+                    owner = self.attr_type_of(owner, attr)
+                    if owner is None:
+                        return None
+                return self.method_of(owner, parts[-1])
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.project.resolve_callee(mod, scope, expr) or (
+                self.project.resolve_name(mod, scope, expr.id)
+                if isinstance(expr, ast.Name)
+                else None
+            )
+        return None
+
+    def _compute_root_tags(self) -> None:
+        entries: Dict[str, Set[str]] = {}
+
+        def add(key: Optional[str], tag: str) -> None:
+            if key is not None and key in self.scans:
+                entries.setdefault(key, set()).add(tag)
+
+        for modname in sorted(self.project.modules):
+            mod = self.project.modules[modname]
+            short = modname.rsplit(".", 1)[-1]
+            for scope, node, _prefix in self.project._walk_scoped(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                callee = (
+                    fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else fn.id
+                    if isinstance(fn, ast.Name)
+                    else None
+                )
+                if callee in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            add(
+                                self._fn_ref_key(mod, scope, kw.value),
+                                f"thread:{short}:{node.lineno}",
+                            )
+                elif callee == "submit" and node.args:
+                    add(
+                        self._fn_ref_key(mod, scope, node.args[0]),
+                        "executor",
+                    )
+                elif callee == "add_done_callback" and node.args:
+                    add(
+                        self._fn_ref_key(mod, scope, node.args[0]),
+                        "future-callback",
+                    )
+        for key, scan in self.scans.items():
+            mname = scan.info.qualname.rsplit(".", 1)[-1]
+            if scan.is_async:
+                entries.setdefault(key, set()).add("async")
+            if mname in _CLOCK_METHOD_NAMES:
+                entries.setdefault(key, set()).add("clock")
+            # externally callable surface: public functions/methods and
+            # container dunders — the caller's own thread is a root
+            if not mname.startswith("_") or (
+                mname.startswith("__")
+                and mname.endswith("__")
+                and mname not in ("__init__", "__del__", "__new__")
+            ):
+                entries.setdefault(key, set()).add("external")
+        tags: Dict[str, Set[str]] = {
+            k: set(v) for k, v in entries.items()
+        }
+        work = list(entries)
+        while work:
+            key = work.pop()
+            scan = self.scans.get(key)
+            if scan is None:
+                continue
+            src = tags.get(key, set())
+            for cs in scan.calls:
+                dst = tags.setdefault(cs.callee, set())
+                if not src <= dst:
+                    dst |= src
+                    work.append(cs.callee)
+        self.tags = {k: frozenset(v) for k, v in tags.items()}
+
+    # -- shared lookup ------------------------------------------------------
+
+    def module_of(self, scan: FnScan) -> Module:
+        return self.project.modules[scan.info.modname]
+
+    def ordered_scans(self) -> List[FnScan]:
+        return [self.scans[k] for k in self.scans]
+
+
+def get_index(project: Project) -> ConcurrencyIndex:
+    idx = getattr(project, "_concurrency_index", None)
+    if idx is None:
+        idx = ConcurrencyIndex(project)
+        project._concurrency_index = idx
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+class _ConcurrencyRule:
+    name = "concurrency"
+    severity = "error"
+
+    def finding(
+        self, mod: Module, node: ast.AST, message: str, severity=None
+    ) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=mod.display_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            severity=severity or self.severity,
+            message=message,
+        )
+
+
+class LockOrderRule(_ConcurrencyRule):
+    name = "lock-order"
+    severity = "error"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        idx = get_index(project)
+        out: List[Finding] = []
+        # transitive acquisitions per function (fixpoint over the call
+        # graph): "calling f while holding L" orders L before everything
+        # f can acquire
+        closure: Dict[str, Set[LockId]] = {
+            k: {a.lock for a in s.acquires} for k, s in idx.scans.items()
+        }
+        callers: Dict[str, Set[str]] = {}
+        for key, scan in idx.scans.items():
+            for cs in scan.calls:
+                callers.setdefault(cs.callee, set()).add(key)
+        work = [k for k, locks in closure.items() if locks]
+        while work:
+            key = work.pop()
+            locks = closure.get(key)
+            if not locks:
+                continue
+            for caller in callers.get(key, ()):
+                cur = closure[caller]
+                if not locks <= cur:
+                    cur |= locks
+                    work.append(caller)
+        # edges + self-deadlocks
+        edges: Dict[Tuple[LockId, LockId], Tuple[FnScan, ast.AST, str]] = {}
+        self_dead: Dict[Tuple[str, LockId], Tuple[FnScan, ast.AST, str]] = {}
+        for key, scan in idx.scans.items():
+            ctx = idx.context_locks.get(key, frozenset())
+            for a in scan.acquires:
+                eff = frozenset(a.held_before) | ctx
+                for h in eff:
+                    if h == a.lock:
+                        if idx.lock_kinds.get(a.lock) == "lock":
+                            self_dead.setdefault(
+                                (key, a.lock), (scan, a.node, "directly")
+                            )
+                    else:
+                        edges.setdefault(
+                            (h, a.lock), (scan, a.node, "")
+                        )
+            for cs in scan.calls:
+                eff = frozenset(cs.held) | ctx
+                if not eff:
+                    continue
+                callee_scan = idx.scans.get(cs.callee)
+                via = (
+                    f"via call to `{callee_scan.info.qualname}`"
+                    if callee_scan
+                    else "via call"
+                )
+                for lock in closure.get(cs.callee, ()):
+                    for h in eff:
+                        if h == lock:
+                            if idx.lock_kinds.get(lock) == "lock":
+                                self_dead.setdefault(
+                                    (key, lock), (scan, cs.node, via)
+                                )
+                        else:
+                            edges.setdefault((h, lock), (scan, cs.node, via))
+        for (key, lock), (scan, node, via) in sorted(
+            self_dead.items(), key=lambda kv: kv[0]
+        ):
+            mod = idx.module_of(scan)
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"self-deadlock: non-reentrant `{idx.lock_name(lock)}` "
+                    f"re-acquired {via} while already held in "
+                    f"`{scan.info.qualname}` — a plain threading.Lock "
+                    f"blocks its own thread; use an RLock or restructure",
+                )
+            )
+        # 2-cycles: both orders observed for a pair of locks
+        reported_pairs: Set[FrozenSet[LockId]] = set()
+        for (a, b), (scan, node, via) in sorted(
+            edges.items(),
+            key=lambda kv: (idx.lock_name(kv[0][0]), idx.lock_name(kv[0][1])),
+        ):
+            if (b, a) not in edges:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported_pairs:
+                continue
+            reported_pairs.add(pair)
+            o_scan, o_node, o_via = edges[(b, a)]
+            o_mod = idx.module_of(o_scan)
+            mod = idx.module_of(scan)
+            via_s = f" {via}" if via else ""
+            o_via_s = f" {o_via}" if o_via else ""
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"lock-order inversion: `{idx.lock_name(a)}` is held "
+                    f"while acquiring `{idx.lock_name(b)}`{via_s} in "
+                    f"`{scan.info.qualname}`, but "
+                    f"`{o_scan.info.qualname}` "
+                    f"({o_mod.display_path}:{getattr(o_node, 'lineno', 1)}) "
+                    f"acquires them in the opposite order{o_via_s} — "
+                    f"concurrent callers can deadlock; pick one order",
+                )
+            )
+        # longer cycles (no 2-cycle inside): SCCs of the remaining graph
+        out.extend(self._scc_findings(idx, edges, reported_pairs))
+        return out
+
+    def _scc_findings(self, idx, edges, reported_pairs) -> List[Finding]:
+        graph: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index_of: Dict[LockId, int] = {}
+        low: Dict[LockId, int] = {}
+        on_stack: Set[LockId] = set()
+        stack: List[LockId] = []
+        sccs: List[List[LockId]] = []
+        counter = [0]
+
+        def strongconnect(v: LockId) -> None:
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index_of[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index_of:
+                        index_of[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index_of[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index_of[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(comp)
+
+        for v in sorted(graph, key=idx.lock_name):
+            if v not in index_of:
+                strongconnect(v)
+        out: List[Finding] = []
+        for comp in sccs:
+            comp_set = set(comp)
+            if any(
+                frozenset((a, b)) in reported_pairs
+                for a in comp
+                for b in comp
+                if a != b
+            ):
+                continue  # already reported as an inversion pair
+            names = sorted(idx.lock_name(l) for l in comp)
+            site = min(
+                (
+                    (scan, node)
+                    for (a, b), (scan, node, _via) in edges.items()
+                    if a in comp_set and b in comp_set
+                ),
+                key=lambda sn: (
+                    idx.module_of(sn[0]).display_path,
+                    getattr(sn[1], "lineno", 1),
+                ),
+            )
+            scan, node = site
+            out.append(
+                self.finding(
+                    idx.module_of(scan),
+                    node,
+                    "lock-order cycle across "
+                    + ", ".join(f"`{n}`" for n in names)
+                    + " — the acquisition graph is cyclic; impose a "
+                    "global order",
+                )
+            )
+        return out
+
+
+class GuardedByRule(_ConcurrencyRule):
+    name = "guarded-by"
+    severity = "warning"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        idx = get_index(project)
+        out: List[Finding] = []
+        # class family: every class sharing a root shares the instance
+        # attribute namespace (subclass methods touch base attrs)
+        family_locks: Dict[str, Dict[str, LockId]] = {}
+        for ckey, cls in idx.classes.items():
+            root = idx.root_class(ckey)
+            fam = family_locks.setdefault(root, {})
+            for attr in cls.lock_attrs:
+                fam.setdefault(attr, (ckey, attr))
+        groups: Dict[Tuple[str, str], List[Tuple[FnScan, Access]]] = {}
+        for key, scan in idx.scans.items():
+            mname = scan.info.qualname.rsplit(".", 1)[-1]
+            if mname in ("__init__", "__del__"):
+                continue
+            for acc in scan.accesses:
+                if acc.attr in family_locks.get(acc.owner, {}):
+                    continue  # the lock attr itself
+                groups.setdefault((acc.owner, acc.attr), []).append(
+                    (scan, acc)
+                )
+        for (owner, attr) in sorted(groups):
+            fam = family_locks.get(owner)
+            if not fam:
+                continue  # no lock anywhere in this hierarchy
+            cand = set(fam.values())
+            accesses = groups[(owner, attr)]
+            stores = [
+                (s, a)
+                for (s, a) in accesses
+                if a.is_store and not s.assume_held_unknown
+            ]
+            locked_holds = [
+                idx.effective_held(s, a.held) & cand
+                for (s, a) in stores
+                if idx.effective_held(s, a.held) & cand
+            ]
+            if not locked_holds:
+                continue  # never written under a class lock
+            guard_set = frozenset.intersection(*locked_holds)
+            if not guard_set:
+                continue  # inconsistent locks; no single guard inferred
+            lock = sorted(
+                guard_set, key=lambda l: (l[1] != "_lock", l)
+            )[0]
+            writers = [
+                (s, a)
+                for (s, a) in stores
+                if lock in idx.effective_held(s, a.held)
+            ]
+            if not writers:
+                continue
+            writer_tags: Set[str] = set()
+            for (s, _a) in writers:
+                writer_tags |= idx.tags.get(s.info.key, frozenset())
+            writer_names = sorted(
+                {s.info.qualname for (s, _a) in writers}
+            )
+            seen_methods: Set[str] = set()
+            for (s, a) in accesses:
+                if s.assume_held_unknown:
+                    continue
+                if lock in idx.effective_held(s, a.held):
+                    continue
+                acc_tags = idx.tags.get(s.info.key, frozenset())
+                if not acc_tags:
+                    continue  # unreachable from any classified root
+                if len(acc_tags | writer_tags) <= 1:
+                    continue  # same single root as every locked writer
+                if s.info.key in seen_methods:
+                    continue
+                seen_methods.add(s.info.key)
+                verb = "written" if a.is_store else "read"
+                roots = ", ".join(sorted(acc_tags))
+                out.append(
+                    self.finding(
+                        idx.module_of(s),
+                        a.node,
+                        f"`self.{attr}` is guarded by "
+                        f"`{idx.lock_name(lock)}` (written under it in "
+                        f"{', '.join(writer_names[:3])}) but {verb} "
+                        f"lock-free in `{s.info.qualname}` (reachable "
+                        f"from: {roots}) — take the lock or suppress "
+                        f"with the benign-race rationale",
+                    )
+                )
+        return out
+
+
+class AsyncLockSafetyRule(_ConcurrencyRule):
+    name = "async-lock-safety"
+    severity = "error"
+
+    _MESSAGES = {
+        "await": (
+            "{desc} while holding `{lock}` — the event loop parks every "
+            "task behind a threading lock"
+        ),
+        "blocking": (
+            "{desc} while holding `{lock}` — blocks every thread "
+            "contending for the lock; move the slow work outside the "
+            "critical section"
+        ),
+        "callback": (
+            "{desc} invoked while holding `{lock}` — user callbacks "
+            "must fire outside the lock (the DeferredVerdict "
+            "swap-and-fire contract); capture under the lock, call "
+            "after release"
+        ),
+        "settle": (
+            "{desc} while holding `{lock}` — done-callbacks run "
+            "synchronously on the settling thread, i.e. inside this "
+            "critical section; settle after release"
+        ),
+    }
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        idx = get_index(project)
+        out: List[Finding] = []
+        for key in idx.scans:
+            scan = idx.scans[key]
+            mod = idx.module_of(scan)
+            if scan.is_async and scan.acquires:
+                for a in scan.acquires:
+                    kind = idx.lock_kinds.get(a.lock, "lock")
+                    out.append(
+                        self.finding(
+                            mod,
+                            a.node,
+                            f"threading {kind} `{idx.lock_name(a.lock)}` "
+                            f"acquired in coroutine "
+                            f"`{scan.info.qualname}` — a contended "
+                            f"acquire stalls the whole event loop; use "
+                            f"asyncio primitives or hand off to a "
+                            f"thread",
+                        )
+                    )
+                continue  # the acquisition finding covers the body
+            ctx = idx.context_locks.get(key, frozenset())
+            seen: Set[Tuple[int, str]] = set()
+            for ev in scan.events:
+                eff = frozenset(ev.held) | ctx
+                if not eff:
+                    continue
+                lock = ev.held[-1] if ev.held else sorted(eff)[0]
+                line = getattr(ev.node, "lineno", 1)
+                dk = (line, ev.desc)
+                if dk in seen:
+                    continue
+                seen.add(dk)
+                out.append(
+                    self.finding(
+                        mod,
+                        ev.node,
+                        self._MESSAGES[ev.etype].format(
+                            desc=ev.desc, lock=idx.lock_name(lock)
+                        )
+                        + f" (in `{scan.info.qualname}`)",
+                    )
+                )
+        return out

@@ -29,6 +29,7 @@ from __future__ import annotations
 import ast
 import json
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -141,11 +142,23 @@ class ExportEntry:
 
 
 class Module:
-    def __init__(self, modname: str, path: Path, display_path: str):
+    def __init__(
+        self,
+        modname: str,
+        path: Path,
+        display_path: str,
+        source: Optional[str] = None,
+    ):
         self.modname = modname
         self.path = path
         self.display_path = display_path
-        self.source = path.read_text(encoding="utf-8", errors="replace")
+        # `source` overrides the on-disk content (--changed baselines
+        # lint the HEAD revision of a file under its working-tree path)
+        self.source = (
+            path.read_text(encoding="utf-8", errors="replace")
+            if source is None
+            else source
+        )
         self.lines = self.source.splitlines()
         self.tree = ast.parse(self.source, filename=str(path))
         self.suppressions = parse_suppressions(self.lines)
@@ -210,7 +223,15 @@ class Project:
             d = d.parent
         return d
 
-    def load_paths(self, paths: Sequence[str]) -> None:
+    def load_paths(
+        self,
+        paths: Sequence[str],
+        source_overrides: Optional[Dict[str, Optional[str]]] = None,
+    ) -> None:
+        """`source_overrides` maps resolved path strings to replacement
+        source text (the --changed baseline lints HEAD revisions under
+        working-tree paths); a None value skips the file entirely (it
+        did not exist at the baseline revision)."""
         for raw in paths:
             p = Path(raw)
             if not p.exists():
@@ -224,12 +245,21 @@ class Project:
                 modname = ".".join(parts) if parts else f.stem
                 if modname in self.modules:
                     continue
+                src: Optional[str] = None
+                if source_overrides is not None:
+                    key = str(f.resolve())
+                    if key in source_overrides:
+                        src = source_overrides[key]
+                        if src is None:
+                            continue
                 try:
                     display = str(f.relative_to(Path.cwd()))
                 except ValueError:
                     display = str(f)
                 try:
-                    self.modules[modname] = Module(modname, f, display)
+                    self.modules[modname] = Module(
+                        modname, f, display, source=src
+                    )
                 except SyntaxError as e:
                     self.parse_errors.append(
                         Finding(
@@ -954,20 +984,33 @@ def _apply_suppressions(
 def analyze(
     paths: Sequence[str],
     only_files: Optional[Set[str]] = None,
+    rule_timings: Optional[Dict[str, float]] = None,
+    source_overrides: Optional[Dict[str, Optional[str]]] = None,
 ) -> List[Finding]:
     """Run every rule over `paths`.  `only_files` (resolved-path strings)
     restricts REPORTING to those files; the whole tree is still parsed
-    so cross-module rules keep full context (--changed mode)."""
+    so cross-module rules keep full context (--changed mode).
+    `rule_timings`, when given, is filled with per-rule wall-clock
+    seconds (plus a "(parse+index)" entry; the first concurrency rule
+    to run also pays the shared concurrency-index build).
+    `source_overrides` is forwarded to Project.load_paths (--changed
+    baseline runs)."""
     from .rules import ALL_RULES
 
     project = Project()
-    project.load_paths(paths)
+    t0 = time.monotonic()
+    project.load_paths(paths, source_overrides=source_overrides)
+    if rule_timings is not None:
+        rule_timings["(parse+index)"] = time.monotonic() - t0
     display_to_mod = {
         m.display_path: m for m in project.modules.values()
     }
     findings: List[Finding] = []
     for rule in ALL_RULES:
+        t0 = time.monotonic()
         findings.extend(rule.run(project))
+        if rule_timings is not None:
+            rule_timings[rule.name] = time.monotonic() - t0
     out: List[Finding] = list(project.parse_errors)
     grouped: Dict[str, List[Finding]] = {}
     for f in findings:
@@ -1001,6 +1044,72 @@ def render_findings(findings: List[Finding]) -> str:
         f"tpulint: {len(active)} finding(s), {n_sup} suppressed"
     )
     return "\n".join(lines)
+
+
+def findings_to_sarif(findings: List[Finding]) -> str:
+    """SARIF 2.1.0 — the interchange shape CI annotators and code-review
+    bots consume.  Suppressed findings are emitted as results carrying
+    an `inSource` suppression (with the mandatory reason as the
+    justification) so reviewers see them without them failing gates;
+    columns are converted to SARIF's 1-based convention."""
+    from .rules import ALL_RULES
+
+    severities = {r.name: r.severity for r in ALL_RULES}
+    severities["bad-suppression"] = "error"
+    severities["parse-error"] = "error"
+    rules = [
+        {
+            "id": name,
+            "defaultConfiguration": {
+                "level": severities.get(name, "warning")
+            },
+        }
+        for name in sorted(severities)
+    ]
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            res["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "justification": f.suppress_reason or "",
+                }
+            ]
+        results.append(res)
+    return json.dumps(
+        {
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "tpulint",
+                            "rules": rules,
+                        }
+                    },
+                    "results": results,
+                }
+            ],
+        },
+        indent=2,
+    )
 
 
 def findings_to_json(findings: List[Finding]) -> str:

@@ -71,6 +71,18 @@ node-hygiene (warning; bare except is error)
     future or a direct `verify_signature_sets*` call blocks the
     handler on the device round-trip; the forward/score decision is a
     DeferredVerdict continuation (network/forwarding.py).
+
+lock-order / guarded-by / async-lock-safety (ISSUE 20)
+    The concurrency tier, implemented over the shared interprocedural
+    lock/thread-root index in analysis/concurrency.py: lock-order
+    inversions and plain-Lock self-deadlocks off the lock-acquisition
+    graph; guarded-by inference (attributes consistently written under
+    a class lock must not be touched lock-free in methods reachable
+    from a different thread/task root); and the async-safety contracts
+    (no blocking call, user-callback invocation, or future settlement
+    while holding a lock; no threading lock acquired in a coroutine).
+    See the concurrency module's docstring for the inference model and
+    its known blind spots.
 """
 
 from __future__ import annotations
@@ -78,6 +90,11 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Set
 
+from .concurrency import (
+    AsyncLockSafetyRule,
+    GuardedByRule,
+    LockOrderRule,
+)
 from .engine import Finding, FunctionInfo, Module, Project
 
 _KERNELS_SEG = "kernels"
@@ -1292,6 +1309,9 @@ ALL_RULES = [
     MetricHygieneRule(),
     NodeHygieneRule(),
     CacheHygieneRule(),
+    LockOrderRule(),
+    GuardedByRule(),
+    AsyncLockSafetyRule(),
 ]
 
 RULE_NAMES = frozenset(r.name for r in ALL_RULES) | {

@@ -178,18 +178,22 @@ class BlsVerifierService:
             return fut
         job = _Job(list(sets), opts)
         with self._lock:
-            if self._closed:
-                job.future.set_exception(RuntimeError("verifier closed"))
-                return job.future
-            self._pending += 1
-            self._pending_sets += len(job.sets)
-            self.metrics.pipeline_pending_sets.set(self._pending_sets)
-            if opts.batchable and len(job.sets) < self._max_buffered:
-                self._submit_buffered_locked(job)
-            else:
-                self._queue.append([job])
-            self.metrics.queue_length.set(self._pending)
-            self._lock.notify_all()
+            closed = self._closed
+            if not closed:
+                self._pending += 1
+                self._pending_sets += len(job.sets)
+                self.metrics.pipeline_pending_sets.set(self._pending_sets)
+                if opts.batchable and len(job.sets) < self._max_buffered:
+                    self._submit_buffered_locked(job)
+                else:
+                    self._queue.append([job])
+                self.metrics.queue_length.set(self._pending)
+                self._lock.notify_all()
+        if closed:
+            # settle AFTER the lock releases: set_exception runs
+            # done-callbacks synchronously on this thread, and a
+            # continuation must never run inside the service Condition
+            job.future.set_exception(RuntimeError("verifier closed"))
         return job.future
 
     def verify_signature_sets(

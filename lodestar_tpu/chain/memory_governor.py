@@ -827,11 +827,14 @@ class StateMemoryGovernor:
 
     @property
     def pressure_active(self) -> bool:
-        return self._episode_active
+        # RLock: re-entrant when the caller already holds it (status())
+        with self._lock:
+            return self._episode_active
 
     @property
     def pressure_level(self) -> int:
-        return min(self._strain, 3)
+        with self._lock:
+            return min(self._strain, 3)
 
     def skip_precompute(self) -> bool:
         """Rung 2: the next-slot epoch precompute is advisory work that

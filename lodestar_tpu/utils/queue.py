@@ -83,21 +83,28 @@ class JobItemQueue(Generic[T, R]):
         return not self._stopped and len(self._items) < threshold
 
     def push(self, item: T) -> "Future[R]":
+        # futures settle AFTER the lock releases: set_exception runs
+        # done-callbacks synchronously on this thread, and a callback
+        # that re-enters the queue (or blocks) must not do so inside
+        # the Condition (tpulint async-lock-safety, ISSUE 20)
         fut: Future = Future()
+        reject: Optional[QueueError] = None
+        dropped: Optional[Future] = None
         with self._lock:
             if self._stopped:
-                fut.set_exception(QueueError("QUEUE_ABORTED"))
-                return fut
-            dropped: Optional[Future] = None
-            if len(self._items) >= self.max_length:
+                reject = QueueError("QUEUE_ABORTED")
+            elif len(self._items) >= self.max_length:
                 self.metrics.dropped_jobs += 1
                 if self.queue_type is QueueType.FIFO:
-                    fut.set_exception(QueueError("QUEUE_MAX_LENGTH"))
-                    return fut
-                _, dropped, _ = self._items.popleft()  # LIFO: evict oldest
-            self._items.append((item, fut, time.perf_counter()))
-            self.metrics.length = len(self._items)
-            self._lock.notify()
+                    reject = QueueError("QUEUE_MAX_LENGTH")
+                else:  # LIFO: evict oldest
+                    _, dropped, _ = self._items.popleft()
+            if reject is None:
+                self._items.append((item, fut, time.perf_counter()))
+                self.metrics.length = len(self._items)
+                self._lock.notify()
+        if reject is not None:
+            fut.set_exception(reject)
         if dropped is not None:
             dropped.set_exception(QueueError("QUEUE_MAX_LENGTH"))
         return fut

@@ -1,0 +1,48 @@
+"""async-lock-safety positives: callback / blocking / settle inside a
+critical section, and a threading lock acquired in a coroutine."""
+
+import threading
+import time
+
+
+class Notifier:
+    def __init__(self, on_drop):
+        self.on_drop = on_drop
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def drop(self, item):
+        with self._lock:
+            self._dropped += 1
+            self.on_drop(item)  # user callback under the lock
+
+
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait_for_device(self, fut):
+        with self._lock:
+            time.sleep(0.1)  # blocking sleep under the lock
+            return fut.result()  # device round-trip under the lock
+
+
+class Settler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = 0
+
+    def complete(self, fut):
+        with self._lock:
+            self._done += 1
+            fut.set_result(True)  # done-callbacks run in-section
+
+
+class AsyncAcquire:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    async def handle(self):
+        with self._lock:  # threading lock in a coroutine
+            self._n += 1

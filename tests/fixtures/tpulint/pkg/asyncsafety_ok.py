@@ -1,0 +1,53 @@
+"""async-lock-safety negatives: the swap-and-fire contract (capture
+under the lock, invoke after release), slow work outside the critical
+section, and Condition wait/notify."""
+
+import threading
+import time
+
+
+class SwapAndFire:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+
+    def subscribe(self, on_done):
+        with self._lock:
+            self._callbacks.append(on_done)  # captured, not invoked
+
+    def fire(self):
+        with self._lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(None)  # fired after release
+
+
+class OutsideWork:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+
+    def run(self, fut):
+        with self._lock:
+            self._pending += 1
+        time.sleep(0)  # blocking, but the lock is released
+        res = fut.result()  # ditto
+        with self._lock:
+            self._pending -= 1
+        return res
+
+
+class ConditionWait:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()

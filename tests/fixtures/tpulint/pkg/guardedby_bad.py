@@ -1,0 +1,35 @@
+"""guarded-by positives: fields written under a lock on one
+thread/task root but touched lock-free from another root."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._count += 1
+
+    def snapshot(self):
+        return self._count  # lock-free read raced with the worker
+
+    def reset(self):
+        self._count = 0  # lock-free write raced with the worker
+
+
+class TickState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slot = 0
+
+    def on_slot(self, slot):  # clock-tick root
+        with self._lock:
+            self._slot = slot
+
+    def describe(self):
+        return str(self._slot)  # lock-free read from the API thread

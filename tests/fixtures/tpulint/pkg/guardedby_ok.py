@@ -1,0 +1,65 @@
+"""guarded-by negatives: locked reads, init-only config, a single
+reachability root, and the `_locked`-suffix helper convention."""
+
+import threading
+
+
+class LockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:  # read under the same lock: no race
+            return self._count
+
+
+class InitOnly:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._limit = 64  # construction-only: immutable thereafter
+
+    def check(self, n):
+        return n < self._limit
+
+
+class SingleRoot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def read(self):
+        # lock-free, but every accessor runs on the same (external)
+        # root — no cross-root race to report
+        return self._n
+
+
+class Convention:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._worker = threading.Thread(target=self._pump, daemon=True)
+
+    def _pump(self):
+        with self._lock:
+            self._items.append(1)
+
+    def _drain_locked(self):
+        # runs with the lock held at every call site: the inferred
+        # context makes these accesses guarded
+        items = self._items
+        self._items = []
+        return items
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()

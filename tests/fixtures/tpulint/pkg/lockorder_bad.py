@@ -1,0 +1,52 @@
+"""lock-order positives: a direct inversion, an inversion hidden
+behind a call, and a plain-Lock self-deadlock through a helper."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def a_then_b(self):
+        with self._lock_a:
+            with self._lock_b:
+                return 1
+
+    def b_then_a(self):
+        with self._lock_b:
+            with self._lock_a:
+                return 2
+
+
+class Chained:
+    def __init__(self):
+        self._front = threading.Lock()
+        self._back = threading.Lock()
+
+    def _take_back(self):
+        with self._back:
+            return 0
+
+    def front_path(self):
+        with self._front:
+            return self._take_back()
+
+    def back_path(self):
+        with self._back:
+            with self._front:
+                return 1
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _helper(self):
+        with self._lock:
+            return 1
+
+    def outer(self):
+        with self._lock:
+            return self._helper()

@@ -1,0 +1,49 @@
+"""lock-order negatives: consistent ordering, re-entrant RLock, and a
+lock handed to a helper function (untracked by design)."""
+
+import threading
+
+
+class Consistent:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def one(self):
+        with self._lock_a:
+            with self._lock_b:
+                return 1
+
+    def two(self):
+        with self._lock_a:
+            with self._lock_b:
+                return 2
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def _helper(self):
+        with self._lock:  # RLock: re-entry from outer() is fine
+            return 1
+
+    def outer(self):
+        with self._lock:
+            return self._helper()
+
+
+def _sum_under(lock, items):
+    # a lock received as a parameter is not tracked (documented blind
+    # spot) — no self-deadlock or ordering finding may fire here
+    with lock:
+        return sum(items)
+
+
+class Handoff:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = [1, 2, 3]
+
+    def total(self):
+        return _sum_under(self._lock, self._values)

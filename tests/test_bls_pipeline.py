@@ -300,6 +300,49 @@ def test_close_rejects_buffered_jobs_and_records_close_flush():
     assert svc.pending_sets() == 0
 
 
+def test_closed_rejection_settles_outside_lock(monkeypatch):
+    """Regression (tpulint async-lock-safety): submitting to a closed
+    service used to settle the job future INSIDE `with self._lock:`.
+    set_exception runs done-callbacks synchronously, so a continuation
+    (DeferredVerdict, aggregate-forward fan-out) would execute under
+    the service Condition — re-entering the service deadlocks."""
+    import lodestar_tpu.bls.service as service_mod
+
+    violations = []
+    locks = []
+
+    class ProbeFuture(service_mod.Future):
+        def set_exception(self, exc):
+            if any(lk._is_owned() for lk in locks):
+                violations.append(repr(exc))
+            super().set_exception(exc)
+
+    monkeypatch.setattr(service_mod, "Future", ProbeFuture)
+    stub = HandleStub()
+    svc = BlsVerificationPipeline(stub, standard_wait_ms=60_000)
+    locks.append(svc._lock)
+    svc.close()
+    fut = svc.verify_signature_sets_async(
+        [single(0)], VerifyOptions(batchable=True)
+    )
+    with pytest.raises(RuntimeError, match="verifier closed"):
+        fut.result(timeout=5)
+    # the continuation can even re-enter the service safely
+    reentered = []
+    fut2 = svc.verify_signature_sets_async(
+        [single(1)], VerifyOptions(batchable=True)
+    )
+    fut2.add_done_callback(
+        lambda f: reentered.append(
+            svc.verify_signature_sets_async(
+                [single(2)], VerifyOptions(batchable=True)
+            )
+        )
+    )
+    assert len(reentered) == 1
+    assert violations == []
+
+
 def test_bench_pipeline_probe_skip_semantics(capsys):
     """bench.py's `bls_pipeline_verified_atts_per_s` probe: any failure
     emits ONE machine-readable skip record (value null, skipped true) —

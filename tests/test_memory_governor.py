@@ -362,6 +362,43 @@ def test_budget_env_parsing(monkeypatch):
     assert budget_from_env() == DEFAULT_BUDGET_BYTES  # fail safe
 
 
+def test_pressure_properties_take_the_lock(cfg):
+    """Regression (tpulint guarded-by): pressure_active / pressure_level
+    read `_episode_active` / `_strain` — written under `_lock` by the
+    clock-tick thread — and used to read them lock-free from the regen
+    and prepare paths.  The governor lock is an RLock, so taking it in
+    the properties stays re-entrant for callers already inside it
+    (e.g. status())."""
+    gov, _, _ = _governed(cfg, 1 << 40)
+    inner = gov._lock
+    acquisitions = []
+
+    class RecordingLock:
+        def __enter__(self):
+            acquisitions.append(1)
+            return inner.__enter__()
+
+        def __exit__(self, *exc):
+            return inner.__exit__(*exc)
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+    gov._lock = RecordingLock()
+    try:
+        assert gov.pressure_active is False
+        assert gov.pressure_level == 0
+        assert gov.skip_precompute() is False
+        assert gov.regen_rejected(replay_depth=10 ** 6) is False
+    finally:
+        gov._lock = inner
+    assert len(acquisitions) >= 4
+    # re-entrancy: reading the property while the lock is held must
+    # not deadlock (status()'s snapshot path)
+    with gov._lock:
+        assert gov.pressure_level == 0
+
+
 def test_memory_snapshot_aggregates(cfg, genesis):
     genesis.hash_tree_root()  # re-warm the shared fixture
     gov, sc, cc = _governed(cfg, 1 << 40)

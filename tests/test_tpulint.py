@@ -10,6 +10,7 @@ mandatory reasons, JSON output keeps its shape.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -345,6 +346,86 @@ def test_repo_bucket_tables_match_runtime_registry():
 
 
 # ---------------------------------------------------------------------------
+# the concurrency tier (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _rule_msgs(findings, name, rule):
+    return [f.message for f in _by_file(findings, name) if f.rule == rule]
+
+
+def test_lock_order_positive(fixture_findings):
+    """Direct inversion, inversion hidden behind a call, and both
+    reports of the plain-Lock self-deadlock (the direct re-acquire in
+    the helper and the call edge from the outer frame)."""
+    msgs = _rule_msgs(fixture_findings, "lockorder_bad.py", "lock-order")
+    assert any(
+        "lock-order inversion" in m and "Transfer._lock_a" in m
+        for m in msgs
+    ), msgs
+    assert any(
+        "lock-order inversion" in m and "Chained._back" in m for m in msgs
+    ), msgs
+    assert any(
+        "self-deadlock" in m
+        and "via call to `SelfDeadlock._helper`" in m
+        for m in msgs
+    ), msgs
+    assert len(msgs) == 4, msgs
+
+
+def test_lock_order_negative(fixture_findings):
+    """Consistent ordering, re-entrant RLock, and a lock handed to a
+    helper function stay silent."""
+    assert not _by_file(fixture_findings, "lockorder_ok.py")
+
+
+def test_guarded_by_positive(fixture_findings):
+    """Fields written under a lock on a worker-thread / clock-tick
+    root but touched lock-free from the external-caller root."""
+    msgs = _rule_msgs(fixture_findings, "guardedby_bad.py", "guarded-by")
+    assert any(
+        "`self._count`" in m
+        and "read lock-free in `Counter.snapshot`" in m
+        for m in msgs
+    ), msgs
+    assert any(
+        "`self._count`" in m
+        and "written lock-free in `Counter.reset`" in m
+        for m in msgs
+    ), msgs
+    assert any(
+        "`self._slot`" in m and "TickState.describe" in m for m in msgs
+    ), msgs
+    assert len(msgs) == 3, msgs
+
+
+def test_guarded_by_negative(fixture_findings):
+    """Locked reads, init-only config, single-root classes, and the
+    `_locked`-suffix context convention stay silent."""
+    assert not _by_file(fixture_findings, "guardedby_ok.py")
+
+
+def test_async_lock_safety_positive(fixture_findings):
+    msgs = _rule_msgs(
+        fixture_findings, "asyncsafety_bad.py", "async-lock-safety"
+    )
+    assert any("user callback `on_drop`" in m for m in msgs), msgs
+    assert any("time.sleep()" in m for m in msgs), msgs
+    assert any(".result()" in m for m in msgs), msgs
+    assert any("settles a future" in m for m in msgs), msgs
+    assert any("acquired in coroutine" in m for m in msgs), msgs
+    assert len(msgs) == 5, msgs
+
+
+def test_async_lock_safety_negative(fixture_findings):
+    """The swap-and-fire contract (callback captured under the lock,
+    invoked after release), blocking work outside the critical
+    section, and Condition wait/notify stay silent."""
+    assert not _by_file(fixture_findings, "asyncsafety_ok.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -414,6 +495,106 @@ def test_json_output_shape(fixture_findings):
     )
 
 
+def test_sarif_output_shape(fixture_findings):
+    """ISSUE 20 satellite: SARIF 2.1.0 golden shape — tool metadata,
+    per-rule default levels, 1-based columns, and suppressed findings
+    carried as `inSource` suppressions with their justification."""
+    from lodestar_tpu.analysis import findings_to_sarif
+
+    doc = json.loads(findings_to_sarif(fixture_findings))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tpulint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    for rid in (
+        "lock-order",
+        "guarded-by",
+        "async-lock-safety",
+        "kernel-purity",
+        "bad-suppression",
+        "parse-error",
+    ):
+        assert rid in rule_ids, rid
+    for r in driver["rules"]:
+        assert r["defaultConfiguration"]["level"] in ("error", "warning")
+    assert len(run["results"]) == len(fixture_findings)
+    by_key = {}
+    for res in run["results"]:
+        assert res["level"] in ("error", "warning")
+        assert res["message"]["text"]
+        (loc,) = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"].endswith(".py")
+        assert phys["region"]["startLine"] >= 1
+        assert phys["region"]["startColumn"] >= 1  # SARIF is 1-based
+        by_key.setdefault(res["ruleId"], []).append(res)
+    # the one reasoned suppression in suppress.py surfaces as an
+    # inSource suppression with its justification
+    sup = [
+        r
+        for rs in by_key.values()
+        for r in rs
+        if r.get("suppressions")
+    ]
+    assert any(
+        s["suppressions"][0]["kind"] == "inSource"
+        and "proves suppression works"
+        in s["suppressions"][0]["justification"]
+        for s in sup
+    ), sup
+    # columns are shifted exactly +1 from the Finding model
+    col0 = {(f.path, f.line, f.col) for f in fixture_findings}
+    for res in run["results"]:
+        phys = res["locations"][0]["physicalLocation"]
+        key = (
+            phys["artifactLocation"]["uri"],
+            phys["region"]["startLine"],
+            phys["region"]["startColumn"] - 1,
+        )
+        assert key in col0, key
+
+
+def test_cli_sarif_and_profile_rules():
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "lodestar_tpu.analysis",
+            "--sarif",
+            "--profile-rules",
+            "lodestar_tpu/analysis",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "rule timings" in res.stderr
+    # every rule (and the parse pass) reports a timing line
+    for name in ("(parse+index)", "lock-order", "kernel-purity"):
+        assert name in res.stderr, res.stderr
+    both = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "lodestar_tpu.analysis",
+            "--json",
+            "--sarif",
+            "lodestar_tpu/analysis",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert both.returncode == 2
+
+
 def test_findings_are_sorted_and_deduped(fixture_findings):
     keys = [
         (f.path, f.line, f.col, f.rule, f.message)
@@ -464,6 +645,89 @@ def test_changed_mode_paths_are_repo_root_anchored():
     for p in changed:
         assert Path(p).is_absolute()
         assert Path(p).exists(), p
+
+
+def test_changed_mode_reports_only_new_findings(tmp_path):
+    """ISSUE 20 satellite: --changed is a pre-push gate — it exits
+    nonzero on NEW findings only, baselining each git-touched file
+    against its HEAD revision, so pre-existing debt in an edited file
+    never fails the push."""
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=tmp_path, check=True, capture_output=True
+        )
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def one(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"
+    )
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "lodestar_tpu.analysis", *extra, "."],
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=120,
+        )
+
+    # nothing touched: --changed is clean even though the tree is not
+    clean = run("--changed")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    # an edit ADDING a finding: only the new one is reported, the
+    # pre-existing one is hidden (and counted on stderr)
+    mod.write_text(
+        mod.read_text()
+        + "\n    def two(self, fut):\n"
+        "        with self._lock:\n"
+        "            fut.set_result(True)\n"
+    )
+    res = run("--changed")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert ".set_result()" in res.stdout
+    assert "time.sleep" not in res.stdout
+    assert "1 pre-existing finding(s) hidden" in res.stderr
+
+    # an untracked file has no baseline: everything in it is new
+    (tmp_path / "fresh.py").write_text(
+        "import threading\n\n\n"
+        "class Fresh:\n"
+        "    def __init__(self, on_done):\n"
+        "        self.on_done = on_done\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            self.on_done(1)\n"
+    )
+    res2 = run("--changed")
+    assert res2.returncode == 1
+    assert "fresh.py" in res2.stdout and "on_done" in res2.stdout
+
+    # the full (non-changed) run still sees the pre-existing debt
+    full = run()
+    assert full.returncode == 1
+    assert "time.sleep" in full.stdout
+
+    # committing everything makes --changed clean again
+    git("add", "-A")
+    git("commit", "-q", "-m", "accepted debt")
+    assert run("--changed").returncode == 0
 
 
 def test_bare_source_suffix_does_not_cover(tmp_path):

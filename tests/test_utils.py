@@ -81,6 +81,54 @@ def test_stop_rejects_pending():
     assert q.push(9).exception(timeout=1) is not None
 
 
+def test_queue_rejections_settle_outside_lock(monkeypatch):
+    """Regression (tpulint async-lock-safety): push() used to call
+    fut.set_exception() while holding the Condition on the stopped and
+    FIFO-overflow paths.  set_exception runs done-callbacks
+    synchronously on the calling thread, so a continuation that
+    re-enters the queue (or blocks) would do so INSIDE the lock."""
+    import lodestar_tpu.utils.queue as queue_mod
+
+    violations = []
+    locks = []
+
+    class ProbeFuture(queue_mod.Future):
+        def set_exception(self, exc):
+            if any(lk._is_owned() for lk in locks):
+                violations.append(repr(exc))
+            super().set_exception(exc)
+
+    monkeypatch.setattr(queue_mod, "Future", ProbeFuture)
+    gate = threading.Event()
+    q = JobItemQueue(lambda x: gate.wait(5) and x, max_length=1)
+    locks.append(q._lock)
+    q.push(0)  # starts processing (blocked on gate)
+    time.sleep(0.05)
+    q.push(1)
+    f_rej = q.push(2)  # FIFO overflow -> incoming rejected
+    with pytest.raises(QueueError):
+        f_rej.result(timeout=1)
+    # LIFO eviction path too
+    q2 = JobItemQueue(
+        lambda x: gate.wait(5) and x, max_length=1,
+        queue_type=QueueType.LIFO,
+    )
+    locks.append(q2._lock)
+    q2.push("busy")
+    time.sleep(0.05)
+    f_old = q2.push(1)
+    q2.push(2)  # evicts f_old
+    with pytest.raises(QueueError):
+        f_old.result(timeout=1)
+    q.stop()
+    f_stopped = q.push(3)  # stopped path
+    with pytest.raises(QueueError):
+        f_stopped.result(timeout=1)
+    gate.set()
+    q2.stop()
+    assert violations == []
+
+
 def test_can_accept_work_threshold():
     gate = threading.Event()
     q = JobItemQueue(lambda x: gate.wait(5), max_length=64)
